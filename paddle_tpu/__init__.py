@@ -1,0 +1,54 @@
+"""paddle_tpu — a TPU-native deep learning framework with PaddlePaddle's
+capabilities, built on jax/XLA/Pallas (capability rebuild, not a port; see
+SURVEY.md for the reference structural map).
+
+Public surface mirrors `import paddle`: tensor ops at top level, `nn`,
+`optimizer`, `io`, `amp`, `jit`, `distributed` (as `parallel`), `vision`,
+plus framework-level save/load, seed, device and flag control.
+"""
+
+__version__ = "0.1.0"
+
+from . import core
+from .core import (  # noqa: F401
+    EnforceNotMet,
+    enforce,
+    get_flags,
+    set_flags,
+    seed,
+)
+from .core.dtypes import (  # noqa: F401
+    bfloat16, bool_, complex64, complex128, float16, float32, float64,
+    float8_e4m3fn, float8_e5m2, get_default_dtype, int8, int16, int32, int64,
+    promote_types, set_default_dtype, uint8, finfo, iinfo,
+)
+from .core.mesh import (  # noqa: F401
+    device_count,
+    get_device,
+    is_compiled_with_tpu,
+    make_mesh,
+    set_device,
+    use_mesh,
+)
+from .ops import *  # noqa: F401,F403
+from .ops.creation import Tensor  # noqa: F401
+
+from . import nn  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import io  # noqa: E402
+from . import amp  # noqa: E402
+from . import jit  # noqa: E402
+from . import framework  # noqa: E402
+from .framework.io import load, save  # noqa: E402
+from . import metric  # noqa: E402
+from . import vision  # noqa: E402
+from . import distributed  # noqa: E402
+from . import profiler  # noqa: E402
+
+
+def grad(func, argnums=0, has_aux=False):
+    """Functional gradient (the TPU-native autograd entry; replaces the
+    reference's eager GradNode engine, SURVEY §3.2 — jax.grad is the engine)."""
+    import jax
+
+    return jax.grad(func, argnums=argnums, has_aux=has_aux)

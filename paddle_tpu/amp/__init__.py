@@ -1,0 +1,200 @@
+"""Automatic mixed precision (parity: python/paddle/amp/ — auto_cast
+amp/auto_cast.py:860, GradScaler grad_scaler.py:619).
+
+TPU-native stance: bf16 is the native MXU dtype and needs NO loss scaling —
+``amp.auto_cast(dtype='bfloat16')`` simply makes matmul/conv inputs bf16
+(O1) or casts whole-model params (O2 via ``amp.decorate``). GradScaler is
+provided for fp16 parity and as a no-op passthrough for bf16.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtypes import canonical_dtype
+from ..nn.module import Layer
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "is_autocast_enabled",
+           "get_autocast_dtype", "white_list", "black_list"]
+
+# O1 lists (parity: amp/auto_cast.py WHITE_LIST/BLACK_LIST): ops that are
+# numerically safe in low precision vs must stay fp32.
+white_list = {"matmul", "conv2d", "conv1d", "conv3d", "einsum", "linear"}
+black_list = {"log", "exp", "softmax", "cross_entropy", "layer_norm", "reduce_sum",
+              "mean", "softmax_with_cross_entropy"}
+
+_state = {"enabled": False, "dtype": jnp.bfloat16, "level": "O1"}
+
+
+def is_autocast_enabled() -> bool:
+    return _state["enabled"]
+
+
+def get_autocast_dtype():
+    return _state["dtype"]
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None, custom_black_list=None,
+              level: str = "O1", dtype: str = "bfloat16", use_promote: bool = True):
+    """Context enabling autocast. Layers consult ``maybe_cast_inputs`` (Linear,
+    Conv, attention call it through nn.functional) — under jit the casts
+    compile into the graph exactly where the reference's AMP pass inserts
+    cast ops (eager_gen.py:526 AMP branch)."""
+    prev = dict(_state)
+    _state.update(enabled=enable, dtype=canonical_dtype(dtype), level=level)
+    try:
+        yield
+    finally:
+        _state.update(prev)
+
+
+amp_guard = auto_cast
+
+
+def maybe_cast_inputs(*tensors):
+    """Cast floating inputs of a white-list op to the autocast dtype."""
+    if not _state["enabled"]:
+        return tensors
+    d = _state["dtype"]
+    out = tuple(
+        t.astype(d) if isinstance(t, jax.Array) and jnp.issubdtype(t.dtype, jnp.floating)
+        else t
+        for t in tensors)
+    return out
+
+
+def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to the low-precision dtype (master fp32 weights
+    live in the optimizer state — multi_precision=True default)."""
+    d = canonical_dtype(dtype)
+    single = isinstance(models, Layer)
+    model_list = [models] if single else list(models)
+    for m in model_list:
+        m.to(dtype=d)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (parity: amp/grad_scaler.py:619 AmpScaler).
+
+    Needed only for fp16; for bf16 construct with enable=False (or just skip).
+    Functional usage inside a jit step::
+
+        scaled = scaler.scale(loss)
+        ... grads of scaled loss ...
+        grads, found_inf = scaler.unscale_(grads)
+        new_scale_state = scaler.update_state(found_inf)
+    """
+
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 2.0 ** 15,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 2000, decr_every_n_nan_or_inf: int = 1,
+                 use_dynamic_loss_scaling: bool = True):
+        self._enable = enable
+        self._init_scale = init_loss_scaling
+        self.incr_ratio = incr_ratio
+        self.decr_ratio = decr_ratio
+        self.incr_every_n_steps = incr_every_n_steps
+        self.decr_every_n = decr_every_n_nan_or_inf
+        self.dynamic = use_dynamic_loss_scaling
+        # eager state
+        self._scale = jnp.float32(init_loss_scaling)
+        self._good_steps = 0
+        self._bad_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, grads):
+        if not self._enable:
+            return grads, jnp.asarray(False)
+        inv = 1.0 / self._scale
+        unscaled = jax.tree.map(lambda g: g * inv, grads)
+        leaves = jax.tree.leaves(unscaled)
+        found_inf = jnp.any(jnp.stack([jnp.any(~jnp.isfinite(g)) for g in leaves])) \
+            if leaves else jnp.asarray(False)
+        return unscaled, found_inf
+
+    def step(self, optimizer, grads):
+        """Eager convenience: unscale, skip update if inf, then opt.step."""
+        grads, found_inf = self.unscale_(grads)
+        if bool(found_inf):
+            self.update(found_inf)
+            return None
+        out = optimizer.step(grads)
+        self.update(found_inf)
+        return out
+
+    def update(self, found_inf):
+        if not (self._enable and self.dynamic):
+            return
+        if bool(found_inf):
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self.decr_every_n:
+                self._scale = jnp.maximum(self._scale * self.decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self.incr_every_n_steps:
+                self._scale = self._scale * self.incr_ratio
+                self._good_steps = 0
+
+    # pure functional variants for jit'd steps
+    def init_scale_state(self):
+        return {"scale": jnp.float32(self._init_scale),
+                "good": jnp.int32(0), "bad": jnp.int32(0)}
+
+    def update_state(self, state, found_inf):
+        scale, good, bad = state["scale"], state["good"], state["bad"]
+        bad2 = jnp.where(found_inf, bad + 1, 0)
+        good2 = jnp.where(found_inf, 0, good + 1)
+        dec = bad2 >= self.decr_every_n
+        inc = good2 >= self.incr_every_n_steps
+        new_scale = jnp.where(dec, jnp.maximum(scale * self.decr_ratio, 1.0),
+                              jnp.where(inc, scale * self.incr_ratio, scale))
+        return {"scale": new_scale,
+                "good": jnp.where(inc, 0, good2).astype(jnp.int32),
+                "bad": jnp.where(dec, 0, bad2).astype(jnp.int32)}
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": float(self._scale), "good": self._good_steps,
+                "bad": self._bad_steps}
+
+    def set_state_dict(self, s):
+        self._scale = jnp.float32(s["scale"])
+        self._good_steps = s["good"]
+        self._bad_steps = s["bad"]
+
+
+class debugging:
+    """Numeric debugging helpers (parity: paddle.amp.debugging)."""
+
+    @staticmethod
+    def check_numerics(x, op_name="tensor", debug_mode=None):
+        import numpy as np
+        bad = int(jnp.sum(~jnp.isfinite(x)))
+        if bad:
+            raise FloatingPointError(f"{op_name}: {bad} non-finite elements")
+        return x
+
+    @staticmethod
+    def collect_operator_stats():
+        return contextlib.nullcontext()

@@ -1,0 +1,25 @@
+"""Core layer: flags, dtypes, errors, rng, mesh, op registry.
+
+TPU-native stand-in for the reference's paddle/common + phi/core foundations
+(SURVEY §2.1/§2.2): XLA owns allocation/streams/layout, so what remains is
+configuration, dtype semantics, RNG streams, device/mesh handles, and the
+single-source op registry.
+"""
+
+from . import dtypes, errors, flags, mesh, registry, rng
+from .errors import EnforceNotMet, enforce
+from .flags import get_flags, set_flags
+from .mesh import (
+    HYBRID_AXES,
+    HybridTopology,
+    axis_size,
+    current_mesh,
+    device_count,
+    get_device,
+    is_compiled_with_tpu,
+    make_mesh,
+    set_device,
+    use_mesh,
+)
+from .registry import all_ops, get_op, register_op
+from .rng import RNGStatesTracker, get_tracker, next_key, rng_stream, seed

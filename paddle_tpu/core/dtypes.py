@@ -1,0 +1,103 @@
+"""Dtype system and promotion.
+
+Equivalent of the reference's ``phi::DataType`` (``paddle/phi/common/data_type.h``)
+and the dtype-promotion logic in ``python/paddle/framework/dtype.py``. On TPU we
+standardize on jax/numpy dtypes; bfloat16 is the preferred reduced precision
+(MXU-native) rather than the reference's fp16-first GPU stance.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "float16", "bfloat16", "float32", "float64",
+    "int8", "int16", "int32", "int64",
+    "uint8", "bool_", "complex64", "complex128",
+    "float8_e4m3fn", "float8_e5m2",
+    "get_default_dtype", "set_default_dtype", "promote_types",
+    "is_floating_point", "is_integer", "is_complex", "canonical_dtype",
+    "finfo", "iinfo",
+]
+
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+float8_e4m3fn = jnp.float8_e4m3fn
+float8_e5m2 = jnp.float8_e5m2
+
+_ALIASES = {
+    "float16": float16, "fp16": float16, "half": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64,
+    "uint8": uint8, "bool": bool_,
+    "complex64": complex64, "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn, "float8_e5m2": float8_e5m2,
+}
+
+_default_dtype = [jnp.float32]
+
+
+def canonical_dtype(dtype: Any):
+    """Map str/np/jnp dtype spec to a jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _ALIASES:
+            raise ValueError(f"Unknown dtype string {dtype!r}")
+        return _ALIASES[dtype]
+    return jnp.dtype(dtype).type
+
+
+def get_default_dtype():
+    return _default_dtype[0]
+
+
+def set_default_dtype(dtype: Any) -> None:
+    d = canonical_dtype(dtype)
+    if not jnp.issubdtype(d, jnp.floating):
+        raise ValueError("default dtype must be floating point")
+    _default_dtype[0] = d
+
+
+def promote_types(a: Any, b: Any):
+    """Binary dtype promotion (jax lattice; matches paddle's T+T rules for the
+    common cases: int+float -> float, f16+f32 -> f32, bf16+f16 -> f32)."""
+    return jnp.promote_types(canonical_dtype(a), canonical_dtype(b))
+
+
+def is_floating_point(x: Any) -> bool:
+    d = getattr(x, "dtype", x)
+    return jnp.issubdtype(jnp.dtype(d), jnp.floating)
+
+
+def is_integer(x: Any) -> bool:
+    d = getattr(x, "dtype", x)
+    return jnp.issubdtype(jnp.dtype(d), jnp.integer)
+
+
+def is_complex(x: Any) -> bool:
+    d = getattr(x, "dtype", x)
+    return jnp.issubdtype(jnp.dtype(d), jnp.complexfloating)
+
+
+def finfo(dtype):
+    return jnp.finfo(canonical_dtype(dtype))
+
+
+def iinfo(dtype):
+    return np.iinfo(jnp.dtype(canonical_dtype(dtype)))

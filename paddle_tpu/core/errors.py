@@ -1,0 +1,56 @@
+"""Rich error raising utilities.
+
+Equivalent of the reference's ``PADDLE_ENFORCE`` machinery
+(``paddle/common/enforce.h`` / ``paddle/phi/core/enforce.h``): check a
+condition and raise a typed, well-formatted error carrying context. On TPU
+there is no CUDA error table to enrich; instead we attach the op name and
+argument summaries when raised through the op registry.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NoReturn
+
+__all__ = ["EnforceNotMet", "enforce", "enforce_eq", "enforce_shape_match", "raise_error"]
+
+
+class EnforceNotMet(RuntimeError):
+    """Error raised when an enforce check fails (parity: paddle EnforceNotMet)."""
+
+    def __init__(self, message: str, hint: str = ""):
+        self.hint = hint
+        full = message if not hint else f"{message}\n  [Hint: {hint}]"
+        super().__init__(full)
+
+
+def _summ(v: Any) -> str:
+    shape = getattr(v, "shape", None)
+    dtype = getattr(v, "dtype", None)
+    if shape is not None:
+        return f"Tensor(shape={tuple(shape)}, dtype={dtype})"
+    return repr(v)
+
+
+def enforce(cond: bool, message: str, hint: str = "") -> None:
+    if not cond:
+        raise EnforceNotMet(message, hint)
+
+
+def enforce_eq(a: Any, b: Any, message: str = "") -> None:
+    if a != b:
+        raise EnforceNotMet(message or f"Expected equality, got {a!r} != {b!r}")
+
+
+def enforce_shape_match(x: Any, expected: tuple, name: str = "input") -> None:
+    shape = tuple(getattr(x, "shape", ()))
+    if len(shape) != len(expected) or any(
+        e is not None and e != s for s, e in zip(shape, expected)
+    ):
+        raise EnforceNotMet(
+            f"Shape mismatch for {name}: got {shape}, expected {expected} (None = any)."
+        )
+
+
+def raise_error(message: str, *args: Any) -> NoReturn:
+    detail = ", ".join(_summ(a) for a in args)
+    raise EnforceNotMet(message + (f" [args: {detail}]" if detail else ""))

@@ -1,0 +1,108 @@
+"""Global flag registry.
+
+TPU-native equivalent of the reference's ``PD_DEFINE_*`` flag system
+(``paddle/common/flags.h:38``, exported map ``paddle/common/flags.cc:20``):
+a process-global registry of typed flags, overridable from the environment
+(``FLAGS_<name>``) and from Python via :func:`set_flags` / :func:`get_flags`,
+mirroring ``paddle.set_flags``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable
+
+__all__ = [
+    "define_flag",
+    "get_flags",
+    "set_flags",
+    "flag_guard",
+]
+
+
+@dataclass
+class _FlagInfo:
+    name: str
+    default: Any
+    value: Any
+    doc: str
+    type: type
+
+
+_REGISTRY: dict[str, _FlagInfo] = {}
+_LOCK = threading.RLock()
+
+
+def _coerce(raw: str, ty: type) -> Any:
+    if ty is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return ty(raw)
+
+
+def define_flag(name: str, default: Any, doc: str = "") -> None:
+    """Register a flag. Environment variable ``FLAGS_<name>`` overrides the default."""
+    with _LOCK:
+        if name in _REGISTRY:
+            return
+        ty = type(default)
+        value = default
+        env = os.environ.get(f"FLAGS_{name}")
+        if env is not None:
+            value = _coerce(env, ty)
+        _REGISTRY[name] = _FlagInfo(name, default, value, doc, ty)
+
+
+def get_flags(names: str | list[str] | None = None) -> dict[str, Any]:
+    with _LOCK:
+        if names is None:
+            return {k: v.value for k, v in _REGISTRY.items()}
+        if isinstance(names, str):
+            names = [names]
+        out = {}
+        for n in names:
+            if n not in _REGISTRY:
+                raise ValueError(f"Unknown flag: {n!r}")
+            out[n] = _REGISTRY[n].value
+        return out
+
+
+def get_flag(name: str) -> Any:
+    return get_flags([name])[name]
+
+
+def set_flags(flags: dict[str, Any]) -> None:
+    with _LOCK:
+        for name, value in flags.items():
+            if name not in _REGISTRY:
+                raise ValueError(f"Unknown flag: {name!r}")
+            info = _REGISTRY[name]
+            info.value = _coerce(value, info.type) if isinstance(value, str) else info.type(value)
+
+
+class flag_guard:
+    """Context manager to temporarily override flags."""
+
+    def __init__(self, **flags: Any):
+        self._new = flags
+        self._old: dict[str, Any] = {}
+
+    def __enter__(self):
+        self._old = get_flags(list(self._new))
+        set_flags(self._new)
+        return self
+
+    def __exit__(self, *exc):
+        set_flags(self._old)
+        return False
+
+
+# --- Core flags (parity with the reference's most used FLAGS_*) ---
+define_flag("check_nan_inf", False, "Check every registered op output for NaN/Inf.")
+define_flag("check_nan_inf_level", 0, "0: error on nan/inf; >0: warn only.")
+define_flag("default_dtype", "float32", "Default floating point dtype.")
+define_flag("enable_x64", False, "Allow 64-bit dtypes (maps to jax_enable_x64).")
+define_flag("benchmark", False, "Synchronize after each op for timing.")
+define_flag("matmul_precision", "default", "XLA matmul precision: default|high|highest.")
+define_flag("log_level", 1, "VLOG-style verbosity for paddle_tpu logging.")

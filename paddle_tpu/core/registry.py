@@ -1,0 +1,117 @@
+"""Single-source-of-truth op registry.
+
+The reference declares every op once in YAML (``paddle/phi/api/yaml/ops.yaml``)
+and codegens five artifacts from it (C++ API, autograd nodes, Python bindings,
+PIR defs, dist branch — SURVEY §1). In a JAX-native framework the compiler and
+autodiff come for free, so the registry's remaining jobs are:
+
+- **inventory**: one row per public op with its schema, for parity tracking;
+- **reference semantics**: an optional numpy reference implementation that the
+  OpTest-style contract suite (tests/op_contract) runs against, mirroring
+  ``test/legacy_test/op_test.py:418``;
+- **debug hooks**: the ``FLAGS_check_nan_inf`` sentinel wraps registered ops
+  (parity: ``fluid/eager/nan_inf_utils.cc``);
+- **sharding rules**: custom-kernel ops (Pallas) attach an SPMD rule, the
+  analogue of ``phi/infermeta/spmd_rules/`` — builtin ops rely on GSPMD
+  propagation instead of the reference's 42 hand-written rule files.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from . import flags
+
+__all__ = ["OpInfo", "register_op", "get_op", "all_ops", "check_numerics"]
+
+
+@dataclass
+class OpInfo:
+    name: str
+    fn: Callable
+    ref: Callable | None = None  # numpy reference impl for contract tests
+    grad_ref: bool = True  # whether jax.grad should be contract-tested
+    category: str = "math"
+    notes: str = ""
+    # contract-test hints
+    test_shapes: tuple = ()
+    test_dtypes: tuple = ("float32",)
+    extra: dict = field(default_factory=dict)
+
+
+_OPS: dict[str, OpInfo] = {}
+
+
+def check_numerics(name: str, *outs):
+    """NaN/Inf sentinel applied to op outputs when FLAGS_check_nan_inf is set."""
+    for i, o in enumerate(outs):
+        if isinstance(o, jax.Array) and jnp.issubdtype(o.dtype, jnp.floating):
+            try:
+                bad = bool(jnp.any(~jnp.isfinite(o)))
+            except jax.errors.TracerBoolConversionError:
+                # Inside jit: use debug callback instead of an eager check.
+                jax.debug.callback(_report_nonfinite, name, i, jnp.any(~jnp.isfinite(o)))
+                continue
+            if bad:
+                _report_nonfinite(name, i, True)
+
+
+def _report_nonfinite(name, idx, bad):
+    if bad:
+        msg = f"[check_nan_inf] op {name!r} output #{idx} contains NaN/Inf"
+        if flags.get_flag("check_nan_inf_level") > 0:
+            print("WARNING:", msg)
+        else:
+            raise FloatingPointError(msg)
+
+
+def register_op(
+    name: str,
+    *,
+    ref: Callable | None = None,
+    category: str = "math",
+    grad_ref: bool = True,
+    test_shapes: tuple = (),
+    test_dtypes: tuple = ("float32",),
+    notes: str = "",
+    **extra: Any,
+):
+    """Decorator registering a public op.
+
+    The wrapped function is returned unchanged except for an optional
+    NaN/Inf check (active when FLAGS_check_nan_inf is on, zero cost otherwise).
+    """
+
+    def deco(fn: Callable) -> Callable:
+        info = OpInfo(
+            name=name, fn=fn, ref=ref, grad_ref=grad_ref, category=category,
+            test_shapes=test_shapes, test_dtypes=test_dtypes, notes=notes, extra=extra,
+        )
+        _OPS[name] = info
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            out = fn(*args, **kwargs)
+            if flags.get_flag("check_nan_inf"):
+                outs = out if isinstance(out, (tuple, list)) else (out,)
+                check_numerics(name, *outs)
+            return out
+
+        wrapper.__op_info__ = info
+        info.fn = fn
+        return wrapper
+
+    return deco
+
+
+def get_op(name: str) -> OpInfo:
+    return _OPS[name]
+
+
+def all_ops() -> dict[str, OpInfo]:
+    return dict(_OPS)

@@ -1,0 +1,120 @@
+"""Random number state management.
+
+Replaces the reference's per-device generator state
+(``paddle/phi/core/generator.cc``) and the tensor-parallel RNG state tracker
+(``python/paddle/distributed/fleet/layers/mpu/random.py``) with JAX threefry
+key streams:
+
+- Eager mode: a process-global key advanced per draw (paddle's ``paddle.seed``).
+- Traced (jit) mode: a context-scoped stream seeded from a key passed into
+  ``functional_call``; draws are derived deterministically by fold_in with a
+  Python-side counter, so retraces are reproducible and jit stays pure.
+- Named streams (``RNGStatesTracker``): independent sub-streams, e.g.
+  "global" vs "local" dropout seeds under tensor parallelism so replicated
+  activations drop identically while model-parallel-private activations
+  drop independently.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterator
+
+import jax
+
+__all__ = ["seed", "next_key", "rng_stream", "RNGStatesTracker", "get_tracker", "default_key"]
+
+_state = threading.local()
+
+
+def _global():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.key(0)
+        _state.stack = []
+    return _state
+
+
+def seed(value: int) -> None:
+    """Seed the process-global eager RNG (parity: ``paddle.seed``)."""
+    s = _global()
+    s.key = jax.random.key(value)
+
+
+def default_key() -> jax.Array:
+    return _global().key
+
+
+class _Stream:
+    """A deterministic key stream: key_i = fold_in(base, i)."""
+
+    def __init__(self, base_key: jax.Array):
+        self.base = base_key
+        self.counter = 0
+
+    def next(self) -> jax.Array:
+        k = jax.random.fold_in(self.base, self.counter)
+        self.counter += 1
+        return k
+
+
+@contextlib.contextmanager
+def rng_stream(base_key: jax.Array) -> Iterator[_Stream]:
+    """Scope a deterministic key stream; ``next_key()`` draws from it.
+
+    Used by ``nn.functional_call`` so stochastic layers (dropout) are pure
+    under jit: the caller supplies one key, layers draw derived keys in
+    deterministic call order.
+    """
+    s = _global()
+    stream = _Stream(base_key)
+    s.stack.append(stream)
+    try:
+        yield stream
+    finally:
+        s.stack.pop()
+
+
+def next_key() -> jax.Array:
+    """Draw the next RNG key: from the innermost scoped stream if one is
+    active (pure/traced mode) else by advancing the global eager key."""
+    s = _global()
+    if s.stack:
+        return s.stack[-1].next()
+    s.key, sub = jax.random.split(s.key)
+    return sub
+
+
+class RNGStatesTracker:
+    """Named independent RNG streams (parity: fleet mpu/random.py:RNGStatesTracker).
+
+    Under tensor parallelism, dropout on replicated tensors must use the same
+    seed on every model-parallel rank while dropout on partitioned tensors
+    must use different seeds; each case gets its own named stream.
+    """
+
+    def __init__(self):
+        self.streams: dict[str, _Stream] = {}
+
+    def add(self, name: str, seed_value: int) -> None:
+        if name in self.streams:
+            raise ValueError(f"RNG stream {name!r} already exists")
+        self.streams[name] = _Stream(jax.random.key(seed_value))
+
+    @contextlib.contextmanager
+    def stream(self, name: str):
+        if name not in self.streams:
+            raise ValueError(f"Unknown RNG stream {name!r}; call add() first")
+        s = _global()
+        s.stack.append(self.streams[name])
+        try:
+            yield
+        finally:
+            s.stack.pop()
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_tracker() -> RNGStatesTracker:
+    return _TRACKER

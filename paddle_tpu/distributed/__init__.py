@@ -1,0 +1,30 @@
+"""paddle_tpu.distributed — the hybrid-parallel stack
+(parity: python/paddle/distributed/, SURVEY §2.7).
+
+TPU-native architecture: one ``jax.sharding.Mesh`` with the canonical axes
+(dp, pp, fsdp, sep, mp) replaces the reference's HybridCommunicateGroup of
+NCCL process groups; collectives are XLA ops compiled over ICI/DCN.
+
+- env bootstrap: ``init_parallel_env`` → jax.distributed.initialize
+- collective API: functional wrappers usable inside shard_map
+- fleet: strategy-driven model/optimizer wrappers (DP/TP/PP/sharding)
+- auto_parallel: shard_tensor/reshard semi-auto API over NamedSharding
+- checkpoint: sharded save/load with cross-topology reshard
+"""
+
+from ..core.mesh import HYBRID_AXES, HybridTopology, current_mesh, make_mesh, use_mesh  # noqa: F401
+from .parallel import (  # noqa: F401
+    DataParallel, get_rank, get_world_size, init_parallel_env,
+)
+from .collective import (  # noqa: F401
+    all_gather, all_reduce, all_to_all, barrier, broadcast, reduce,
+    reduce_scatter, scatter, send, recv, new_group, ReduceOp, split_group,
+)
+from .auto_parallel_api import (  # noqa: F401
+    ProcessMesh, shard_tensor, shard_layer, reshard, dtensor_from_fn,
+    shard_dataloader,
+)
+from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401
+
+# launch CLI: python -m paddle_tpu.distributed.launch

@@ -1,0 +1,168 @@
+"""Semi-auto parallel API (parity: python/paddle/distributed/auto_parallel/api.py
+— shard_tensor:129, reshard:347, shard_layer:446, dtensor_from_fn).
+
+The reference's DistTensor(local tensor + TensorDistAttr{mesh, dims_mapping,
+partial}) IS jax.Array + NamedSharding: placements [Shard(i)/Replicate/Partial]
+map to PartitionSpec entries, InferSpmd+reshard-per-op collapses into GSPMD
+propagation, and explicit ``reshard`` is a device_put / with_sharding_constraint.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core import mesh as mesh_lib
+from ..nn.module import Layer
+
+__all__ = ["ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
+           "reshard", "shard_layer", "dtensor_from_fn", "shard_dataloader",
+           "unshard_dtensor", "placements_to_spec"]
+
+
+class ProcessMesh:
+    """Parity: paddle.distributed.ProcessMesh — thin wrapper building a
+    jax Mesh from an ndarray of ranks + dim names."""
+
+    def __init__(self, mesh: Sequence, dim_names: Sequence[str] | None = None):
+        import numpy as np
+        arr = np.asarray(mesh)
+        self.shape = arr.shape
+        self.dim_names = tuple(dim_names) if dim_names else tuple(
+            f"d{i}" for i in range(arr.ndim))
+        devs = np.asarray(jax.devices())[arr.reshape(-1)].reshape(arr.shape)
+        self.jax_mesh = Mesh(devs, self.dim_names)
+
+    def __enter__(self):
+        self._ctx = mesh_lib.use_mesh(self.jax_mesh)
+        return self._ctx.__enter__()
+
+    def __exit__(self, *exc):
+        return self._ctx.__exit__(*exc)
+
+
+class Shard:
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard({self.dim})"
+
+
+class Replicate:
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial:
+    """Pending-reduction placement. jax has no user-visible partial arrays;
+    a Partial placement is resolved to Replicate via psum at reshard points
+    (matching the reference's p->r reshard function)."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+
+def placements_to_spec(placements, mesh_names, ndim) -> PartitionSpec:
+    """[Shard(0), Replicate] over mesh axes -> PartitionSpec rows."""
+    entries: list = [None] * ndim
+    for axis_name, p in zip(mesh_names, placements):
+        if isinstance(p, Shard):
+            if entries[p.dim] is None:
+                entries[p.dim] = axis_name
+            elif isinstance(entries[p.dim], tuple):
+                entries[p.dim] = entries[p.dim] + (axis_name,)
+            else:
+                entries[p.dim] = (entries[p.dim], axis_name)
+    return PartitionSpec(*entries)
+
+
+def _resolve_mesh(mesh):
+    if isinstance(mesh, ProcessMesh):
+        return mesh.jax_mesh
+    if isinstance(mesh, Mesh):
+        return mesh
+    m = mesh_lib.current_mesh()
+    if m is None:
+        raise ValueError("no mesh: pass mesh= or enter use_mesh(...)")
+    return m
+
+
+def shard_tensor(data, mesh=None, placements=None, dtype=None, stop_gradient=True):
+    """Place a tensor on the mesh with given placements (parity: api.py:129)."""
+    from ..ops.creation import to_tensor
+    m = _resolve_mesh(mesh)
+    x = to_tensor(data, dtype=dtype)
+    placements = placements or [Replicate() for _ in m.axis_names]
+    spec = placements_to_spec(placements, m.axis_names, x.ndim)
+    return jax.device_put(x, NamedSharding(m, spec))
+
+
+def reshard(x, mesh=None, placements=None, spec: PartitionSpec | None = None):
+    """Change an array's distribution (parity: api.py:347; engine:
+    phi reshard functions SURVEY §B.3 — here XLA emits the collective)."""
+    m = _resolve_mesh(mesh)
+    if spec is None:
+        spec = placements_to_spec(placements or [], m.axis_names, x.ndim)
+    target = NamedSharding(m, spec)
+    if isinstance(jax.core.get_aval(x), jax.core.ShapedArray) and not isinstance(
+            x, jax.Array):
+        # inside a trace: constraint, XLA inserts the reshard collective
+        return jax.lax.with_sharding_constraint(x, target)
+    return jax.device_put(x, target)
+
+
+def unshard_dtensor(x):
+    """Gather to a fully replicated array (parity: dtensor_to_local)."""
+    m = mesh_lib.current_mesh()
+    if m is None:
+        return x
+    return jax.device_put(x, NamedSharding(m, PartitionSpec()))
+
+
+def shard_layer(layer: Layer, process_mesh=None, shard_fn: Callable | None = None,
+                input_fn=None, output_fn=None) -> Layer:
+    """Shard a layer's params in place (parity: api.py:446).
+
+    ``shard_fn(name, sublayer)`` may call ``sublayer.set_param_spec``; default
+    uses specs already attached at Parameter creation (Linear weight_spec etc.).
+    """
+    m = _resolve_mesh(process_mesh)
+    if shard_fn is not None:
+        for name, sub in layer.named_sublayers(include_self=True):
+            shard_fn(name, sub)
+    specs = layer.spec_dict()
+    params = layer.param_dict()
+    new = {}
+    for k, v in params.items():
+        spec = specs.get(k)
+        pspec = PartitionSpec(*spec) if spec else PartitionSpec()
+        new[k] = jax.device_put(v, NamedSharding(m, pspec))
+    layer.set_state_dict(new)
+    return layer
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    out = fn(*args, **kwargs)
+    return shard_tensor(out, mesh, placements)
+
+
+def shard_dataloader(dataloader, meshes=None, shard_dims="dp", input_keys=None):
+    """Wrap a DataLoader so yielded host batches are placed dp-sharded on the
+    mesh (parity: auto_parallel ShardDataloader)."""
+    m = _resolve_mesh(meshes)
+
+    class _Sharded:
+        def __iter__(self):
+            for batch in dataloader:
+                def place(a):
+                    spec = PartitionSpec(shard_dims, *([None] * (a.ndim - 1)))
+                    return jax.device_put(a, NamedSharding(m, spec))
+                yield jax.tree.map(place, batch)
+
+        def __len__(self):
+            return len(dataloader)
+
+    return _Sharded()

@@ -1,0 +1,14 @@
+"""Distributed checkpoint (parity: python/paddle/distributed/checkpoint/ —
+save_state_dict/load_state_dict with per-shard files + global metadata and
+cross-topology reshard on load, SURVEY §A.10).
+
+TPU-native: each process writes the shards it owns (addressable shards of
+jax.Arrays) as ``<rank>.distcp.npz`` plus a pickled Metadata mapping
+tensor -> [LocalTensorMetadata(global_offset, local_shape)]. Loading computes
+the overlap between saved shards and the target sharding and assembles each
+local shard from the intersecting saved pieces — same algorithm as the
+reference's load_state_dict.py, with jax.Arrays instead of DenseTensors.
+"""
+
+from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata  # noqa: F401
+from .save_load import load_state_dict, save_state_dict  # noqa: F401

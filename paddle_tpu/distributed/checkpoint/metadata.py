@@ -1,0 +1,32 @@
+"""Checkpoint metadata (parity: distributed/checkpoint/metadata.py:20-40)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class LocalTensorMetadata:
+    """One shard: where it sits in the global tensor."""
+
+    global_offset: tuple
+    local_shape: tuple
+    dtype: str
+
+
+@dataclass(frozen=True)
+class LocalTensorIndex:
+    """Key of a shard: (tensor name, global offset)."""
+
+    tensor_key: str
+    global_offset: tuple
+
+
+@dataclass
+class Metadata:
+    # tensor name -> list of shard metadata
+    state_dict_metadata: dict = field(default_factory=dict)
+    # LocalTensorIndex -> file name
+    storage_metadata: dict = field(default_factory=dict)
+    # tensor name -> global shape
+    global_shapes: dict = field(default_factory=dict)

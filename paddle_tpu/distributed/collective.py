@@ -1,0 +1,205 @@
+"""Collective communication API (parity: python/paddle/distributed/communication/
+— all_reduce/all_gather/all_to_all/reduce_scatter/broadcast/send/recv + groups).
+
+Two modes, mirroring how the reference splits Python API vs in-graph ops
+(SURVEY §A.1):
+
+1. **Inside shard_map/pjit** (where real communication happens on TPU):
+   these wrappers emit jax.lax collectives over a named mesh axis — psum,
+   all_gather, ppermute, all_to_all. This is the in-graph c_allreduce_sum
+   equivalent, compiled onto ICI by XLA.
+2. **Eager on a sharded Array**: reduce-style ops are performed by resharding
+   (device_put) — rarely needed; provided for API completeness.
+
+Group model: a "group" is a mesh axis name (string) or an axis tuple —
+declarative, no communicator bootstrap (the NCCL unique-id/TCPStore dance
+does not exist on TPU).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ReduceOp", "all_reduce", "all_gather", "all_gather_object", "reduce",
+           "reduce_scatter", "broadcast", "scatter", "all_to_all", "send", "recv",
+           "barrier", "new_group", "split_group", "get_group", "wait",
+           "stream"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class _Group:
+    """A named communication group = one or more mesh axes."""
+
+    def __init__(self, axes, ranks=None, name=None):
+        self.axes = (axes,) if isinstance(axes, str) else tuple(axes)
+        self.ranks = ranks
+        self.name = name or "+".join(self.axes)
+
+    @property
+    def axis(self):
+        return self.axes if len(self.axes) > 1 else self.axes[0]
+
+
+_GROUPS: dict[str, _Group] = {}
+
+
+def new_group(ranks=None, backend=None, axes="dp", name=None) -> _Group:
+    g = _Group(axes, ranks, name)
+    _GROUPS[g.name] = g
+    return g
+
+
+def split_group(parent, sizes):
+    raise NotImplementedError("define sub-axes in the mesh instead")
+
+
+def get_group(name) -> _Group:
+    return _GROUPS[name]
+
+
+def _axis(group) -> Any:
+    if group is None:
+        return "dp"
+    if isinstance(group, _Group):
+        return group.axis
+    return group  # axis name / tuple
+
+
+def all_reduce(tensor, op: str = ReduceOp.SUM, group=None, sync_op=True):
+    """Inside shard_map: psum/pmax/pmin over the group's mesh axis."""
+    ax = _axis(group)
+    if op in (ReduceOp.SUM, ReduceOp.AVG):
+        out = lax.psum(tensor, ax)
+        if op == ReduceOp.AVG:
+            out = out / lax.psum(jnp.ones((), tensor.dtype), ax)
+        return out
+    if op == ReduceOp.MAX:
+        return lax.pmax(tensor, ax)
+    if op == ReduceOp.MIN:
+        return lax.pmin(tensor, ax)
+    if op == ReduceOp.PROD:
+        return jnp.exp(lax.psum(jnp.log(tensor.astype(jnp.float32)), ax)).astype(tensor.dtype)
+    raise ValueError(f"unknown op {op}")
+
+
+def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True, axis=0):
+    """shard_map form: ``all_gather(x, group=...)`` → concat along axis.
+    (The paddle list-out form ``all_gather(out_list, x)`` is also accepted.)"""
+    if isinstance(tensor_or_list, list):
+        x = tensor
+        out = lax.all_gather(x, _axis(group), axis=axis, tiled=False)
+        parts = [out[i] for i in range(out.shape[0])]
+        tensor_or_list.extend(parts)
+        return parts
+    return lax.all_gather(tensor_or_list, _axis(group), axis=axis, tiled=True)
+
+
+def all_gather_object(obj_list, obj, group=None):
+    import numpy as np
+    obj_list.append(obj)  # single-process fallback
+    return obj_list
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # on SPMD hardware reduce == all_reduce (every rank gets the value;
+    # dst-only delivery has no bandwidth advantage over ICI)
+    return all_reduce(tensor, op, group)
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True, axis=0):
+    if op != ReduceOp.SUM:
+        raise NotImplementedError("reduce_scatter supports SUM")
+    return lax.psum_scatter(tensor, _axis(group), scatter_dimension=axis, tiled=True)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """Take src's value on every member of the group."""
+    ax = _axis(group)
+    idx = lax.axis_index(ax)
+    masked = jnp.where(idx == src, tensor, jnp.zeros_like(tensor))
+    return lax.psum(masked, ax)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True, axis=0):
+    ax = _axis(group)
+    full = broadcast(tensor, src, group)
+    n = lax.axis_size(ax)
+    idx = lax.axis_index(ax)
+    piece = full.shape[axis] // n
+    return lax.dynamic_slice_in_dim(full, idx * piece, piece, axis)
+
+
+def all_to_all(in_tensor_or_list, out_tensor_list=None, group=None, sync_op=True,
+               split_axis=0, concat_axis=0):
+    """shard_map form: one tensor in, split along split_axis across the group,
+    concatenated along concat_axis (parity: alltoall / MoE global_scatter)."""
+    x = in_tensor_or_list
+    if isinstance(x, list):
+        x = jnp.concatenate(x, axis=split_axis)
+    return lax.all_to_all(x, _axis(group), split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """P2P send == ppermute to a fixed destination (pipeline stage handoff).
+    Must be paired with recv on the same axis; see pipeline_parallel for the
+    ring pattern (parity: send_v2/recv_v2, p2p_communication.py)."""
+    ax = _axis(group)
+    n = lax.axis_size(ax)
+    perm = [(i, dst) for i in range(n)]
+    return lax.ppermute(tensor, ax, perm)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    ax = _axis(group)
+    n = lax.axis_size(ax)
+    perm = [(src, i) for i in range(n)]
+    return lax.ppermute(tensor, ax, perm)
+
+
+def shift(tensor, offset: int, group=None):
+    """Ring shift by offset along the group axis (the PP/ring-attn primitive)."""
+    ax = _axis(group)
+    n = lax.axis_size(ax)
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return lax.ppermute(tensor, ax, perm)
+
+
+def barrier(group=None):
+    # under jit, data dependencies order execution; an explicit barrier is a
+    # tiny psum (parity: paddle.distributed.barrier)
+    try:
+        return lax.psum(jnp.ones(()), _axis(group))
+    except NameError:
+        jax.effects_barrier()
+        return None
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    return tensor  # stream semantics are XLA's problem on TPU
+
+
+class stream:
+    """paddle.distributed.stream.* parity — explicit-stream variants collapse
+    to the same collectives on TPU (XLA owns stream assignment)."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    broadcast = staticmethod(broadcast)
+    scatter = staticmethod(scatter)
+    alltoall = staticmethod(all_to_all)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

@@ -1,0 +1,144 @@
+"""Fleet facade (parity: python/paddle/distributed/fleet/ — fleet.init:167,
+distributed_model model.py:32, distributed_optimizer fleet.py:1302,
+DistributedStrategy distributed_strategy.py:175).
+
+The strategy object declares parallel degrees; ``init`` builds one hybrid
+Mesh; model/optimizer wrappers attach shardings instead of rewriting graphs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+
+from ...core import mesh as mesh_lib
+from ...nn.module import Layer
+
+__all__ = ["DistributedStrategy", "init", "distributed_model",
+           "distributed_optimizer", "get_hybrid_communicate_group",
+           "worker_index", "worker_num", "HybridCommunicateGroup"]
+
+
+@dataclass
+class HybridConfig:
+    dp_degree: int = 1
+    mp_degree: int = 1
+    pp_degree: int = 1
+    sharding_degree: int = 1
+    sep_degree: int = 1
+
+
+@dataclass
+class DistributedStrategy:
+    """Declarative parallel config (parity: proto-backed DistributedStrategy).
+    Only TPU-meaningful knobs are kept; unknown attribute writes are accepted
+    and ignored (the reference has ~80 flags, most CUDA-specific)."""
+
+    hybrid_configs: dict = field(default_factory=dict)
+    amp: bool = False
+    amp_configs: dict = field(default_factory=dict)
+    recompute: bool = False
+    recompute_configs: dict = field(default_factory=dict)
+    gradient_merge: bool = False
+    gradient_merge_configs: dict = field(default_factory=dict)
+    sharding: bool = False
+    sharding_configs: dict = field(default_factory=dict)
+    pipeline: bool = False
+    pipeline_configs: dict = field(default_factory=dict)
+    tensor_parallel: bool = False
+    tensor_parallel_configs: dict = field(default_factory=dict)
+    find_unused_parameters: bool = False
+
+    def hybrid(self) -> HybridConfig:
+        hc = self.hybrid_configs or {}
+        return HybridConfig(
+            dp_degree=hc.get("dp_degree", 1),
+            mp_degree=hc.get("mp_degree", 1),
+            pp_degree=hc.get("pp_degree", 1),
+            sharding_degree=hc.get("sharding_degree", 1),
+            sep_degree=hc.get("sep_degree", 1),
+        )
+
+
+class HybridCommunicateGroup(mesh_lib.HybridTopology):
+    """Parity: fleet/base/topology.py:178 — rank/size per axis over the Mesh."""
+
+    def get_model_parallel_world_size(self):
+        return self.mp_degree
+
+    def get_data_parallel_world_size(self):
+        return self.dp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self.pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self.sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self.sep_degree
+
+
+_state: dict = {"strategy": None, "hcg": None, "mesh": None}
+
+
+def init(role_maker=None, is_collective: bool = True,
+         strategy: DistributedStrategy | None = None):
+    """Build the hybrid mesh from the strategy's degrees.
+
+    dp is outermost (cross-host/DCN friendly), mp innermost (ICI-bandwidth
+    hungry) — the same ordering the reference fixes in CommunicateTopology.
+    """
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid()
+    degrees = {"dp": hc.dp_degree, "pp": hc.pp_degree, "fsdp": hc.sharding_degree,
+               "sep": hc.sep_degree, "mp": hc.mp_degree}
+    n_needed = 1
+    for v in degrees.values():
+        n_needed *= v
+    n_dev = jax.device_count()
+    if n_needed == 1:
+        degrees["dp"] = n_dev  # default pure-DP over all devices
+    elif n_needed < n_dev and n_dev % n_needed == 0:
+        degrees["dp"] *= n_dev // n_needed
+    mesh = mesh_lib.make_mesh(degrees)
+    _state["strategy"] = strategy
+    _state["mesh"] = mesh
+    _state["hcg"] = HybridCommunicateGroup(mesh)
+    mesh_lib._current_mesh[0] = mesh
+    return _state["hcg"]
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    if _state["hcg"] is None:
+        init()
+    return _state["hcg"]
+
+
+def fleet_mesh():
+    return _state["mesh"]
+
+
+def worker_index():
+    return jax.process_index()
+
+
+def worker_num():
+    return jax.process_count()
+
+
+def distributed_model(model: Layer) -> Layer:
+    """Attach shardings per strategy (parity: fleet/model.py:32 which wraps in
+    PipelineParallel/TensorParallel/ShardingParallel/DataParallel by degree)."""
+    from .meta_parallel import apply_hybrid_shardings
+    if _state["hcg"] is None:
+        init()
+    return apply_hybrid_shardings(model, _state["mesh"], _state["strategy"])
+
+
+def distributed_optimizer(optimizer, strategy: DistributedStrategy | None = None):
+    """Parity: HybridParallelOptimizer — on TPU the optimizer is already
+    sharding-agnostic (opt state inherits param shardings = ZeRO-1); grad
+    clip over the global norm is correct because XLA reduces over all axes."""
+    return optimizer

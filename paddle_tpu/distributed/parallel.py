@@ -1,0 +1,109 @@
+"""Environment bootstrap + DataParallel wrapper
+(parity: python/paddle/distributed/parallel.py — init_parallel_env:943,
+DataParallel:202).
+
+On TPU, process bootstrap is ``jax.distributed.initialize`` (the TCPStore/
+NCCL-unique-id rendezvous collapses into the JAX coordinator), and DP is a
+sharding, not a wrapper with gradient hooks: the EagerReducer's fused
+allreduce (reducer.cc, SURVEY §B.4) is what XLA emits automatically when the
+batch axis is sharded and grads are computed under jit. DataParallel here
+therefore only (a) records the mesh axis, (b) provides no_sync semantics via
+gradient accumulation, preserving the reference API.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+import jax
+
+from ..core import mesh as mesh_lib
+from ..nn.module import Layer
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size", "DataParallel",
+           "ParallelEnv"]
+
+_initialized = [False]
+
+
+def init_parallel_env(coordinator_address: str | None = None,
+                      num_processes: int | None = None,
+                      process_id: int | None = None):
+    """Multi-host bootstrap. Single-process (one host driving its chips) needs
+    no init — jax sees all local devices; multi-host reads the standard env
+    (COORDINATOR_ADDRESS / PADDLE_TRAINER_* compatible)."""
+    if _initialized[0]:
+        return
+    coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    if num_processes is None:
+        n = os.environ.get("PADDLE_TRAINERS_NUM") or os.environ.get("NUM_PROCESSES")
+        num_processes = int(n) if n else None
+    if process_id is None:
+        r = os.environ.get("PADDLE_TRAINER_ID") or os.environ.get("PROCESS_ID")
+        process_id = int(r) if r else None
+    if coordinator_address and num_processes and num_processes > 1:
+        jax.distributed.initialize(coordinator_address, num_processes, process_id)
+    _initialized[0] = True
+
+
+def get_rank(group=None) -> int:
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    return jax.process_count()
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+
+class DataParallel(Layer):
+    """Wraps a layer for data-parallel training (parity: paddle.DataParallel).
+
+    Under GSPMD the wrapped forward is unchanged; gradient averaging across
+    the 'dp' mesh axis happens inside jit when the loss is a mean over a
+    dp-sharded batch. ``no_sync`` is provided for grad-accumulation parity:
+    it simply marks that the caller accumulates grads host-side.
+    """
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None, mesh=None, axis="dp"):
+        super().__init__()
+        self._layers = layers
+        self.axis = axis
+        self.mesh = mesh or mesh_lib.current_mesh()
+        self.find_unused_parameters = find_unused_parameters
+        self._in_no_sync = False
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        self._in_no_sync = True
+        try:
+            yield
+        finally:
+            self._in_no_sync = False
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)
+
+    def scale_loss(self, loss):
+        return loss

@@ -1,0 +1,6 @@
+"""Framework-level utilities: save/load, seeding re-export.
+(parity: python/paddle/framework/)."""
+
+from .io import load, save  # noqa: F401
+from ..core.rng import seed  # noqa: F401
+from ..core.dtypes import get_default_dtype, set_default_dtype  # noqa: F401

@@ -1,0 +1,44 @@
+"""paddle.save/load equivalents (parity: python/paddle/framework/io.py:723/960).
+
+Format: a pickle of the nested object with jax/numpy arrays swapped for
+numpy payloads — same shape as the reference's pickled state dicts, so
+user code (`paddle.save(model.state_dict(), path)`) ports directly.
+Distributed/sharded checkpointing lives in distributed.checkpoint.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax
+import numpy as np
+
+__all__ = ["save", "load"]
+
+_PROTOCOL = 4
+
+
+def _to_host(obj: Any):
+    if isinstance(obj, jax.Array):
+        return np.asarray(obj)
+    if isinstance(obj, dict):
+        return {k: _to_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_host(v) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = _PROTOCOL, **kwargs) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_host(obj), f, protocol=protocol)
+
+
+def load(path: str, **kwargs) -> Any:
+    with open(path, "rb") as f:
+        return pickle.load(f)

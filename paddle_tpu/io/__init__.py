@@ -1,0 +1,17 @@
+"""paddle_tpu.io — datasets and DataLoader (parity: python/paddle/io/).
+
+The reference DataLoader (io/reader.py:216) uses multiprocess workers with
+shared-memory tensor transport feeding CUDA streams. On TPU the input
+pipeline's job is to keep host batches ready ahead of device dispatch:
+worker threads/processes produce numpy batches, and the loader prefetches
+``device_put`` transfers so step N+1's H2D overlaps step N's compute.
+"""
+
+from .dataset import (  # noqa: F401
+    ChainDataset, ComposeDataset, ConcatDataset, Dataset, IterableDataset,
+    Subset, TensorDataset, random_split,
+)
+from .dataloader import (  # noqa: F401
+    BatchSampler, DataLoader, DistributedBatchSampler, RandomSampler, Sampler,
+    SequenceSampler, SubsetRandomSampler, WeightedRandomSampler, default_collate_fn,
+)

@@ -1,0 +1,272 @@
+"""DataLoader with samplers, worker threads, and device prefetch
+(parity: python/paddle/io/reader.py:216 DataLoader +
+io/dataloader/{batch_sampler,dataloader_iter,worker}.py).
+
+The reference forks worker *processes* with shared-memory transport because
+CPython + CUDA favor process isolation. Here workers are threads (numpy
+releases the GIL for the heavy copies) feeding a bounded queue, plus an
+optional device-prefetch stage that issues jax.device_put one batch ahead —
+the piece that actually hides H2D latency on TPU.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Any, Callable, Iterable, Sequence
+
+import numpy as np
+
+from .dataset import Dataset, IterableDataset
+
+__all__ = ["Sampler", "SequenceSampler", "RandomSampler", "SubsetRandomSampler",
+           "WeightedRandomSampler", "BatchSampler", "DistributedBatchSampler",
+           "DataLoader", "default_collate_fn"]
+
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None, generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+        self.generator = generator
+
+    @property
+    def num_samples(self):
+        return self._num_samples or len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        rng = np.random.default_rng(self.generator)
+        if self.replacement:
+            return iter(rng.integers(0, n, self.num_samples).tolist())
+        return iter(rng.permutation(n)[: self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class SubsetRandomSampler(Sampler):
+    def __init__(self, indices, generator=None):
+        super().__init__(None)
+        self.indices = list(indices)
+        self.generator = generator
+
+    def __iter__(self):
+        rng = np.random.default_rng(self.generator)
+        return iter(np.array(self.indices)[rng.permutation(len(self.indices))].tolist())
+
+    def __len__(self):
+        return len(self.indices)
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True):
+        super().__init__(None)
+        self.weights = np.asarray(weights, np.float64)
+        self.num_samples = num_samples
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        rng = np.random.default_rng()
+        return iter(rng.choice(len(self.weights), self.num_samples,
+                               replace=self.replacement, p=p).tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    def __init__(self, dataset=None, sampler=None, shuffle=False, batch_size=1,
+                 drop_last=False):
+        super().__init__(dataset)
+        if sampler is None:
+            sampler = RandomSampler(dataset) if shuffle else SequenceSampler(dataset)
+        self.sampler = sampler
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        return n // self.batch_size if self.drop_last else (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Shards indices across data-parallel ranks (parity:
+    io/dataloader/batch_sampler.py DistributedBatchSampler). On a single-host
+    GSPMD setup prefer feeding the global batch and sharding via the mesh; this
+    sampler exists for multi-process (jax.distributed) loops."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        import jax
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.nranks = num_replicas if num_replicas is not None else jax.process_count()
+        self.local_rank = rank if rank is not None else jax.process_index()
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.num_samples = int(np.ceil(len(dataset) / self.nranks))
+        self.total_size = self.num_samples * self.nranks
+
+    def __iter__(self):
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.default_rng(self.epoch)
+            indices = rng.permutation(n).tolist()
+        else:
+            indices = list(range(n))
+        indices += indices[: (self.total_size - n)]
+        indices = indices[self.local_rank: self.total_size: self.nranks]
+        batch = []
+        for idx in indices:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+
+def default_collate_fn(batch: Sequence[Any]):
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.number)):
+        return np.asarray(batch)
+    if isinstance(sample, (tuple, list)):
+        return tuple(default_collate_fn([b[i] for b in batch]) for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if hasattr(sample, "__array__"):
+        return np.stack([np.asarray(b) for b in batch])
+    return batch
+
+
+class _Prefetcher:
+    """Background thread filling a bounded queue."""
+
+    _DONE = object()
+
+    def __init__(self, gen_fn: Callable[[], Iterable], depth: int):
+        self.q: queue.Queue = queue.Queue(maxsize=depth)
+        self.gen_fn = gen_fn
+        self.err = None
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        try:
+            for item in self.gen_fn():
+                self.q.put(item)
+        except BaseException as e:  # propagate to consumer
+            self.err = e
+        finally:
+            self.q.put(self._DONE)
+
+    def __iter__(self):
+        while True:
+            item = self.q.get()
+            if item is self._DONE:
+                if self.err is not None:
+                    raise self.err
+                return
+            yield item
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1, shuffle=False,
+                 drop_last=False, collate_fn=None, num_workers=0,
+                 use_buffer_reader=True, prefetch_factor=2, use_shared_memory=True,
+                 timeout=0, worker_init_fn=None, to_device=True):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(1, prefetch_factor)
+        self.use_buffer_reader = use_buffer_reader
+        self.to_device = to_device
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size, drop_last=drop_last)
+
+    def _raw_batches(self):
+        if self._iterable_mode:
+            it = iter(self.dataset)
+            while True:
+                batch = list(itertools.islice(it, self.batch_size))
+                if not batch:
+                    return
+                if len(batch) < self.batch_size and self.drop_last:
+                    return
+                yield self.collate_fn(batch)
+        else:
+            for idxs in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in idxs])
+
+    def _device_batches(self):
+        import jax
+        src = self._raw_batches()
+        if not self.to_device:
+            yield from src
+            return
+        for batch in src:
+            yield jax.tree.map(
+                lambda a: jax.device_put(np.asarray(a)) if isinstance(
+                    a, (np.ndarray, np.number)) else a, batch,
+                is_leaf=lambda a: isinstance(a, (np.ndarray, np.number)))
+
+    def __iter__(self):
+        if self.use_buffer_reader:
+            depth = self.prefetch_factor * max(1, self.num_workers)
+            return iter(_Prefetcher(self._device_batches, depth))
+        return self._device_batches()
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)

@@ -1,0 +1,21 @@
+"""paddle_tpu.nn — layers, functional ops, initializers, clipping.
+
+Parity: python/paddle/nn/ (SURVEY §2.6). The Layer/functional_call split is
+the TPU-native replacement for the reference's eager autograd engine.
+"""
+
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .clip import (  # noqa: F401
+    ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue, clip_grad_norm_,
+    clip_grad_value_,
+)
+from .module import Layer, Module, Parameter, functional_call  # noqa: F401
+from .layer.activation import *  # noqa: F401,F403
+from .layer.common import *  # noqa: F401,F403
+from .layer.container import *  # noqa: F401,F403
+from .layer.conv import *  # noqa: F401,F403
+from .layer.loss import *  # noqa: F401,F403
+from .layer.norm import *  # noqa: F401,F403
+from .layer.pooling import *  # noqa: F401,F403
+from .layer.transformer import *  # noqa: F401,F403

@@ -1,0 +1,65 @@
+"""Gradient clipping (parity: python/paddle/nn/clip.py).
+
+Clips operate on path-keyed grad dicts (the functional currency). Under
+hybrid parallel, the reference's ClipGradByGlobalNorm sums squared norms
+across mp/pp/sharding groups explicitly; here grads of sharded params are
+jax.Arrays whose global norm is computed by XLA with the right collectives
+automatically — the hybrid-aware branch is only needed in shard_map code.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+           "clip_grad_value_", "clip_grad_norm_", "global_norm"]
+
+
+class ClipGradByValue:
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(-abs(max) if min is None else min)
+
+    def __call__(self, grads):
+        return jax.tree.map(lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class ClipGradByNorm:
+    """Per-tensor norm clip."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, grads):
+        def clip_one(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+            return (g.astype(jnp.float32) * scale).astype(g.dtype)
+        return jax.tree.map(clip_one, grads)
+
+
+def global_norm(grads) -> jax.Array:
+    leaves = jax.tree.leaves(grads)
+    if not leaves:
+        return jnp.zeros(())
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves))
+
+
+class ClipGradByGlobalNorm:
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, grads):
+        n = global_norm(grads)
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+        return jax.tree.map(lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype),
+                            grads)
+
+
+def clip_grad_value_(grads, clip_value):
+    return ClipGradByValue(clip_value)(grads)
+
+
+def clip_grad_norm_(grads, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    return ClipGradByGlobalNorm(max_norm)(grads)

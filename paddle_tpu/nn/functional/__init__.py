@@ -1,0 +1,12 @@
+"""paddle_tpu.nn.functional — functional NN ops.
+
+Parity: python/paddle/nn/functional/ (activation, common, conv, pooling, norm,
+loss, flash_attention modules)."""
+
+from .activation import *  # noqa: F401,F403
+from .attention import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403

@@ -1,0 +1,176 @@
+"""Activation functions (parity: python/paddle/nn/functional/activation.py).
+
+All map to jax.nn / jnp primitives; XLA fuses them into adjacent matmuls on
+TPU so none need custom kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "relu", "relu6", "relu_", "leaky_relu", "prelu", "rrelu", "elu", "selu",
+    "celu", "gelu", "silu", "swish", "mish", "softplus", "softshrink",
+    "softsign", "tanhshrink", "thresholded_relu", "hardtanh", "hardshrink",
+    "hardsigmoid", "hardswish", "sigmoid", "log_sigmoid", "tanh", "tanh_",
+    "softmax", "log_softmax", "gumbel_softmax", "maxout", "glu",
+]
+
+
+def relu(x, name=None):
+    return jax.nn.relu(jnp.asarray(x))
+
+
+relu_ = relu
+
+
+def relu6(x, name=None):
+    return jax.nn.relu6(jnp.asarray(x))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return jax.nn.leaky_relu(jnp.asarray(x), negative_slope)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, w = jnp.asarray(x), jnp.asarray(weight)
+    if w.size > 1 and x.ndim > 1:
+        shape = [1] * x.ndim
+        ch = 1 if data_format[1] == "C" else x.ndim - 1
+        shape[ch] = w.size
+        w = w.reshape(shape)
+    return jnp.where(x >= 0, x, w * x)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, key=None, name=None):
+    x = jnp.asarray(x)
+    if training:
+        from ...core import rng
+        k = key if key is not None else rng.next_key()
+        a = jax.random.uniform(k, x.shape, x.dtype, lower, upper)
+    else:
+        a = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, a * x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return jax.nn.elu(jnp.asarray(x), alpha)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    x = jnp.asarray(x)
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def celu(x, alpha=1.0, name=None):
+    return jax.nn.celu(jnp.asarray(x), alpha)
+
+
+def gelu(x, approximate=False, name=None):
+    return jax.nn.gelu(jnp.asarray(x), approximate=approximate)
+
+
+def silu(x, name=None):
+    return jax.nn.silu(jnp.asarray(x))
+
+
+def swish(x, name=None):
+    return jax.nn.silu(jnp.asarray(x))
+
+
+def mish(x, name=None):
+    return jax.nn.mish(jnp.asarray(x))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    x = jnp.asarray(x)
+    return jnp.where(x * beta > threshold, x, jax.nn.softplus(x * beta) / beta)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    x = jnp.asarray(x)
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+def softsign(x, name=None):
+    return jax.nn.soft_sign(jnp.asarray(x))
+
+
+def tanhshrink(x, name=None):
+    x = jnp.asarray(x)
+    return x - jnp.tanh(x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    x = jnp.asarray(x)
+    return jnp.where(x > threshold, x, value)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return jnp.clip(jnp.asarray(x), min, max)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    x = jnp.asarray(x)
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5, name=None):
+    return jnp.clip(jnp.asarray(x) * slope + offset, 0.0, 1.0)
+
+
+def hardswish(x, name=None):
+    x = jnp.asarray(x)
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+def sigmoid(x, name=None):
+    return jax.nn.sigmoid(jnp.asarray(x))
+
+
+def log_sigmoid(x, name=None):
+    return jax.nn.log_sigmoid(jnp.asarray(x))
+
+
+def tanh(x, name=None):
+    return jnp.tanh(jnp.asarray(x))
+
+
+tanh_ = tanh
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtypes import canonical_dtype
+    x = jnp.asarray(x)
+    d = canonical_dtype(dtype)
+    if d is not None:
+        x = x.astype(d)
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtypes import canonical_dtype
+    x = jnp.asarray(x)
+    d = canonical_dtype(dtype)
+    if d is not None:
+        x = x.astype(d)
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, key=None, name=None):
+    from ...ops.random import gumbel_softmax as _gs
+    return _gs(x, temperature=temperature, hard=hard, axis=axis, key=key)
+
+
+def maxout(x, groups, axis=1, name=None):
+    x = jnp.asarray(x)
+    axis = axis % x.ndim
+    c = x.shape[axis]
+    new_shape = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:]
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+def glu(x, axis=-1, name=None):
+    return jax.nn.glu(jnp.asarray(x), axis=axis)

@@ -1,0 +1,107 @@
+"""Attention functionals.
+
+Parity targets: ``paddle.nn.functional.scaled_dot_product_attention``
+(nn/functional/flash_attention.py:442) and ``flash_attention``
+(flash_attention.py:147), whose CUDA path wraps Dao FA2
+(phi/kernels/gpu/flash_attn_kernel.cu:250 — see SURVEY §B.7 for the contract).
+
+TPU-native design: one reference XLA implementation (fused well by XLA for
+moderate sequence lengths) and a Pallas flash kernel (ops/pallas/flash_attention)
+selected automatically on TPU for long sequences — tiled online-softmax, no
+O(S^2) materialization, stored LSE for the backward.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["scaled_dot_product_attention", "flash_attention", "sdp_kernel"]
+
+_FLASH_MIN_SEQ = 1024  # below this XLA's fused softmax-matmul is already fine
+
+
+def _xla_attention(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
+                   scale=None, training=True):
+    """Reference attention in pure XLA. Layout: [batch, seq, heads, head_dim]
+    (paddle flash-attention layout)."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    # [b, h, sq, sk]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kf)
+    if is_causal:
+        causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        scores = jnp.where(causal, scores, -jnp.inf)
+    if attn_mask is not None:
+        m = jnp.asarray(attn_mask)
+        if m.dtype == jnp.bool_:
+            scores = jnp.where(m, scores, -jnp.inf)
+        else:
+            scores = scores + m.astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_p > 0.0 and training:
+        from ...core import rng
+        keep = jax.random.bernoulli(rng.next_key(), 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
+    return out.astype(q.dtype)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """Inputs [batch, seq, num_heads, head_dim] (paddle convention)."""
+    q, k, v = jnp.asarray(query), jnp.asarray(key), jnp.asarray(value)
+    use_flash = (
+        q.shape[1] >= _FLASH_MIN_SEQ
+        and attn_mask is None
+        and dropout_p == 0.0
+        and q.devices() and next(iter(q.devices())).platform == "tpu"
+    )
+    if use_flash:
+        from ...ops.pallas.flash_attention import flash_attention as _fa
+        return _fa(q, k, v, causal=is_causal)
+    return _xla_attention(q, k, v, attn_mask, dropout_p, is_causal, training=training)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
+                    fixed_seed_offset=None, rng_name="", training=True, name=None):
+    """Parity: paddle.nn.functional.flash_attention.flash_attention.
+    Returns (out, softmax) — softmax is None unless return_softmax (the
+    reference only materializes it for debugging)."""
+    out = scaled_dot_product_attention(query, key, value, None, dropout, causal,
+                                       training=training)
+    if return_softmax:
+        q, k, v = (jnp.asarray(t) for t in (query, key, value))
+        d = q.shape[-1]
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+        scores = scores / math.sqrt(d)
+        if causal:
+            sq, sk = q.shape[1], k.shape[1]
+            scores = jnp.where(jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq), scores, -jnp.inf)
+        return out, jax.nn.softmax(scores, -1).astype(q.dtype)
+    return out, None
+
+
+class sdp_kernel:
+    """Context manager selecting the attention backend (parity shim for
+    torch/paddle-style backend toggles)."""
+
+    def __init__(self, enable_flash=True, enable_math=True, enable_mem_efficient=True):
+        self.enable_flash = enable_flash
+
+    def __enter__(self):
+        global _FLASH_MIN_SEQ
+        self._saved = _FLASH_MIN_SEQ
+        if not self.enable_flash:
+            globals()["_FLASH_MIN_SEQ"] = 1 << 62
+        return self
+
+    def __exit__(self, *a):
+        globals()["_FLASH_MIN_SEQ"] = self._saved
+        return False

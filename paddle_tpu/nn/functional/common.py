@@ -1,0 +1,275 @@
+"""Common NN functionals: linear, dropout, embedding, padding, interpolate,
+pixel shuffle, fold/unfold, similarity (parity: python/paddle/nn/functional/common.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import rng
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout", "embedding",
+    "one_hot", "label_smooth", "pad", "zeropad2d", "interpolate", "upsample",
+    "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "cosine_similarity",
+    "unfold", "fold", "bilinear", "class_center_sample", "normalize",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b). Paddle weight layout: [in_features, out_features].
+    Lowers to a single MXU matmul; bias add is fused by XLA."""
+    x, w = jnp.asarray(x), jnp.asarray(weight)
+    y = x @ w
+    if bias is not None:
+        y = y + jnp.asarray(bias)
+    return y
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", key=None, name=None):
+    x = jnp.asarray(x)
+    if not training or p == 0.0:
+        return x if mode == "upscale_in_train" else x * (1.0 - p)
+    if p == 1.0:
+        return jnp.zeros_like(x)
+    k = key if key is not None else rng.next_key()
+    shape = list(x.shape)
+    if axis is not None:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        shape = [s if i in [a % x.ndim for a in axes] else 1 for i, s in enumerate(x.shape)]
+    keep = jax.random.bernoulli(k, 1.0 - p, tuple(shape))
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    return jnp.where(keep, x, 0.0).astype(x.dtype)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", key=None, name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training, key=key)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", key=None, name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training, key=key)
+
+
+def alpha_dropout(x, p=0.5, training=True, key=None, name=None):
+    x = jnp.asarray(x)
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    k = key if key is not None else rng.next_key()
+    keep = jax.random.bernoulli(k, 1.0 - p, x.shape)
+    a = (1.0 / ((1.0 - p) * (1.0 + p * alpha_p**2)) ** 0.5)
+    b = -a * alpha_p * p
+    return (a * jnp.where(keep, x, alpha_p) + b).astype(x.dtype)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Row gather from [vocab, dim] table. `sparse` is accepted for API parity
+    (gradients are always dense on TPU; XLA scatters efficiently)."""
+    x, w = jnp.asarray(x), jnp.asarray(weight)
+    out = jnp.take(w, x, axis=0)
+    if padding_idx is not None:
+        mask = (x == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out)
+    return out
+
+
+def one_hot(x, num_classes, name=None):
+    return jax.nn.one_hot(jnp.asarray(x), num_classes)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = jnp.asarray(label)
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * jnp.asarray(prior_dist)
+    return (1 - epsilon) * label + epsilon / k
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ...ops.manipulation import pad as _pad
+    return _pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    x = jnp.asarray(x)
+    channel_last = data_format[-1] == "C"
+    spatial = x.shape[1:-1] if channel_last else x.shape[2:]
+    nd = len(spatial)
+    if size is None:
+        if scale_factor is None:
+            raise ValueError("one of size/scale_factor required")
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * nd
+        size = [int(s * f) for s, f in zip(spatial, sf)]
+    size = [int(s) for s in (size if isinstance(size, (list, tuple)) else [size] * nd)]
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    if channel_last:
+        out_shape = (x.shape[0],) + tuple(size) + (x.shape[-1],)
+    else:
+        out_shape = x.shape[:2] + tuple(size)
+    if mode == "nearest":
+        return jax.image.resize(x, out_shape, method="nearest")
+    if align_corners and all(s > 1 for s in size):
+        # jax.image.resize uses half-pixel centers; emulate align_corners by
+        # explicit coordinate gather
+        return _resize_align_corners(x, out_shape, jmode, channel_last)
+    return jax.image.resize(x, out_shape, method=jmode)
+
+
+def _resize_align_corners(x, out_shape, method, channel_last):
+    sp_axes = range(1, x.ndim - 1) if channel_last else range(2, x.ndim)
+    out = x
+    for ax in sp_axes:
+        n_in, n_out = x.shape[ax], out_shape[ax]
+        if n_in == n_out:
+            continue
+        pos = jnp.linspace(0.0, n_in - 1.0, n_out)
+        lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, n_in - 2)
+        w = (pos - lo).astype(x.dtype)
+        a = jnp.take(out, lo, axis=ax)
+        b = jnp.take(out, lo + 1, axis=ax)
+        shape = [1] * out.ndim
+        shape[ax] = n_out
+        w = w.reshape(shape)
+        out = a * (1 - w) + b * w
+        x = out
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    x = jnp.asarray(x)
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = x.transpose(0, 1, 4, 2, 5, 3)
+        return x.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, r, r, c // (r * r))
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h * r, w * r, c // (r * r))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    x = jnp.asarray(x)
+    r = downscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // r, r, w // r, r)
+        x = x.transpose(0, 1, 3, 5, 2, 4)
+        return x.reshape(n, c * r * r, h // r, w // r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // r, r, w // r, r, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // r, w // r, c * r * r)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    x = jnp.asarray(x)
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        return x.reshape(n, groups, c // groups, h, w).transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+    n, h, w, c = x.shape
+    return x.reshape(n, h, w, groups, c // groups).transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    x1, x2 = jnp.asarray(x1), jnp.asarray(x2)
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(x1 * x1, axis=axis))
+    n2 = jnp.sqrt(jnp.sum(x2 * x2, axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    x = jnp.asarray(x)
+    n = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+    return x / jnp.maximum(n, epsilon)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col: [N,C,H,W] -> [N, C*kh*kw, L] (parity: paddle unfold op)."""
+    x = jnp.asarray(x)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    pads = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 4
+    if len(pads) == 2:
+        pads = [pads[0], pads[0], pads[1], pads[1]]
+    n, c, h, w = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (pads[0], pads[1]), (pads[2], pads[3])))
+    oh = (x.shape[2] - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (x.shape[3] - (dw * (kw - 1) + 1)) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return patches.reshape(n, c * kh * kw, oh * ow)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """col2im: inverse of unfold via scatter-add."""
+    x = jnp.asarray(x)
+    oh_, ow_ = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    pads = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 4
+    if len(pads) == 2:
+        pads = [pads[0], pads[0], pads[1], pads[1]]
+    n, ckk, L = x.shape
+    c = ckk // (kh * kw)
+    ph = oh_ + pads[0] + pads[1]
+    pw = ow_ + pads[2] + pads[3]
+    nh = (ph - (dh * (kh - 1) + 1)) // sh + 1
+    nw = (pw - (dw * (kw - 1) + 1)) // sw + 1
+    x = x.reshape(n, c, kh, kw, nh, nw)
+    out = jnp.zeros((n, c, ph, pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            hi = i * dh + sh * np.arange(nh)
+            wj = j * dw + sw * np.arange(nw)
+            out = out.at[:, :, hi[:, None], wj[None, :]].add(x[:, :, i, j])
+    return out[:, :, pads[0]: ph - pads[1], pads[2]: pw - pads[3]]
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    x1, x2, w = jnp.asarray(x1), jnp.asarray(x2), jnp.asarray(weight)
+    out = jnp.einsum("bi,oij,bj->bo", x1, w, x2)
+    if bias is not None:
+        out = out + jnp.asarray(bias)
+    return out
+
+
+def class_center_sample(label, num_classes, num_samples, group=None, key=None):
+    label = jnp.asarray(label)
+    k = key if key is not None else rng.next_key()
+    pos = jnp.unique(label, size=min(int(label.size), num_classes), fill_value=num_classes)
+    perm = jax.random.permutation(k, num_classes)
+    # keep all positives + random negatives up to num_samples
+    sampled = jnp.unique(jnp.concatenate([pos, perm[:num_samples]]),
+                         size=num_samples, fill_value=num_classes)
+    remap = jnp.searchsorted(sampled, label)
+    return remap, sampled
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v, v)

@@ -1,0 +1,166 @@
+"""Convolution functionals (parity: python/paddle/nn/functional/conv.py).
+
+Convs lower to XLA ``conv_general_dilated`` which tiles onto the MXU — the
+TPU analogue of the reference's cudnn path (phi/kernels/gpudnn/conv_kernel.cu).
+Paddle weight layout [out_c, in_c/groups, *k] and NCHW default are kept at the
+API; internally XLA is free to relayout (bitcast-free on TPU).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+           "conv3d_transpose"]
+
+
+def _tup(v, n):
+    if isinstance(v, (list, tuple)):
+        out = tuple(int(x) for x in v)
+        if len(out) == 1:
+            out = out * n
+        return out
+    return (int(v),) * n
+
+
+def _resolve_padding(padding, n, stride, dilation, ksize):
+    """Map paddle padding spec (int, list, 'SAME', 'VALID') to lax pairs."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)):
+        p = list(padding)
+        if len(p) == n and isinstance(p[0], (list, tuple)):
+            return [tuple(int(v) for v in x) for x in p]
+        if len(p) == n:
+            return [(int(x), int(x)) for x in p]
+        if len(p) == 2 * n:
+            return [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(n)]
+    return [(int(padding), int(padding))] * n
+
+
+def _dn(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    x, w = jnp.asarray(x), jnp.asarray(weight)
+    channel_last = data_format[-1] == "C"
+    stride = _tup(stride, n)
+    dilation = _tup(dilation, n)
+    ksize = w.shape[2:]
+    pad = _resolve_padding(padding, n, stride, dilation, ksize)
+    lhs_dn, rhs_dn, out_dn = _dn(n, channel_last)
+    if channel_last:
+        # weight is [out_c, in_c/groups, *k] (paddle layout) -> spatial+IO
+        w = jnp.moveaxis(w, (0, 1), (-1, -2))
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, feature_group_count=groups,
+        dimension_numbers=(lhs_dn, rhs_dn, out_dn))
+    if bias is not None:
+        b = jnp.asarray(bias)
+        shape = [1] * out.ndim
+        shape[-1 if channel_last else 1] = b.size
+        out = out + b.reshape(shape)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, fmt)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, n, data_format, output_size=None):
+    x, w = jnp.asarray(x), jnp.asarray(weight)
+    channel_last = data_format[-1] == "C"
+    stride = _tup(stride, n)
+    dilation = _tup(dilation, n)
+    out_pad = _tup(output_padding, n)
+    ksize = w.shape[2:]
+    pad = _resolve_padding(padding, n, stride, dilation, ksize)
+    lhs_dn, rhs_dn, out_dn = _dn(n, channel_last)
+    # paddle transpose-conv weight layout: [in_c, out_c/groups, *k]
+    # grad-of-conv formulation: lhs_dilation=stride implements the upsample
+    if isinstance(pad, str):
+        if pad == "SAME":
+            pads = []
+            for i in range(n):
+                effective_k = (ksize[i] - 1) * dilation[i] + 1
+                total = max(effective_k - stride[i], 0)
+                pads.append((total // 2, total - total // 2))
+            pad = pads
+        else:
+            pad = [(0, 0)] * n
+    tpads = []
+    for i in range(n):
+        effective_k = (ksize[i] - 1) * dilation[i] + 1
+        lo = effective_k - 1 - pad[i][0]
+        hi = effective_k - 1 - pad[i][1] + out_pad[i]
+        tpads.append((lo, hi))
+    def one_group(xg, wg):
+        wt = jnp.flip(wg, axis=tuple(range(2, 2 + n)))  # flip spatial
+        wt = jnp.swapaxes(wt, 0, 1)  # [in_c, out_c, *k] -> [out_c, in_c, *k]
+        if channel_last:
+            wt = jnp.moveaxis(wt, (0, 1), (-1, -2))
+        return jax.lax.conv_general_dilated(
+            xg, wt, window_strides=(1,) * n, padding=tpads,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=(lhs_dn, rhs_dn, out_dn))
+
+    if groups == 1:
+        out = one_group(x, w)
+    else:
+        ch_axis = x.ndim - 1 if channel_last else 1
+        xs = jnp.split(x, groups, axis=ch_axis)
+        ws = jnp.split(w, groups, axis=0)  # weight [in_c, out_c/groups, *k]
+        out = jnp.concatenate([one_group(xg, wg) for xg, wg in zip(xs, ws)], axis=ch_axis)
+    if output_size is not None:
+        szs = _tup(output_size, n)
+        idx = [slice(None)] * out.ndim
+        off = 1 if not channel_last else 1
+        sp0 = 2 if not channel_last else 1
+        for i in range(n):
+            idx[sp0 + i] = slice(0, szs[i])
+        out = out[tuple(idx)]
+    if bias is not None:
+        b = jnp.asarray(bias)
+        shape = [1] * out.ndim
+        shape[-1 if channel_last else 1] = b.size
+        out = out + b.reshape(shape)
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, fmt, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format, output_size)

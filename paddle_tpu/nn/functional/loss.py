@@ -1,0 +1,391 @@
+"""Loss functionals (parity: python/paddle/nn/functional/loss.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "nll_loss", "mse_loss", "l1_loss",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "hinge_embedding_loss",
+    "cosine_embedding_loss", "triplet_margin_loss",
+    "triplet_margin_with_distance_loss", "multi_label_soft_margin_loss",
+    "soft_margin_loss", "sigmoid_focal_loss", "log_loss", "square_error_cost",
+    "poisson_nll_loss", "gaussian_nll_loss", "huber_loss", "ctc_loss",
+    "rnnt_loss", "dice_loss", "npair_loss", "multi_margin_loss",
+]
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    """Softmax cross entropy (parity: paddle.nn.functional.cross_entropy;
+    reference kernel phi/kernels/gpu/cross_entropy_kernel.cu). Computes
+    log-softmax in fp32 regardless of input dtype."""
+    x = jnp.asarray(input).astype(jnp.float32)
+    logp = jax.nn.log_softmax(x, axis=axis) if use_softmax else jnp.log(
+        jnp.clip(x, 1e-30))
+    nclass = x.shape[axis]
+    if soft_label:
+        lab = jnp.asarray(label).astype(jnp.float32)
+        if label_smoothing > 0:
+            lab = (1 - label_smoothing) * lab + label_smoothing / nclass
+        loss = -jnp.sum(lab * logp, axis=axis)
+        if weight is not None:
+            w = jnp.sum(lab * jnp.asarray(weight, jnp.float32), axis=axis)
+            loss = loss * w
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+        return _reduce(loss, reduction)
+    label = jnp.asarray(label)
+    if label.ndim == x.ndim and label.shape[axis] == 1:
+        label = jnp.squeeze(label, axis)
+    valid = label != ignore_index
+    safe_label = jnp.where(valid, label, 0)
+    if label_smoothing > 0:
+        onehot = jax.nn.one_hot(safe_label, nclass, axis=axis, dtype=jnp.float32)
+        lab = (1 - label_smoothing) * onehot + label_smoothing / nclass
+        loss = -jnp.sum(lab * logp, axis=axis)
+    else:
+        loss = -jnp.take_along_axis(logp, jnp.expand_dims(safe_label, axis), axis=axis)
+        loss = jnp.squeeze(loss, axis)
+    loss = jnp.where(valid, loss, 0.0)
+    if weight is not None:
+        w = jnp.take(jnp.asarray(weight, jnp.float32), safe_label)
+        w = jnp.where(valid, w, 0.0)
+        loss = loss * w
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    return _reduce(loss, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    loss = jnp.expand_dims(loss, axis)
+    if return_softmax:
+        return loss, jax.nn.softmax(jnp.asarray(logits), axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    x = jnp.asarray(input).astype(jnp.float32)
+    y = jnp.asarray(label).astype(jnp.float32)
+    x = jnp.clip(x, 1e-12, 1.0 - 1e-12)
+    loss = -(y * jnp.log(x) + (1 - y) * jnp.log1p(-x))
+    if weight is not None:
+        loss = loss * jnp.asarray(weight, jnp.float32)
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    x = jnp.asarray(logit).astype(jnp.float32)
+    y = jnp.asarray(label).astype(jnp.float32)
+    # numerically stable: max(x,0) - x*y + log(1+exp(-|x|))
+    loss = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    if pos_weight is not None:
+        pw = jnp.asarray(pos_weight, jnp.float32)
+        log_w = (pw - 1) * y + 1
+        loss = loss * log_w
+    if weight is not None:
+        loss = loss * jnp.asarray(weight, jnp.float32)
+    return _reduce(loss, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    logp = jnp.asarray(input).astype(jnp.float32)
+    label = jnp.asarray(label)
+    valid = label != ignore_index
+    safe = jnp.where(valid, label, 0)
+    loss = -jnp.take_along_axis(logp, safe[:, None] if logp.ndim == 2 else
+                                jnp.expand_dims(safe, 1), axis=1)
+    loss = jnp.squeeze(loss, 1)
+    w = jnp.ones_like(loss)
+    if weight is not None:
+        w = jnp.take(jnp.asarray(weight, jnp.float32), safe)
+    w = jnp.where(valid, w, 0.0)
+    loss = loss * w
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+    return _reduce(loss, reduction)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    d = jnp.asarray(input) - jnp.asarray(label)
+    return _reduce(jnp.square(d), reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return _reduce(jnp.abs(jnp.asarray(input) - jnp.asarray(label)), reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    d = jnp.asarray(input) - jnp.asarray(label)
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    d = jnp.asarray(input) - jnp.asarray(label)
+    ad = jnp.abs(d)
+    loss = jnp.where(ad <= delta, 0.5 * d * d, delta * (ad - 0.5 * delta))
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    logp = jnp.asarray(input).astype(jnp.float32)
+    y = jnp.asarray(label).astype(jnp.float32)
+    if log_target:
+        loss = jnp.exp(y) * (y - logp)
+    else:
+        loss = y * (jnp.log(jnp.clip(y, 1e-30)) - logp)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / logp.shape[0]
+    return _reduce(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    loss = jnp.maximum(0.0, -jnp.asarray(label) * (jnp.asarray(input) - jnp.asarray(other)) + margin)
+    return _reduce(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    x, y = jnp.asarray(input), jnp.asarray(label)
+    loss = jnp.where(y == 1, x, jnp.maximum(0.0, margin - x))
+    return _reduce(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    from .common import cosine_similarity
+    sim = cosine_similarity(input1, input2, axis=-1)
+    y = jnp.asarray(label)
+    loss = jnp.where(y == 1, 1 - sim, jnp.maximum(0.0, sim - margin))
+    return _reduce(loss, reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    a, pos, neg = jnp.asarray(input), jnp.asarray(positive), jnp.asarray(negative)
+    def dist(u, v):
+        return jnp.sum(jnp.abs(u - v + epsilon) ** p, axis=-1) ** (1.0 / p)
+    dp = dist(a, pos)
+    dn = dist(a, neg)
+    if swap:
+        dn = jnp.minimum(dn, dist(pos, neg))
+    return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative, distance_function=None,
+                                      margin=1.0, swap=False, reduction="mean", name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        dn = jnp.minimum(dn, distance_function(positive, negative))
+    return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", name=None):
+    x, y = jnp.asarray(input).astype(jnp.float32), jnp.asarray(label).astype(jnp.float32)
+    loss = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+    if weight is not None:
+        loss = loss * jnp.asarray(weight, jnp.float32)
+    return _reduce(jnp.mean(loss, axis=-1), reduction)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    x, y = jnp.asarray(input).astype(jnp.float32), jnp.asarray(label).astype(jnp.float32)
+    return _reduce(jnp.log1p(jnp.exp(-y * x)), reduction)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None, reduction="mean", name=None):
+    x = jnp.asarray(input).astype(jnp.float32)
+    label = jnp.asarray(label)
+    xy = jnp.take_along_axis(x, label[:, None], axis=1)
+    m = jnp.maximum(0.0, margin - xy + x) ** p
+    m = m.at[jnp.arange(x.shape[0]), label].set(0.0)
+    if weight is not None:
+        m = m * jnp.take(jnp.asarray(weight, jnp.float32), label)[:, None]
+    return _reduce(jnp.sum(m, axis=1) / x.shape[1], reduction)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    x = jnp.asarray(logit).astype(jnp.float32)
+    y = jnp.asarray(label).astype(jnp.float32)
+    p = jax.nn.sigmoid(x)
+    ce = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    p_t = p * y + (1 - p) * (1 - y)
+    loss = ce * ((1 - p_t) ** gamma)
+    if alpha >= 0:
+        loss = loss * (alpha * y + (1 - alpha) * (1 - y))
+    if normalizer is not None:
+        loss = loss / jnp.asarray(normalizer)
+    return _reduce(loss, reduction)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    x = jnp.asarray(input).astype(jnp.float32)
+    y = jnp.asarray(label).astype(jnp.float32)
+    return -y * jnp.log(x + epsilon) - (1 - y) * jnp.log(1 - x + epsilon)
+
+
+def square_error_cost(input, label):
+    d = jnp.asarray(input) - jnp.asarray(label)
+    return d * d
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    x = jnp.asarray(input).astype(jnp.float32)
+    y = jnp.asarray(label).astype(jnp.float32)
+    if log_input:
+        loss = jnp.exp(x) - y * x
+    else:
+        loss = x - y * jnp.log(x + epsilon)
+    if full:
+        stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(2 * jnp.pi * (y + epsilon))
+        loss = loss + jnp.where(y > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    x = jnp.asarray(input).astype(jnp.float32)
+    y = jnp.asarray(label).astype(jnp.float32)
+    v = jnp.maximum(jnp.asarray(variance).astype(jnp.float32), epsilon)
+    loss = 0.5 * (jnp.log(v) + jnp.square(x - y) / v)
+    if full:
+        loss = loss + 0.5 * jnp.log(2 * jnp.asarray(jnp.pi))
+    return _reduce(loss, reduction)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    x = jnp.asarray(input)
+    y = jax.nn.one_hot(jnp.squeeze(jnp.asarray(label), -1), x.shape[-1], dtype=x.dtype)
+    x = x.reshape(x.shape[0], -1)
+    y = y.reshape(y.shape[0], -1)
+    inter = jnp.sum(x * y, axis=1)
+    union = jnp.sum(x, axis=1) + jnp.sum(y, axis=1)
+    return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    a, p = jnp.asarray(anchor), jnp.asarray(positive)
+    labels = jnp.asarray(labels).ravel()
+    sim = a @ p.T
+    same = (labels[:, None] == labels[None, :]).astype(jnp.float32)
+    same = same / jnp.sum(same, axis=1, keepdims=True)
+    ce = jnp.mean(-jnp.sum(same * jax.nn.log_softmax(sim, axis=1), axis=1))
+    reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1)) + jnp.mean(jnp.sum(p * p, axis=1))) / 2
+    return ce + reg
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard forward algorithm in log space under lax.scan
+    (reference: warpctc third_party binding; here a pure-XLA implementation).
+    log_probs: [T, B, C] (paddle convention) or [B, T, C] auto-detected by
+    matching input_lengths length."""
+    lp = jnp.asarray(log_probs).astype(jnp.float32)
+    labels = jnp.asarray(labels)
+    if lp.shape[1] == labels.shape[0] and lp.shape[0] != labels.shape[0]:
+        pass  # already [T, B, C]
+    elif lp.shape[0] == labels.shape[0]:
+        lp = jnp.transpose(lp, (1, 0, 2))
+    T, B, C = lp.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    # extended label sequence: blank, l1, blank, l2, ... blank
+    ext = jnp.full((B, S), blank, labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    ninf = -1e30
+    alpha0 = jnp.full((B, S), ninf)
+    alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(B), blank])
+    alpha0 = alpha0.at[:, 1].set(jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0])
+
+    same_as_prev2 = jnp.concatenate(
+        [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    def step(alpha, lp_t):
+        a0 = alpha
+        a1 = jnp.concatenate([jnp.full((B, 1), ninf), alpha[:, :-1]], axis=1)
+        a2 = jnp.concatenate([jnp.full((B, 2), ninf), alpha[:, :-2]], axis=1)
+        a2 = jnp.where(same_as_prev2, ninf, a2)
+        m = jnp.maximum(jnp.maximum(a0, a1), a2)
+        acc = m + jnp.log(jnp.exp(a0 - m) + jnp.exp(a1 - m) + jnp.exp(a2 - m) + 1e-30)
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)
+        return acc + emit, None
+
+    def scan_step(carry, t):
+        alpha = carry
+        new_alpha, _ = step(alpha, lp[t])
+        # freeze past input_lengths
+        new_alpha = jnp.where((t < input_lengths)[:, None], new_alpha, alpha)
+        return new_alpha, None
+
+    alpha, _ = jax.lax.scan(scan_step, alpha0, jnp.arange(1, T))
+    # final: sum of last two valid positions
+    last = 2 * label_lengths  # index of final blank
+    a_last = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(alpha, jnp.maximum(last - 1, 0)[:, None], axis=1)[:, 0]
+    m = jnp.maximum(a_last, a_prev)
+    ll = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m) + 1e-30)
+    loss = -ll
+    if norm_by_times:
+        loss = loss / jnp.maximum(input_lengths.astype(jnp.float32), 1.0)
+    return _reduce(loss, reduction)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0, fastemit_lambda=0.0,
+              reduction="mean", name=None):
+    """RNN-T forward-algorithm loss (reference: warprnnt binding) in pure XLA."""
+    logits = jnp.asarray(input).astype(jnp.float32)  # [B, T, U+1, C]
+    labels = jnp.asarray(label)
+    B, T, U1, C = logits.shape
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    blank_lp = logp[..., blank]  # [B, T, U+1]
+    lab_lp = jnp.take_along_axis(
+        logp[:, :, :-1, :], labels[:, None, :, None].repeat(T, axis=1), axis=3
+    )[..., 0]  # [B, T, U]
+    ninf = -1e30
+
+    # forward variable alpha[b, t, u]
+    def outer(b_blank, b_lab, t_len, u_len):
+        def t_step(alpha_prev_t, t):
+            def u_step(carry, u):
+                alpha_tm1_u, alpha_row = carry
+                from_top = jnp.where(t > 0, alpha_prev_t[u] + b_blank[t - 1, u], ninf)
+                from_left = jnp.where(u > 0, alpha_row[u - 1] + b_lab[t, u - 1], ninf)
+                init = jnp.where((t == 0) & (u == 0), 0.0, ninf)
+                m = jnp.maximum(jnp.maximum(from_top, from_left), init)
+                val = m + jnp.log(jnp.exp(from_top - m) + jnp.exp(from_left - m)
+                                  + jnp.exp(init - m) + 1e-30)
+                return (alpha_tm1_u, alpha_row.at[u].set(val)), None
+
+            (_, row), _ = jax.lax.scan(u_step, (alpha_prev_t, jnp.full((U1,), ninf)),
+                                       jnp.arange(U1))
+            return row, row
+
+        _, rows = jax.lax.scan(t_step, jnp.full((U1,), ninf), jnp.arange(T))
+        a_final = rows[t_len - 1, u_len] + b_blank[t_len - 1, u_len]
+        return -a_final
+
+    loss = jax.vmap(outer)(blank_lp, lab_lp, input_lengths, label_lengths)
+    return _reduce(loss, reduction)

@@ -1,0 +1,139 @@
+"""Normalization functionals (parity: python/paddle/nn/functional/norm.py +
+incubate fused_rms_norm — the fused path routes to the Pallas kernel in
+ops/pallas when on TPU)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["layer_norm", "rms_norm", "batch_norm", "instance_norm", "group_norm",
+           "local_response_norm"]
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    x = jnp.asarray(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    # compute in fp32 for bf16 stability (reference does the same for fp16:
+    # phi/kernels/gpu/layer_norm_kernel.cu uses float accumulators)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * jnp.asarray(weight, jnp.float32)
+    if bias is not None:
+        out = out + jnp.asarray(bias, jnp.float32)
+    return out.astype(x.dtype)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (parity: paddle.incubate.nn.functional.fused_rms_norm)."""
+    x = jnp.asarray(x)
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * jnp.asarray(weight, jnp.float32)
+    return out.astype(x.dtype)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               use_global_stats=None, name=None):
+    """Returns (out, new_running_mean, new_running_var) when training else out.
+
+    Unlike the reference (which mutates running stats in the kernel,
+    phi/kernels/gpu/batch_norm_kernel.cu), immutable arrays force the stat
+    update to be explicit; layers handle the writeback via buffers.
+    """
+    x = jnp.asarray(x)
+    channel_axis = x.ndim - 1 if data_format[-1] == "C" else 1
+    axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+    shape = [1] * x.ndim
+    shape[channel_axis] = x.shape[channel_axis]
+
+    use_stats = (not training) if use_global_stats is None else use_global_stats
+    xf = x.astype(jnp.float32)
+    if use_stats:
+        mean = jnp.asarray(running_mean, jnp.float32)
+        var = jnp.asarray(running_var, jnp.float32)
+        new_mean, new_var = running_mean, running_var
+    else:
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.var(xf, axis=axes)
+        n = xf.size / xf.shape[channel_axis]
+        unbiased = var * n / max(n - 1.0, 1.0)
+        new_mean = momentum * jnp.asarray(running_mean, jnp.float32) + (1 - momentum) * mean
+        new_var = momentum * jnp.asarray(running_var, jnp.float32) + (1 - momentum) * unbiased
+    out = (xf - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+    if weight is not None:
+        out = out * jnp.asarray(weight, jnp.float32).reshape(shape)
+    if bias is not None:
+        out = out + jnp.asarray(bias, jnp.float32).reshape(shape)
+    out = out.astype(x.dtype)
+    if training and not use_stats:
+        return out, new_mean, new_var
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW",
+                  name=None):
+    x = jnp.asarray(x)
+    channel_axis = x.ndim - 1 if data_format[-1] == "C" else 1
+    axes = tuple(i for i in range(2, x.ndim)) if channel_axis == 1 else tuple(range(1, x.ndim - 1))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
+    shape = [1] * x.ndim
+    shape[channel_axis] = x.shape[channel_axis]
+    if weight is not None:
+        out = out * jnp.asarray(weight, jnp.float32).reshape(shape)
+    if bias is not None:
+        out = out + jnp.asarray(bias, jnp.float32).reshape(shape)
+    return out.astype(x.dtype)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = jnp.asarray(x)
+    channel_last = data_format[-1] == "C"
+    if channel_last:
+        x_ = jnp.moveaxis(x, -1, 1)
+    else:
+        x_ = x
+    n, c = x_.shape[0], x_.shape[1]
+    xf = x_.astype(jnp.float32).reshape(n, num_groups, c // num_groups, -1)
+    mean = jnp.mean(xf, axis=(2, 3), keepdims=True)
+    var = jnp.var(xf, axis=(2, 3), keepdims=True)
+    out = ((xf - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x_.shape)
+    shape = [1] * x_.ndim
+    shape[1] = c
+    if weight is not None:
+        out = out * jnp.asarray(weight, jnp.float32).reshape(shape)
+    if bias is not None:
+        out = out + jnp.asarray(bias, jnp.float32).reshape(shape)
+    out = out.astype(x.dtype)
+    return jnp.moveaxis(out, 1, -1) if channel_last else out
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    x = jnp.asarray(x)
+    channel_axis = x.ndim - 1 if data_format[-1] == "C" else 1
+    sq = jnp.square(x.astype(jnp.float32))
+    c = x.shape[channel_axis]
+    half = size // 2
+    pad_width = [(0, 0)] * x.ndim
+    pad_width[channel_axis] = (half, size - half - 1)
+    sq = jnp.pad(sq, pad_width)
+    window = [1] * x.ndim
+    window[channel_axis] = size
+    acc = jax.lax.reduce_window(sq, 0.0, jax.lax.add, tuple(window), (1,) * x.ndim,
+                                [(0, 0)] * x.ndim)
+    div = (k + alpha * acc / size) ** beta
+    return (x.astype(jnp.float32) / div).astype(x.dtype)

@@ -1,0 +1,235 @@
+"""Pooling functionals (parity: python/paddle/nn/functional/pooling.py).
+All lower to XLA reduce_window."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+    "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d", "lp_pool1d", "lp_pool2d", "max_unpool2d",
+]
+
+
+def _tup(v, n):
+    if isinstance(v, (list, tuple)):
+        t = tuple(int(x) for x in v)
+        return t * n if len(t) == 1 else t
+    return (int(v),) * n
+
+
+def _pads(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)):
+        p = [int(x) for x in padding]
+        if len(p) == n:
+            return [(x, x) for x in p]
+        if len(p) == 2 * n:
+            return [(p[2 * i], p[2 * i + 1]) for i in range(n)]
+    return [(int(padding), int(padding))] * n
+
+
+def _pool(x, ksize, stride, padding, n, channel_last, reducer, init, ceil_mode=False):
+    x = jnp.asarray(x)
+    ksize = _tup(ksize, n)
+    stride = _tup(stride if stride is not None else ksize, n)
+    sp0 = 1 if channel_last else 2
+    window = [1] * x.ndim
+    strides = [1] * x.ndim
+    for i in range(n):
+        window[sp0 + i] = ksize[i]
+        strides[sp0 + i] = stride[i]
+    pads = _pads(padding, n)
+    if isinstance(pads, str):
+        full_pads = pads
+    else:
+        full_pads = [(0, 0)] * x.ndim
+        for i in range(n):
+            full_pads[sp0 + i] = pads[i]
+        if ceil_mode:
+            full_pads = [list(p) for p in full_pads]
+            for i in range(n):
+                size = x.shape[sp0 + i] + pads[i][0] + pads[i][1]
+                rem = (size - ksize[i]) % stride[i]
+                if rem:
+                    full_pads[sp0 + i][1] += stride[i] - rem
+            full_pads = [tuple(p) for p in full_pads]
+    return jax.lax.reduce_window(x, init, reducer, tuple(window), tuple(strides), full_pads)
+
+
+def _avg(x, ksize, stride, padding, n, data_format, exclusive=True, ceil_mode=False):
+    channel_last = data_format[-1] == "C"
+    summed = _pool(x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x,
+                   ksize, stride, padding, n, channel_last, jax.lax.add, 0.0,
+                   ceil_mode)
+    if exclusive and (isinstance(padding, str) or np.any(np.asarray(padding))) or ceil_mode:
+        ones = jnp.ones(jnp.asarray(x).shape, summed.dtype)
+        count = _pool(ones, ksize, stride, padding, n, channel_last, jax.lax.add, 0.0, ceil_mode)
+        out = summed / count
+    else:
+        out = summed / float(np.prod(_tup(ksize, n)))
+    return out.astype(jnp.asarray(x).dtype)
+
+
+def _max(x, ksize, stride, padding, n, data_format, ceil_mode=False):
+    channel_last = data_format[-1] == "C"
+    x = jnp.asarray(x)
+    neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return _pool(x, ksize, stride, padding, n, channel_last, jax.lax.max, neg, ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _avg(x, kernel_size, stride, padding, 1, "NWC" if data_format[-1] == "C" else "NCW",
+                exclusive, ceil_mode)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    out = _avg(x, kernel_size, stride, padding, 2, data_format, exclusive, ceil_mode)
+    if divisor_override is not None:
+        k = _tup(kernel_size, 2)
+        out = out * (float(np.prod(k)) / divisor_override)
+    return out
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    out = _avg(x, kernel_size, stride, padding, 3, data_format, exclusive, ceil_mode)
+    if divisor_override is not None:
+        k = _tup(kernel_size, 3)
+        out = out * (float(np.prod(k)) / divisor_override)
+    return out
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    out = _max(x, kernel_size, stride, padding, 1,
+               "NWC" if data_format[-1] == "C" else "NCW", ceil_mode)
+    return (out, _argmax_mask(x, out, kernel_size, stride, padding, 1)) if return_mask else out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _max(x, kernel_size, stride, padding, 2, data_format, ceil_mode)
+    return (out, _argmax_mask(x, out, kernel_size, stride, padding, 2)) if return_mask else out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _max(x, kernel_size, stride, padding, 3, data_format, ceil_mode)
+    return (out, _argmax_mask(x, out, kernel_size, stride, padding, 3)) if return_mask else out
+
+
+def _argmax_mask(x, pooled, kernel_size, stride, padding, n):
+    """Flat indices of the max within each window (paddle return_mask parity).
+    Implemented via unfold comparison; NCHW only."""
+    x = jnp.asarray(x)
+    if n != 2:
+        raise NotImplementedError("return_mask only for 2d pooling")
+    from .common import unfold
+    k = _tup(kernel_size, 2)
+    s = _tup(stride if stride is not None else kernel_size, 2)
+    pads = _pads(padding, 2)
+    p = [pads[0][0], pads[0][1], pads[1][0], pads[1][1]] if not isinstance(pads, str) else [0, 0, 0, 0]
+    # pad with -inf (not unfold's zero-pad) so padding never wins the argmax
+    neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) else \
+        jnp.iinfo(x.dtype).min
+    xp = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])), constant_values=neg)
+    cols = unfold(xp, k, s, 0, 1)  # [N, C*kh*kw, L]
+    ncols = cols.reshape(x.shape[0], x.shape[1], k[0] * k[1], -1)
+    return jnp.argmax(ncols, axis=2).reshape(pooled.shape)
+
+
+def _adaptive_pool(x, output_size, n, data_format, op="avg"):
+    x = jnp.asarray(x)
+    channel_last = data_format[-1] == "C"
+    sp0 = 1 if channel_last else 2
+    out_sizes = _tup(output_size, n)
+    out_sizes = tuple(x.shape[sp0 + i] if out_sizes[i] is None else out_sizes[i]
+                      for i in range(n))
+    # adaptive pooling with uneven windows: per output position, slice+reduce.
+    out = x
+    for i in range(n):
+        axis = sp0 + i
+        in_s, out_s = out.shape[axis], out_sizes[i]
+        if in_s == out_s:
+            continue
+        starts = (np.arange(out_s) * in_s) // out_s
+        ends = ((np.arange(out_s) + 1) * in_s + out_s - 1) // out_s
+        pieces = []
+        for s_, e_ in zip(starts, ends):
+            sl = [slice(None)] * out.ndim
+            sl[axis] = slice(int(s_), int(e_))
+            seg = out[tuple(sl)]
+            red = jnp.mean if op == "avg" else jnp.max
+            pieces.append(red(seg, axis=axis, keepdims=True))
+        out = jnp.concatenate(pieces, axis=axis)
+    return out
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCW", "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, "avg")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 1, "NCW", "max")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 2, "NCHW", "max")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 3, "NCDHW", "max")
+    return (out, None) if return_mask else out
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
+              data_format="NCL", name=None):
+    x = jnp.asarray(x)
+    p = float(norm_type)
+    s = _pool(jnp.abs(x) ** p, kernel_size, stride, padding, 1,
+              data_format[-1] == "C", jax.lax.add, 0.0, ceil_mode)
+    return s ** (1.0 / p)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
+              data_format="NCHW", name=None):
+    x = jnp.asarray(x)
+    p = float(norm_type)
+    s = _pool(jnp.abs(x) ** p, kernel_size, stride, padding, 2,
+              data_format[-1] == "C", jax.lax.add, 0.0, ceil_mode)
+    return s ** (1.0 / p)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    x, indices = jnp.asarray(x), jnp.asarray(indices)
+    k = _tup(kernel_size, 2)
+    s = _tup(stride if stride is not None else kernel_size, 2)
+    n, c, h, w = x.shape
+    if output_size is None:
+        oh = (h - 1) * s[0] + k[0] - 2 * (padding if isinstance(padding, int) else 0)
+        ow = (w - 1) * s[1] + k[1] - 2 * (padding if isinstance(padding, int) else 0)
+    else:
+        oh, ow = _tup(output_size, 2)[-2:]
+    out = jnp.zeros((n, c, oh * ow), x.dtype)
+    flat_idx = indices.reshape(n, c, -1)
+    out = jax.vmap(jax.vmap(lambda o, i, v: o.at[i].set(v)))(out, flat_idx, x.reshape(n, c, -1))
+    return out.reshape(n, c, oh, ow)

@@ -1,0 +1,1 @@
+from . import activation, common, container, conv, loss, norm, pooling, transformer  # noqa: F401

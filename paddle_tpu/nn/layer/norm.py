@@ -1,0 +1,228 @@
+"""Normalization layers (parity: python/paddle/nn/layer/norm.py).
+
+BatchNorm keeps running stats in registered buffers; under ``functional_call``
+the updated stats come back in the buffer dict and the jit TrainStep writes
+them into the live module — replacing the reference's in-kernel mutation.
+SyncBatchNorm: under GSPMD with the batch sharded on 'dp', the batch statistics
+computed by jnp.mean are ALREADY global (XLA inserts the all-reduce), so
+SyncBatchNorm == BatchNorm in this framework; the class exists for parity.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import functional as F
+from .. import initializer as I
+from ..module import Layer, Parameter
+
+__all__ = ["BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D",
+           "SyncBatchNorm", "LayerNorm", "RMSNorm", "GroupNorm", "InstanceNorm1D",
+           "InstanceNorm2D", "InstanceNorm3D", "LocalResponseNorm", "SpectralNorm"]
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", use_global_stats=None, name=None):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.use_global_stats = use_global_stats
+        if weight_attr is False:
+            self.weight = None
+        else:
+            w_init = weight_attr if callable(weight_attr) else I.Constant(1.0)
+            self.weight = Parameter(w_init((num_features,), self._dtype))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            b_init = bias_attr if callable(bias_attr) else I.Constant(0.0)
+            self.bias = Parameter(b_init((num_features,), self._dtype))
+        self.register_buffer("_mean", jnp.zeros((num_features,), jnp.float32))
+        self.register_buffer("_variance", jnp.ones((num_features,), jnp.float32))
+
+    def forward(self, x):
+        out = F.batch_norm(x, self._mean, self._variance, self.weight, self.bias,
+                           training=self.training, momentum=self.momentum,
+                           epsilon=self.epsilon, data_format=self.data_format,
+                           use_global_stats=self.use_global_stats)
+        if isinstance(out, tuple):
+            out, new_mean, new_var = out
+            self._mean = new_mean
+            self._variance = new_var
+        return out
+
+
+class BatchNorm(_BatchNormBase):
+    pass
+
+
+class BatchNorm1D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCL", use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr, bias_attr,
+                         "NCW" if data_format in ("NCL", "NCW") else "NWC",
+                         use_global_stats, name)
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCDHW", use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr, bias_attr,
+                         data_format, use_global_stats, name)
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica BN. Under a dp-sharded mesh the plain-BN reduction is
+    already global (GSPMD); kept as its own class for API parity with
+    paddle.nn.SyncBatchNorm (reference: sync_batch_norm_kernel.cu)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        if isinstance(layer, _BatchNormBase) and not isinstance(layer, SyncBatchNorm):
+            new = SyncBatchNorm(layer.num_features, layer.momentum, layer.epsilon,
+                                data_format=layer.data_format)
+            new._parameters.update(layer._parameters)
+            new._buffers.update(layer._buffers)
+            return new
+        return layer
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.epsilon = epsilon
+        if weight_attr is False:
+            self.weight = None
+        else:
+            w_init = weight_attr if callable(weight_attr) else I.Constant(1.0)
+            self.weight = Parameter(w_init(self.normalized_shape, self._dtype))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            b_init = bias_attr if callable(bias_attr) else I.Constant(0.0)
+            self.bias = Parameter(b_init(self.normalized_shape, self._dtype))
+
+    def forward(self, x):
+        return F.layer_norm(x, self.normalized_shape, self.weight, self.bias,
+                            self.epsilon)
+
+
+class RMSNorm(Layer):
+    """Parity: paddle.incubate fused_rms_norm; first-class here (LLM norm).
+    Routes to the Pallas fused kernel on TPU via F.rms_norm."""
+
+    def __init__(self, hidden_size, epsilon=1e-6, name=None):
+        super().__init__()
+        self.epsilon = epsilon
+        self.weight = Parameter(I.Constant(1.0)((hidden_size,), self._dtype))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self.epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self.num_groups = num_groups
+        self.epsilon = epsilon
+        self.data_format = data_format
+        if weight_attr is False:
+            self.weight = None
+        else:
+            w_init = weight_attr if callable(weight_attr) else I.Constant(1.0)
+            self.weight = Parameter(w_init((num_channels,), self._dtype))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            b_init = bias_attr if callable(bias_attr) else I.Constant(0.0)
+            self.bias = Parameter(b_init((num_channels,), self._dtype))
+
+    def forward(self, x):
+        return F.group_norm(x, self.num_groups, self.epsilon, self.weight,
+                            self.bias, self.data_format)
+
+
+class _InstanceNormBase(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self.epsilon = epsilon
+        if weight_attr is False:
+            self.weight = None
+            self.bias = None
+        else:
+            w_init = weight_attr if callable(weight_attr) else I.Constant(1.0)
+            b_init = bias_attr if callable(bias_attr) else I.Constant(0.0)
+            self.weight = Parameter(w_init((num_features,), self._dtype))
+            self.bias = Parameter(b_init((num_features,), self._dtype))
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.weight, bias=self.bias,
+                               eps=self.epsilon, data_format=self.data_format)
+
+
+class InstanceNorm1D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm2D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormBase):
+    pass
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (size, alpha, beta, k, data_format)
+
+    def forward(self, x):
+        return F.local_response_norm(x, *self.args)
+
+
+class SpectralNorm(Layer):
+    """Spectral normalization of a weight (parity: paddle.nn.SpectralNorm —
+    power iteration on the fly)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12, name=None):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        self.register_buffer("weight_u", I.Normal(0, 1)((h,), "float32"))
+        self.register_buffer("weight_v", I.Normal(0, 1)((w,), "float32"))
+
+    def forward(self, weight):
+        w = jnp.moveaxis(jnp.asarray(weight), self.dim, 0)
+        mat = w.reshape(w.shape[0], -1).astype(jnp.float32)
+        u, v = self.weight_u, self.weight_v
+        for _ in range(self.power_iters):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + self.eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + self.eps)
+        self.weight_u, self.weight_v = u, v
+        sigma = u @ mat @ v
+        return (jnp.moveaxis(w / sigma, 0, self.dim)).astype(jnp.asarray(weight).dtype)

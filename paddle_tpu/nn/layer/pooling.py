@@ -1,0 +1,129 @@
+"""Pooling layers (parity: python/paddle/nn/layer/pooling.py)."""
+
+from __future__ import annotations
+
+from .. import functional as F
+from ..module import Layer
+
+__all__ = ["AvgPool1D", "AvgPool2D", "AvgPool3D", "MaxPool1D", "MaxPool2D",
+           "MaxPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D", "AdaptiveAvgPool3D",
+           "AdaptiveMaxPool1D", "AdaptiveMaxPool2D", "AdaptiveMaxPool3D",
+           "LPPool1D", "LPPool2D", "MaxUnPool2D"]
+
+
+class _Pool(Layer):
+    def __init__(self, fn, **kw):
+        super().__init__()
+        self._fn = fn
+        self._kw = {k: v for k, v in kw.items() if k != "name"}
+
+    def forward(self, x):
+        return getattr(F, self._fn)(x, **self._kw)
+
+
+class AvgPool1D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
+                 ceil_mode=False, name=None):
+        super().__init__("avg_pool1d", kernel_size=kernel_size, stride=stride,
+                         padding=padding, exclusive=exclusive, ceil_mode=ceil_mode)
+
+
+class AvgPool2D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+        super().__init__("avg_pool2d", kernel_size=kernel_size, stride=stride,
+                         padding=padding, ceil_mode=ceil_mode, exclusive=exclusive,
+                         divisor_override=divisor_override, data_format=data_format)
+
+
+class AvgPool3D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+        super().__init__("avg_pool3d", kernel_size=kernel_size, stride=stride,
+                         padding=padding, ceil_mode=ceil_mode, exclusive=exclusive,
+                         divisor_override=divisor_override, data_format=data_format)
+
+
+class MaxPool1D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, name=None):
+        super().__init__("max_pool1d", kernel_size=kernel_size, stride=stride,
+                         padding=padding, return_mask=return_mask, ceil_mode=ceil_mode)
+
+
+class MaxPool2D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__("max_pool2d", kernel_size=kernel_size, stride=stride,
+                         padding=padding, return_mask=return_mask, ceil_mode=ceil_mode,
+                         data_format=data_format)
+
+
+class MaxPool3D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, data_format="NCDHW", name=None):
+        super().__init__("max_pool3d", kernel_size=kernel_size, stride=stride,
+                         padding=padding, return_mask=return_mask, ceil_mode=ceil_mode,
+                         data_format=data_format)
+
+
+class AdaptiveAvgPool1D(_Pool):
+    def __init__(self, output_size, name=None):
+        super().__init__("adaptive_avg_pool1d", output_size=output_size)
+
+
+class AdaptiveAvgPool2D(_Pool):
+    def __init__(self, output_size, data_format="NCHW", name=None):
+        super().__init__("adaptive_avg_pool2d", output_size=output_size,
+                         data_format=data_format)
+
+
+class AdaptiveAvgPool3D(_Pool):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__("adaptive_avg_pool3d", output_size=output_size,
+                         data_format=data_format)
+
+
+class AdaptiveMaxPool1D(_Pool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__("adaptive_max_pool1d", output_size=output_size,
+                         return_mask=return_mask)
+
+
+class AdaptiveMaxPool2D(_Pool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__("adaptive_max_pool2d", output_size=output_size,
+                         return_mask=return_mask)
+
+
+class AdaptiveMaxPool3D(_Pool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__("adaptive_max_pool3d", output_size=output_size,
+                         return_mask=return_mask)
+
+
+class LPPool1D(_Pool):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__("lp_pool1d", norm_type=norm_type, kernel_size=kernel_size,
+                         stride=stride, padding=padding, ceil_mode=ceil_mode,
+                         data_format=data_format)
+
+
+class LPPool2D(_Pool):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__("lp_pool2d", norm_type=norm_type, kernel_size=kernel_size,
+                         stride=stride, padding=padding, ceil_mode=ceil_mode,
+                         data_format=data_format)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCHW",
+                 output_size=None, name=None):
+        super().__init__()
+        self._kw = dict(kernel_size=kernel_size, stride=stride, padding=padding,
+                        data_format=data_format, output_size=output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, **self._kw)

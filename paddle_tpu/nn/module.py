@@ -1,0 +1,432 @@
+"""Layer: the module base class.
+
+Parity target: ``paddle.nn.Layer`` (python/paddle/nn/layer/layers.py:332) —
+parameters/buffers/sublayers registries, state_dict, hooks, train/eval mode.
+
+TPU-native twist: the reference mutates parameters in place through the eager
+autograd engine; here parameters are immutable jax Arrays and the **functional
+core** is :func:`functional_call`, which temporarily binds a path-keyed state
+dict into the module tree, runs forward under a scoped RNG stream, and returns
+(output, mutated-buffer state). jit/grad/shard_map all operate on that pure
+function; the mutable Layer object is the user-facing, dygraph-feeling shell.
+"""
+
+from __future__ import annotations
+
+import typing
+from collections import OrderedDict
+from typing import Any, Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng
+from ..core.dtypes import canonical_dtype
+
+__all__ = ["Layer", "Parameter", "functional_call", "to_static_state", "Module"]
+
+
+class Parameter:
+    """Creation-time marker wrapping an array to be registered as trainable.
+
+    After ``layer.w = Parameter(arr)`` the attribute reads back as the raw
+    jax Array; Parameter is not a tensor subclass (jax Arrays are final).
+    Sharding metadata (mesh axes for TP/FSDP) rides along as ``spec``.
+    """
+
+    def __init__(self, value: jax.Array, trainable: bool = True, spec: tuple | None = None):
+        self.value = value
+        self.trainable = trainable
+        self.spec = spec
+
+
+class Layer:
+    """Base class for all neural network layers."""
+
+    def __init__(self, name_scope: str | None = None, dtype: Any = "float32"):
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        object.__setattr__(self, "_non_persistable_buffer_names", set())
+        object.__setattr__(self, "_sub_layers", OrderedDict())
+        object.__setattr__(self, "_param_specs", {})
+        object.__setattr__(self, "_trainable_set", set())
+        object.__setattr__(self, "_forward_pre_hooks", OrderedDict())
+        object.__setattr__(self, "_forward_post_hooks", OrderedDict())
+        self.training = True
+        self._dtype = canonical_dtype(dtype)
+
+    # ---- attribute routing ----
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        params = self.__dict__.get("_parameters")
+        if params is None:
+            object.__setattr__(self, name, value)
+            return
+        if isinstance(value, Parameter):
+            params[name] = value.value
+            self._param_specs[name] = value.spec
+            if value.trainable:
+                self._trainable_set.add(name)
+            else:
+                self._trainable_set.discard(name)
+            self.__dict__.pop(name, None)
+            return
+        if isinstance(value, Layer):
+            self._sub_layers[name] = value
+            self.__dict__.pop(name, None)
+            return
+        if name in params:
+            if value is None:
+                del params[name]
+                object.__setattr__(self, name, None)
+            else:
+                params[name] = value
+            return
+        if name in self._buffers:
+            self._buffers[name] = value
+            return
+        if name in self._sub_layers:
+            if isinstance(value, Layer):
+                self._sub_layers[name] = value
+            else:
+                del self._sub_layers[name]
+                object.__setattr__(self, name, value)
+            return
+        object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str) -> Any:
+        # only called when normal lookup fails
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __delattr__(self, name: str) -> None:
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    # ---- registration API (parity: Layer.add_parameter/register_buffer/add_sublayer) ----
+
+    def add_parameter(self, name: str, param: jax.Array | Parameter | None):
+        if param is None:
+            self._parameters[name] = None
+        elif isinstance(param, Parameter):
+            setattr(self, name, param)
+        else:
+            setattr(self, name, Parameter(param))
+        return getattr(self, name, None)
+
+    def create_parameter(self, shape, dtype=None, default_initializer=None,
+                         is_bias: bool = False, attr=None):
+        """Create (and return) a parameter array; caller assigns it to an attr
+        (parity: Layer.create_parameter)."""
+        from . import initializer as I
+
+        dtype = canonical_dtype(dtype) or self._dtype
+        if default_initializer is None:
+            default_initializer = I.Constant(0.0) if is_bias else I.XavierNormal()
+        return default_initializer(tuple(shape), dtype)
+
+    def register_buffer(self, name: str, tensor: jax.Array | None, persistable: bool = True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        self.__dict__.pop(name, None)
+        return tensor
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    # ---- forward ----
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement forward()")
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, args)
+            if out is not None:
+                args = out if isinstance(out, tuple) else (out,)
+        y = self.forward(*args, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            out = hook(self, args, y)
+            if out is not None:
+                y = out
+        return y
+
+    def register_forward_pre_hook(self, hook: Callable):
+        handle = _HookHandle(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook: Callable):
+        handle = _HookHandle(self._forward_post_hooks)
+        self._forward_post_hooks[handle.id] = hook
+        return handle
+
+    # ---- traversal ----
+
+    def children(self) -> Iterator["Layer"]:
+        yield from self._sub_layers.values()
+
+    def named_children(self) -> Iterator[tuple[str, "Layer"]]:
+        yield from self._sub_layers.items()
+
+    def sublayers(self, include_self: bool = False) -> list["Layer"]:
+        out = [self] if include_self else []
+        for c in self._sub_layers.values():
+            out.extend(c.sublayers(include_self=True))
+        return out
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False):
+        if include_self:
+            yield prefix, self
+        for name, c in self._sub_layers.items():
+            p = f"{prefix}.{name}" if prefix else name
+            yield from c.named_sublayers(prefix=p, include_self=True)
+
+    def named_parameters(self, prefix: str = "") -> Iterator[tuple[str, jax.Array]]:
+        for name, v in self._parameters.items():
+            if v is not None:
+                yield (f"{prefix}.{name}" if prefix else name), v
+        for cname, c in self._sub_layers.items():
+            p = f"{prefix}.{cname}" if prefix else cname
+            yield from c.named_parameters(prefix=p)
+
+    def named_buffers(self, prefix: str = "", persistable_only: bool = False):
+        for name, v in self._buffers.items():
+            if v is None:
+                continue
+            if persistable_only and name in self._non_persistable_buffer_names:
+                continue
+            yield (f"{prefix}.{name}" if prefix else name), v
+        for cname, c in self._sub_layers.items():
+            p = f"{prefix}.{cname}" if prefix else cname
+            yield from c.named_buffers(prefix=p, persistable_only=persistable_only)
+
+    def parameters(self) -> list[jax.Array]:
+        return [v for _, v in self.named_parameters()]
+
+    def buffers(self) -> list[jax.Array]:
+        return [v for _, v in self.named_buffers()]
+
+    # ---- state dicts (path-keyed: the functional currency) ----
+
+    def param_dict(self, trainable_only: bool = False) -> dict[str, jax.Array]:
+        out = {}
+        for name, v in self._parameters.items():
+            if v is None:
+                continue
+            if trainable_only and name not in self._trainable_set:
+                continue
+            out[name] = v
+        for cname, c in self._sub_layers.items():
+            for k, v in c.param_dict(trainable_only).items():
+                out[f"{cname}.{k}"] = v
+        return out
+
+    def buffer_dict(self, persistable_only: bool = False) -> dict[str, jax.Array]:
+        return dict(self.named_buffers(persistable_only=persistable_only))
+
+    def state_dict(self, include_non_persistable_buffer: bool = False) -> dict[str, jax.Array]:
+        d = self.param_dict()
+        d.update(self.buffer_dict(persistable_only=not include_non_persistable_buffer))
+        return d
+
+    def _resolve(self, path: str) -> tuple["Layer", str]:
+        mod = self
+        parts = path.split(".")
+        for p in parts[:-1]:
+            mod = mod._sub_layers[p]
+        return mod, parts[-1]
+
+    def set_state_dict(self, state: dict[str, Any], use_structured_name: bool = True):
+        """Load a path-keyed state dict in place (parity: Layer.set_state_dict).
+        Shapes must match; dtypes are cast to the existing entry's dtype."""
+        missing, unexpected = [], []
+        current = self.state_dict(include_non_persistable_buffer=True)
+        for k, v in state.items():
+            if k not in current:
+                unexpected.append(k)
+                continue
+            mod, leaf = self._resolve(k)
+            arr = jnp.asarray(v)
+            old = current[k]
+            if tuple(arr.shape) != tuple(old.shape):
+                raise ValueError(
+                    f"state_dict shape mismatch for {k!r}: {arr.shape} vs {old.shape}")
+            arr = arr.astype(old.dtype)
+            if leaf in mod._parameters:
+                mod._parameters[leaf] = arr
+            else:
+                mod._buffers[leaf] = arr
+        for k in current:
+            if k not in state:
+                missing.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # ---- sharding specs ----
+
+    def spec_dict(self) -> dict[str, tuple | None]:
+        """Path-keyed PartitionSpec-like tuples attached at Parameter creation
+        (the analogue of the reference's per-op SPMD rules applied to weights)."""
+        out = {}
+        for name in self._parameters:
+            if self._parameters[name] is not None:
+                out[name] = self._param_specs.get(name)
+        for cname, c in self._sub_layers.items():
+            for k, v in c.spec_dict().items():
+                out[f"{cname}.{k}"] = v
+        return out
+
+    def set_param_spec(self, name: str, spec: tuple | None):
+        self._param_specs[name] = spec
+
+    # ---- modes ----
+
+    def train(self):
+        self.training = True
+        for c in self._sub_layers.values():
+            c.train()
+        return self
+
+    def eval(self):
+        self.training = False
+        for c in self._sub_layers.values():
+            c.eval()
+        return self
+
+    def apply(self, fn: Callable[["Layer"], None]):
+        for c in self._sub_layers.values():
+            c.apply(fn)
+        fn(self)
+        return self
+
+    def to(self, device=None, dtype: Any = None, blocking: bool = True):
+        """Cast floating-point params/buffers and/or move to a device."""
+        d = canonical_dtype(dtype)
+
+        def convert(mod: Layer):
+            for store in (mod._parameters, mod._buffers):
+                for k, v in store.items():
+                    if v is None:
+                        continue
+                    if d is not None and jnp.issubdtype(v.dtype, jnp.floating):
+                        v = v.astype(d)
+                    if device is not None:
+                        v = jax.device_put(v, device)
+                    store[k] = v
+
+        self.apply(convert)
+        if d is not None:
+            self._dtype = d
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    # ---- functional binding ----
+
+    def _swap_in(self, state: dict[str, jax.Array]) -> dict[str, tuple]:
+        saved = {}
+        for k, v in state.items():
+            mod, leaf = self._resolve(k)
+            if leaf in mod._parameters:
+                saved[k] = ("p", mod._parameters[leaf])
+                mod._parameters[leaf] = v
+            elif leaf in mod._buffers:
+                saved[k] = ("b", mod._buffers[leaf])
+                mod._buffers[leaf] = v
+            else:
+                raise KeyError(f"no parameter/buffer {k!r} in {type(self).__name__}")
+        return saved
+
+    def _swap_restore(self, saved: dict[str, tuple]) -> None:
+        for k, (kind, v) in saved.items():
+            mod, leaf = self._resolve(k)
+            if kind == "p":
+                mod._parameters[leaf] = v
+            else:
+                mod._buffers[leaf] = v
+
+    def __repr__(self):
+        lines = [type(self).__name__ + "("]
+        for name, c in self._sub_layers.items():
+            child = repr(c).splitlines()
+            lines.append(f"  ({name}): " + child[0])
+            lines.extend("  " + l for l in child[1:])
+        lines.append(")")
+        return "\n".join(lines) if len(lines) > 2 else type(self).__name__ + "()"
+
+
+class _HookHandle:
+    _next_id = [0]
+
+    def __init__(self, store):
+        self.id = self._next_id[0]
+        self._next_id[0] += 1
+        self._store = store
+
+    def remove(self):
+        self._store.pop(self.id, None)
+
+
+def functional_call(
+    module: Layer,
+    state: dict[str, jax.Array] | None,
+    *args,
+    rngs: jax.Array | None = None,
+    training: bool | None = None,
+    **kwargs,
+):
+    """Run ``module(*args)`` as a pure function of ``state``.
+
+    Returns ``(output, new_buffers)`` where ``new_buffers`` is the path-keyed
+    dict of buffers after the call (e.g. BatchNorm running stats). This is the
+    purity bridge between the mutable Layer shell and jax transforms — the
+    analogue of the reference's dygraph→static program capture (SURVEY §3.5),
+    done by binding instead of bytecode tracing.
+    """
+    state = state if state is not None else {}
+    prev_mode = module.training
+    if training is not None:
+        module.train() if training else module.eval()
+    # Snapshot every buffer, not just those in `state`: forward may mutate
+    # buffers in place (BN stats); tracers must never leak into the module.
+    all_buffers = module.buffer_dict()
+    saved = module._swap_in({**all_buffers, **state})
+    try:
+        key = rngs if rngs is not None else jax.random.key(0)
+        with rng.rng_stream(key):
+            out = module(*args, **kwargs)
+        new_buffers = module.buffer_dict()
+    finally:
+        module._swap_restore(saved)
+        if training is not None:
+            module.train() if prev_mode else module.eval()
+    return out, new_buffers
+
+
+def to_static_state(module: Layer) -> dict[str, np.ndarray]:
+    """Snapshot state as host numpy arrays (for checkpointing)."""
+    return {k: np.asarray(v) for k, v in module.state_dict().items()}
+
+
+# Torch-style alias used throughout model code
+Module = Layer

@@ -1,0 +1,16 @@
+"""Op library: the public tensor-function surface.
+
+Parity map (reference python/paddle/tensor/*): creation, math+stat+reduction,
+manipulation+search, linalg, logic, random. Activation-style functions live in
+nn.functional. Everything is a traceable jnp/lax composition — the "kernel
+library" on TPU is XLA itself, plus Pallas kernels under ops/pallas for the
+few patterns XLA cannot fuse well (SURVEY §7 translation table).
+"""
+
+from . import creation, linalg, logic, manipulation, math, random  # noqa: F401
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403

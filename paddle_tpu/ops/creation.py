@@ -1,0 +1,161 @@
+"""Tensor creation ops (parity: python/paddle/tensor/creation.py).
+
+Our Tensor type IS ``jax.Array`` — there is no wrapper class. XLA owns
+placement and layout; ``place``-style arguments map to jax devices/shardings.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtypes
+from ..core.dtypes import canonical_dtype, get_default_dtype
+
+__all__ = [
+    "Tensor", "to_tensor", "zeros", "ones", "full", "empty", "zeros_like",
+    "ones_like", "full_like", "empty_like", "arange", "linspace", "logspace",
+    "eye", "diag", "diagflat", "tril", "triu", "meshgrid", "assign", "clone",
+    "numel", "tril_indices", "triu_indices", "complex", "polar", "cauchy_",
+    "one_hot",
+]
+
+Tensor = jax.Array
+
+
+def _dt(dtype, default=None):
+    d = canonical_dtype(dtype)
+    return d if d is not None else default
+
+
+def to_tensor(data: Any, dtype: Any = None, place: Any = None, stop_gradient: bool = True) -> Tensor:
+    """Convert data to a device array (parity: paddle.to_tensor).
+
+    ``stop_gradient`` is accepted for API compatibility; gradient flow in a
+    functional framework is decided by what you differentiate, not a flag.
+    """
+    d = canonical_dtype(dtype)
+    if isinstance(data, jax.Array) and d is None:
+        return data
+    arr = jnp.asarray(data, dtype=d)
+    if arr.dtype == jnp.float64 and d is None and not jax.config.jax_enable_x64:
+        arr = arr.astype(get_default_dtype())
+    return arr
+
+
+def zeros(shape: Sequence[int], dtype: Any = None) -> Tensor:
+    return jnp.zeros(shape, _dt(dtype, get_default_dtype()))
+
+
+def ones(shape: Sequence[int], dtype: Any = None) -> Tensor:
+    return jnp.ones(shape, _dt(dtype, get_default_dtype()))
+
+
+def full(shape: Sequence[int], fill_value: Any, dtype: Any = None) -> Tensor:
+    return jnp.full(shape, fill_value, _dt(dtype))
+
+
+def empty(shape: Sequence[int], dtype: Any = None) -> Tensor:
+    # XLA has no uninitialized memory; zeros compiles to a cheap broadcast.
+    return jnp.zeros(shape, _dt(dtype, get_default_dtype()))
+
+
+def zeros_like(x: Tensor, dtype: Any = None) -> Tensor:
+    return jnp.zeros_like(x, dtype=_dt(dtype))
+
+
+def ones_like(x: Tensor, dtype: Any = None) -> Tensor:
+    return jnp.ones_like(x, dtype=_dt(dtype))
+
+
+def full_like(x: Tensor, fill_value: Any, dtype: Any = None) -> Tensor:
+    return jnp.full_like(x, fill_value, dtype=_dt(dtype))
+
+
+def empty_like(x: Tensor, dtype: Any = None) -> Tensor:
+    return jnp.zeros_like(x, dtype=_dt(dtype))
+
+
+def arange(start=0, end=None, step=1, dtype: Any = None) -> Tensor:
+    return jnp.arange(start, end, step, dtype=_dt(dtype))
+
+
+def linspace(start, stop, num, dtype: Any = None) -> Tensor:
+    return jnp.linspace(start, stop, int(num), dtype=_dt(dtype))
+
+
+def logspace(start, stop, num, base=10.0, dtype: Any = None) -> Tensor:
+    return jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype))
+
+
+def eye(num_rows: int, num_columns: int | None = None, dtype: Any = None) -> Tensor:
+    return jnp.eye(num_rows, num_columns, dtype=_dt(dtype, get_default_dtype()))
+
+
+def diag(x: Tensor, offset: int = 0, padding_value: float = 0) -> Tensor:
+    x = to_tensor(x)
+    out = jnp.diag(x, k=offset)
+    if padding_value != 0 and x.ndim == 1:
+        n = x.shape[0] + abs(offset)
+        mask = jnp.eye(n, k=offset, dtype=bool)
+        out = jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+    return out
+
+
+def diagflat(x: Tensor, offset: int = 0) -> Tensor:
+    return jnp.diagflat(x, k=offset)
+
+
+def tril(x: Tensor, diagonal: int = 0) -> Tensor:
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x: Tensor, diagonal: int = 0) -> Tensor:
+    return jnp.triu(x, k=diagonal)
+
+
+def tril_indices(row: int, col: int, offset: int = 0) -> Tensor:
+    return jnp.stack(jnp.tril_indices(row, k=offset, m=col))
+
+
+def triu_indices(row: int, col: int, offset: int = 0) -> Tensor:
+    return jnp.stack(jnp.triu_indices(row, k=offset, m=col))
+
+
+def meshgrid(*args: Tensor, indexing: str = "ij"):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return list(jnp.meshgrid(*args, indexing=indexing))
+
+
+def assign(x: Any, output: Tensor | None = None) -> Tensor:
+    return to_tensor(np.asarray(x) if not isinstance(x, jax.Array) else x)
+
+
+def clone(x: Tensor) -> Tensor:
+    return jnp.copy(x)
+
+
+def numel(x: Tensor) -> int:
+    return int(np.prod(x.shape)) if x.ndim else 1
+
+
+def complex(real: Tensor, imag: Tensor) -> Tensor:
+    return jax.lax.complex(jnp.asarray(real, jnp.float32), jnp.asarray(imag, jnp.float32))
+
+
+def polar(abs_: Tensor, angle: Tensor) -> Tensor:
+    return complex(abs_ * jnp.cos(angle), abs_ * jnp.sin(angle))
+
+
+def cauchy_(shape, loc=0.0, scale=1.0, key=None):
+    from ..core import rng
+    k = key if key is not None else rng.next_key()
+    return loc + scale * jnp.tan(jnp.pi * (jax.random.uniform(k, shape) - 0.5))
+
+
+def one_hot(x: Tensor, num_classes: int) -> Tensor:
+    return jax.nn.one_hot(x, num_classes)

@@ -1,0 +1,310 @@
+"""Linear algebra ops (parity: python/paddle/tensor/linalg.py, paddle.linalg).
+
+matmul is THE op on TPU: it lowers to MXU systolic-array tiles. We route every
+matmul through one wrapper so precision policy (FLAGS_matmul_precision) is
+applied uniformly — the analogue of the reference's single blas entry point
+(phi/kernels/funcs/blas/).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import flags
+from ..core.registry import register_op
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "mv", "t", "norm", "vector_norm", "matrix_norm",
+    "dist", "cross", "cholesky", "cholesky_solve", "inv", "pinv", "svd", "svdvals",
+    "qr", "eig", "eigh", "eigvals", "eigvalsh", "det", "slogdet", "solve",
+    "triangular_solve", "lstsq", "matrix_power", "matrix_rank", "lu", "lu_unpack",
+    "einsum", "tensordot", "multi_dot", "histogram", "histogramdd", "bincount",
+    "corrcoef", "cov", "matrix_exp", "householder_product", "cdist", "vecdot",
+    "ormqr",
+]
+
+
+def _precision():
+    p = flags.get_flag("matmul_precision")
+    return {"default": None, "high": jax.lax.Precision.HIGH,
+            "highest": jax.lax.Precision.HIGHEST}[p]
+
+
+@register_op("matmul", category="linalg", test_shapes=((4, 8), (8, 16)))
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """Batched matmul with optional transposes (parity: paddle.matmul,
+    reference kernel phi/kernels/impl/matmul_kernel_impl.h)."""
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y, precision=_precision())
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    return jnp.sum(x * y, axis=-1)
+
+
+def vecdot(x, y, axis=-1, name=None):
+    return jnp.sum(jnp.asarray(x) * jnp.asarray(y), axis=axis)
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+def t(input, name=None):
+    x = jnp.asarray(input)
+    return x if x.ndim < 2 else jnp.swapaxes(x, -1, -2)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = jnp.asarray(x)
+    if p is None:
+        p = "fro" if (axis is None or isinstance(axis, (list, tuple))) else 2
+    if p == "fro":
+        if axis is None:
+            return jnp.sqrt(jnp.sum(x * x))
+        return jnp.sqrt(jnp.sum(x * x, axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis,
+                                keepdims=keepdim))
+    if p == "nuc":
+        return jnp.sum(jnp.linalg.svd(x, compute_uv=False), axis=-1)
+    if axis is None:
+        x = x.ravel()
+        axis = 0
+    if isinstance(axis, (list, tuple)) and len(axis) == 2:
+        return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
+    ax = axis if isinstance(axis, int) else tuple(axis)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=ax, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=ax, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=ax, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return jnp.linalg.norm(jnp.asarray(x), ord=p, axis=tuple(axis), keepdims=keepdim)
+
+
+def dist(x, y, p=2, name=None):
+    return norm(jnp.asarray(x) - jnp.asarray(y), p=p)
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    if axis == 9:  # paddle default: first axis of size 3
+        axis = next(i for i, s in enumerate(x.shape) if s == 3)
+    return jnp.cross(x, y, axis=axis)
+
+
+def cholesky(x, upper=False, name=None):
+    L = jnp.linalg.cholesky(jnp.asarray(x))
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    y_ = jnp.asarray(y)
+    b = jnp.asarray(x)
+    L = jnp.swapaxes(y_, -1, -2) if upper else y_
+    z = jax.scipy.linalg.solve_triangular(L, b, lower=True)
+    return jax.scipy.linalg.solve_triangular(jnp.swapaxes(L, -1, -2), z, lower=False)
+
+
+def inv(x, name=None):
+    return jnp.linalg.inv(jnp.asarray(x))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return jnp.linalg.pinv(jnp.asarray(x), rtol=rcond, hermitian=hermitian)
+
+
+def svd(x, full_matrices=False, name=None):
+    return jnp.linalg.svd(jnp.asarray(x), full_matrices=full_matrices)
+
+
+def svdvals(x, name=None):
+    return jnp.linalg.svd(jnp.asarray(x), compute_uv=False)
+
+
+def qr(x, mode="reduced", name=None):
+    return jnp.linalg.qr(jnp.asarray(x), mode=mode)
+
+
+def eig(x, name=None):
+    # CPU-only in jax (same restriction as many LAPACK ops); used eagerly.
+    import numpy.linalg as nla
+    w, v = nla.eig(np.asarray(jnp.asarray(x).astype(jnp.float32)))
+    return jnp.asarray(w), jnp.asarray(v)
+
+
+def eigh(x, UPLO="L", name=None):
+    return jnp.linalg.eigh(jnp.asarray(x), UPLO=UPLO)
+
+
+def eigvals(x, name=None):
+    import numpy.linalg as nla
+    return jnp.asarray(nla.eigvals(np.asarray(jnp.asarray(x).astype(jnp.float32))))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return jnp.linalg.eigvalsh(jnp.asarray(x), UPLO=UPLO)
+
+
+def det(x, name=None):
+    return jnp.linalg.det(jnp.asarray(x))
+
+
+def slogdet(x, name=None):
+    sign, logdet = jnp.linalg.slogdet(jnp.asarray(x))
+    return jnp.stack([sign, logdet])
+
+
+def solve(x, y, name=None):
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    if y.ndim == x.ndim - 1:
+        return jnp.linalg.solve(x, y[..., None])[..., 0]
+    return jnp.linalg.solve(x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    a = jnp.asarray(x)
+    b = jnp.asarray(y)
+    return jax.scipy.linalg.solve_triangular(
+        a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(jnp.asarray(x), jnp.asarray(y), rcond=rcond)
+    return sol, res, rank, sv
+
+
+def matrix_power(x, n, name=None):
+    return jnp.linalg.matrix_power(jnp.asarray(x), n)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return jnp.linalg.matrix_rank(jnp.asarray(x), rtol=tol)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_, piv = jax.scipy.linalg.lu_factor(jnp.asarray(x))
+    piv = piv + 1  # paddle/LAPACK 1-based pivots
+    if get_infos:
+        return lu_, piv, jnp.zeros((), jnp.int32)
+    return lu_, piv
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    lu_, piv = jnp.asarray(x), jnp.asarray(y) - 1
+    m, n = lu_.shape[-2], lu_.shape[-1]
+    k = min(m, n)
+    L = jnp.tril(lu_[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_.dtype)
+    U = jnp.triu(lu_[..., :k, :])
+    perm = jnp.arange(m)
+    def body(i, p):
+        j = piv[i]
+        pi, pj = p[i], p[j]
+        return p.at[i].set(pj).at[j].set(pi)
+    perm = jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+    P = jnp.eye(m, dtype=lu_.dtype)[perm].T
+    return P, L, U
+
+
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *[jnp.asarray(o) for o in operands], precision=_precision())
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in axes)
+    return jnp.tensordot(jnp.asarray(x), jnp.asarray(y), axes=axes)
+
+
+def multi_dot(tensors, name=None):
+    return jnp.linalg.multi_dot([jnp.asarray(t) for t in tensors])
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    x = jnp.asarray(input).ravel()
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(x), jnp.max(x)
+    else:
+        lo, hi = min, max
+    h, _ = jnp.histogram(x, bins=bins, range=(lo, hi),
+                         weights=None if weight is None else jnp.asarray(weight).ravel(),
+                         density=density)
+    return h
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    h, edges = jnp.histogramdd(jnp.asarray(x), bins=bins, range=ranges,
+                               weights=weights, density=density)
+    return h, list(edges)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    return jnp.bincount(jnp.asarray(x).ravel(),
+                        weights=None if weights is None else jnp.asarray(weights).ravel(),
+                        minlength=minlength)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return jnp.corrcoef(jnp.asarray(x), rowvar=rowvar)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return jnp.cov(jnp.asarray(x), rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+def matrix_exp(x, name=None):
+    return jax.scipy.linalg.expm(jnp.asarray(x))
+
+
+def householder_product(x, tau, name=None):
+    a, t_ = jnp.asarray(x), jnp.asarray(tau)
+    m, k = a.shape[-2], t_.shape[-1]
+    def one(av, tv):
+        q = jnp.eye(m, dtype=av.dtype)
+        def body(i, q):
+            v = jnp.where(jnp.arange(m) < i, 0.0, jnp.where(jnp.arange(m) == i, 1.0, av[:, i]))
+            h = jnp.eye(m, dtype=av.dtype) - tv[i] * jnp.outer(v, v)
+            return q @ h
+        return jax.lax.fori_loop(0, k, body, q)[:, : a.shape[-1]]
+    if a.ndim == 2:
+        return one(a, t_)
+    batch = a.reshape((-1,) + a.shape[-2:])
+    tb = t_.reshape((-1, k))
+    return jax.vmap(one)(batch, tb).reshape(a.shape[:-2] + (m, a.shape[-1]))
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+    return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    q = householder_product(x, tau)
+    qt = jnp.swapaxes(q, -1, -2) if transpose else q
+    return matmul(qt, other) if left else matmul(other, qt)

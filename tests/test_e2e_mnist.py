@@ -1,0 +1,72 @@
+"""Walking-skeleton e2e: LeNet on (synthetic) MNIST with the jit TrainStep
+(parity model: the reference's MNIST convergence tests; SURVEY §7 step 2)."""
+
+import itertools
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.io import DataLoader
+from paddle_tpu.nn import functional as F
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+
+
+def test_lenet_mnist_loss_decreases(tmp_path):
+    pt.seed(42)
+    model = LeNet()
+    opt = pt.optimizer.Adam(learning_rate=2e-3, parameters=model)
+    step = pt.jit.TrainStep(model, opt, lambda out, y: F.cross_entropy(out, y))
+
+    ds = MNIST(mode="train")
+    dl = DataLoader(ds, batch_size=64, shuffle=True)
+    losses = [float(step(x, y)) for x, y in itertools.islice(dl, 40)]
+    first = np.mean(losses[:5])
+    last = np.mean(losses[-5:])
+    assert last < first * 0.8, f"no learning: {first} -> {last}"
+
+    # eval accuracy beats chance
+    model.eval()
+    es = pt.jit.EvalStep(model)
+    test = MNIST(mode="test")
+    xs, ys = test.images[:512], test.labels[:512]
+    logits = np.asarray(es(xs))
+    acc = (logits.argmax(-1) == ys).mean()
+    assert acc > 0.2, f"accuracy {acc}"
+
+    # checkpoint roundtrip mid-training
+    path = str(tmp_path / "ckpt.pdparams")
+    pt.save({"model": model.state_dict(), "opt": step.state_dict()}, path)
+    blob = pt.load(path)
+    model2 = LeNet()
+    model2.set_state_dict(blob["model"])
+    logits2 = np.asarray(pt.jit.EvalStep(model2)(xs))
+    np.testing.assert_allclose(logits, logits2, rtol=1e-5, atol=1e-5)
+
+
+def test_trainstep_updates_bn_buffers():
+    pt.seed(0)
+    model = nn.Sequential(nn.Conv2D(1, 4, 3, padding=1), nn.BatchNorm2D(4),
+                          nn.ReLU(), nn.Flatten(), nn.Linear(4 * 8 * 8, 2))
+    opt = pt.optimizer.SGD(learning_rate=0.01, parameters=model)
+    step = pt.jit.TrainStep(model, opt, lambda out, y: F.cross_entropy(out, y))
+    x = np.random.default_rng(0).standard_normal((4, 1, 8, 8)).astype(np.float32)
+    y = np.array([0, 1, 0, 1])
+    before = np.asarray(model.state_dict()["1._mean"])
+    step(x, y)
+    after = np.asarray(model.state_dict()["1._mean"])
+    assert not np.allclose(before, after)
+
+
+def test_dataloader_batching_and_prefetch():
+    from paddle_tpu.io import TensorDataset
+    xs = np.arange(100, dtype=np.float32).reshape(100, 1)
+    ys = np.arange(100)
+    ds = TensorDataset([xs, ys])
+    dl = DataLoader(ds, batch_size=32, drop_last=True)
+    batches = list(dl)
+    assert len(batches) == 3
+    assert batches[0][0].shape == (32, 1)
+    dl2 = DataLoader(ds, batch_size=32, shuffle=True, drop_last=False)
+    assert len(list(dl2)) == 4
