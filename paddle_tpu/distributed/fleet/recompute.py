@@ -1,0 +1,67 @@
+"""Activation recomputation (parity: fleet/recompute/recompute.py:108 —
+PyLayer-based checkpointing with RNG-state restore).
+
+TPU-native: jax.checkpoint (remat) — XLA re-runs the wrapped segment in the
+backward instead of storing activations; RNG correctness is automatic because
+stochastic layers draw from counter-derived keys (core/rng.py), which remat
+replays identically. Policies map paddle's selective-recompute knobs onto
+jax.checkpoint_policies.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Sequence
+
+import jax
+
+__all__ = ["recompute", "recompute_sequential", "no_recompute",
+           "RECOMPUTE_POLICIES"]
+
+RECOMPUTE_POLICIES = {
+    "full": None,  # save nothing, recompute all
+    "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    "dots_with_no_batch_dims_saveable":
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+    "everything_saveable": jax.checkpoint_policies.everything_saveable,
+}
+
+
+def recompute(function: Callable, *args, use_reentrant: bool = True,
+              policy: str | None = None, **kwargs):
+    """Run ``function(*args)`` under remat (parity: paddle
+    distributed.fleet.recompute / paddle.distributed.recompute)."""
+    pol = RECOMPUTE_POLICIES.get(policy) if isinstance(policy, str) else policy
+    return jax.checkpoint(function, policy=pol)(*args, **kwargs)
+
+
+def recompute_sequential(ctx: dict | None, functions: Sequence[Callable] | Callable,
+                         *args, **kwargs):
+    """Checkpoint a Sequential-like chain segment-by-segment (parity:
+    recompute_sequential). ``ctx`` may carry {'segments': N}."""
+    segments = (ctx or {}).get("segments", 1)
+    if callable(functions) and hasattr(functions, "_sub_layers"):
+        layers = list(functions._sub_layers.values())
+    else:
+        layers = list(functions)
+    n = len(layers)
+    per = max(1, n // max(1, segments))
+    x = args[0] if len(args) == 1 else args
+
+    def run_segment(seg, x):
+        for l in seg:
+            x = l(x)
+        return x
+
+    i = 0
+    while i < n:
+        seg = layers[i:i + per]
+        x = jax.checkpoint(functools.partial(run_segment, seg))(x)
+        i += per
+    return x
+
+
+def no_recompute(fn: Callable) -> Callable:
+    """Mark a function's outputs as saveable inside an enclosing remat."""
+    return fn

@@ -1,0 +1,8 @@
+"""Model zoo: LLM families the reference's distributed stack targets
+(PaddleNLP llama/gpt/bert + MoE configs). Vision models live in
+paddle_tpu.vision.models."""
+
+from . import bert, gpt, llama  # noqa: F401
+from .bert import BertConfig, BertForPreTraining, BertModel  # noqa: F401
+from .gpt import GPTConfig, GPTForCausalLM  # noqa: F401
+from .llama import LlamaConfig, LlamaForCausalLM, llama_3_8b, llama_tiny  # noqa: F401

@@ -1,0 +1,121 @@
+"""BERT (parity: PaddleNLP bert — the reference's DP/AMP benchmark model,
+BASELINE.md config 3)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..nn.module import Layer
+
+__all__ = ["BertConfig", "BertModel", "BertForPreTraining",
+           "BertForSequenceClassification", "bert_base", "bert_large"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: str = "float32"
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__(dtype=config.dtype)
+        self.word_embeddings = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.position_embeddings = nn.Embedding(config.max_position_embeddings,
+                                                config.hidden_size)
+        self.token_type_embeddings = nn.Embedding(config.type_vocab_size,
+                                                  config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        s = input_ids.shape[1]
+        pos = jnp.arange(s)[None, :]
+        x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            x = x + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        layer = nn.TransformerEncoderLayer(
+            config.hidden_size, config.num_attention_heads,
+            config.intermediate_size, dropout=config.hidden_dropout_prob,
+            activation=config.hidden_act,
+            attn_dropout=config.attention_probs_dropout_prob,
+            layer_norm_eps=config.layer_norm_eps)
+        self.encoder = nn.TransformerEncoder(layer, config.num_hidden_layers)
+        self.pooler_dense = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # [b, s] validity -> additive [b, 1, 1, s]
+            attention_mask = jnp.where(attention_mask[:, None, None, :] > 0,
+                                       0.0, -1e9)
+        x = self.encoder(x, attention_mask)
+        pooled = F.tanh(self.pooler_dense(x[:, 0]))
+        return x, pooled
+
+
+class BertForPreTraining(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__(dtype=config.dtype)
+        self.bert = BertModel(config)
+        self.mlm_transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.mlm_norm = nn.LayerNorm(config.hidden_size)
+        self.nsp_head = nn.Linear(config.hidden_size, 2)
+        self.config = config
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
+        mlm_logits = h @ self.bert.embeddings.word_embeddings.weight.T
+        nsp_logits = self.nsp_head(pooled)
+        return mlm_logits, nsp_logits
+
+    def loss(self, mlm_logits, nsp_logits, mlm_labels, nsp_labels):
+        mlm = F.cross_entropy(mlm_logits.reshape(-1, mlm_logits.shape[-1]),
+                              mlm_labels.reshape(-1), ignore_index=-100)
+        nsp = F.cross_entropy(nsp_logits, nsp_labels)
+        return mlm + nsp
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig, num_classes: int = 2):
+        super().__init__(dtype=config.dtype)
+        self.bert = BertModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+def bert_base(**kw):
+    return BertConfig(**kw)
+
+
+def bert_large(**kw):
+    return BertConfig(hidden_size=1024, num_hidden_layers=24,
+                      num_attention_heads=16, intermediate_size=4096, **kw)

@@ -1,0 +1,105 @@
+"""GPT-2/3 style decoder (parity: PaddleNLP gpt — the reference fleet's
+classic mp/pp test model, e.g. test/collective/fleet hybrid tests)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..nn.module import Layer
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt2_small", "gpt2_medium"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-5
+    dtype: str = "float32"
+    mp_axis: str | None = "mp"
+
+
+class GPTBlock(Layer):
+    def __init__(self, c: GPTConfig):
+        super().__init__(dtype=c.dtype)
+        mp = c.mp_axis
+        self.ln_1 = nn.LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+        self.attn_qkv = nn.Linear(c.hidden_size, 3 * c.hidden_size,
+                                  weight_spec=(None, mp))
+        self.attn_out = nn.Linear(c.hidden_size, c.hidden_size,
+                                  weight_spec=(mp, None))
+        self.ln_2 = nn.LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+        self.mlp_fc = nn.Linear(c.hidden_size, c.intermediate_size,
+                                weight_spec=(None, mp))
+        self.mlp_proj = nn.Linear(c.intermediate_size, c.hidden_size,
+                                  weight_spec=(mp, None))
+        self.dropout = nn.Dropout(c.hidden_dropout_prob)
+        self.nheads = c.num_attention_heads
+        self.attn_dropout_p = c.attention_probs_dropout_prob
+
+    def forward(self, x):
+        b, s, hdim = x.shape
+        h = self.ln_1(x)
+        qkv = self.attn_qkv(h).reshape(b, s, 3, self.nheads, hdim // self.nheads)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        a = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True,
+            dropout_p=self.attn_dropout_p if self.training else 0.0,
+            training=self.training)
+        x = x + self.dropout(self.attn_out(a.reshape(b, s, hdim)))
+        x = x + self.dropout(self.mlp_proj(F.gelu(self.mlp_fc(self.ln_2(x)))))
+        return x
+
+
+class GPTModel(Layer):
+    def __init__(self, c: GPTConfig):
+        super().__init__(dtype=c.dtype)
+        self.config = c
+        self.wte = nn.Embedding(c.vocab_size, c.hidden_size,
+                                weight_spec=(c.mp_axis, None))
+        self.wpe = nn.Embedding(c.max_position_embeddings, c.hidden_size)
+        self.drop = nn.Dropout(c.hidden_dropout_prob)
+        self.blocks = nn.LayerList([GPTBlock(c) for _ in range(c.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+
+    def forward(self, input_ids):
+        s = input_ids.shape[1]
+        x = self.wte(input_ids) + self.wpe(jnp.arange(s)[None, :])
+        x = self.drop(x)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, c: GPTConfig):
+        super().__init__(dtype=c.dtype)
+        self.transformer = GPTModel(c)
+        self.config = c
+
+    def forward(self, input_ids):
+        h = self.transformer(input_ids)
+        return h @ self.transformer.wte.weight.T  # tied lm head
+
+    def loss(self, logits, labels):
+        return F.cross_entropy(logits[:, :-1].reshape(-1, logits.shape[-1]),
+                               labels[:, 1:].reshape(-1))
+
+
+def gpt2_small(**kw):
+    return GPTConfig(**kw)
+
+
+def gpt2_medium(**kw):
+    return GPTConfig(hidden_size=1024, num_hidden_layers=24,
+                     num_attention_heads=16, intermediate_size=4096, **kw)

@@ -61,7 +61,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
         q.shape[1] >= _FLASH_MIN_SEQ
         and attn_mask is None
         and dropout_p == 0.0
-        and q.devices() and next(iter(q.devices())).platform == "tpu"
+        and jax.default_backend() == "tpu"
     )
     if use_flash:
         from ...ops.pallas.flash_attention import flash_attention as _fa

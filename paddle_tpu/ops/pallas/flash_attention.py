@@ -1,0 +1,377 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Parity contract (SURVEY §B.7, reference phi/kernels/gpu/flash_attn_kernel.cu:250
+wrapping Dao FA2): inputs [batch, seqlen, num_heads, head_dim]; outputs
+(out, softmax_lse); backward consumes (q, k, v, out, lse, d_out). Tiled
+online-softmax — no O(S^2) materialization; LSE stored for the backward.
+
+TPU mapping:
+- grid (batch*heads, q_blocks, k_blocks), k innermost: K/V blocks stream
+  HBM→VMEM via BlockSpec double-buffering while accumulators (acc, m, l)
+  persist in VMEM scratch across the k dimension — the Pallas version of
+  FA2's warp-level pipeline.
+- all matmuls hit the MXU in fp32 accumulation; inputs may be bf16.
+- causal masking by global row/col iota comparison; fully-masked blocks
+  skip compute via pl.when (the DMA still runs — block-sparse skipping via
+  PrefetchScalarGridSpec is a later optimization).
+
+The backward recomputes P per block from (q, k, lse) — the standard
+flash-bwd — with separate dq and dkv kernels so each accumulator has a
+clean grid-persistence story.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific memory spaces; absent on CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+__all__ = ["flash_attention", "flash_attention_with_lse"]
+
+_LANES = 128  # VPU lane count; scratch row-stat tiles use full lanes
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block_sizes(seq_q, seq_k, head_dim):
+    """Tuned on v5e (bench 2026-07): bq=512, bk=256 ≈ XLA-fused parity before
+    causal DMA elision; elision adds the causal ~2x."""
+    bq = 512
+    while bq > 8 and seq_q % bq:
+        bq //= 2
+    bk = 256
+    while bk > 8 and seq_k % bk:
+        bk //= 2
+    return min(bq, seq_q), min(bk, seq_k)
+
+
+# ---------------- forward ----------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+                scale, causal, bq, bk, nk):
+    j = pl.program_id(2)
+    i = pl.program_id(1)
+
+    NEG = jnp.float32(-1e30)  # finite mask value: avoids inf-inf NaN paths,
+    # saving three VPU where-passes per [bq,bk] tile vs a -inf formulation
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    run = True
+    if causal:
+        # block is live unless its first col strictly exceeds the last row
+        run = (j * bk) <= (i * bq + bq - 1)
+
+    @pl.when(run if causal else (j >= 0))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale  # [bq, d] (one scale pass)
+        k = k_ref[0].astype(jnp.float32)  # [bk, d]
+        v = v_ref[0].astype(jnp.float32)  # [bk, d]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, NEG)
+        m_prev = m_ref[:, 0]  # [bq]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_cur[:, None])  # masked entries: exp(<=-1e29) == 0
+        alpha = jnp.exp(m_prev - m_cur)
+        l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_ref[:, 0] = m_cur
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0, :, 0] = m_ref[:, 0] + jnp.log(l_safe)
+
+
+def _fwd(q, k, v, scale, causal):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    qh = jnp.moveaxis(q, 2, 1).reshape(b * h, sq, d)
+    kh = jnp.moveaxis(k, 2, 1).reshape(b * h, sk, d)
+    vh = jnp.moveaxis(v, 2, 1).reshape(b * h, sk, d)
+    bq, bk = _block_sizes(sq, sk, d)
+    # pad seq dims to block multiples
+    pq = (-sq) % bq
+    pk = (-sk) % bk
+    if pq:
+        qh = jnp.pad(qh, ((0, 0), (0, pq), (0, 0)))
+    if pk:
+        kh = jnp.pad(kh, ((0, 0), (0, pk), (0, 0)))
+        vh = jnp.pad(vh, ((0, 0), (0, pk), (0, 0)))
+    SQ, SK = sq + pq, sk + pk
+    nq, nk = SQ // bq, SK // bk
+    if pk and not causal:
+        # Padded keys would join the softmax (k=0 rows score 0, not -inf).
+        # Under the causal mask they are provably excluded when sq == sk;
+        # ragged non-causal shapes take the XLA reference path instead.
+        raise NotImplementedError(
+            "non-causal flash path requires seq_k % 128 == 0; "
+            "scaled_dot_product_attention falls back to the XLA path")
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               bq=bq, bk=bk, nk=nk)
+
+    if causal:
+        # Clamp dead (fully masked) k blocks to the last live block index:
+        # Mosaic elides the DMA when the block index is unchanged between
+        # iterations, so the upper-triangular half costs neither bandwidth
+        # nor compute (compute is skipped by pl.when in the kernel).
+        def kv_index(b_, i, j):
+            return (b_, jnp.minimum(j, (i * bq + bq - 1) // bk), 0)
+    else:
+        def kv_index(b_, i, j):
+            return (b_, j, 0)
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0)),  # q
+        pl.BlockSpec((1, bk, d), kv_index),  # k
+        pl.BlockSpec((1, bk, d), kv_index),  # v
+    ]
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, nq, nk),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b_, i, j: (b_, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, SQ, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, SQ, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            _scratch((bq, d)),
+            _scratch((bq, _LANES)),
+            _scratch((bq, _LANES)),
+        ],
+        compiler_params=None if _interpret() else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(qh, kh, vh)
+    out = out[:, :sq].reshape(b, h, sq, d)
+    lse = lse[:, :sq, 0].reshape(b, h, sq)
+    return jnp.moveaxis(out, 1, 2), lse
+
+
+def _scratch(shape):
+    if _VMEM is None:  # pragma: no cover - pallas tpu module always ships
+        raise RuntimeError("pallas TPU memory spaces unavailable")
+    return pltpu.VMEM(shape, jnp.float32)
+
+
+# ---------------- backward ----------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale, causal, bq, bk, nk):
+    j = pl.program_id(2)
+    i = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = True
+    if causal:
+        run = (j * bk) <= (i * bq + bq - 1)
+
+    @pl.when(run if causal else (j >= 0))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        delta = delta_ref[0, :, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, jnp.float32(-1e30))
+        p = jnp.exp(s - lse[:, None])  # masked: exp(-1e30 - lse) == 0
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dq_acc[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _fin():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, bq, bk, nq):
+    i = pl.program_id(2)  # q block (innermost)
+    j = pl.program_id(1)  # k block
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        run = (i * bq + bq - 1) >= (j * bk)
+
+    @pl.when(run if causal else (i >= 0))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        delta = delta_ref[0, :, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, jnp.float32(-1e30))
+        p = jnp.exp(s - lse[:, None])  # masked: exp(-1e30 - lse) == 0
+        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(i == nq - 1)
+    def _fin():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, res, g):
+    q, k, v, out, lse = res
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    do = g
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [b,sq,h]
+    qh = jnp.moveaxis(q, 2, 1).reshape(b * h, sq, d)
+    kh = jnp.moveaxis(k, 2, 1).reshape(b * h, sk, d)
+    vh = jnp.moveaxis(v, 2, 1).reshape(b * h, sk, d)
+    doh = jnp.moveaxis(do, 2, 1).reshape(b * h, sq, d)
+    lseh = lse.reshape(b * h, sq, 1)
+    deltah = jnp.moveaxis(delta, 2, 1).reshape(b * h, sq, 1)
+    bq, bk = _block_sizes(sq, sk, d)
+    nq, nk = sq // bq, sk // bk
+    common_in = [qh, kh, vh, doh, lseh, deltah]
+    if causal:
+        def kv_index(b_, i, j):  # dead k blocks re-use the last live index (no DMA)
+            return (b_, jnp.minimum(j, (i * bq + bq - 1) // bk), 0)
+
+        def q_index_kv(b_, j, i):  # dead q blocks before the diagonal
+            return (b_, jnp.maximum(i, (j * bk) // bq), 0)
+    else:
+        def kv_index(b_, i, j):
+            return (b_, j, 0)
+
+        def q_index_kv(b_, j, i):
+            return (b_, i, 0)
+    in_specs_q = [
+        pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0)),
+        pl.BlockSpec((1, bk, d), kv_index),
+        pl.BlockSpec((1, bk, d), kv_index),
+        pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0)),
+        pl.BlockSpec((1, bq, 1), lambda b_, i, j: (b_, i, 0)),
+        pl.BlockSpec((1, bq, 1), lambda b_, i, j: (b_, i, 0)),
+    ]
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk),
+        grid=(b * h, nq, nk),
+        in_specs=in_specs_q,
+        out_specs=pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[_scratch((bq, d))],
+        interpret=_interpret(),
+    )(*common_in)
+    in_specs_kv = [
+        pl.BlockSpec((1, bq, d), q_index_kv),
+        pl.BlockSpec((1, bk, d), lambda b_, j, i: (b_, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b_, j, i: (b_, j, 0)),
+        pl.BlockSpec((1, bq, d), q_index_kv),
+        pl.BlockSpec((1, bq, 1), lambda b_, j, i: (b_, i, 0) if not causal else
+                     (b_, jnp.maximum(i, (j * bk) // bq), 0)),
+        pl.BlockSpec((1, bq, 1), lambda b_, j, i: (b_, i, 0) if not causal else
+                     (b_, jnp.maximum(i, (j * bk) // bq), 0)),
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nq=nq),
+        grid=(b * h, nk, nq),
+        in_specs=in_specs_kv,
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b_, j, i: (b_, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b_, j, i: (b_, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        scratch_shapes=[_scratch((bk, d)), _scratch((bk, d))],
+        interpret=_interpret(),
+    )(*common_in)
+    dq = jnp.moveaxis(dq.reshape(b, h, sq, d), 1, 2)
+    dk = jnp.moveaxis(dk.reshape(b, h, sk, d), 1, 2)
+    dv = jnp.moveaxis(dv.reshape(b, h, sk, d), 1, 2)
+    return dq, dk, dv
+
+
+# ---------------- public API ----------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, scale, causal):
+    out, _ = _fwd(q, k, v, scale, causal)
+    return out
+
+
+def _flash_fwd(q, k, v, scale, causal):
+    out, lse = _fwd(q, k, v, scale, causal)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(scale, causal, res, g):
+    return _bwd(scale, causal, res, g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False, scale: float | None = None):
+    """Differentiable flash attention; layout [batch, seq, heads, head_dim]."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    return _flash(q, k, v, scale, causal)
+
+
+def flash_attention_with_lse(q, k, v, causal: bool = False,
+                             scale: float | None = None):
+    """Forward-only variant returning (out, lse) — the reference kernel's
+    full output contract (lse needed by ring attention)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    return _fwd(q, k, v, scale, causal)

@@ -1,0 +1,86 @@
+"""Pallas flash-attention contract tests (parity: the reference FA2 contract,
+SURVEY §B.7) — run in interpret mode on CPU."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas.flash_attention import (
+    flash_attention, flash_attention_with_lse)
+
+RNG = np.random.default_rng(7)
+
+
+def ref_attn(q, k, v, causal):
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / math.sqrt(d)
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        s = jnp.where(jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq), s, -jnp.inf)
+    p = jax.nn.softmax(s, -1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+@pytest.mark.parametrize("b,s,h,d,causal", [
+    (2, 256, 2, 64, False),
+    (2, 256, 2, 64, True),
+    (1, 128, 4, 128, True),
+    (1, 384, 1, 64, True),  # seq not a multiple of 256 -> bk fallback
+])
+def test_forward_matches_reference(b, s, h, d, causal):
+    q = jnp.asarray(RNG.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(RNG.standard_normal((b, s, h, d)), jnp.float32)
+    v = jnp.asarray(RNG.standard_normal((b, s, h, d)), jnp.float32)
+    out = flash_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_attn(q, k, v, causal)),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_gradients_match_reference():
+    b, s, h, d = 1, 256, 2, 64
+    q = jnp.asarray(RNG.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(RNG.standard_normal((b, s, h, d)), jnp.float32)
+    v = jnp.asarray(RNG.standard_normal((b, s, h, d)), jnp.float32)
+    f1 = lambda q, k, v: jnp.sum(jnp.sin(flash_attention(q, k, v, causal=True)))
+    f2 = lambda q, k, v: jnp.sum(jnp.sin(ref_attn(q, k, v, True)))
+    g1 = jax.grad(f1, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f2, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-3,
+                                   atol=1e-3, err_msg=f"d{name}")
+
+
+def test_lse_contract():
+    b, s, h, d = 1, 128, 2, 64
+    q = jnp.asarray(RNG.standard_normal((b, s, h, d)), jnp.float32)
+    out, lse = flash_attention_with_lse(q, q, q, causal=True)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, q) / math.sqrt(d)
+    scores = jnp.where(jnp.tril(jnp.ones((s, s), bool)), scores, -jnp.inf)
+    want = jax.scipy.special.logsumexp(scores, -1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(want), rtol=1e-5,
+                               atol=1e-5)
+    assert lse.shape == (b, h, s)
+
+
+def test_bf16_inputs():
+    b, s, h, d = 1, 256, 2, 64
+    q = jnp.asarray(RNG.standard_normal((b, s, h, d)), jnp.bfloat16)
+    out = flash_attention(q, q, q, causal=True)
+    assert out.dtype == jnp.bfloat16
+    want = ref_attn(q.astype(jnp.float32), q.astype(jnp.float32),
+                    q.astype(jnp.float32), True)
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(want),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_jit_and_vmap_compose():
+    b, s, h, d = 1, 128, 1, 64
+    q = jnp.asarray(RNG.standard_normal((b, s, h, d)), jnp.float32)
+    jit_out = jax.jit(lambda q: flash_attention(q, q, q, causal=True))(q)
+    np.testing.assert_allclose(np.asarray(jit_out),
+                               np.asarray(flash_attention(q, q, q, causal=True)),
+                               rtol=1e-5, atol=1e-6)

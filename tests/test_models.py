@@ -1,0 +1,127 @@
+"""Model zoo tests (parity model: reference llama decoder tests in
+test/auto_parallel/hybrid_strategy/ + vision model tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.models import llama as llama_mod
+from paddle_tpu.models.bert import BertConfig, BertForSequenceClassification
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+RNG = np.random.default_rng(3)
+
+
+def test_llama_forward_and_loss_decreases():
+    pt.seed(0)
+    cfg = llama_tiny()
+    model = LlamaForCausalLM(cfg)
+    opt = pt.optimizer.AdamW(learning_rate=1e-3, parameters=model)
+    ids = RNG.integers(0, cfg.vocab_size, (2, 64))
+    step = pt.jit.TrainStep(model, opt, lambda logits, labels: model.loss(logits, labels),
+                            n_inputs=1)
+    losses = [float(step(ids, ids)) for _ in range(15)]
+    assert losses[-1] < losses[0], losses
+    assert losses[0] < 1.2 * np.log(cfg.vocab_size)  # sane init
+
+
+def test_llama_kv_cache_decode_matches_full():
+    pt.seed(1)
+    cfg = llama_tiny()
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids = jnp.asarray(RNG.integers(0, cfg.vocab_size, (1, 12)))
+    full = model(ids)
+    # prefill + decode one-at-a-time through the cache
+    caches = model.init_kv_caches(1, 32, dtype=jnp.float32)
+    logits, caches = model(ids[:, :8], kv_caches=caches, position_offset=0)
+    np.testing.assert_allclose(np.asarray(logits[0, -1]), np.asarray(full[0, 7]),
+                               rtol=2e-2, atol=2e-3)
+    for t in range(8, 12):
+        logits, caches = model(ids[:, t:t + 1], kv_caches=caches, position_offset=t)
+        np.testing.assert_allclose(np.asarray(logits[0, 0]), np.asarray(full[0, t]),
+                                   rtol=2e-2, atol=2e-3)
+
+
+def test_llama_gqa_shapes():
+    cfg = llama_tiny()
+    assert cfg.num_key_value_heads < cfg.num_attention_heads
+    model = LlamaForCausalLM(cfg)
+    out = model(jnp.asarray(RNG.integers(0, cfg.vocab_size, (2, 16))))
+    assert out.shape == (2, 16, cfg.vocab_size)
+
+
+def test_llama_tp_specs_cover_big_weights():
+    cfg = llama_tiny()
+    model = LlamaForCausalLM(cfg)
+    specs = model.spec_dict()
+    assert specs["model.layers.0.self_attn.q_proj.weight"] == (None, "mp")
+    assert specs["model.layers.0.self_attn.o_proj.weight"] == ("mp", None)
+    assert specs["model.layers.0.mlp.gate_proj.weight"] == (None, "mp")
+    assert specs["model.layers.0.mlp.down_proj.weight"] == ("mp", None)
+    assert specs["model.embed_tokens.weight"] == ("mp", None)
+
+
+def test_gpt_trains():
+    pt.seed(2)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=128,
+                    max_position_embeddings=64, hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg)
+    opt = pt.optimizer.Adam(learning_rate=1e-3, parameters=model)
+    ids = RNG.integers(0, 256, (2, 32))
+    step = pt.jit.TrainStep(model, opt, lambda lg, lb: model.loss(lg, lb))
+    losses = [float(step(ids, ids)) for _ in range(10)]
+    assert losses[-1] < losses[0]
+
+
+def test_bert_classification_forward():
+    cfg = BertConfig(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=128,
+                     max_position_embeddings=64)
+    model = BertForSequenceClassification(cfg, num_classes=3)
+    model.eval()
+    ids = jnp.asarray(RNG.integers(0, 128, (2, 16)))
+    mask = jnp.ones((2, 16), jnp.int32)
+    out = model(ids, attention_mask=mask)
+    assert out.shape == (2, 3)
+    # padding must not change the unmasked logits
+    ids2 = jnp.concatenate([ids, jnp.zeros((2, 4), ids.dtype)], axis=1)
+    mask2 = jnp.concatenate([mask, jnp.zeros((2, 4), jnp.int32)], axis=1)
+    out2 = model(ids2, attention_mask=mask2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2), rtol=2e-2,
+                               atol=2e-3)
+
+
+def test_resnet18_forward_and_train_shape():
+    from paddle_tpu.vision.models import resnet18
+    pt.seed(3)
+    model = resnet18(num_classes=10)
+    x = RNG.standard_normal((2, 3, 32, 32)).astype(np.float32)
+    out = model(x)
+    assert out.shape == (2, 10)
+    model.eval()
+    out2 = model(x)
+    assert out2.shape == (2, 10)
+
+
+def test_rope_rotation_property():
+    # relative-position property: scores depend only on distance
+    cfg = llama_tiny()
+    cos, sin = llama_mod._rope_cache(cfg)
+    d = cfg.head_dim
+    q = jnp.asarray(RNG.standard_normal((1, 8, 1, d)), jnp.float32)
+    k = jnp.asarray(RNG.standard_normal((1, 8, 1, d)), jnp.float32)
+    qr = llama_mod.apply_rotary_pos_emb(q, cos, sin)
+    kr = llama_mod.apply_rotary_pos_emb(k, cos, sin)
+    # score(i, j) with both shifted by +2 must match
+    pos = jnp.arange(8)[None, :] + 2
+    qr2 = llama_mod.apply_rotary_pos_emb(q, cos, sin, jnp.broadcast_to(pos, (1, 8)))
+    kr2 = llama_mod.apply_rotary_pos_emb(k, cos, sin, jnp.broadcast_to(pos, (1, 8)))
+    s1 = jnp.einsum("bqhd,bkhd->bqk", qr, kr)
+    s2 = jnp.einsum("bqhd,bkhd->bqk", qr2, kr2)
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=1e-3, atol=1e-3)
