@@ -26,5 +26,14 @@ from .auto_parallel_api import (  # noqa: F401
 )
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import moe  # noqa: F401
+from . import pipeline  # noqa: F401
+from . import sequence_parallel  # noqa: F401
+from . import sharding  # noqa: F401
+from .fleet.recompute import recompute, recompute_sequential  # noqa: F401
+from .moe import MoELayer  # noqa: F401
+from .pipeline import PipelineStagedLayers, pipeline_forward  # noqa: F401
+from .sequence_parallel import ring_attention, ulysses_attention  # noqa: F401
+from .sharding import group_sharded_parallel  # noqa: F401
 
 # launch CLI: python -m paddle_tpu.distributed.launch

@@ -1,0 +1,127 @@
+"""Tensor-parallel layers (parity: fleet/layers/mpu/mp_layers.py —
+VocabParallelEmbedding:47, ColumnParallelLinear:334, RowParallelLinear:541,
+ParallelCrossEntropy:742 — and mp_ops.py collective wrappers).
+
+TPU-native: the math is the plain layer; parallelism is a weight
+PartitionSpec + activation sharding constraints, compiled by GSPMD into the
+same allreduce/allgather pattern the reference launches by hand. The
+``gather_output`` / ``input_is_parallel`` knobs become sharding constraints
+on the activations. Explicit shard_map variants of the collective ops are in
+distributed.collective for hand-scheduled code.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core import mesh as mesh_lib
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.module import Layer, Parameter
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear", "RowParallelLinear",
+           "ParallelCrossEntropy", "mark_sharding"]
+
+
+def mark_sharding(x, *spec):
+    """with_sharding_constraint against the current mesh (no-op without one)."""
+    mesh = mesh_lib.current_mesh()
+    if mesh is None:
+        return x
+    try:
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+    except ValueError:
+        return x  # outside jit with mismatched mesh
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded on mp. GSPMD turns the gather
+    into local-lookup + allreduce exactly like the reference's masked lookup
+    + mp_allreduce (mp_layers.py:47)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None, axis="mp"):
+        super().__init__()
+        init = weight_attr if callable(weight_attr) else I.Normal(0.0, 0.02)
+        self.weight = Parameter(init((num_embeddings, embedding_dim), self._dtype),
+                                spec=(axis, None))
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with output-dim sharded on mp (parity: mp_layers.py:334).
+
+    ``gather_output=True`` adds a constraint forcing the output replicated
+    (allgather); False leaves it mp-sharded for a following RowParallel.
+    """
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None, axis="mp"):
+        super().__init__()
+        self.axis = axis
+        self.gather_output = gather_output
+        init = weight_attr if callable(weight_attr) else I.XavierNormal()
+        self.weight = Parameter(init((in_features, out_features), self._dtype),
+                                spec=(None, axis))
+        if has_bias:
+            self.bias = Parameter(I.Constant(0.0)((out_features,), self._dtype),
+                                  spec=(axis,))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            y = mark_sharding(y, *([None] * y.ndim))
+        else:
+            y = mark_sharding(y, *([None] * (y.ndim - 1)), self.axis)
+        return y
+
+
+class RowParallelLinear(Layer):
+    """Linear with input-dim sharded on mp (parity: mp_layers.py:541).
+    The partial-sum allreduce the reference issues explicitly is inserted by
+    GSPMD when the output constraint is replicated."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, fuse_matmul_bias=False, mp_group=None,
+                 name=None, axis="mp"):
+        super().__init__()
+        self.axis = axis
+        self.input_is_parallel = input_is_parallel
+        init = weight_attr if callable(weight_attr) else I.XavierNormal()
+        self.weight = Parameter(init((in_features, out_features), self._dtype),
+                                spec=(axis, None))
+        if has_bias:
+            self.bias = Parameter(I.Constant(0.0)((out_features,), self._dtype))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if not self.input_is_parallel:
+            x = mark_sharding(x, *([None] * (x.ndim - 1)), self.axis)
+        y = x @ self.weight
+        y = mark_sharding(y, *([None] * y.ndim))
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+class ParallelCrossEntropy(Layer):
+    """Cross entropy over vocab-sharded logits (parity: mp_layers.py:742 /
+    c_softmax_with_cross_entropy). Under GSPMD the standard cross_entropy on
+    mp-sharded logits compiles to the same two-collective pattern (max + sum
+    over the vocab axis); this class exists for API parity."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)

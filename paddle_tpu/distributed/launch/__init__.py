@@ -1,0 +1,11 @@
+"""Distributed launcher (parity: python/paddle/distributed/launch —
+``python -m paddle_tpu.distributed.launch --nproc_per_node=N train.py``).
+
+On TPU pods the runtime launches one process per host (GKE/TPU-VM); this
+launcher covers the single-host multi-process case (CPU simulation and
+jax.distributed testing) the reference covers with its collective controller:
+it spawns N local processes with COORDINATOR_ADDRESS/PROCESS_ID env and
+aggregates logs — the TCPStore rendezvous is jax's coordinator service.
+"""
+
+from .main import launch  # noqa: F401

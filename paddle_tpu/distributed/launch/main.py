@@ -1,0 +1,86 @@
+"""Launcher implementation (parity: distributed/launch/main.py:20 launch())."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "main"]
+
+
+def launch(argv=None):
+    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    parser.add_argument("--nproc_per_node", "--nprocs", type=int, default=1)
+    parser.add_argument("--master", default="127.0.0.1:12355",
+                        help="coordinator address (host:port)")
+    parser.add_argument("--log_dir", default=None)
+    parser.add_argument("--devices", default=None,
+                        help="devices per process (cpu simulation: count)")
+    parser.add_argument("script", help="training script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    n = args.nproc_per_node
+    procs: list[subprocess.Popen] = []
+    log_files = []
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+    for rank in range(n):
+        env = dict(os.environ)
+        env.update({
+            "COORDINATOR_ADDRESS": args.master,
+            "NUM_PROCESSES": str(n),
+            "PROCESS_ID": str(rank),
+            # reference-compatible names
+            "PADDLE_TRAINERS_NUM": str(n),
+            "PADDLE_TRAINER_ID": str(rank),
+        })
+        if args.devices:
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                f" --xla_force_host_platform_device_count={args.devices}").strip()
+        stdout = None
+        if args.log_dir:
+            f = open(os.path.join(args.log_dir, f"worker.{rank}.log"), "w")
+            log_files.append(f)
+            stdout = f
+        elif rank != 0:
+            stdout = subprocess.DEVNULL
+        procs.append(subprocess.Popen(
+            [sys.executable, args.script, *args.script_args], env=env,
+            stdout=stdout, stderr=subprocess.STDOUT if stdout else None))
+
+    def _kill_all(*_):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+    signal.signal(signal.SIGTERM, _kill_all)
+    code = 0
+    try:
+        while procs:
+            for p in list(procs):
+                rc = p.poll()
+                if rc is not None:
+                    procs.remove(p)
+                    if rc != 0:
+                        if code == 0:  # keep the first real failure code,
+                            code = rc  # not the SIGTERM of siblings we kill
+                        _kill_all()
+            time.sleep(0.2)
+    finally:
+        _kill_all()
+        for f in log_files:
+            f.close()
+    return code
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

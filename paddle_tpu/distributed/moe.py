@@ -1,0 +1,215 @@
+"""Mixture-of-Experts with expert parallelism
+(parity: python/paddle/incubate/distributed/models/moe/ — MoELayer
+moe_layer.py:263, GShardGate gshard_gate.py:31, SwitchGate switch_gate.py:31,
+token dispatch via global_scatter/global_gather all-to-all
+distributed/utils/moe_utils.py:20,153 and the CUDA routing kernels
+number_count/limit_by_capacity/prune_gate_by_capacity).
+
+TPU-native design (GShard-style dense dispatch):
+- routing, capacity limiting and combine are einsums over a one-hot dispatch
+  tensor — XLA turns these into the same all-to-all the reference launches
+  explicitly when the expert dim is sharded on the 'ep'/'mp' mesh axis;
+- expert FFNs are ONE batched weight tensor [E, d_in, d_out] sharded on the
+  expert axis — the grouped GEMM the reference implements in cutlass
+  (fused_moe) is a single einsum on the MXU;
+- capacity enforcement via position-in-expert cumsum (the reference's
+  limit_by_capacity/prune_gate kernels collapse into a cumsum + mask).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core import rng
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.module import Layer, Parameter
+
+__all__ = ["MoELayer", "TopKGate", "SwitchGate", "GShardGate", "ExpertFFN",
+           "moe_dispatch_combine"]
+
+
+def _top2_gating(logits, capacity, *, second_policy="random", key=None,
+                 balance_loss_weight=1.0):
+    """GShard top-2 gating. logits: [tokens, E]. Returns (dispatch [T,E,C],
+    combine [T,E,C], aux_loss)."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    g1_idx = jnp.argmax(probs, axis=-1)
+    g1 = jnp.take_along_axis(probs, g1_idx[:, None], axis=1)[:, 0]
+    probs_wo1 = probs * (1 - jax.nn.one_hot(g1_idx, E))
+    g2_idx = jnp.argmax(probs_wo1, axis=-1)
+    g2 = jnp.take_along_axis(probs_wo1, g2_idx[:, None], axis=1)[:, 0]
+    # load-balance aux loss (GShard eq.4): E * sum_e f_e * p_e
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(g1_idx, E, dtype=jnp.float32), axis=0)
+    aux = jnp.sum(me * ce) * E * balance_loss_weight
+    # second-expert random drop (gshard: keep with prob proportional to g2)
+    if second_policy == "random":
+        k = key if key is not None else rng.next_key()
+        keep2 = jax.random.uniform(k, (T,)) < (2.0 * g2 / jnp.maximum(g1 + g2, 1e-9))
+    else:
+        keep2 = jnp.ones((T,), bool)
+    # positions within each expert, first-come-first-served, top1 before top2
+    mask1 = jax.nn.one_hot(g1_idx, E, dtype=jnp.int32)
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1  # 0-based
+    count1 = jnp.sum(mask1, axis=0)  # tokens claimed by top1 per expert
+    mask2 = jax.nn.one_hot(g2_idx, E, dtype=jnp.int32) * keep2[:, None].astype(jnp.int32)
+    pos2 = (jnp.cumsum(mask2, axis=0) * mask2 - mask2) + count1[None, :]
+    keep1 = jnp.sum(pos1 * mask1, axis=1) < capacity
+    keep2f = (jnp.sum(pos2 * mask2, axis=1) < capacity) & (jnp.sum(mask2, 1) > 0)
+    p1 = jnp.sum(pos1 * mask1, axis=1)
+    p2 = jnp.sum(pos2 * mask2, axis=1)
+    denom = jnp.maximum(g1 * keep1 + g2 * keep2f, 1e-9)
+    w1 = jnp.where(keep1, g1, 0.0) / denom
+    w2 = jnp.where(keep2f, g2, 0.0) / denom
+    disp1 = (jax.nn.one_hot(g1_idx, E, dtype=jnp.float32)[:, :, None] *
+             jax.nn.one_hot(p1, capacity, dtype=jnp.float32)[:, None, :] *
+             keep1[:, None, None])
+    disp2 = (jax.nn.one_hot(g2_idx, E, dtype=jnp.float32)[:, :, None] *
+             jax.nn.one_hot(p2, capacity, dtype=jnp.float32)[:, None, :] *
+             keep2f[:, None, None])
+    dispatch = disp1 + disp2
+    combine = disp1 * w1[:, None, None] + disp2 * w2[:, None, None]
+    return dispatch, combine, aux
+
+
+def _top1_gating(logits, capacity, *, balance_loss_weight=1.0, jitter_eps=0.0,
+                 key=None, training=True):
+    """Switch-transformer top-1 gating."""
+    T, E = logits.shape
+    if jitter_eps > 0 and training:
+        k = key if key is not None else rng.next_key()
+        logits = logits * jax.random.uniform(k, logits.shape, jnp.float32,
+                                             1 - jitter_eps, 1 + jitter_eps)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    idx = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, idx[:, None], axis=1)[:, 0]
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(idx, E, dtype=jnp.float32), axis=0)
+    aux = jnp.sum(me * ce) * E * balance_loss_weight
+    mask = jax.nn.one_hot(idx, E, dtype=jnp.int32)
+    pos = jnp.cumsum(mask, axis=0) * mask - mask
+    p = jnp.sum(pos * mask, axis=1)
+    keep = p < capacity
+    dispatch = (jax.nn.one_hot(idx, E, dtype=jnp.float32)[:, :, None] *
+                jax.nn.one_hot(p, capacity, dtype=jnp.float32)[:, None, :] *
+                keep[:, None, None])
+    combine = dispatch * gate[:, None, None]
+    return dispatch, combine, aux
+
+
+class TopKGate(Layer):
+    """Router: linear gate + top-k dispatch (base for GShard/Switch)."""
+
+    def __init__(self, d_model, num_experts, top_k=2, capacity_factor=1.25,
+                 eval_capacity_factor=2.0, balance_loss_weight=1.0,
+                 jitter_eps=0.0, name=None):
+        super().__init__()
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor
+        self.balance_loss_weight = balance_loss_weight
+        self.jitter_eps = jitter_eps
+        self.weight = Parameter(I.XavierUniform()((d_model, num_experts),
+                                                  "float32"))
+
+    def capacity(self, num_tokens):
+        f = self.capacity_factor if self.training else self.eval_capacity_factor
+        cap = int(f * num_tokens * self.top_k / self.num_experts)
+        return max(cap, 4)
+
+    def forward(self, x):
+        T = x.shape[0]
+        logits = x.astype(jnp.float32) @ self.weight
+        cap = self.capacity(T)
+        if self.top_k == 1:
+            return _top1_gating(logits, cap,
+                                balance_loss_weight=self.balance_loss_weight,
+                                jitter_eps=self.jitter_eps, training=self.training)
+        return _top2_gating(logits, cap,
+                            balance_loss_weight=self.balance_loss_weight,
+                            second_policy="random" if self.training else "all")
+
+
+class SwitchGate(TopKGate):
+    def __init__(self, d_model, num_experts, capacity_factor=1.25, **kw):
+        super().__init__(d_model, num_experts, top_k=1,
+                         capacity_factor=capacity_factor, jitter_eps=0.01, **kw)
+
+
+class GShardGate(TopKGate):
+    def __init__(self, d_model, num_experts, capacity_factor=1.25, **kw):
+        super().__init__(d_model, num_experts, top_k=2,
+                         capacity_factor=capacity_factor, **kw)
+
+
+class ExpertFFN(Layer):
+    """Batched expert FFNs: weights [E, ...] sharded on the expert axis —
+    one einsum = the reference's cutlass grouped GEMM."""
+
+    def __init__(self, num_experts, d_model, d_hidden, activation=F.silu,
+                 ep_axis="mp", gated=True):
+        super().__init__()
+        self.activation = activation
+        self.gated = gated
+        init = I.XavierNormal()
+        self.w_in = Parameter(init((num_experts, d_model, d_hidden), self._dtype),
+                              spec=(ep_axis, None, None))
+        if gated:
+            self.w_gate = Parameter(init((num_experts, d_model, d_hidden),
+                                         self._dtype), spec=(ep_axis, None, None))
+        self.w_out = Parameter(init((num_experts, d_hidden, d_model), self._dtype),
+                               spec=(ep_axis, None, None))
+
+    def forward(self, x):
+        # x: [E, C, d_model]
+        h = jnp.einsum("ecd,edh->ech", x, self.w_in)
+        if self.gated:
+            h = self.activation(jnp.einsum("ecd,edh->ech", x, self.w_gate)) * h
+        else:
+            h = self.activation(h)
+        return jnp.einsum("ech,ehd->ecd", h, self.w_out)
+
+
+def moe_dispatch_combine(x, dispatch, combine, expert_fn):
+    """Dense GShard dispatch: x [T, D], dispatch/combine [T, E, C]."""
+    expert_in = jnp.einsum("td,tec->ecd", x.astype(jnp.float32), dispatch)
+    expert_out = expert_fn(expert_in.astype(x.dtype))
+    return jnp.einsum("ecd,tec->td", expert_out.astype(jnp.float32),
+                      combine).astype(x.dtype)
+
+
+class MoELayer(Layer):
+    """Parity: paddle.incubate.distributed.models.moe.MoELayer(:263).
+
+    ``gate`` may be a TopKGate instance or a string ('gshard'|'switch'|'naive').
+    The aux (load-balance) loss accumulates in ``self.aux_loss`` each forward;
+    training code adds it to the objective (same contract as the reference).
+    """
+
+    def __init__(self, d_model, experts=None, gate="gshard", num_experts=8,
+                 d_hidden=None, recompute_interval=0, ep_axis="mp", name=None):
+        super().__init__()
+        d_hidden = d_hidden or 4 * d_model
+        if isinstance(gate, str):
+            gate = {"gshard": GShardGate, "switch": SwitchGate,
+                    "naive": SwitchGate}[gate](d_model, num_experts)
+        self.gate = gate
+        self.experts = experts if experts is not None else ExpertFFN(
+            num_experts, d_model, d_hidden, ep_axis=ep_axis)
+        self.register_buffer("aux_loss", jnp.zeros((), jnp.float32),
+                             persistable=False)
+
+    def forward(self, x):
+        shape = x.shape
+        t = x.reshape(-1, shape[-1])
+        dispatch, combine, aux = self.gate(t)
+        self.aux_loss = aux
+        out = moe_dispatch_combine(t, dispatch, combine, self.experts)
+        return out.reshape(shape)

@@ -1,0 +1,176 @@
+"""Pipeline parallelism (parity: fleet/meta_parallel/ — PipelineLayer
+pp_layers.py:257, 1F1B scheduler pipeline_parallel.py:148/455, p2p handoff
+p2p_communication.py:559; behavioral spec SURVEY §B.1).
+
+TPU-native architecture: no per-rank interpreter or message bus. The whole
+pipeline is ONE SPMD program under shard_map over the 'pp' mesh axis:
+
+- homogeneous stage layers are STACKED — params get a leading layer axis
+  sharded on pp (each device owns L/P layers, applied with lax.scan);
+- the microbatch schedule is a lax.scan over T = M + P - 1 ticks; at tick t
+  stage r computes microbatch t - r, then hands its activation to stage r+1
+  with a single ring ppermute (the p2p send/recv pair);
+- reverse pass: jax.grad differentiates through scan + ppermute, yielding
+  the mirrored backward pipeline automatically (GPipe fill-drain schedule;
+  activation memory bounded by remat of the stage body).
+
+The reference's 1F1B ordering reduces peak activation memory vs fill-drain;
+under remat the difference is one stage's activations per in-flight
+microbatch — acceptable for round 1 and marked for the scheduler upgrade.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..core import mesh as mesh_lib
+from ..nn.module import Layer, functional_call
+
+__all__ = ["pipeline_forward", "stack_layer_params", "PipelineStagedLayers"]
+
+
+def stack_layer_params(layers: Sequence[Layer]) -> dict[str, jax.Array]:
+    """Stack the path-keyed params of homogeneous layers along a new leading
+    axis: list of L layers -> {path: [L, ...]} (the PipelineLayer
+    LayerDesc-list collapses into one stacked tensor per weight)."""
+    dicts = [l.state_dict(include_non_persistable_buffer=True) for l in layers]
+    keys = dicts[0].keys()
+    for d in dicts[1:]:
+        if d.keys() != keys:
+            raise ValueError("pipeline stages must be homogeneous")
+    return {k: jnp.stack([d[k] for d in dicts]) for k in keys}
+
+
+def pipeline_forward(stacked: dict[str, jax.Array], x: jax.Array,
+                     layer_apply: Callable, *, mesh: Mesh | None = None,
+                     axis: str = "pp", num_micro: int = 1,
+                     remat: bool = True) -> jax.Array:
+    """Run x through L stacked layers pipelined over the pp axis.
+
+    stacked: {path: [L, ...]} (sharded or not — shard_map partitions by spec)
+    x: [batch, ...] global batch; split into num_micro microbatches.
+    layer_apply(params_slice, h) -> h : applies ONE layer.
+    """
+    mesh = mesh or mesh_lib.current_mesh()
+    pp = mesh_lib.axis_size(axis, mesh) if mesh else 1
+    if mesh is None or pp == 1:
+        def body(h, sl):
+            return layer_apply(sl, h), None
+        out, _ = lax.scan(body, x, stacked)
+        return out
+    if x.shape[0] % num_micro:
+        raise ValueError(f"batch {x.shape[0]} not divisible by {num_micro} microbatches")
+    mb = x.shape[0] // num_micro
+    xs = x.reshape(num_micro, mb, *x.shape[1:])
+
+    apply_one = jax.checkpoint(layer_apply) if remat else layer_apply
+
+    def stage_fn(local_params, h):
+        # local_params leaves: [L/P, ...]; scan them over the microbatch act
+        def body(carry, sl):
+            return apply_one(sl, carry), None
+        out, _ = lax.scan(body, h, local_params)
+        return out
+
+    T = num_micro + pp - 1
+    perm_fwd = [(r, (r + 1) % pp) for r in range(pp)]
+
+    def per_device(local_params, xs_local):
+        r = lax.axis_index(axis)
+        h0 = jnp.zeros((mb,) + xs_local.shape[2:], xs_local.dtype)
+        outs0 = jnp.zeros_like(xs_local)
+
+        def tick(carry, t):
+            h_in, outs = carry
+            m_idx = t - r  # microbatch this stage handles at tick t
+            valid = (m_idx >= 0) & (m_idx < num_micro)
+            # stage 0 reads from the input queue; others use the received act
+            src = lax.cond(r == 0,
+                           lambda _: lax.dynamic_index_in_dim(
+                               xs_local, jnp.clip(m_idx, 0, num_micro - 1), 0,
+                               keepdims=False),
+                           lambda _: h_in, None)
+            y = stage_fn(local_params, src)
+            y = jnp.where(valid, y, jnp.zeros_like(y))
+            # last stage banks its finished microbatch
+            outs = lax.cond(
+                (r == pp - 1) & valid,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, y, jnp.clip(m_idx, 0, num_micro - 1), 0),
+                lambda o: o, outs)
+            # hand off to the next stage (ring; stage P-1 -> 0 is ignored)
+            h_next = lax.ppermute(y, axis, perm_fwd)
+            return (h_next, outs), None
+
+        (_, outs), _ = lax.scan(tick, (h0, outs0), jnp.arange(T))
+        # broadcast final outputs from the last stage to every rank
+        outs = lax.psum(jnp.where(r == pp - 1, outs, jnp.zeros_like(outs)), axis)
+        return outs
+
+    pspec = jax.tree.map(lambda v: P(axis, *([None] * (v.ndim - 1))), stacked)
+    out = shard_map(per_device, mesh=mesh,
+                    in_specs=(pspec, P()), out_specs=P(),
+                    check_vma=False)(stacked, xs)
+    return out.reshape(x.shape[0], *out.shape[2:])
+
+
+class PipelineStagedLayers(Layer):
+    """Module owning stacked homogeneous layers, executed pipelined.
+
+    Parity: PipelineLayer(pp_layers.py:257) — but the segmentation is
+    "stack + shard leading axis" instead of per-rank layer assignment.
+
+    Example (Llama middle):
+        staged = PipelineStagedLayers([LlamaDecoderLayer(cfg) for _ in range(L)],
+                                      lambda layer, params, h: ...,)
+    """
+
+    def __init__(self, layers: Sequence[Layer], num_micro: int = 1,
+                 axis: str = "pp", remat: bool = True):
+        super().__init__()
+        # the template is used only to re-apply one layer functionally; keep
+        # it OUT of the registries so its (stage-0) weights are not duplicated
+        # as trainable params next to the stacked copies
+        object.__setattr__(self, "template", layers[0])
+        from ..nn.module import Parameter
+        param_keys = set(layers[0].param_dict())
+        stacked = stack_layer_params(layers)
+        for k, v in stacked.items():
+            name = "s__" + k.replace(".", "__")
+            spec = (axis,) + (None,) * (v.ndim - 1)
+            if k in param_keys:
+                self.add_parameter(name, Parameter(v, spec=spec))
+            else:
+                # stage buffers (BN stats, rope caches) stay buffers
+                self.register_buffer(name, v)
+        self._stacked_keys = list(stacked.keys())
+        self.num_micro = num_micro
+        self.axis = axis
+        self.remat = remat
+
+    def _stacked(self):
+        out = {}
+        for k in self._stacked_keys:
+            name = "s__" + k.replace(".", "__")
+            out[k] = (self._parameters.get(name)
+                      if name in self._parameters else self._buffers[name])
+        return out
+
+    def layer_apply(self, params_slice, h, *extra):
+        out, _ = functional_call(self.template, params_slice, h, *extra,
+                                 training=self.training)
+        return out
+
+    def forward(self, x, *extra):
+        def apply_fn(sl, h):
+            return self.layer_apply(sl, h, *extra)
+        return pipeline_forward(self._stacked(), x, apply_fn,
+                                axis=self.axis, num_micro=self.num_micro,
+                                remat=self.remat)

@@ -266,16 +266,28 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd(scale, causal, res, g):
     q, k, v, out, lse = res
-    b, sq, h, d = q.shape
-    sk = k.shape[1]
     do = g
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [b,sq,h]
+    delta = jnp.moveaxis(delta, 2, 1)  # [b,h,sq]
+    return flash_block_grads(q, k, v, do, lse, delta, scale=scale, causal=causal)
+
+
+def flash_block_grads(q, k, v, do, lse, delta, *, scale, causal):
+    """Gradient building block given precomputed row stats.
+
+    Inputs: q/do [b,sq,h,d]; k/v [b,sk,h,d]; lse/delta [b,h,sq] where lse is
+    the GLOBAL log-sum-exp of the full attention row and delta = rowsum(do *
+    out_full). Returns (dq, dk, dv) contributions of THIS k/v block — the
+    primitive ring attention's backward rotates over (SURVEY §5.7 ring plan).
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
     qh = jnp.moveaxis(q, 2, 1).reshape(b * h, sq, d)
     kh = jnp.moveaxis(k, 2, 1).reshape(b * h, sk, d)
     vh = jnp.moveaxis(v, 2, 1).reshape(b * h, sk, d)
     doh = jnp.moveaxis(do, 2, 1).reshape(b * h, sq, d)
     lseh = lse.reshape(b * h, sq, 1)
-    deltah = jnp.moveaxis(delta, 2, 1).reshape(b * h, sq, 1)
+    deltah = delta.reshape(b * h, sq, 1)
     bq, bk = _block_sizes(sq, sk, d)
     nq, nk = sq // bq, sk // bk
     common_in = [qh, kh, vh, doh, lseh, deltah]
