@@ -2,9 +2,10 @@
 (parity: distributed/checkpoint/{save_state_dict,load_state_dict}.py).
 
 Works for single-process multi-device (all shards addressable) and
-multi-process (each process writes its addressable shards; rank 0 writes the
-metadata after an implicit agreement that metadata is deterministic from the
-shardings — no gather needed, unlike the reference's NCCL-coordinated dedup).
+multi-process: each process writes its addressable shards plus a per-rank
+metadata piece; after a global barrier the coordinator merges the pieces
+into the global ``metadata.pkl`` (the file-based analogue of the reference's
+NCCL-coordinated gather/dedup in save_state_dict.py).
 """
 
 from __future__ import annotations
@@ -34,6 +35,13 @@ def _shards_of(arr: jax.Array):
         yield offset, np.asarray(shard.data)
 
 
+def _barrier(tag: str) -> None:
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+
 def save_state_dict(state_dict: dict, path: str, process_group=None,
                     coordinator_rank: int = 0) -> None:
     os.makedirs(path, exist_ok=True)
@@ -56,9 +64,28 @@ def save_state_dict(state_dict: dict, path: str, process_group=None,
             payload[f"{key}|{','.join(map(str, offset))}"] = data
         meta.state_dict_metadata[key] = shard_metas
     np.savez(os.path.join(path, fname), **payload)
+    with open(os.path.join(path, f"{rank}.meta.pkl"), "wb") as f:
+        pickle.dump(meta, f)
+    _barrier(f"ckpt_save_shards:{path}")
     if rank == coordinator_rank:
+        merged = Metadata()
+        for r in range(jax.process_count()):
+            with open(os.path.join(path, f"{r}.meta.pkl"), "rb") as f:
+                piece: Metadata = pickle.load(f)
+            merged.global_shapes.update(piece.global_shapes)
+            for li, file in piece.storage_metadata.items():
+                # replicated shards may be written by several ranks; first wins
+                merged.storage_metadata.setdefault(li, file)
+            for key, shard_metas in piece.state_dict_metadata.items():
+                have = {sm.global_offset
+                        for sm in merged.state_dict_metadata.get(key, [])}
+                merged.state_dict_metadata.setdefault(key, []).extend(
+                    sm for sm in shard_metas if sm.global_offset not in have)
         with open(os.path.join(path, "metadata.pkl"), "wb") as f:
-            pickle.dump(meta, f)
+            pickle.dump(merged, f)
+        for r in range(jax.process_count()):
+            os.remove(os.path.join(path, f"{r}.meta.pkl"))
+    _barrier(f"ckpt_save_meta:{path}")
 
 
 def _overlap(dst_off, dst_shape, src_off, src_shape):
@@ -104,6 +131,7 @@ def load_state_dict(state_dict: dict, path: str, process_group=None,
                 (s.stop if s.stop is not None else g) - (s.start or 0)
                 for s, g in zip(index, target.shape)) if index else target.shape
             buf = np.zeros(dst_shape, target.dtype)
+            covered = np.zeros(dst_shape, bool)
             for sm in saved:
                 ov = _overlap(dst_off, dst_shape, sm.global_offset, sm.local_shape)
                 if ov is None:
@@ -113,6 +141,13 @@ def load_state_dict(state_dict: dict, path: str, process_group=None,
                     meta.storage_metadata[LocalTensorIndex(key, sm.global_offset)],
                     key, sm.global_offset)
                 buf[dst_sl] = data[src_sl]
+                covered[dst_sl] = True
+            if not covered.all():
+                raise ValueError(
+                    f"checkpoint at {path!r} does not cover tensor {key!r}: "
+                    f"region offset={dst_off} shape={dst_shape} has "
+                    f"{int((~covered).sum())} uncovered elements (saved shards "
+                    f"are incomplete for this target sharding)")
             return buf
 
         if target.ndim == 0:

@@ -152,21 +152,27 @@ def all_to_all(in_tensor_or_list, out_tensor_list=None, group=None, sync_op=True
                           concat_axis=concat_axis, tiled=True)
 
 
-def send(tensor, dst=0, group=None, sync_op=True):
-    """P2P send == ppermute to a fixed destination (pipeline stage handoff).
-    Must be paired with recv on the same axis; see pipeline_parallel for the
-    ring pattern (parity: send_v2/recv_v2, p2p_communication.py)."""
+def send(tensor, dst=0, group=None, sync_op=True, src=None):
+    """P2P send as a single-pair ppermute (parity: send_v2,
+    p2p_communication.py). Under SPMD both endpoints must be named
+    statically: ``src`` defaults to the rank before ``dst`` (the pipeline
+    stage-handoff pattern). The result is ``src``'s tensor on ``dst`` and
+    zeros elsewhere. For ring patterns use :func:`shift`."""
     ax = _axis(group)
     n = lax.axis_size(ax)
-    perm = [(i, dst) for i in range(n)]
-    return lax.ppermute(tensor, ax, perm)
+    if src is None:
+        src = (dst - 1) % n
+    return lax.ppermute(tensor, ax, [(src % n, dst % n)])
 
 
-def recv(tensor, src=0, group=None, sync_op=True):
+def recv(tensor, src=0, group=None, sync_op=True, dst=None):
+    """P2P recv: the matching single-pair ppermute; ``dst`` defaults to the
+    rank after ``src``. See :func:`send`."""
     ax = _axis(group)
     n = lax.axis_size(ax)
-    perm = [(src, i) for i in range(n)]
-    return lax.ppermute(tensor, ax, perm)
+    if dst is None:
+        dst = (src + 1) % n
+    return lax.ppermute(tensor, ax, [(src % n, dst % n)])
 
 
 def shift(tensor, offset: int, group=None):

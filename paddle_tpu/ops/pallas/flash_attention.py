@@ -60,7 +60,7 @@ def _block_sizes(seq_q, seq_k, head_dim):
 # ---------------- forward ----------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-                scale, causal, bq, bk, nk):
+                scale, causal, bq, bk, nk, off, k_valid):
     j = pl.program_id(2)
     i = pl.program_id(1)
 
@@ -76,7 +76,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
     run = True
     if causal:
         # block is live unless its first col strictly exceeds the last row
-        run = (j * bk) <= (i * bq + bq - 1)
+        # (bottom-right aligned: row r sees cols <= r + off, off = sk - sq)
+        run = (j * bk) <= (i * bq + bq - 1 + off)
 
     @pl.when(run if causal else (j >= 0))
     def _compute():
@@ -85,13 +86,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         v = v_ref[0].astype(jnp.float32)  # [bk, d]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        if causal or k_valid is not None:
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         if causal:
             rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(rows >= cols, s, NEG)
+            s = jnp.where(rows + off >= cols, s, NEG)
+        if k_valid is not None:  # ragged non-causal: exclude padded keys
+            s = jnp.where(cols < k_valid, s, NEG)
         m_prev = m_ref[:, 0]  # [bq]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
-        p = jnp.exp(s - m_cur[:, None])  # masked entries: exp(<=-1e29) == 0
+        # clamp the subtracted max so fully-masked rows (m_cur == NEG, possible
+        # with bottom-right alignment when off < 0) give p == 0, not exp(0)
+        p = jnp.exp(s - jnp.maximum(m_cur, jnp.float32(-1e25))[:, None])
         alpha = jnp.exp(m_prev - m_cur)
         l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
         acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
@@ -123,15 +129,13 @@ def _fwd(q, k, v, scale, causal):
         vh = jnp.pad(vh, ((0, 0), (0, pk), (0, 0)))
     SQ, SK = sq + pq, sk + pk
     nq, nk = SQ // bq, SK // bk
-    if pk and not causal:
-        # Padded keys would join the softmax (k=0 rows score 0, not -inf).
-        # Under the causal mask they are provably excluded when sq == sk;
-        # ragged non-causal shapes take the XLA reference path instead.
-        raise NotImplementedError(
-            "non-causal flash path requires seq_k % 128 == 0; "
-            "scaled_dot_product_attention falls back to the XLA path")
+    off = sk - sq  # bottom-right causal alignment (FA2 convention)
+    # Padded keys would otherwise join the softmax (zero-filled keys score 0,
+    # not -inf). Under the causal mask they are provably excluded when
+    # off >= 0; ragged shapes get an explicit in-kernel validity mask.
+    k_valid = sk if (pk and not causal) else None
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk, nk=nk)
+                               bq=bq, bk=bk, nk=nk, off=off, k_valid=k_valid)
 
     if causal:
         # Clamp dead (fully masked) k blocks to the last live block index:
@@ -139,7 +143,8 @@ def _fwd(q, k, v, scale, causal):
         # iterations, so the upper-triangular half costs neither bandwidth
         # nor compute (compute is skipped by pl.when in the kernel).
         def kv_index(b_, i, j):
-            return (b_, jnp.minimum(j, (i * bq + bq - 1) // bk), 0)
+            last_live = jnp.maximum((i * bq + bq - 1 + off) // bk, 0)
+            return (b_, jnp.minimum(j, last_live), 0)
     else:
         def kv_index(b_, i, j):
             return (b_, j, 0)
@@ -183,7 +188,7 @@ def _scratch(shape):
 # ---------------- backward ----------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, scale, causal, bq, bk, nk):
+                   dq_acc, *, scale, causal, bq, bk, nk, off):
     j = pl.program_id(2)
     i = pl.program_id(1)
 
@@ -193,7 +198,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     run = True
     if causal:
-        run = (j * bk) <= (i * bq + bq - 1)
+        run = (j * bk) <= (i * bq + bq - 1 + off)
 
     @pl.when(run if causal else (j >= 0))
     def _compute():
@@ -208,8 +213,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         if causal:
             rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(rows >= cols, s, jnp.float32(-1e30))
-        p = jnp.exp(s - lse[:, None])  # masked: exp(-1e30 - lse) == 0
+            s = jnp.where(rows + off >= cols, s, jnp.float32(-1e30))
+        # clamped so fully-masked rows (lse == -1e30 sentinel) give p == 0
+        p = jnp.exp(s - jnp.maximum(lse, jnp.float32(-1e25))[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
@@ -222,7 +228,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, bq, bk, nq):
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, bq, bk,
+                    nq, off):
     i = pl.program_id(2)  # q block (innermost)
     j = pl.program_id(1)  # k block
 
@@ -233,7 +240,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     run = True
     if causal:
-        run = (i * bq + bq - 1) >= (j * bk)
+        run = (i * bq + bq - 1 + off) >= (j * bk)
 
     @pl.when(run if causal else (i >= 0))
     def _compute():
@@ -248,8 +255,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(rows >= cols, s, jnp.float32(-1e30))
-        p = jnp.exp(s - lse[:, None])  # masked: exp(-1e30 - lse) == 0
+            s = jnp.where(rows + off >= cols, s, jnp.float32(-1e30))
+        # clamped so fully-masked rows (lse == -1e30 sentinel) give p == 0
+        p = jnp.exp(s - jnp.maximum(lse, jnp.float32(-1e25))[:, None])
         dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -289,14 +297,32 @@ def flash_block_grads(q, k, v, do, lse, delta, *, scale, causal):
     lseh = lse.reshape(b * h, sq, 1)
     deltah = delta.reshape(b * h, sq, 1)
     bq, bk = _block_sizes(sq, sk, d)
-    nq, nk = sq // bq, sk // bk
+    off = sk - sq  # bottom-right causal alignment, matching the forward
+    # Mirror the forward's padding to block multiples. Padded q rows carry
+    # lse=+big so p == 0 there (no pollution of dk/dv); padded k rows are
+    # zero so their dq contribution is exactly zero; padded dk/dv/dq rows
+    # are sliced off below.
+    pq_ = (-sq) % bq
+    pk_ = (-sk) % bk
+    if pq_:
+        qh = jnp.pad(qh, ((0, 0), (0, pq_), (0, 0)))
+        doh = jnp.pad(doh, ((0, 0), (0, pq_), (0, 0)))
+        lseh = jnp.pad(lseh, ((0, 0), (0, pq_), (0, 0)),
+                       constant_values=jnp.float32(1e30))
+        deltah = jnp.pad(deltah, ((0, 0), (0, pq_), (0, 0)))
+    if pk_:
+        kh = jnp.pad(kh, ((0, 0), (0, pk_), (0, 0)))
+        vh = jnp.pad(vh, ((0, 0), (0, pk_), (0, 0)))
+    SQ, SK = sq + pq_, sk + pk_
+    nq, nk = SQ // bq, SK // bk
     common_in = [qh, kh, vh, doh, lseh, deltah]
     if causal:
         def kv_index(b_, i, j):  # dead k blocks re-use the last live index (no DMA)
-            return (b_, jnp.minimum(j, (i * bq + bq - 1) // bk), 0)
+            last_live = jnp.maximum((i * bq + bq - 1 + off) // bk, 0)
+            return (b_, jnp.minimum(j, last_live), 0)
 
         def q_index_kv(b_, j, i):  # dead q blocks before the diagonal
-            return (b_, jnp.maximum(i, (j * bk) // bq), 0)
+            return (b_, jnp.maximum(i, (j * bk - off) // bq), 0)
     else:
         def kv_index(b_, i, j):
             return (b_, j, 0)
@@ -313,11 +339,11 @@ def flash_block_grads(q, k, v, do, lse, delta, *, scale, causal):
     ]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk),
+                          bq=bq, bk=bk, nk=nk, off=off),
         grid=(b * h, nq, nk),
         in_specs=in_specs_q,
         out_specs=pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b * h, SQ, d), q.dtype),
         scratch_shapes=[_scratch((bq, d))],
         interpret=_interpret(),
     )(*common_in)
@@ -326,14 +352,12 @@ def flash_block_grads(q, k, v, do, lse, delta, *, scale, causal):
         pl.BlockSpec((1, bk, d), lambda b_, j, i: (b_, j, 0)),
         pl.BlockSpec((1, bk, d), lambda b_, j, i: (b_, j, 0)),
         pl.BlockSpec((1, bq, d), q_index_kv),
-        pl.BlockSpec((1, bq, 1), lambda b_, j, i: (b_, i, 0) if not causal else
-                     (b_, jnp.maximum(i, (j * bk) // bq), 0)),
-        pl.BlockSpec((1, bq, 1), lambda b_, j, i: (b_, i, 0) if not causal else
-                     (b_, jnp.maximum(i, (j * bk) // bq), 0)),
+        pl.BlockSpec((1, bq, 1), q_index_kv),
+        pl.BlockSpec((1, bq, 1), q_index_kv),
     ]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq),
+                          bq=bq, bk=bk, nq=nq, off=off),
         grid=(b * h, nk, nq),
         in_specs=in_specs_kv,
         out_specs=[
@@ -341,15 +365,15 @@ def flash_block_grads(q, k, v, do, lse, delta, *, scale, causal):
             pl.BlockSpec((1, bk, d), lambda b_, j, i: (b_, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+            jax.ShapeDtypeStruct((b * h, SK, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, SK, d), v.dtype),
         ],
         scratch_shapes=[_scratch((bk, d)), _scratch((bk, d))],
         interpret=_interpret(),
     )(*common_in)
-    dq = jnp.moveaxis(dq.reshape(b, h, sq, d), 1, 2)
-    dk = jnp.moveaxis(dk.reshape(b, h, sk, d), 1, 2)
-    dv = jnp.moveaxis(dv.reshape(b, h, sk, d), 1, 2)
+    dq = jnp.moveaxis(dq[:, :sq].reshape(b, h, sq, d), 1, 2)
+    dk = jnp.moveaxis(dk[:, :sk].reshape(b, h, sk, d), 1, 2)
+    dv = jnp.moveaxis(dv[:, :sk].reshape(b, h, sk, d), 1, 2)
     return dq, dk, dv
 
 

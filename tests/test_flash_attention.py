@@ -77,6 +77,53 @@ def test_bf16_inputs():
                                rtol=5e-2, atol=5e-2)
 
 
+@pytest.mark.parametrize("sq,sk", [(128, 256), (64, 192), (256, 128)])
+def test_causal_bottom_right_alignment(sq, sk):
+    """seq_q != seq_k causal must match the FA2 bottom-right convention
+    (the XLA reference path: tril with k=sk-sq)."""
+    b, h, d = 1, 2, 64
+    q = jnp.asarray(RNG.standard_normal((b, sq, h, d)), jnp.float32)
+    k = jnp.asarray(RNG.standard_normal((b, sk, h, d)), jnp.float32)
+    v = jnp.asarray(RNG.standard_normal((b, sk, h, d)), jnp.float32)
+    out = flash_attention(q, k, v, causal=True)
+    live = max(sq - sk, 0)  # rows < sq-sk are fully masked: ref gives NaN,
+    # flash gives zeros (the safer defined behavior)
+    np.testing.assert_allclose(np.asarray(out)[:, live:],
+                               np.asarray(ref_attn(q, k, v, True))[:, live:],
+                               rtol=1e-4, atol=1e-4)
+    if live:
+        assert np.all(np.asarray(out)[:, :live] == 0)
+    if sq <= sk:  # grads too (sq > sk has fully-masked rows: NaN in the ref)
+        g1 = jax.grad(lambda q, k, v: jnp.sum(
+            jnp.sin(flash_attention(q, k, v, causal=True))), (0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda q, k, v: jnp.sum(
+            jnp.sin(ref_attn(q, k, v, True))), (0, 1, 2))(q, k, v)
+        for a, b_, name in zip(g1, g2, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-3,
+                                       atol=1e-3, err_msg=f"d{name}")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ragged_seq_padding(causal):
+    """seq not a multiple of the minimum block (8): forward masks padded keys
+    in-kernel, backward pads to block multiples (was: silently wrong grads)."""
+    b, s, h, d = 1, 130, 2, 64
+    q = jnp.asarray(RNG.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(RNG.standard_normal((b, s, h, d)), jnp.float32)
+    v = jnp.asarray(RNG.standard_normal((b, s, h, d)), jnp.float32)
+    out = flash_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(ref_attn(q, k, v, causal)),
+                               rtol=1e-4, atol=1e-4)
+    g1 = jax.grad(lambda q, k, v: jnp.sum(
+        jnp.sin(flash_attention(q, k, v, causal=causal))), (0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: jnp.sum(
+        jnp.sin(ref_attn(q, k, v, causal))), (0, 1, 2))(q, k, v)
+    for a, b_, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-3,
+                                   atol=1e-3, err_msg=f"d{name}")
+
+
 def test_jit_and_vmap_compose():
     b, s, h, d = 1, 128, 1, 64
     q = jnp.asarray(RNG.standard_normal((b, s, h, d)), jnp.float32)
