@@ -14,9 +14,15 @@ pipeline is ONE SPMD program under shard_map over the 'pp' mesh axis:
   the mirrored backward pipeline automatically (GPipe fill-drain schedule;
   activation memory bounded by remat of the stage body).
 
-The reference's 1F1B ordering reduces peak activation memory vs fill-drain;
-under remat the difference is one stage's activations per in-flight
-microbatch — acceptable for round 1 and marked for the scheduler upgrade.
+``pipeline_forward`` keeps the forward-only GPipe schedule (inference);
+training uses ``pipeline_train_1f1b`` — a lockstep SPMD 1F1B schedule
+(parity: pipeline_parallel.py:455, behavioral spec SURVEY §B.1) where each
+tick runs one forward and one rematerialised backward per stage, so peak
+activation memory is O(pp) stage inputs instead of O(num_micro), and
+heterogeneous first/last stages (embedding source, loss sink) are expressed
+as ``first_fn``/``last_fn`` with shared-parameter gradients merged by one
+psum over the pp axis (parity: PipelineLayer shared embeddings,
+pp_layers.py:257).
 """
 
 from __future__ import annotations
@@ -33,7 +39,8 @@ from jax import shard_map
 from ..core import mesh as mesh_lib
 from ..nn.module import Layer, functional_call
 
-__all__ = ["pipeline_forward", "stack_layer_params", "PipelineStagedLayers"]
+__all__ = ["pipeline_forward", "stack_layer_params", "PipelineStagedLayers",
+           "pipeline_train_1f1b"]
 
 
 def stack_layer_params(layers: Sequence[Layer]) -> dict[str, jax.Array]:
@@ -110,15 +117,205 @@ def pipeline_forward(stacked: dict[str, jax.Array], x: jax.Array,
             return (h_next, outs), None
 
         (_, outs), _ = lax.scan(tick, (h0, outs0), jnp.arange(T))
-        # broadcast final outputs from the last stage to every rank
+        # ONE post-loop collective broadcasts the finished microbatches from
+        # the last stage to every rank (replicated output contract)
         outs = lax.psum(jnp.where(r == pp - 1, outs, jnp.zeros_like(outs)), axis)
         return outs
 
     pspec = jax.tree.map(lambda v: P(axis, *([None] * (v.ndim - 1))), stacked)
-    out = shard_map(per_device, mesh=mesh,
-                    in_specs=(pspec, P()), out_specs=P(),
-                    check_vma=False)(stacked, xs)
+    # partial-manual shard_map (manual pp, auto dp/fsdp/mp) requires jit;
+    # nested jit is inlined so this is free inside a compiled train step
+    out = jax.jit(shard_map(per_device, mesh=mesh,
+                            in_specs=(pspec, P()), out_specs=P(),
+                            axis_names=frozenset({axis}),
+                            check_vma=False))(stacked, xs)
     return out.reshape(x.shape[0], *out.shape[2:])
+
+
+def pipeline_train_1f1b(stage_params, extra_params, micro_inputs,
+                        first_fn: Callable, layer_apply: Callable,
+                        last_fn: Callable, *, mesh: Mesh | None = None,
+                        axis: str = "pp", remat: bool = True,
+                        extra_manual_axes: Sequence[str] = (),
+                        micro_in_specs=None):
+    """One pipelined forward+backward over microbatches with the 1F1B
+    schedule (parity: PipelineParallel.forward_backward_pipeline,
+    pipeline_parallel.py:455; spec SURVEY §B.1).
+
+    The whole schedule is ONE SPMD program: shard_map manual over ``axis``
+    (plus ``extra_manual_axes``, e.g. 'sep' for ring attention inside the
+    stage body); every other mesh axis (dp/fsdp/mp) stays a GSPMD auto axis,
+    so batch sharding and ZeRO/TP weight shardings compose untouched.
+
+    Schedule: T = M + 2P - 2 lockstep ticks. At tick t stage r runs the
+    forward of microbatch ``t - r`` and the backward of microbatch
+    ``t - (2P - 2 - r)`` (the classic 1F1B interleaving: the last stage
+    folds loss forward+backward into one tick, grads stream back one stage
+    per tick). Backward rematerialises the stage from its saved *input*, so
+    only O(P) stage inputs are alive — the reference's "one in-flight
+    activation per stage depth" property — vs O(M) for fill-drain GPipe.
+
+    Args:
+      stage_params: pytree with leading stacked-layer dim on every leaf,
+        sharded ``P(axis, ...)``.
+      extra_params: pytree used by ``first_fn``/``last_fn`` (embedding, final
+        norm, lm head). A param referenced by both (tied embeddings) gets its
+        two gradient contributions summed by the final psum over ``axis`` —
+        the reference's shared-embedding allreduce (pp_layers.py:257).
+      micro_inputs: pytree, every leaf ``[M, ...]`` (microbatch-major).
+      first_fn(extra, micro_in) -> h:        stage-0 source (embedding).
+      layer_apply(param_slice, h) -> h:      one stacked layer.
+      last_fn(extra, h, micro_in) -> (num, den): loss numerator/denominator
+        (sum & token count); total loss = Σnum/Σden, gradients are of the
+        total loss.
+
+    Returns (loss, d_stage_params, d_extra_params); d_stage stays sharded on
+    ``axis`` like the params, d_extra is replicated over ``axis``.
+    """
+    mesh = mesh or mesh_lib.current_mesh()
+    pp = mesh_lib.axis_size(axis, mesh) if mesh else 1
+    apply_one = jax.checkpoint(layer_apply) if remat else layer_apply
+
+    def stage_fn(local_params, h):
+        def body(carry, sl):
+            return apply_one(sl, carry), None
+        out, _ = lax.scan(body, h, local_params)
+        return out
+
+    M = jax.tree.leaves(micro_inputs)[0].shape[0]
+
+    if mesh is None or pp == 1:
+        # degenerate: plain grad-accumulation over microbatches
+        def total_loss(sp, ep):
+            def mb(carry, mi):
+                num, den = carry
+                h = first_fn(ep, mi)
+                h = stage_fn(sp, h)
+                n, d = last_fn(ep, h, mi)
+                return (num + n, den + d), None
+            (num, den), _ = lax.scan(mb, (jnp.float32(0), jnp.float32(0)),
+                                     micro_inputs)
+            return num / den
+        loss, grads = jax.value_and_grad(total_loss, argnums=(0, 1))(
+            stage_params, extra_params)
+        return loss, grads[0], grads[1]
+
+    T = M + 2 * pp - 2
+    B = 2 * pp + 1          # input ring buffer; slot B-1 is the trash slot
+    perm_fwd = [(r, (r + 1) % pp) for r in range(pp)]
+    perm_bwd = [(r, (r - 1) % pp) for r in range(pp)]
+    manual = {axis, *extra_manual_axes}
+
+    def per_device(sp_local, extra, micros):
+        r = lax.axis_index(axis)
+        m0 = jax.tree.map(lambda a: a[0], micros)
+        h_struct = jax.eval_shape(first_fn, extra, m0)
+        zero_h = jnp.zeros(h_struct.shape, h_struct.dtype)
+        zeros_sp = jax.tree.map(jnp.zeros_like, sp_local)
+        zeros_ex = jax.tree.map(jnp.zeros_like, extra)
+
+        def tick(carry, t):
+            # NO lax.cond anywhere in this body: collectives (ring-attention
+            # ppermutes in the stage, GSPMD-inserted psums for mp/dp/fsdp)
+            # must be reached by EVERY device in lockstep — stage-dependent
+            # work is expressed through masked VJP cotangents instead, so
+            # masked contributions are exactly zero without divergent control
+            # flow (the SPMD-safe formulation of the 1F1B schedule).
+            h_in, g_in, buf, gsp, gex, num_acc, den_acc = carry
+            mf = t - r
+            valid_f = (mf >= 0) & (mf < M)
+            mf_c = jnp.clip(mf, 0, M - 1)
+            mb_ = t - (2 * pp - 2 - r)
+            valid_b = (mb_ >= 0) & (mb_ < M)
+            mb_c = jnp.clip(mb_, 0, M - 1)
+            is_last = r == pp - 1
+            mi_f = jax.tree.map(lambda a: lax.dynamic_index_in_dim(
+                a, mf_c, 0, keepdims=False), micros)
+            mi_b = jax.tree.map(lambda a: lax.dynamic_index_in_dim(
+                a, mb_c, 0, keepdims=False), micros)
+
+            # ---- forward: stage 0 sources from the embedding, others from
+            # the act received over the ring
+            emb = first_fn(extra, mi_f)
+            src = jnp.where(r == 0, emb, h_in)
+            slot_f = jnp.where(valid_f, mf_c % (B - 1), B - 1)
+            buf = lax.dynamic_update_index_in_dim(buf, src, slot_f, 0)
+            y = stage_fn(sp_local, src)
+
+            # ---- backward: ONE vjp serves both roles. The last stage
+            # differentiates loss(stage(src_f)) seeded with cot_n=1; middle
+            # stages differentiate stage(saved input) seeded with the grad
+            # received from downstream (cot_y). The other cotangent is zero,
+            # so the unused path contributes exactly 0 to every gradient.
+            slot_b = mb_c % (B - 1)
+            src_saved = lax.dynamic_index_in_dim(buf, slot_b, 0,
+                                                 keepdims=False)
+            src_bwd = jnp.where(is_last, src, src_saved)
+            mi_bwd = jax.tree.map(
+                lambda a, b_: jnp.where(is_last, a, b_), mi_f, mi_b)
+
+            def composite(sp, s, ex):
+                y2 = stage_fn(sp, s)
+                n, d = last_fn(ex, y2, mi_bwd)
+                return (y2, n), d
+
+            (_, n), vjp_fn, d = jax.vjp(composite, sp_local, src_bwd, extra,
+                                        has_aux=True)
+            cot_n = jnp.where(is_last & valid_f, jnp.float32(1),
+                              jnp.float32(0))
+            cot_y = jnp.where((~is_last) & valid_b, g_in,
+                              jnp.zeros_like(g_in))
+            dsp, dsrc, dex = vjp_fn((cot_y, cot_n))
+
+            # ---- stage-0 embedding backward (masked seed => exact zeros
+            # elsewhere); shared (tied) params get both contributions summed
+            seed = jnp.where((r == 0) & valid_b, dsrc, jnp.zeros_like(dsrc))
+            _, evjp = jax.vjp(lambda ex: first_fn(ex, mi_b), extra)
+            (dex0,) = evjp(seed)
+
+            # ---- accumulate + hand off
+            gsp = jax.tree.map(jnp.add, gsp, dsp)
+            gex = jax.tree.map(lambda a, x, yy: a + x + yy, gex, dex, dex0)
+            num_acc = num_acc + jnp.where(is_last & valid_f, n, 0.0)
+            den_acc = den_acc + jnp.where(is_last & valid_f, d, 0.0)
+            y_send = jnp.where(valid_f, y, jnp.zeros_like(y))
+            h_next = lax.ppermute(y_send, axis, perm_fwd)
+            g_next = lax.ppermute(dsrc, axis, perm_bwd)
+            return (h_next, g_next, buf, gsp, gex, num_acc, den_acc), None
+
+        buf0 = jnp.zeros((B,) + h_struct.shape, h_struct.dtype)
+        carry0 = (zero_h, jnp.zeros_like(zero_h), buf0, zeros_sp, zeros_ex,
+                  jnp.float32(0), jnp.float32(0))
+        (_, _, _, gsp, gex, num, den), _ = lax.scan(tick, carry0,
+                                                    jnp.arange(T))
+        axes = tuple(manual)
+        num = lax.psum(num, axes)
+        den = lax.psum(den, axes)
+        gex = jax.tree.map(lambda a: lax.psum(a, axes), gex)
+        inv = jnp.where(den > 0, 1.0 / den, 0.0)
+        # stage grads: psum over the extra manual axes only (they stay
+        # sharded over `axis`); scale everything by 1/Σden so the gradients
+        # are of the mean loss
+        if extra_manual_axes:
+            gsp = jax.tree.map(lambda a: lax.psum(a, tuple(extra_manual_axes)),
+                               gsp)
+        gsp = jax.tree.map(lambda a: (a * inv).astype(a.dtype), gsp)
+        gex = jax.tree.map(lambda a: (a * inv).astype(a.dtype), gex)
+        return num * inv, gsp, gex
+
+    sp_spec = jax.tree.map(lambda v: P(axis, *([None] * (v.ndim - 1))),
+                           stage_params)
+    if micro_in_specs is None:
+        micro_in_specs = jax.tree.map(lambda v: P(), micro_inputs)
+    ex_spec = jax.tree.map(lambda v: P(), extra_params)
+    out_specs = (P(), sp_spec, ex_spec)
+    # partial-manual shard_map (manual pp/sep, auto dp/fsdp/mp) requires jit;
+    # nested jit is inlined so this is free inside a compiled train step
+    fn = jax.jit(shard_map(per_device, mesh=mesh,
+                           in_specs=(sp_spec, ex_spec, micro_in_specs),
+                           out_specs=out_specs, axis_names=frozenset(manual),
+                           check_vma=False))
+    return fn(stage_params, extra_params, micro_inputs)
 
 
 class PipelineStagedLayers(Layer):

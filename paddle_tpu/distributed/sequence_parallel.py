@@ -31,7 +31,31 @@ from .fleet.mp_layers import ColumnParallelLinear, RowParallelLinear, mark_shard
 
 __all__ = ["ulysses_attention", "ring_attention", "scatter_to_sequence_parallel",
            "gather_from_sequence_parallel", "ColumnSequenceParallelLinear",
-           "RowSequenceParallelLinear", "sep_reshard_qkv", "sep_reshard_out"]
+           "RowSequenceParallelLinear", "sep_reshard_qkv", "sep_reshard_out",
+           "manual_sep_region", "current_manual_sep", "ring_attention_manual"]
+
+# Trace-time flag: set while tracing code that is INSIDE a shard_map manual
+# over the sep axis (e.g. the 1F1B pipeline body), so seq-sharded-aware
+# layers (LlamaAttention) switch to ring attention + offset rope positions.
+_MANUAL_SEP: list[str | None] = [None]
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def manual_sep_region(axis: str | None):
+    """Mark the enclosed trace as running inside a manual-sep shard_map."""
+    prev = _MANUAL_SEP[0]
+    _MANUAL_SEP[0] = axis
+    try:
+        yield
+    finally:
+        _MANUAL_SEP[0] = prev
+
+
+def current_manual_sep() -> str | None:
+    return _MANUAL_SEP[0]
 
 
 # ---------- Megatron-SP annotation shims ----------
@@ -122,10 +146,26 @@ def _ring_rotate(t, axis, nsteps):
     return lax.ppermute(t, axis, perm)
 
 
+def _rep_kv(t, rep):
+    """GQA: expand kvh key/value heads to the query head count. Done
+    per-ring-step so the rotating buffers (and their backward accumulators)
+    stay at kvh heads — h/kvh less ICI traffic than pre-repeating."""
+    return t if rep == 1 else jnp.repeat(t, rep, axis=2)
+
+
+def _reduce_kv_heads(g, rep):
+    """Fold gradient heads back onto the kvh grouped heads."""
+    if rep == 1:
+        return g
+    b, s, h, d = g.shape
+    return g.reshape(b, s, h // rep, rep, d).sum(3)
+
+
 def _ring_fwd_loop(q, k, v, axis, nsteps, causal, scale):
     my = lax.axis_index(axis)
     NEG = jnp.float32(-1e30)
     b, sl, h, d = q.shape
+    rep = h // k.shape[2]
 
     def step(carry, i):
         o, lse, kb, vb = carry
@@ -136,11 +176,15 @@ def _ring_fwd_loop(q, k, v, axis, nsteps, causal, scale):
                     jnp.full((b, h, sl), NEG, jnp.float32))
 
         def do_full(_):
-            ob, lseb = flash_attention_with_lse(q, kb, vb, causal=False, scale=scale)
+            ob, lseb = flash_attention_with_lse(q, _rep_kv(kb, rep),
+                                                _rep_kv(vb, rep),
+                                                causal=False, scale=scale)
             return ob.astype(jnp.float32), lseb
 
         def do_causal(_):
-            ob, lseb = flash_attention_with_lse(q, kb, vb, causal=True, scale=scale)
+            ob, lseb = flash_attention_with_lse(q, _rep_kv(kb, rep),
+                                                _rep_kv(vb, rep),
+                                                causal=True, scale=scale)
             return ob.astype(jnp.float32), lseb
 
         if causal:
@@ -181,6 +225,7 @@ def _ring_core_bwd(axis, nsteps, causal, scale, res, do):
     from ..ops.pallas.flash_attention import flash_block_grads
     q, k, v, out, lse = res
     my = lax.axis_index(axis)
+    rep = q.shape[2] // k.shape[2]
     delta = jnp.moveaxis(
         jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1), 2, 1)
 
@@ -195,10 +240,12 @@ def _ring_core_bwd(axis, nsteps, causal, scale, res, do):
 
         def grads(causal_flag):
             def f(_):
-                a, b_, c = flash_block_grads(q, kb, vb, do, lse, delta,
+                a, b_, c = flash_block_grads(q, _rep_kv(kb, rep),
+                                             _rep_kv(vb, rep), do, lse, delta,
                                              scale=scale, causal=causal_flag)
-                return (a.astype(jnp.float32), b_.astype(jnp.float32),
-                        c.astype(jnp.float32))
+                return (a.astype(jnp.float32),
+                        _reduce_kv_heads(b_.astype(jnp.float32), rep),
+                        _reduce_kv_heads(c.astype(jnp.float32), rep))
             return f
 
         if causal:
@@ -220,6 +267,18 @@ def _ring_core_bwd(axis, nsteps, causal, scale, res, do):
 
 
 _ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
+
+
+def ring_attention_manual(q, k, v, axis: str = "sep", causal: bool = True,
+                          scale: float | None = None):
+    """Ring attention for callers ALREADY inside a shard_map manual over
+    ``axis`` (e.g. the 1F1B pipeline body): q/k/v are local seq shards
+    [b, S/P, h, d]; GQA (fewer k/v heads) is supported — k/v blocks rotate
+    at kv-head width. Public entry point for model code."""
+    import math
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    nsteps = mesh_lib.axis_size(axis)
+    return _ring_core(q, k, v, axis, nsteps, causal, scale)
 
 
 def ring_attention(q, k, v, mesh: Mesh | None = None, axis: str = "sep",

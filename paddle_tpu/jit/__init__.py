@@ -27,7 +27,8 @@ from ..core import rng as _rng
 from ..nn.module import Layer, functional_call
 from ..optimizer.optimizer import Optimizer
 
-__all__ = ["to_static", "TrainStep", "EvalStep", "not_to_static"]
+__all__ = ["to_static", "TrainStep", "EvalStep", "PipelineTrainStep",
+           "not_to_static"]
 
 
 def to_static(function=None, input_spec=None, full_graph=True, backend=None,
@@ -98,25 +99,32 @@ class TrainStep:
         self._base_key = _rng.next_key()
 
         def pure_step(params, buffers, opt_state, lr, key, *batch):
-            inputs, labels = batch[: self.n_inputs], batch[self.n_inputs:]
-
-            def loss_of(p):
-                out, new_buffers = functional_call(
-                    self.model, {**buffers, **p}, *inputs, rngs=key, training=True)
-                loss_out = self.loss_fn(out, *labels)
-                if self.has_aux:
-                    loss, aux = loss_out
-                    return loss, (aux, new_buffers)
-                return loss_out, (None, new_buffers)
-
-            (loss, (aux, new_buffers)), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(params)
+            loss, aux, grads, new_buffers = self._loss_and_grads(
+                params, buffers, key, *batch)
             new_params, new_opt_state = self.optimizer.update(
                 params, grads, opt_state, lr=lr)
             return loss, aux, new_params, new_buffers, new_opt_state
 
         donate_argnums = (0, 1, 2) if donate else ()
         self._compiled = jax.jit(pure_step, donate_argnums=donate_argnums)
+
+    def _loss_and_grads(self, params, buffers, key, *batch):
+        """Default: jax.value_and_grad of loss_fn(model(*inputs), *labels).
+        Subclasses (PipelineTrainStep) override with custom grad schedules."""
+        inputs, labels = batch[: self.n_inputs], batch[self.n_inputs:]
+
+        def loss_of(p):
+            out, new_buffers = functional_call(
+                self.model, {**buffers, **p}, *inputs, rngs=key, training=True)
+            loss_out = self.loss_fn(out, *labels)
+            if self.has_aux:
+                loss, aux = loss_out
+                return loss, (aux, new_buffers)
+            return loss_out, (None, new_buffers)
+
+        (loss, (aux, new_buffers)), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(params)
+        return loss, aux, grads, new_buffers
 
     def __call__(self, *batch):
         params = self.model.param_dict(trainable_only=True)
@@ -145,6 +153,28 @@ class TrainStep:
     def set_state_dict(self, s):
         self._opt_state = s["opt_state"]
         self._host_step = s["host_step"]
+
+
+class PipelineTrainStep(TrainStep):
+    """Train step for pipeline-parallel models (1F1B microbatch schedule).
+
+    The model must expose ``pipeline_loss_and_grads(params, buffers, *batch)
+    -> (loss, grads)`` (e.g. ``LlamaForCausalLMPipe``); the optimizer update
+    and donation semantics are inherited — forward, 1F1B backward, optimizer
+    and p2p handoffs all compile into ONE XLA program (the TPU-native
+    replacement for PipelineParallel.train_batch +
+    HybridParallelOptimizer.step, hybrid_parallel_optimizer.py:479).
+    """
+
+    def __init__(self, model: Layer, optimizer: Optimizer, **kw):
+        if not hasattr(model, "pipeline_loss_and_grads"):
+            raise TypeError("model must define pipeline_loss_and_grads")
+        super().__init__(model, optimizer, loss_fn=None, **kw)
+
+    def _loss_and_grads(self, params, buffers, key, *batch):
+        loss, grads = self.model.pipeline_loss_and_grads(params, buffers,
+                                                         *batch)
+        return loss, None, grads, buffers
 
 
 class EvalStep:

@@ -46,6 +46,11 @@ class LlamaConfig:
     # parallel axes (None disables the annotation; degrees of 1 are no-ops)
     mp_axis: str | None = "mp"
     fsdp_axis: str | None = "fsdp"
+    # pipeline / sequence parallelism (consumed by LlamaForCausalLMPipe;
+    # sep_axis also switches LlamaAttention to ring attention when tracing
+    # inside a manual-sep shard_map region)
+    pp_axis: str | None = None
+    sep_axis: str | None = None
 
     @property
     def head_dim(self):
@@ -102,6 +107,26 @@ class LlamaAttention(Layer):
         q = self.q_proj(x).reshape(b, s, h, d)
         k = self.k_proj(x).reshape(b, s, kvh, d)
         v = self.v_proj(x).reshape(b, s, kvh, d)
+        # sequence parallelism: when tracing inside a manual-sep shard_map
+        # region (the pipelined train step), x is the LOCAL seq shard —
+        # rope positions are offset by the shard start and attention runs
+        # as ring attention over the sep axis (parity: segment_parallel.py:26,
+        # here with cross-shard causal handled in LSE space).
+        from ..distributed import sequence_parallel as _sp
+        sep = cfg.sep_axis
+        if sep is not None and _sp.current_manual_sep() == sep and kv_cache is None:
+            if attn_mask is not None:
+                raise NotImplementedError(
+                    "sep ring attention is causal-only; attn_mask is not "
+                    "supported on the sequence-sharded path")
+            off = jax.lax.axis_index(sep) * s
+            pos = jnp.broadcast_to(off + jnp.arange(s)[None, :], (b, s))
+            q = apply_rotary_pos_emb(q, cos, sin, pos)
+            k = apply_rotary_pos_emb(k, cos, sin, pos)
+            # GQA k/v stay at kvh heads — ring_attention_manual repeats
+            # per-step so rotating buffers are h/kvh smaller
+            out = _sp.ring_attention_manual(q, k, v, axis=sep, causal=True)
+            return self.o_proj(out.reshape(b, s, h * d))
         if position_offset:
             pos = position_offset + jnp.arange(s)[None, :]
             pos = jnp.broadcast_to(pos, (b, s))

@@ -1,0 +1,216 @@
+"""Pipelined Llama flagship — PP (1F1B) x SEP (ring attention) x dp/fsdp/mp.
+
+Parity: PaddleNLP ``LlamaForCausalLMPipe`` + the reference's dygraph pipeline
+stack (``fleet/meta_parallel/pipeline_parallel.py:148/455`` 1F1B scheduler,
+``parallel_layers/pp_layers.py:257`` PipelineLayer segmentation with shared
+embeddings, ``p2p_communication.py:559`` stage handoff).
+
+TPU-native design: the decoder stack is STACKED along a leading layer axis
+sharded on 'pp'; the whole 1F1B microbatch schedule (forward + rematerialised
+backward + grad accumulation) is one SPMD program built by
+``pipeline_train_1f1b`` — stage handoff is a single ``ppermute`` per tick
+instead of batched isend/irecv, and the shared-embedding gradient allreduce
+is one psum over pp. dp batch sharding, fsdp (ZeRO) weight sharding and mp
+(TP) shardings ride along as GSPMD auto axes. When ``config.sep_axis`` is
+set, activations are additionally sequence-sharded over 'sep' and attention
+runs as ring attention (capability beyond the reference's SEP all-to-all).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from .. import nn
+from ..nn import functional as F
+from ..nn.module import Layer, Parameter, functional_call
+from ..core import mesh as mesh_lib
+from .llama import LlamaConfig, LlamaDecoderLayer, _rope_cache
+
+__all__ = ["LlamaForCausalLMPipe"]
+
+
+class LlamaForCausalLMPipe(Layer):
+    """Llama causal LM with the decoder stack staged over the 'pp' mesh axis.
+
+    Parameters are the flat stacked decoder weights (leading dim = layer,
+    sharded on pp) plus embedding / final norm / lm head ("extra" params that
+    live on the first/last stages; with ``tie_word_embeddings`` the embedding
+    is shared and its two gradient contributions merge in one psum).
+    """
+
+    def __init__(self, config: LlamaConfig, num_micro: int = 1):
+        super().__init__(dtype=config.dtype)
+        if config.pp_axis is None:
+            import dataclasses
+            config = dataclasses.replace(config, pp_axis="pp")
+        self.config = config
+        self.num_micro = num_micro
+        pp = mesh_lib.axis_size(config.pp_axis)
+        if config.num_hidden_layers % max(pp, 1):
+            raise ValueError(
+                f"num_hidden_layers={config.num_hidden_layers} must divide "
+                f"evenly over pp={pp} stages")
+        self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size,
+                                         weight_spec=(config.mp_axis, None))
+        self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        if not config.tie_word_embeddings:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False,
+                                     weight_spec=(None, config.mp_axis))
+        # template used for functional re-application of ONE layer; its own
+        # weights are NOT registered (the stacked copies below are the params)
+        template = LlamaDecoderLayer(config)
+        object.__setattr__(self, "template", template)
+        from ..distributed.pipeline import stack_layer_params
+        layers = [LlamaDecoderLayer(config)
+                  for _ in range(config.num_hidden_layers)]
+        stacked = stack_layer_params(layers)
+        tmpl_specs = layers[0].spec_dict()
+        self._stage_keys = []
+        for k, v in stacked.items():
+            name = "stage__" + k.replace(".", "__")
+            base = tmpl_specs.get(k) or (None,) * (v.ndim - 1)
+            self.add_parameter(name, Parameter(v, spec=(config.pp_axis, *base)))
+            self._stage_keys.append(k)
+        cos, sin = _rope_cache(config)
+        self.register_buffer("rope_cos", cos, persistable=False)
+        self.register_buffer("rope_sin", sin, persistable=False)
+
+    # ---- param split helpers ----
+
+    def _split_params(self, params: dict):
+        stage = {}
+        extra = {}
+        for k, v in params.items():
+            if k.startswith("stage__"):
+                stage[k[len("stage__"):].replace("__", ".")] = v
+            else:
+                extra[k] = v
+        return stage, extra
+
+    def _layer_apply(self, cos, sin):
+        cfg = self.config
+
+        def apply_fn(param_slice, h):
+            out, _ = functional_call(self.template, param_slice, h, cos, sin,
+                                     training=self.training)
+            return out
+        return apply_fn
+
+    def _logits(self, extra, h):
+        h = F.rms_norm(h, extra["norm.weight"], self.config.rms_norm_eps)
+        if self.config.tie_word_embeddings:
+            return h @ extra["embed_tokens.weight"].T
+        return h @ extra["lm_head.weight"]
+
+    # ---- training: 1F1B loss + grads ----
+
+    def pipeline_loss_and_grads(self, params, buffers, ids, labels,
+                                ignore_index: int = -100):
+        """Returns (loss, grads) for one global batch, scheduled 1F1B.
+
+        ids/labels: [batch, seq] int arrays (global view). Labels are
+        pre-shifted here so the per-shard loss needs no cross-shard shift
+        (seq may be sep-sharded inside).
+        """
+        from ..distributed.pipeline import pipeline_train_1f1b
+        from ..distributed import sequence_parallel as _sp
+        cfg = self.config
+        M = self.num_micro
+        b, s = ids.shape
+        if b % M:
+            raise ValueError(f"batch {b} not divisible by num_micro {M}")
+        cos, sin = buffers["rope_cos"], buffers["rope_sin"]
+        stage, extra = self._split_params(params)
+        ids_m = ids.reshape(M, b // M, s)
+        shifted = jnp.concatenate(
+            [labels[:, 1:],
+             jnp.full((b, 1), ignore_index, labels.dtype)], axis=1)
+        lab_m = shifted.reshape(M, b // M, s)
+        micros = {"ids": ids_m, "labels": lab_m}
+
+        sep = cfg.sep_axis if (cfg.sep_axis and
+                               mesh_lib.axis_size(cfg.sep_axis) > 1) else None
+        layer_apply = self._layer_apply(cos, sin)
+        if sep:
+            base_apply = layer_apply
+
+            def layer_apply(sl, h, _base=base_apply):  # noqa: F811
+                with _sp.manual_sep_region(sep):
+                    return _base(sl, h)
+
+        def first_fn(ex, mi):
+            return F.embedding(mi["ids"], ex["embed_tokens.weight"])
+
+        def last_fn(ex, h, mi):
+            logits = self._logits(ex, h).astype(jnp.float32)
+            lab = mi["labels"]
+            valid = lab != ignore_index
+            safe = jnp.where(valid, lab, 0)
+            ll = jnp.take_along_axis(jax.nn.log_softmax(logits, axis=-1),
+                                     safe[..., None], axis=-1)[..., 0]
+            num = -jnp.sum(ll * valid)
+            den = jnp.sum(valid).astype(jnp.float32)
+            return num, den
+
+        micro_specs = {"ids": P(None, None, sep) if sep else P(),
+                       "labels": P(None, None, sep) if sep else P()}
+        loss, g_stage, g_extra = pipeline_train_1f1b(
+            stage, extra, micros, first_fn, layer_apply, last_fn,
+            axis=cfg.pp_axis, remat=True,
+            extra_manual_axes=(sep,) if sep else (),
+            micro_in_specs=micro_specs)
+        grads = {("stage__" + k.replace(".", "__")): v
+                 for k, v in g_stage.items()}
+        grads.update(g_extra)
+        return loss, grads
+
+    # ---- inference forward (GPipe forward-only; no sep) ----
+
+    def forward(self, input_ids):
+        from ..distributed.pipeline import pipeline_forward
+        cos, sin = self.rope_cos, self.rope_sin
+        h = self.embed_tokens(input_ids)
+        stage = {k: getattr(self, "stage__" + k.replace(".", "__"))
+                 for k in self._stage_keys}
+        h = pipeline_forward(stage, h, self._layer_apply(cos, sin),
+                             axis=self.config.pp_axis,
+                             num_micro=self.num_micro)
+        extra = {k: v for k, v in self.param_dict().items()
+                 if not k.startswith("stage__")}
+        return self._logits(extra, h)
+
+    def loss(self, logits, labels, ignore_index=-100):
+        shift_logits = logits[:, :-1]
+        shift_labels = labels[:, 1:]
+        return F.cross_entropy(
+            shift_logits.reshape(-1, shift_logits.shape[-1]),
+            shift_labels.reshape(-1), ignore_index=ignore_index)
+
+    @classmethod
+    def from_unstacked(cls, model, num_micro: int = 1):
+        """Build a pipe model from a LlamaForCausalLM, copying weights
+        (stacking the per-layer decoder params)."""
+        cfg = model.config
+        pipe = cls(cfg, num_micro=num_micro)
+        src = model.param_dict()
+        new = {}
+        for k, v in pipe.param_dict().items():
+            if k.startswith("stage__"):
+                path = k[len("stage__"):].replace("__", ".")
+                per_layer = [src[f"model.layers.{i}.{path}"]
+                             for i in range(cfg.num_hidden_layers)]
+                new[k] = jnp.stack(per_layer)
+            elif k == "embed_tokens.weight":
+                new[k] = src["model.embed_tokens.weight"]
+            elif k == "norm.weight":
+                new[k] = src["model.norm.weight"]
+            elif k == "lm_head.weight":
+                new[k] = src["lm_head.weight"]
+            else:
+                raise KeyError(k)
+        pipe.set_state_dict(new)
+        return pipe

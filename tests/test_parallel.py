@@ -192,6 +192,28 @@ def test_ulysses_and_ring_match_reference(sep_mesh):
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-3, atol=1e-4)
 
 
+def test_ring_attention_gqa(sep_mesh):
+    """GQA ring: k/v travel at kv-head width, repeated per step — must match
+    the pre-repeated full-head reference, values and grads."""
+    from paddle_tpu.distributed.sequence_parallel import ring_attention
+    from paddle_tpu.nn.functional.attention import _xla_attention
+    b, s, h, kvh, d = 2, 128, 4, 2, 16
+    q = jnp.asarray(RNG.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(RNG.standard_normal((b, s, kvh, d)), jnp.float32)
+    v = jnp.asarray(RNG.standard_normal((b, s, kvh, d)), jnp.float32)
+    kf = jnp.repeat(k, h // kvh, axis=2)
+    vf = jnp.repeat(v, h // kvh, axis=2)
+    ref = _xla_attention(q, kf, vf, is_causal=True)
+    np.testing.assert_allclose(np.asarray(ring_attention(q, k, v, causal=True)),
+                               np.asarray(ref), rtol=1e-4, atol=1e-5)
+    gk = jax.grad(lambda k: jnp.sum(jnp.sin(
+        ring_attention(q, k, v, causal=True))))(k)
+    gk_ref = jax.grad(lambda k: jnp.sum(jnp.sin(_xla_attention(
+        q, jnp.repeat(k, h // kvh, axis=2), vf, is_causal=True))))(k)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(gk_ref),
+                               rtol=1e-3, atol=1e-4)
+
+
 def test_moe_layer_and_gates(sep_mesh):
     from paddle_tpu.distributed.moe import MoELayer
     pt.seed(4)
