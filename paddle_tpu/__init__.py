@@ -44,6 +44,15 @@ from . import metric  # noqa: E402
 from . import vision  # noqa: E402
 from . import distributed  # noqa: E402
 from . import profiler  # noqa: E402
+from . import incubate  # noqa: E402
+from . import inference  # noqa: E402
+from . import hapi  # noqa: E402
+from . import distribution  # noqa: E402
+from . import fft  # noqa: E402
+from . import signal  # noqa: E402
+from . import utils  # noqa: E402
+from .hapi import Model  # noqa: E402  (paddle.Model parity)
+from .hapi import callbacks  # noqa: E402  (paddle.callbacks parity)
 
 
 def grad(func, argnums=0, has_aux=False):

@@ -1,0 +1,10 @@
+"""Tiny helper: is any multi-device mesh active? Used to gate Pallas kernels
+(which carry no GSPMD sharding rule) onto the single-device path."""
+
+from __future__ import annotations
+
+
+def no_mesh_active() -> bool:
+    from .core import mesh as mesh_lib
+    m = mesh_lib.current_mesh()
+    return m is None or all(s == 1 for s in m.shape.values())

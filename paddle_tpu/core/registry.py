@@ -41,6 +41,12 @@ class OpInfo:
     # contract-test hints
     test_shapes: tuple = ()
     test_dtypes: tuple = ("float32",)
+    # richer contract hooks (OpTest parity, op_test.py:418):
+    # make_inputs(rng) -> tuple of positional inputs for fn_call and ref;
+    # fn_call defaults to fn — use it to pin keyword arguments so fn_call
+    # and ref share one positional signature.
+    make_inputs: Callable | None = None
+    fn_call: Callable | None = None
     extra: dict = field(default_factory=dict)
 
 
@@ -107,6 +113,34 @@ def register_op(
         return wrapper
 
     return deco
+
+
+def register_contract(
+    name: str,
+    fn: Callable,
+    ref: Callable | None,
+    make_inputs: Callable | None = None,
+    *,
+    fn_call: Callable | None = None,
+    grad_ref: bool = False,
+    category: str = "contract",
+    test_dtypes: tuple = ("float32",),
+    notes: str = "",
+):
+    """Non-decorator registration for an already-defined public op.
+
+    This is how the blanket contract manifest (``ops/contracts.py``) enrolls
+    the whole op surface: one row per op, a numpy reference with the same
+    positional signature as ``fn_call``, and an input generator. The contract
+    suite (tests/test_op_contract.py) enumerates every row — the analogue of
+    one OpTest subclass per op in test/legacy_test/."""
+    if name in _OPS and _OPS[name].ref is not None:
+        return _OPS[name]  # decorator registration already carries a ref
+    info = OpInfo(name=name, fn=fn, ref=ref, grad_ref=grad_ref,
+                  category=category, test_dtypes=test_dtypes,
+                  make_inputs=make_inputs, fn_call=fn_call or fn, notes=notes)
+    _OPS[name] = info
+    return info
 
 
 def get_op(name: str) -> OpInfo:

@@ -1,0 +1,756 @@
+"""Probability distributions (parity: python/paddle/distribution/ — ~25
+distributions, transforms, TransformedDistribution, Independent,
+kl_divergence with a registry).
+
+TPU-native: sampling uses explicit jax.random keys (the framework RNG
+stream supplies one when omitted); log_prob/entropy are jnp compositions
+that fuse under jit. Shapes follow the reference: ``batch_shape`` from
+broadcast parameters, ``sample([n])`` prepends sample dims.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng as _rng
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical", "Beta",
+    "Gamma", "Dirichlet", "Exponential", "Laplace", "LogNormal", "Gumbel",
+    "Geometric", "Cauchy", "StudentT", "Poisson", "Binomial", "Multinomial",
+    "ContinuousBernoulli", "ExponentialFamily", "Independent",
+    "TransformedDistribution", "kl_divergence", "register_kl",
+    "Transform", "AffineTransform", "ExpTransform", "SigmoidTransform",
+    "TanhTransform", "AbsTransform", "PowerTransform", "ChainTransform",
+]
+
+
+def _key(key):
+    return key if key is not None else _rng.next_key()
+
+
+def _shape(sample_shape, batch_shape):
+    return tuple(sample_shape) + tuple(batch_shape)
+
+
+class Distribution:
+    """Base (parity: distribution/distribution.py)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=(), key=None):
+        return jax.lax.stop_gradient(self.rsample(shape, key=key))
+
+    def rsample(self, shape=(), key=None):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return jnp.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return jnp.broadcast_to(self.loc, self.batch_shape)
+
+    @property
+    def variance(self):
+        return jnp.broadcast_to(self.scale ** 2, self.batch_shape)
+
+    @property
+    def stddev(self):
+        return jnp.broadcast_to(self.scale, self.batch_shape)
+
+    def rsample(self, shape=(), key=None):
+        s = _shape(shape, self.batch_shape)
+        return self.loc + self.scale * jax.random.normal(_key(key), s)
+
+    def log_prob(self, value):
+        var = self.scale ** 2
+        return (-((value - self.loc) ** 2) / (2 * var)
+                - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def cdf(self, value):
+        return 0.5 * (1 + jax.scipy.special.erf(
+            (value - self.loc) / (self.scale * math.sqrt(2))))
+
+    def entropy(self):
+        return jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self.batch_shape)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = jnp.asarray(low, jnp.float32)
+        self.high = jnp.asarray(high, jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    @property
+    def mean(self):
+        return jnp.broadcast_to((self.low + self.high) / 2, self.batch_shape)
+
+    @property
+    def variance(self):
+        return jnp.broadcast_to((self.high - self.low) ** 2 / 12,
+                                self.batch_shape)
+
+    def rsample(self, shape=(), key=None):
+        s = _shape(shape, self.batch_shape)
+        u = jax.random.uniform(_key(key), s)
+        return self.low + (self.high - self.low) * u
+
+    def log_prob(self, value):
+        inside = (value >= self.low) & (value < self.high)
+        return jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+
+    def entropy(self):
+        return jnp.broadcast_to(jnp.log(self.high - self.low),
+                                self.batch_shape)
+
+    def cdf(self, value):
+        return jnp.clip((value - self.low) / (self.high - self.low), 0, 1)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is None:
+            self.logits = jnp.asarray(logits, jnp.float32)
+            self.probs = jax.nn.sigmoid(self.logits)
+        else:
+            self.probs = jnp.asarray(probs, jnp.float32)
+            self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return self.probs * (1 - self.probs)
+
+    def sample(self, shape=(), key=None):
+        s = _shape(shape, self.batch_shape)
+        return jax.random.bernoulli(_key(key), self.probs, s).astype(
+            jnp.float32)
+
+    def log_prob(self, value):
+        v = jnp.asarray(value, jnp.float32)
+        return v * jax.nn.log_sigmoid(self.logits) + \
+            (1 - v) * jax.nn.log_sigmoid(-self.logits)
+
+    def entropy(self):
+        p = self.probs
+        return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if logits is None:
+            p = jnp.asarray(probs, jnp.float32)
+            self.logits = jnp.log(p / p.sum(-1, keepdims=True))
+        else:
+            self.logits = jax.nn.log_softmax(
+                jnp.asarray(logits, jnp.float32), axis=-1)
+        self.probs = jnp.exp(self.logits)
+        super().__init__(self.logits.shape[:-1])
+
+    def sample(self, shape=(), key=None):
+        s = _shape(shape, self.batch_shape)
+        return jax.random.categorical(_key(key), self.logits, shape=s)
+
+    def log_prob(self, value):
+        v = jnp.asarray(value, jnp.int32)
+        return jnp.take_along_axis(self.logits, v[..., None], axis=-1)[..., 0]
+
+    def entropy(self):
+        return -jnp.sum(self.probs * self.logits, axis=-1)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = jnp.asarray(alpha, jnp.float32)
+        self.beta = jnp.asarray(beta, jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        t = self.alpha + self.beta
+        return self.alpha * self.beta / (t * t * (t + 1))
+
+    def rsample(self, shape=(), key=None):
+        s = _shape(shape, self.batch_shape)
+        return jax.random.beta(_key(key), self.alpha, self.beta, s)
+
+    def log_prob(self, value):
+        return jax.scipy.stats.beta.logpdf(value, self.alpha, self.beta)
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+        a, b = self.alpha, self.beta
+        return (betaln(a, b) - (a - 1) * digamma(a) - (b - 1) * digamma(b)
+                + (a + b - 2) * digamma(a + b))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = jnp.asarray(concentration, jnp.float32)
+        self.rate = jnp.asarray(rate, jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return self.concentration / self.rate
+
+    @property
+    def variance(self):
+        return self.concentration / self.rate ** 2
+
+    def rsample(self, shape=(), key=None):
+        s = _shape(shape, self.batch_shape)
+        return jax.random.gamma(_key(key), self.concentration, s) / self.rate
+
+    def log_prob(self, value):
+        return jax.scipy.stats.gamma.logpdf(value, self.concentration,
+                                            scale=1.0 / self.rate)
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+        a = self.concentration
+        return a - jnp.log(self.rate) + gammaln(a) + (1 - a) * digamma(a)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = jnp.asarray(concentration, jnp.float32)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return self.concentration / self.concentration.sum(-1, keepdims=True)
+
+    def rsample(self, shape=(), key=None):
+        s = _shape(shape, self.batch_shape)
+        return jax.random.dirichlet(_key(key), self.concentration, s)
+
+    def log_prob(self, value):
+        return jax.scipy.stats.dirichlet.logpdf(
+            jnp.moveaxis(jnp.asarray(value), -1, 0), self.concentration)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = jnp.asarray(rate, jnp.float32)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return 1.0 / self.rate
+
+    @property
+    def variance(self):
+        return 1.0 / self.rate ** 2
+
+    def rsample(self, shape=(), key=None):
+        s = _shape(shape, self.batch_shape)
+        return jax.random.exponential(_key(key), s) / self.rate
+
+    def log_prob(self, value):
+        return jnp.log(self.rate) - self.rate * value
+
+    def entropy(self):
+        return 1.0 - jnp.log(self.rate)
+
+    def cdf(self, value):
+        return 1 - jnp.exp(-self.rate * value)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return jnp.broadcast_to(self.loc, self.batch_shape)
+
+    @property
+    def variance(self):
+        return jnp.broadcast_to(2 * self.scale ** 2, self.batch_shape)
+
+    def rsample(self, shape=(), key=None):
+        s = _shape(shape, self.batch_shape)
+        return self.loc + self.scale * jax.random.laplace(_key(key), s)
+
+    def log_prob(self, value):
+        return (-jnp.abs(value - self.loc) / self.scale
+                - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return jnp.broadcast_to(1 + jnp.log(2 * self.scale),
+                                self.batch_shape)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return jnp.exp(self.loc + self.scale ** 2 / 2)
+
+    @property
+    def variance(self):
+        return (jnp.exp(self.scale ** 2) - 1) * jnp.exp(
+            2 * self.loc + self.scale ** 2)
+
+    def rsample(self, shape=(), key=None):
+        s = _shape(shape, self.batch_shape)
+        return jnp.exp(self.loc + self.scale * jax.random.normal(_key(key), s))
+
+    def log_prob(self, value):
+        logv = jnp.log(value)
+        return (-((logv - self.loc) ** 2) / (2 * self.scale ** 2)
+                - logv - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * np.float32(np.euler_gamma)
+
+    @property
+    def variance(self):
+        return (math.pi ** 2 / 6) * self.scale ** 2
+
+    def rsample(self, shape=(), key=None):
+        s = _shape(shape, self.batch_shape)
+        return self.loc + self.scale * jax.random.gumbel(_key(key), s)
+
+    def log_prob(self, value):
+        z = (value - self.loc) / self.scale
+        return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (failures before first success)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = jnp.asarray(probs, jnp.float32)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return (1 - self.probs) / self.probs
+
+    @property
+    def variance(self):
+        return (1 - self.probs) / self.probs ** 2
+
+    def sample(self, shape=(), key=None):
+        s = _shape(shape, self.batch_shape)
+        u = jax.random.uniform(_key(key), s)
+        return jnp.floor(jnp.log1p(-u) / jnp.log1p(-self.probs))
+
+    def log_prob(self, value):
+        return value * jnp.log1p(-self.probs) + jnp.log(self.probs)
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def rsample(self, shape=(), key=None):
+        s = _shape(shape, self.batch_shape)
+        return self.loc + self.scale * jax.random.cauchy(_key(key), s)
+
+    def log_prob(self, value):
+        return jax.scipy.stats.cauchy.logpdf(value, self.loc, self.scale)
+
+    def entropy(self):
+        return jnp.broadcast_to(jnp.log(4 * math.pi * self.scale),
+                                self.batch_shape)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = jnp.asarray(df, jnp.float32)
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape,
+                                              self.scale.shape))
+
+    def rsample(self, shape=(), key=None):
+        s = _shape(shape, self.batch_shape)
+        return self.loc + self.scale * jax.random.t(_key(key), self.df, s)
+
+    def log_prob(self, value):
+        return jax.scipy.stats.t.logpdf(value, self.df, self.loc, self.scale)
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = jnp.asarray(rate, jnp.float32)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=(), key=None):
+        s = _shape(shape, self.batch_shape)
+        return jax.random.poisson(_key(key), self.rate, s).astype(jnp.float32)
+
+    def log_prob(self, value):
+        return jax.scipy.stats.poisson.logpmf(value, self.rate)
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = jnp.asarray(total_count, jnp.float32)
+        self.probs = jnp.asarray(probs, jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape,
+                                              self.probs.shape))
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1 - self.probs)
+
+    def sample(self, shape=(), key=None):
+        s = _shape(shape, self.batch_shape)
+        return jax.random.binomial(_key(key), self.total_count, self.probs,
+                                   shape=s)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        n, p = self.total_count, self.probs
+        v = jnp.asarray(value, jnp.float32)
+        return (gammaln(n + 1) - gammaln(v + 1) - gammaln(n - v + 1)
+                + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = jnp.asarray(probs, jnp.float32)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    def sample(self, shape=(), key=None):
+        s = _shape(shape, self.batch_shape)
+        draws = jax.random.categorical(
+            _key(key), jnp.log(self.probs),
+            shape=(self.total_count,) + s)
+        k = self.probs.shape[-1]
+        return jax.nn.one_hot(draws, k).sum(0)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = jnp.asarray(value, jnp.float32)
+        return (gammaln(jnp.sum(v, -1) + 1) - jnp.sum(gammaln(v + 1), -1)
+                + jnp.sum(v * jnp.log(self.probs), -1))
+
+
+class ContinuousBernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = jnp.asarray(probs, jnp.float32)
+        super().__init__(self.probs.shape)
+
+    def log_prob(self, value):
+        p = self.probs
+        logc = jnp.where(
+            jnp.abs(p - 0.5) < 1e-4, jnp.log(jnp.float32(2.0)),
+            jnp.log(2 * jnp.arctanh(1 - 2 * p) / (1 - 2 * p)))
+        return (logc + value * jnp.log(p) + (1 - value) * jnp.log1p(-p))
+
+
+ExponentialFamily = Distribution  # API alias (reference exports it)
+
+
+class Independent(Distribution):
+    """Reinterprets batch dims as event dims (parity:
+    distribution/independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = reinterpreted_batch_rank
+        bs = base.batch_shape
+        super().__init__(bs[: len(bs) - self.rank],
+                         bs[len(bs) - self.rank:] + base.event_shape)
+
+    def sample(self, shape=(), key=None):
+        return self.base.sample(shape, key=key)
+
+    def rsample(self, shape=(), key=None):
+        return self.base.rsample(shape, key=key)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        return jnp.sum(lp, axis=tuple(range(-self.rank, 0)))
+
+    def entropy(self):
+        return jnp.sum(self.base.entropy(),
+                       axis=tuple(range(-self.rank, 0)))
+
+
+# ---------------- transforms ----------------
+
+class Transform:
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def forward(self, x):
+        return self.loc + self.scale * x
+
+    def inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), jnp.shape(x))
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return jnp.exp(x)
+
+    def inverse(self, y):
+        return jnp.log(y)
+
+    def forward_log_det_jacobian(self, x):
+        return x
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def forward_log_det_jacobian(self, x):
+        return jax.nn.log_sigmoid(x) + jax.nn.log_sigmoid(-x)
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return jnp.tanh(x)
+
+    def inverse(self, y):
+        return jnp.arctanh(y)
+
+    def forward_log_det_jacobian(self, x):
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class AbsTransform(Transform):
+    def forward(self, x):
+        return jnp.abs(x)
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = jnp.asarray(power, jnp.float32)
+
+    def forward(self, x):
+        return jnp.power(x, self.power)
+
+    def inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        ld = 0.0
+        for t in self.transforms:
+            ld = ld + t.forward_log_det_jacobian(x)
+            x = t.forward(x)
+        return ld
+
+
+class TransformedDistribution(Distribution):
+    """Parity: distribution/transformed_distribution.py."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transform = (transforms[0] if len(transforms) == 1
+                          else ChainTransform(transforms))
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def rsample(self, shape=(), key=None):
+        return self.transform.forward(self.base.rsample(shape, key=key))
+
+    def sample(self, shape=(), key=None):
+        return self.transform.forward(self.base.sample(shape, key=key))
+
+    def log_prob(self, value):
+        x = self.transform.inverse(value)
+        return (self.base.log_prob(x)
+                - self.transform.forward_log_det_jacobian(x))
+
+
+# ---------------- KL divergence registry ----------------
+
+_KL_REGISTRY: dict = {}
+
+
+def register_kl(type_p, type_q):
+    """Parity: distribution/kl.py register_kl decorator."""
+
+    def deco(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    for (tp, tq), fn in _KL_REGISTRY.items():
+        if isinstance(p, tp) and isinstance(q, tq):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p, q):
+    return jnp.sum(p.probs * (p.logits - q.logits), axis=-1)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p, q):
+    a = p.probs * (jnp.log(p.probs) - jnp.log(q.probs))
+    b = (1 - p.probs) * (jnp.log1p(-p.probs) - jnp.log1p(-q.probs))
+    return a + b
+
+
+@register_kl(Uniform, Uniform)
+def _kl_unif_unif(p, q):
+    return jnp.log((q.high - q.low) / (p.high - p.low))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exp_exp(p, q):
+    r = q.rate / p.rate
+    return jnp.log(p.rate) - jnp.log(q.rate) + r - 1
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    from jax.scipy.special import digamma, gammaln
+    return ((p.concentration - q.concentration) * digamma(p.concentration)
+            - gammaln(p.concentration) + gammaln(q.concentration)
+            + q.concentration * (jnp.log(p.rate) - jnp.log(q.rate))
+            + p.concentration * (q.rate / p.rate - 1))

@@ -1,0 +1,206 @@
+"""hapi — the Keras-like high-level API (parity: python/paddle/hapi/
+model.py — Model.prepare/fit/evaluate/predict/save/load/summary :1750, and
+callbacks.py).
+
+TPU-native: fit() drives ONE compiled TrainStep (forward+backward+optimizer
+in a single XLA program) instead of the reference's per-op dygraph loop;
+evaluate/predict reuse the compiled EvalStep. Everything else — callbacks,
+metrics, checkpointing — is the same orchestration surface.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import optimizer as _opt
+from ..framework.io import load as _load, save as _save
+from ..jit import EvalStep, TrainStep
+from ..metric import Metric
+from ..nn.module import Layer
+from . import callbacks as callbacks  # noqa: F401  (paddle.callbacks parity)
+from .callbacks import CallbackList, ProgBarLogger
+
+__all__ = ["Model", "callbacks"]
+
+
+class Model:
+    """Parity: paddle.Model (hapi/model.py)."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._train_step = None
+        self._eval_step = None
+
+    # ---- configuration ----
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        ms = metrics or []
+        self._metrics = ms if isinstance(ms, (list, tuple)) else [ms]
+        if optimizer is not None and loss is not None:
+            self._train_step = TrainStep(self.network, optimizer,
+                                         lambda out, y: self._loss(out, y))
+        self._eval_step = EvalStep(self.network)
+
+    # ---- training ----
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        from ..io.dataloader import DataLoader
+        loader = train_data
+        if not isinstance(train_data, DataLoader):
+            loader = DataLoader(train_data, batch_size=batch_size,
+                                shuffle=shuffle, drop_last=drop_last,
+                                num_workers=num_workers)
+        eval_loader = eval_data
+        if eval_data is not None and not isinstance(eval_data, DataLoader):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size)
+        cbs = list(callbacks or [])
+        if verbose and not any(isinstance(c, ProgBarLogger) for c in cbs):
+            cbs.insert(0, ProgBarLogger(log_freq=log_freq, verbose=verbose))
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cblist = CallbackList(cbs, model=self,
+                              params={"epochs": epochs, "steps": steps,
+                                      "verbose": verbose})
+        cblist.on_train_begin()
+        history = {"loss": []}
+        for epoch in range(epochs):
+            self.network.train()
+            cblist.on_epoch_begin(epoch)
+            last_loss = None
+            for step, batch in enumerate(loader):
+                cblist.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                loss = self._train_step(*inputs, *labels)
+                last_loss = float(loss)
+                cblist.on_train_batch_end(step, {"loss": last_loss})
+            logs = {"loss": last_loss}
+            history["loss"].append(last_loss)
+            if save_dir and epoch % save_freq == 0:
+                self.save(os.path.join(save_dir, str(epoch)))
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          _callbacks=cblist)
+                logs.update(eval_logs)
+            cblist.on_epoch_end(epoch, logs)
+            if cblist.stop_training:
+                break
+        cblist.on_train_end({"loss": last_loss})
+        if save_dir:
+            self.save(os.path.join(save_dir, "final"))
+        return history
+
+    def _split_batch(self, batch):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return batch[:-1], batch[-1:]
+        return (batch,), ()
+
+    # ---- evaluation / prediction ----
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, _callbacks=None):
+        from ..io.dataloader import DataLoader
+        loader = eval_data
+        if not isinstance(eval_data, DataLoader):
+            loader = DataLoader(eval_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        self.network.eval()
+        if self._eval_step is None:
+            self._eval_step = EvalStep(self.network)
+        for m in self._metrics:
+            m.reset()
+        cblist = _callbacks or CallbackList(list(callbacks or []), model=self,
+                                            params={"verbose": verbose})
+        cblist.on_eval_begin()
+        losses = []
+        for step, batch in enumerate(loader):
+            inputs, labels = self._split_batch(batch)
+            out = self._eval_step(*inputs)
+            if self._loss is not None and labels:
+                losses.append(float(self._loss(out, *labels)))
+            for m in self._metrics:
+                m.update(m.compute(out, *labels))
+            cblist.on_eval_batch_end(step)
+        logs = {}
+        if losses:
+            logs["eval_loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            res = m.accumulate()
+            name = m.name() if callable(getattr(m, "name", None)) else str(m)
+            if isinstance(name, (list, tuple)):
+                for n, r in zip(name, np.atleast_1d(res)):
+                    logs[n] = float(r)
+            else:
+                logs[name] = (float(res) if np.ndim(res) == 0
+                              else float(np.asarray(res).ravel()[0]))
+        cblist.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        from ..io.dataloader import DataLoader
+        loader = test_data
+        if not isinstance(test_data, DataLoader):
+            loader = DataLoader(test_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        self.network.eval()
+        if self._eval_step is None:
+            self._eval_step = EvalStep(self.network)
+        outs = []
+        for batch in loader:
+            if isinstance(batch, (list, tuple)):
+                # trailing element is the label for (x, ..., y) datasets;
+                # single-element batches are pure inputs
+                inputs = tuple(batch) if len(batch) == 1 else tuple(batch[:-1])
+            else:
+                inputs = (batch,)
+            outs.append(np.asarray(self._eval_step(*inputs)))
+        if stack_outputs:
+            return [np.concatenate(outs, axis=0)]
+        return [outs]
+
+    # ---- persistence / introspection ----
+
+    def save(self, path: str, training: bool = True):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._train_step is not None:
+            _save(self._train_step.state_dict(), path + ".pdopt")
+
+    def load(self, path: str, skip_mismatch: bool = False, reset_optimizer: bool = False):
+        self.network.set_state_dict(_load(path + ".pdparams"))
+        if not reset_optimizer and self._train_step is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._train_step.set_state_dict(_load(path + ".pdopt"))
+
+    def parameters(self):
+        return list(self.network.param_dict().values())
+
+    def summary(self, input_size=None, dtype=None):
+        """Parity: hapi summary — parameter table + totals."""
+        rows = []
+        total = 0
+        trainable = 0
+        params = self.network.param_dict()
+        train_set = set(self.network.param_dict(trainable_only=True))
+        for k, v in params.items():
+            n = int(np.prod(v.shape))
+            total += n
+            if k in train_set:
+                trainable += n
+            rows.append((k, tuple(v.shape), n))
+        width = max((len(r[0]) for r in rows), default=20) + 2
+        lines = [f"{'Layer (param)':<{width}}{'Shape':<20}{'Param #':>12}"]
+        lines += [f"{k:<{width}}{str(s):<20}{n:>12,}" for k, s, n in rows]
+        lines.append(f"Total params: {total:,}")
+        lines.append(f"Trainable params: {trainable:,}")
+        print("\n".join(lines))
+        return {"total_params": total, "trainable_params": trainable}

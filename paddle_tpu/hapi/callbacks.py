@@ -1,0 +1,194 @@
+"""Training callbacks (parity: python/paddle/hapi/callbacks.py —
+ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler, VisualDL; the
+VisualDL writer becomes a CSV/JSONL history logger, TensorBoard being the
+TPU-native visualizer via jax.profiler)."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
+           "LRScheduler", "HistoryLogger", "CallbackList"]
+
+
+class Callback:
+    """Base callback: hooks mirror hapi/callbacks.py:Callback."""
+
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks, model=None, params=None):
+        self.callbacks = list(callbacks)
+        for c in self.callbacks:
+            if model is not None:
+                c.set_model(model)
+            c.set_params(params or {})
+
+    def _dispatch(self, name, *args):
+        for c in self.callbacks:
+            getattr(c, name)(*args)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            return lambda *a: self._dispatch(name, *a)
+        raise AttributeError(name)
+
+    @property
+    def stop_training(self):
+        return any(getattr(c, "stop_training", False) for c in self.callbacks)
+
+
+class ProgBarLogger(Callback):
+    """Parity: hapi ProgBarLogger — per-epoch progress with loss/metrics."""
+
+    def __init__(self, log_freq: int = 1, verbose: int = 1):
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self.steps = self.params.get("steps")
+        self._start = time.time()
+        if self.verbose:
+            print(f"Epoch {epoch + 1}/{self.params.get('epochs', '?')}")
+
+    def on_train_batch_end(self, step, logs=None):
+        logs = logs or {}
+        if self.verbose and step % self.log_freq == 0:
+            ips = (step + 1) / max(time.time() - self._start, 1e-9)
+            parts = [f"{k}: {v:.4f}" if isinstance(v, float) else f"{k}: {v}"
+                     for k, v in logs.items()]
+            total = self.steps if self.steps is not None else "?"
+            print(f"step {step + 1}/{total} - " + " - ".join(parts)
+                  + f" - {ips:.1f} step/s", file=sys.stdout)
+
+    def on_eval_end(self, logs=None):
+        if self.verbose and logs:
+            parts = [f"{k}: {v:.4f}" if isinstance(v, float) else f"{k}: {v}"
+                     for k, v in logs.items()]
+            print("Eval - " + " - ".join(parts))
+
+
+class ModelCheckpoint(Callback):
+    """Parity: hapi ModelCheckpoint — saves weights every save_freq epochs."""
+
+    def __init__(self, save_freq: int = 1, save_dir: str = "checkpoint"):
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if epoch % self.save_freq == 0:
+            self.model.save(os.path.join(self.save_dir, str(epoch)))
+
+    def on_train_end(self, logs=None):
+        self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class EarlyStopping(Callback):
+    """Parity: hapi EarlyStopping (monitor/patience/min_delta/mode)."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.stop_training = False
+
+    def on_train_begin(self, logs=None):
+        self.wait = 0
+        self.best = self.baseline if self.baseline is not None else (
+            float("-inf") if self.mode == "max" else float("inf"))
+
+    def _better(self, cur):
+        if self.mode == "max":
+            return cur > self.best + self.min_delta
+        return cur < self.best - self.min_delta
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stop_training = True
+                if self.verbose:
+                    print(f"Early stopping: no {self.monitor} improvement "
+                          f"in {self.patience} evals")
+
+
+class LRScheduler(Callback):
+    """Parity: hapi LRScheduler — steps the optimizer's lr schedule (our
+    schedules are step-indexed functions, so this only controls by_step /
+    by_epoch stepping granularity bookkeeping)."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+
+class HistoryLogger(Callback):
+    """JSONL metrics history (the VisualDL-writer slot)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def on_train_begin(self, logs=None):
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._f = open(self.path, "a")
+
+    def on_epoch_end(self, epoch, logs=None):
+        rec = {"epoch": epoch, **{k: (float(v) if hasattr(v, "__float__")
+                                      else v) for k, v in (logs or {}).items()}}
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def on_train_end(self, logs=None):
+        self._f.close()

@@ -1,0 +1,4 @@
+"""paddle_tpu.incubate — staging ground for fused/experimental features
+(parity: python/paddle/incubate/, SURVEY §A.5 fused LLM layer zoo)."""
+
+from . import nn  # noqa: F401

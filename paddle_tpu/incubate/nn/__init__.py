@@ -1,0 +1,248 @@
+"""Fused transformer layer zoo (parity: python/paddle/incubate/nn/layer/
+fused_transformer.py — FusedMultiHeadAttention :189, FusedFeedForward :483,
+FusedTransformerEncoderLayer :697, FusedMultiTransformer :994,
+FusedBiasDropoutResidualLayerNorm :83 — and layer/fused_linear.py).
+
+TPU design: each layer is a thin Module over the fused functional surface
+(incubate.nn.functional) — Pallas norms, flash/decode attention kernels, and
+XLA-fused epilogues — rather than a monolithic C++ kernel: under jit the
+whole block compiles into the same fused program the reference hand-writes.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ... import nn
+from ...nn import functional as F
+from ...nn.module import Layer, Parameter
+from ...nn import initializer as I
+from . import functional as FF
+
+__all__ = [
+    "FusedLinear", "FusedMultiHeadAttention", "FusedFeedForward",
+    "FusedTransformerEncoderLayer", "FusedMultiTransformer",
+    "FusedBiasDropoutResidualLayerNorm",
+]
+
+
+class FusedLinear(Layer):
+    """Parity: incubate FusedLinear — bias epilogue fused onto the matmul."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        w_init = weight_attr if callable(weight_attr) else I.XavierNormal()
+        shape = ((out_features, in_features) if transpose_weight
+                 else (in_features, out_features))
+        self.weight = Parameter(w_init(shape, self._dtype))
+        self.transpose_weight = transpose_weight
+        if bias_attr is False:
+            self.bias = None
+        else:
+            b_init = bias_attr if callable(bias_attr) else I.Constant(0.0)
+            self.bias = Parameter(b_init((out_features,), self._dtype))
+
+    def forward(self, x):
+        return FF.fused_linear(x, self.weight, self.bias,
+                               transpose_weight=self.transpose_weight)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """Parity: fused_transformer.py:83."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, epsilon=1e-5, name=None):
+        super().__init__()
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.ln_scale = Parameter(I.Constant(1.0)((embed_dim,), self._dtype))
+        self.ln_bias = Parameter(I.Constant(0.0)((embed_dim,), self._dtype))
+
+    def forward(self, x, residual):
+        return FF.fused_bias_dropout_residual_layer_norm(
+            x, residual, ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            dropout_rate=self.dropout_rate, ln_epsilon=self.epsilon,
+            training=self.training)
+
+
+class FusedMultiHeadAttention(Layer):
+    """Parity: fused_transformer.py:189 — pre/post-LN MHA block with fused
+    qkv projection, flash attention core, and fused residual+dropout+LN."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 epsilon=1e-5, name=None, mp_axis=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.epsilon = epsilon
+        self.qkv_proj = nn.Linear(embed_dim, 3 * embed_dim,
+                                  weight_spec=(None, mp_axis))
+        self.out_proj = nn.Linear(embed_dim, embed_dim,
+                                  weight_spec=(mp_axis, None))
+        self.norm = nn.LayerNorm(embed_dim, epsilon=epsilon)
+
+    def forward(self, query, key=None, value=None, attn_mask=None):
+        x = query
+        residual = x
+        if self.normalize_before:
+            x = self.norm(x)
+        b, s, e = x.shape
+        qkv = self.qkv_proj(x).reshape(b, s, 3, self.num_heads, self.head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_rate if self.training else 0.0,
+            training=self.training)
+        out = self.out_proj(out.reshape(b, s, e))
+        out = FF.fused_dropout_add(out, residual, p=self.dropout_rate,
+                                   training=self.training)
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """Parity: fused_transformer.py:483."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, name=None, mp_axis=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (act_dropout_rate if act_dropout_rate
+                                 is not None else dropout_rate)
+        self.activation = activation
+        self.linear1 = nn.Linear(d_model, dim_feedforward,
+                                 weight_spec=(None, mp_axis))
+        self.linear2 = nn.Linear(dim_feedforward, d_model,
+                                 weight_spec=(mp_axis, None))
+        self.norm = nn.LayerNorm(d_model, epsilon=epsilon)
+
+    def forward(self, x):
+        residual = x
+        if self.normalize_before:
+            x = self.norm(x)
+        act = getattr(F, self.activation)
+        h = act(self.linear1(x))
+        h = F.dropout(h, p=self.act_dropout_rate, training=self.training)
+        h = self.linear2(h)
+        out = FF.fused_dropout_add(h, residual, p=self.dropout_rate,
+                                   training=self.training)
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """Parity: fused_transformer.py:697."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False, name=None):
+        super().__init__()
+        self.self_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=(attn_dropout_rate if attn_dropout_rate
+                               is not None else dropout_rate),
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None):
+        return self.ffn(self.self_attn(src, attn_mask=src_mask))
+
+
+class FusedMultiTransformer(Layer):
+    """Parity: fused_transformer.py:994 — the full fused decoder stack with
+    a KV-cache path, the reference's LLM-inference workhorse.
+
+    Pre-norm decoder blocks (LN -> attention -> LN -> FFN, residuals), GQA
+    via num_key_value_heads. Three modes:
+      - ``forward(x)``: training/prefill without cache (flash attention);
+      - ``forward(x, caches=..., seq_lens=...)``: single-token decode step
+        through ``masked_multihead_attention`` over fixed-size caches;
+      - norm kernels are the Pallas fused norms.
+    """
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward, num_layers=1,
+                 dropout_rate=0.0, activation="gelu", epsilon=1e-5,
+                 num_key_value_heads=None, normalize_before=True, name=None):
+        super().__init__()
+        assert normalize_before, "FusedMultiTransformer is pre-norm"
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.kv_heads = num_key_value_heads or num_heads
+        self.head_dim = embed_dim // num_heads
+        self.activation = activation
+        self.epsilon = epsilon
+        self.num_layers = num_layers
+        self.dropout_rate = dropout_rate
+        h, kvh, d = num_heads, self.kv_heads, self.head_dim
+        for i in range(num_layers):
+            self.add_sublayer(f"ln1_{i}", nn.LayerNorm(embed_dim, epsilon))
+            self.add_sublayer(f"q_{i}", nn.Linear(embed_dim, h * d,
+                                                  bias_attr=False))
+            self.add_sublayer(f"kv_{i}", nn.Linear(embed_dim, 2 * kvh * d,
+                                                   bias_attr=False))
+            self.add_sublayer(f"o_{i}", nn.Linear(h * d, embed_dim,
+                                                  bias_attr=False))
+            self.add_sublayer(f"ln2_{i}", nn.LayerNorm(embed_dim, epsilon))
+            self.add_sublayer(f"ff1_{i}", nn.Linear(embed_dim,
+                                                    dim_feedforward))
+            self.add_sublayer(f"ff2_{i}", nn.Linear(dim_feedforward,
+                                                    embed_dim))
+
+    def _layer(self, i):
+        g = lambda n: getattr(self, f"{n}_{i}")  # noqa: E731
+        return (g("ln1"), g("q"), g("kv"), g("o"), g("ln2"), g("ff1"),
+                g("ff2"))
+
+    def init_caches(self, batch_size, max_len, dtype=None):
+        dtype = dtype or jnp.bfloat16
+        shape = (batch_size, max_len, self.kv_heads, self.head_dim)
+        return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                for _ in range(self.num_layers)]
+
+    def forward(self, x, attn_mask=None, caches=None, seq_lens=None):
+        b, s, e = x.shape
+        h, kvh, d = self.num_heads, self.kv_heads, self.head_dim
+        act = getattr(F, self.activation)
+        new_caches = []
+        for i in range(self.num_layers):
+            ln1, q_p, kv_p, o_p, ln2, ff1, ff2 = self._layer(i)
+            res = x
+            hdn = FF.fused_layer_norm(x, ln1.weight, ln1.bias, self.epsilon)
+            q = q_p(hdn).reshape(b, s, h, d)
+            kv = kv_p(hdn).reshape(b, s, 2, kvh, d)
+            k, v = kv[:, :, 0], kv[:, :, 1]
+            if caches is not None:
+                assert s == 1, "cache path is single-token decode"
+                out, ck, cv = FF.masked_multihead_attention(
+                    q, k, v, caches[i][0], caches[i][1], seq_lens)
+                new_caches.append((ck, cv))
+            else:
+                if kvh != h:
+                    k = jnp.repeat(k, h // kvh, axis=2)
+                    v = jnp.repeat(v, h // kvh, axis=2)
+                out = F.scaled_dot_product_attention(
+                    q, k, v, attn_mask=attn_mask, is_causal=True,
+                    training=self.training)
+            x = res + o_p(out.reshape(b, s, h * d))
+            res = x
+            hdn = FF.fused_layer_norm(x, ln2.weight, ln2.bias, self.epsilon)
+            x = res + ff2(act(ff1(hdn)))
+        if caches is not None:
+            return x, new_caches
+        return x

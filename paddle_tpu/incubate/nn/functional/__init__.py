@@ -1,0 +1,229 @@
+"""Fused functional surface (parity: python/paddle/incubate/nn/functional/ —
+fused_rms_norm.py, fused_layer_norm.py, fused_rotary_position_embedding.py,
+swiglu.py, fused_matmul_bias.py, fused_dropout_add.py,
+masked_multihead_attention.py, block_multihead_attention.py,
+variable_length_memory_efficient_attention.py).
+
+TPU mapping: norms hit the Pallas one-pass kernels; rope/swiglu/matmul-bias
+are XLA compositions that the compiler provably fuses into the surrounding
+matmuls (they exist here for API parity and as the single place the fusion
+contract is tested); decode attention is gather+einsum shaped for the MXU
+with length masking; varlen attention is the segment-masked flash kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ....ops.pallas.fused_norm import fused_rms_norm as _rms_pallas
+from ....ops.pallas.fused_norm import fused_layer_norm as _ln_pallas
+from ....ops.pallas.flash_attention import flash_attn_unpadded
+
+__all__ = [
+    "fused_rms_norm", "fused_layer_norm", "fused_rotary_position_embedding",
+    "swiglu", "fused_linear", "fused_matmul_bias", "fused_dropout_add",
+    "fused_bias_dropout_residual_layer_norm", "masked_multihead_attention",
+    "block_multihead_attention", "variable_length_memory_efficient_attention",
+]
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon: float = 1e-6,
+                   begin_norm_axis: int = -1, bias=None, residual=None):
+    """Parity: incubate fused_rms_norm — optional bias+residual add fused in
+    front of the norm; returns (out, residual_out) when residual is given."""
+    pre = x
+    if bias is not None:
+        pre = pre + bias
+    if residual is not None:
+        pre = pre + residual
+    out = _rms_pallas(pre, norm_weight, epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    if residual is not None:
+        return out, pre
+    return out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon: float = 1e-5,
+                     begin_norm_axis: int = -1, bias=None, residual=None):
+    pre = x
+    if bias is not None:
+        pre = pre + bias
+    if residual is not None:
+        pre = pre + residual
+    out = _ln_pallas(pre, norm_weight, norm_bias, epsilon)
+    if residual is not None:
+        return out, pre
+    return out
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style: bool = True):
+    """Parity: incubate fused_rotary_position_embedding. q/k/v:
+    [b, s, h, d]; cos/sin: [S, d/2] (or [S, d] — the half is used). Rotates
+    q and k (v passes through, matching the reference contract)."""
+    def rot(x):
+        if x is None:
+            return None
+        b, s, h, d = x.shape
+        c, si = cos, sin
+        if c.shape[-1] == d:
+            c = c[..., : d // 2]
+            si = si[..., : d // 2]
+        if position_ids is None:
+            cc = c[:s][None, :, None, :]
+            ss = si[:s][None, :, None, :]
+        else:
+            cc = jnp.take(c, position_ids, axis=0)[:, :, None, :]
+            ss = jnp.take(si, position_ids, axis=0)[:, :, None, :]
+        if use_neox_rotary_style:
+            x1, x2 = jnp.split(x, 2, axis=-1)
+        else:  # interleaved (GPT-J style)
+            x1, x2 = x[..., 0::2], x[..., 1::2]
+        xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+        o1 = xf1 * cc - xf2 * ss
+        o2 = xf2 * cc + xf1 * ss
+        if use_neox_rotary_style:
+            out = jnp.concatenate([o1, o2], axis=-1)
+        else:
+            out = jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+        return out.astype(x.dtype)
+
+    return rot(q), rot(k), v
+
+
+def swiglu(x, y=None):
+    """Parity: incubate swiglu — silu(x) * y; with y=None, x is split in
+    half on the last axis. XLA fuses this into the surrounding matmuls."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+def fused_linear(x, weight, bias=None, transpose_weight: bool = False):
+    """Parity: incubate fused_matmul_bias/FusedLinear — XLA fuses the bias
+    epilogue onto the MXU matmul (the cublasLt epilogue equivalent)."""
+    w = weight.T if transpose_weight else weight
+    out = x @ w
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+fused_matmul_bias = fused_linear
+
+
+def fused_dropout_add(x, y, p: float = 0.5, training: bool = True,
+                      mode: str = "upscale_in_train", key=None):
+    """Parity: incubate fused_dropout_add — dropout(x) + y in one fused op."""
+    if not training or p == 0.0:
+        return x + y
+    from ....core import rng as _rng
+    key = key if key is not None else _rng.next_key()
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), 0.0) + y
+    return jnp.where(keep, x, 0.0) + y
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate: float = 0.5,
+                                           ln_epsilon: float = 1e-5,
+                                           training: bool = True, key=None):
+    """Parity: incubate FusedBiasDropoutResidualLayerNorm (functional)."""
+    pre = x if bias is None else x + bias
+    pre = fused_dropout_add(pre, residual, p=dropout_rate, training=training,
+                            key=key)
+    d = pre.shape[-1]
+    scale = ln_scale if ln_scale is not None else jnp.ones((d,), pre.dtype)
+    shift = ln_bias if ln_bias is not None else jnp.zeros((d,), pre.dtype)
+    return _ln_pallas(pre, scale, shift, ln_epsilon)
+
+
+# ---------------- decode-time attention ----------------
+
+def masked_multihead_attention(q, k_new, v_new, cache_k, cache_v, seq_lens,
+                               scale: float | None = None):
+    """Decode-step attention over a fixed-size KV cache (parity: incubate
+    masked_multihead_attention.py — the per-token decode kernel).
+
+    q/k_new/v_new: [b, 1, h(kvh), d] — this step's projections.
+    cache_k/v: [b, S_max, kvh, d]; seq_lens: [b] tokens already cached.
+    Writes the new k/v at position seq_lens, then attends q over positions
+    <= seq_lens. GQA supported (q heads a multiple of cache kv heads).
+    Returns (out [b, 1, h, d], cache_k, cache_v) — caches functionally
+    updated (donate/alias under jit for in-place HBM update).
+    """
+    b, _, h, d = q.shape
+    kvh = cache_k.shape[2]
+    S = cache_k.shape[1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    bidx = jnp.arange(b)
+    cache_k = cache_k.at[bidx, seq_lens].set(
+        k_new[:, 0].astype(cache_k.dtype))
+    cache_v = cache_v.at[bidx, seq_lens].set(
+        v_new[:, 0].astype(cache_v.dtype))
+    out = _grouped_decode_attn(q, cache_k, cache_v, seq_lens, scale)
+    return out, cache_k, cache_v
+
+
+def _grouped_decode_attn(q, kc, vc, seq_lens, scale):
+    """GQA decode core: group the h query heads as [kvh, h/kvh] and attend
+    against the UNREPEATED cache — no h/kvh-times HBM copy of the cache."""
+    b, _, h, d = q.shape
+    kvh = kc.shape[2]
+    S = kc.shape[1]
+    g = h // kvh
+    qg = q[:, 0].reshape(b, kvh, g, d).astype(jnp.float32)
+    s = jnp.einsum("bngd,bsnd->bngs", qg, kc.astype(jnp.float32)) * scale
+    mask = jnp.arange(S)[None, None, None, :] <= seq_lens[:, None, None, None]
+    s = jnp.where(mask, s, jnp.float32(-1e30))
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bngs,bsnd->bngd", p, vc.astype(jnp.float32))
+    return out.reshape(b, 1, h, d).astype(q.dtype)
+
+
+def block_multihead_attention(q, pool_k, pool_v, block_tables, seq_lens,
+                              k_new=None, v_new=None,
+                              scale: float | None = None):
+    """Decode attention over a PAGED (blocked) KV cache (parity: incubate
+    block_multihead_attention.py — the paged-attention decode path).
+
+    Pages live in a shared pool; each sequence owns a list of pages:
+      pool_k/pool_v: [num_blocks, block_size, kvh, d]
+      block_tables:  [b, max_blocks_per_seq] int32 page ids
+      seq_lens:      [b] tokens already cached
+    With k_new/v_new [b, 1, kvh, d], the step's KV is first written into the
+    page at position seq_lens (pages must be pre-allocated in block_tables).
+    Returns (out [b, 1, h, d], pool_k, pool_v).
+    """
+    b, _, h, d = q.shape
+    nb, bs, kvh, _ = pool_k.shape
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    if k_new is not None:
+        bidx = jnp.arange(b)
+        blk = jnp.take_along_axis(block_tables, (seq_lens // bs)[:, None],
+                                  axis=1)[:, 0]
+        pool_k = pool_k.at[blk, seq_lens % bs].set(
+            k_new[:, 0].astype(pool_k.dtype))
+        pool_v = pool_v.at[blk, seq_lens % bs].set(
+            v_new[:, 0].astype(pool_v.dtype))
+    # gather this batch's pages: [b, max_blocks, bs, kvh, d] -> [b, S, kvh, d]
+    kg = pool_k[block_tables].reshape(b, -1, kvh, d)
+    vg = pool_v[block_tables].reshape(b, -1, kvh, d)
+    out = _grouped_decode_attn(q, kg, vg, seq_lens, scale)
+    return out, pool_k, pool_v
+
+
+def variable_length_memory_efficient_attention(q, k, v, cu_seqlens_q,
+                                               cu_seqlens_k,
+                                               causal: bool = False,
+                                               scale: float | None = None):
+    """Parity: incubate variable_length_memory_efficient_attention — routed
+    to the segment-masked Pallas flash kernel (flash_attn_unpadded)."""
+    return flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                               causal=causal, scale=scale)

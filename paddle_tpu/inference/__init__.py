@@ -1,0 +1,86 @@
+"""paddle_tpu.inference — deployment API (parity: paddle.inference
+Config/create_predictor over AnalysisPredictor,
+fluid/inference/api/analysis_predictor.cc:1423).
+
+TPU-native collapse: the reference's analysis passes (fusion, subgraph
+offload, memory optimization) are XLA's job; what remains is the loading +
+serving contract: load a source-free artifact, expose named IO, run
+batches. The artifact is the StableHLO export from ``paddle_tpu.jit.save``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..jit.save_load import load as _load
+
+__all__ = ["Config", "Predictor", "create_predictor"]
+
+
+class Config:
+    """Parity: paddle.inference.Config — model path + runtime knobs. Device
+    placement is jax's; the knobs kept are the ones with TPU meaning."""
+
+    def __init__(self, prog_file_or_prefix: str, params_file: str | None = None):
+        prefix = prog_file_or_prefix
+        if prefix.endswith(".pdmodel"):
+            prefix = prefix[: -len(".pdmodel")]
+        self.prefix = prefix
+        self._memory_optim = True
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._memory_optim = flag
+
+    def model_dir(self):
+        return self.prefix
+
+
+class Predictor:
+    """Parity: paddle_infer.Predictor — named-handle IO over the loaded
+    program."""
+
+    def __init__(self, config: Config):
+        self._layer = _load(config.prefix)
+        self._inputs = [None] * len(self._layer.input_shapes)
+
+    def get_input_names(self):
+        return [f"input_{i}" for i in range(len(self._inputs))]
+
+    def get_input_handle(self, name: str):
+        idx = int(name.split("_")[-1])
+        pred = self
+
+        class _Handle:
+            def copy_from_cpu(self, arr):
+                pred._inputs[idx] = np.asarray(arr)
+
+            def reshape(self, shape):
+                pass
+
+        return _Handle()
+
+    def run(self, inputs=None):
+        args = inputs if inputs is not None else self._inputs
+        if any(a is None for a in args):
+            raise ValueError("inputs not set; pass them to run() or via "
+                             "get_input_handle().copy_from_cpu")
+        out = self._layer(*args)
+        self._outputs = out if isinstance(out, (tuple, list)) else [out]
+        return [np.asarray(o) for o in self._outputs]
+
+    def get_output_names(self):
+        return [f"output_{i}" for i in range(len(getattr(self, "_outputs", [0])))]
+
+    def get_output_handle(self, name: str):
+        idx = int(name.split("_")[-1])
+        pred = self
+
+        class _Handle:
+            def copy_to_cpu(self):
+                return np.asarray(pred._outputs[idx])
+
+        return _Handle()
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -28,7 +28,9 @@ from ..nn.module import Layer, functional_call
 from ..optimizer.optimizer import Optimizer
 
 __all__ = ["to_static", "TrainStep", "EvalStep", "PipelineTrainStep",
-           "not_to_static"]
+           "not_to_static", "save", "load", "InputSpec", "TranslatedLayer"]
+
+from .save_load import InputSpec, TranslatedLayer, load, save  # noqa: E402,F401
 
 
 def to_static(function=None, input_spec=None, full_graph=True, backend=None,

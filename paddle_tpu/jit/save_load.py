@@ -1,0 +1,129 @@
+"""jit.save / jit.load — source-free model export (parity:
+paddle.jit.save -> translated_layer.py loadable program+params, and the C++
+side fluid/jit/ loader; SURVEY §2.3 "Inference" row).
+
+Format (prefix-based like the reference's .pdmodel/.pdiparams):
+  {prefix}.pdmodel   — serialized multi-platform StableHLO program
+                       (jax.export), the PIR-program analogue;
+  {prefix}.pdiparams — pickled path-keyed weight arrays;
+  {prefix}.pdmeta    — input structure metadata.
+
+``load`` returns a ``TranslatedLayer``: a callable that runs the compiled
+program with the saved weights in a FRESH process with no model source —
+the contract AnalysisPredictor provides in the reference.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import jax
+import numpy as np
+from jax import export as jax_export
+
+from ..nn.module import Layer, functional_call
+
+__all__ = ["save", "load", "TranslatedLayer", "InputSpec"]
+
+
+class InputSpec:
+    """Parity: paddle.static.InputSpec — shape/dtype of a model input."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def to_sds(self):
+        return jax.ShapeDtypeStruct(self.shape, jax.numpy.dtype(self.dtype))
+
+
+def _as_sds(spec):
+    if isinstance(spec, InputSpec):
+        return spec.to_sds()
+    if isinstance(spec, jax.ShapeDtypeStruct):
+        return spec
+    arr = np.asarray(spec)
+    return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+
+def save(layer: Layer, path_prefix: str, input_spec=None):
+    """Export ``layer.forward`` as a standalone program + weights.
+
+    input_spec: list of InputSpec / ShapeDtypeStruct / example arrays.
+    The exported program takes (weights, *inputs) so weights stay a separate
+    artifact (the reference's program/params split).
+    """
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (shapes are static "
+                         "under XLA export)")
+    state = layer.state_dict(include_non_persistable_buffer=True)
+    state = {k: np.asarray(v) for k, v in state.items()}
+    in_sds = [_as_sds(s) for s in input_spec]
+    state_sds = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                 for k, v in state.items()}
+
+    def fn(state, *inputs):
+        out, _ = functional_call(layer, state, *inputs, training=False)
+        return out
+
+    platforms = ["cpu"]
+    if any(d.platform == "tpu" for d in jax.devices()):
+        platforms.append("tpu")
+    exp = jax_export.export(jax.jit(fn), platforms=tuple(platforms))(
+        state_sds, *in_sds)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exp.serialize())
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump(state, f)
+    with open(path_prefix + ".pdmeta", "wb") as f:
+        pickle.dump({"n_inputs": len(in_sds),
+                     "input_shapes": [s.shape for s in in_sds],
+                     "input_dtypes": [str(s.dtype) for s in in_sds],
+                     "platforms": platforms}, f)
+    return path_prefix
+
+
+class TranslatedLayer:
+    """A loaded source-free model (parity: jit/translated_layer.py)."""
+
+    def __init__(self, exported, state, meta):
+        self._exported = exported
+        self._state = state
+        self._meta = meta
+        self._jitted = jax.jit(
+            lambda state, *inputs: self._exported.call(state, *inputs))
+
+    def __call__(self, *inputs):
+        return self._jitted(self._state, *inputs)
+
+    forward = __call__
+
+    def state_dict(self):
+        return dict(self._state)
+
+    def set_state_dict(self, state):
+        self._state = {**self._state, **state}
+
+    @property
+    def input_shapes(self):
+        return self._meta["input_shapes"]
+
+    def eval(self):
+        return self
+
+    def mlir_module(self) -> str:
+        """The exported StableHLO text — inspectable/compilable from C++
+        tooling (the fluid/jit C++ loader analogue is any StableHLO-aware
+        runtime: PJRT's LoadedExecutable consumes exactly this)."""
+        return self._exported.mlir_module()
+
+
+def load(path_prefix: str) -> TranslatedLayer:
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    with open(path_prefix + ".pdmeta", "rb") as f:
+        meta = pickle.load(f)
+    return TranslatedLayer(exported, state, meta)

@@ -127,15 +127,25 @@ class LlamaAttention(Layer):
             # per-step so rotating buffers are h/kvh smaller
             out = _sp.ring_attention_manual(q, k, v, axis=sep, causal=True)
             return self.o_proj(out.reshape(b, s, h * d))
-        if position_offset:
+        static_zero = not isinstance(position_offset, jax.Array) and position_offset == 0
+        if static_zero:
+            q = apply_rotary_pos_emb(q, cos, sin)
+            k = apply_rotary_pos_emb(k, cos, sin)
+        else:  # offset may be a traced scalar (jitted decode step)
             pos = position_offset + jnp.arange(s)[None, :]
             pos = jnp.broadcast_to(pos, (b, s))
             q = apply_rotary_pos_emb(q, cos, sin, pos)
             k = apply_rotary_pos_emb(k, cos, sin, pos)
-        else:
-            q = apply_rotary_pos_emb(q, cos, sin)
-            k = apply_rotary_pos_emb(k, cos, sin)
         new_cache = None
+        if kv_cache is not None and s == 1 and attn_mask is None:
+            # single-token decode: fused masked MHA over the fixed cache
+            # (parity: incubate masked_multihead_attention decode kernel)
+            from ..incubate.nn import functional as FF
+            seq_lens = jnp.broadcast_to(jnp.asarray(position_offset), (b,))
+            out, ck, cv = FF.masked_multihead_attention(
+                q, k, v, kv_cache[0], kv_cache[1], seq_lens)
+            out = self.o_proj(out.reshape(b, s, h * d))
+            return out, (ck, cv)
         if kv_cache is not None:
             ck, cv = kv_cache
             ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype),
@@ -266,6 +276,37 @@ class LlamaForCausalLM(Layer):
         shape = (batch_size, max_len, cfg.num_key_value_heads, cfg.head_dim)
         return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
                 for _ in range(cfg.num_hidden_layers)]
+
+    def generate(self, input_ids, max_new_tokens: int = 32, max_len: int | None = None):
+        """Greedy decode: one jitted prefill + one jitted per-token step over
+        the fixed-size KV cache (decode routes through the fused masked-MHA
+        path; the whole loop is two compiled programs, no per-op dispatch —
+        parity: AnalysisPredictor/FusedMultiTransformer generation)."""
+        from ..nn.module import functional_call
+        input_ids = jnp.asarray(input_ids)
+        b, s0 = input_ids.shape
+        max_len = max_len or (s0 + max_new_tokens)
+        state = self.state_dict(include_non_persistable_buffer=True)
+        caches = self.init_kv_caches(b, max_len)
+
+        @jax.jit
+        def prefill(state, ids, caches):
+            (logits, caches), _ = functional_call(
+                self, state, ids, None, caches, 0, training=False)
+            return jnp.argmax(logits[:, -1], axis=-1), caches
+
+        @jax.jit
+        def step(state, tok, caches, pos):
+            (logits, caches), _ = functional_call(
+                self, state, tok[:, None], None, caches, pos, training=False)
+            return jnp.argmax(logits[:, -1], axis=-1), caches
+
+        tok, caches = prefill(state, input_ids, caches)
+        out = [tok]
+        for i in range(1, max_new_tokens):
+            tok, caches = step(state, tok, caches, s0 + i - 1)
+            out.append(tok)
+        return jnp.concatenate([input_ids, jnp.stack(out, axis=1)], axis=1)
 
     def loss(self, logits, labels, ignore_index=-100):
         """Shifted causal-LM cross entropy (parity: ParallelCrossEntropy for

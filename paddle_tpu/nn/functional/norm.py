@@ -30,8 +30,16 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
 
 
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
-    """RMSNorm (parity: paddle.incubate.nn.functional.fused_rms_norm)."""
+    """RMSNorm (parity: paddle.incubate.nn.functional.fused_rms_norm).
+    With a weight and a lane-aligned feature dim this routes to the Pallas
+    one-pass kernel (ops/pallas/fused_norm.py); otherwise the XLA-fused
+    composition below."""
     x = jnp.asarray(x)
+    if weight is not None and x.ndim >= 2:
+        # fused_rms_norm gates itself: Pallas one-pass kernel on aligned
+        # single-device shapes, XLA composition otherwise
+        from ...ops.pallas.fused_norm import fused_rms_norm
+        return fused_rms_norm(x, jnp.asarray(weight), epsilon)
     xf = x.astype(jnp.float32)
     var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
     out = xf * jax.lax.rsqrt(var + epsilon)

@@ -1,0 +1,617 @@
+"""Blanket op-contract manifest: every public op enrolled with a numpy
+reference (parity: one OpTest subclass per op under test/legacy_test/,
+op_test.py:418 check_output/check_grad — here one declarative row each).
+
+Rows are registered through ``core.registry.register_contract``; the contract
+suite (tests/test_op_contract.py) enumerates them all: forward vs numpy,
+finite-difference grads for rows flagged ``grad=True``, and statistical
+checks for sampling ops (``check=`` rows). ``fn_call`` pins keyword
+arguments so the op and its reference share one positional signature.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.registry import register_contract
+from . import creation as C
+from . import linalg as L
+from . import logic as G
+from . import manipulation as M
+from . import math as MT
+from . import random as R
+
+__all__: list[str] = []
+
+
+# ---------- input builders ----------
+
+def f(*shape):
+    return lambda rng: (rng.standard_normal(shape).astype(np.float32),)
+
+
+def f2(s1, s2):
+    return lambda rng: (rng.standard_normal(s1).astype(np.float32),
+                        rng.standard_normal(s2).astype(np.float32))
+
+
+def pos(*shape):
+    return lambda rng: (np.abs(rng.standard_normal(shape)).astype(np.float32)
+                        + 0.5,)
+
+
+def ints(shape, hi=10):
+    return lambda rng: (rng.integers(0, hi, shape).astype(np.int32),)
+
+
+def bools(*shape):
+    return lambda rng: (rng.integers(0, 2, shape).astype(bool),)
+
+
+def spd(n):
+    def make(rng):
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        return (a @ a.T + n * np.eye(n, dtype=np.float32),)
+    return make
+
+
+def sym(n):
+    def make(rng):
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        return ((a + a.T) / 2,)
+    return make
+
+
+def c_(name, fn, ref, make_inputs, grad=False, category="contract",
+       dtypes=("float32",), fn_call=None, notes=""):
+    register_contract(name, fn, ref, make_inputs, fn_call=fn_call,
+                      grad_ref=grad, category=category, test_dtypes=dtypes,
+                      notes=notes)
+
+
+# =====================================================================
+# math: reductions / scans / misc (python/paddle/tensor/math.py,stat.py)
+# =====================================================================
+
+c_("sum", MT.sum, lambda x: x.sum(1), f(4, 6),
+   fn_call=lambda x: MT.sum(x, axis=1), grad=True)
+c_("mean", MT.mean, lambda x: x.mean(-1), f(4, 6),
+   fn_call=lambda x: MT.mean(x, axis=-1), grad=True)
+c_("nansum", MT.nansum, lambda x: np.nansum(x, 0),
+   f(4, 6), fn_call=lambda x: MT.nansum(x, axis=0))
+c_("nanmean", MT.nanmean, lambda x: np.nanmean(x, 0),
+   f(4, 6), fn_call=lambda x: MT.nanmean(x, axis=0))
+c_("prod", MT.prod, lambda x: x.prod(1), f(3, 4),
+   fn_call=lambda x: MT.prod(x, axis=1), grad=True)
+c_("max", MT.max, lambda x: x.max(1), f(4, 6),
+   fn_call=lambda x: MT.max(x, axis=1), grad=True)
+c_("min", MT.min, lambda x: x.min(1), f(4, 6),
+   fn_call=lambda x: MT.min(x, axis=1), grad=True)
+c_("all", MT.all, lambda x: x.all(1), bools(4, 6),
+   fn_call=lambda x: MT.all(x, axis=1))
+c_("any", MT.any, lambda x: x.any(1), bools(4, 6),
+   fn_call=lambda x: MT.any(x, axis=1))
+c_("std", MT.std, lambda x: x.std(1, ddof=1), f(4, 6),
+   fn_call=lambda x: MT.std(x, axis=1), grad=True)
+c_("var", MT.var, lambda x: x.var(1, ddof=1), f(4, 6),
+   fn_call=lambda x: MT.var(x, axis=1), grad=True)
+c_("median", MT.median, lambda x: np.median(x, 1), f(4, 7),
+   fn_call=lambda x: MT.median(x, axis=1))
+c_("nanmedian", MT.nanmedian, lambda x: np.nanmedian(x, 1), f(4, 7),
+   fn_call=lambda x: MT.nanmedian(x, axis=1))
+c_("quantile", MT.quantile, lambda x: np.quantile(x, 0.3, axis=1), f(4, 9),
+   fn_call=lambda x: MT.quantile(x, 0.3, axis=1))
+c_("nanquantile", MT.nanquantile, lambda x: np.nanquantile(x, 0.7, axis=1),
+   f(4, 9), fn_call=lambda x: MT.nanquantile(x, 0.7, axis=1))
+c_("logsumexp", MT.logsumexp, lambda x: np.log(np.exp(x).sum(-1)), f(4, 6),
+   fn_call=lambda x: MT.logsumexp(x, axis=-1), grad=True)
+c_("cumsum", MT.cumsum, lambda x: np.cumsum(x, 1), f(4, 6),
+   fn_call=lambda x: MT.cumsum(x, axis=1), grad=True)
+c_("cumprod", MT.cumprod, lambda x: np.cumprod(x, 1), pos(4, 6),
+   fn_call=lambda x: MT.cumprod(x, dim=1), grad=True)
+c_("cummax", MT.cummax,
+   lambda x: (np.maximum.accumulate(x, 1),
+              np.argmax(x[:, None, :] * (np.tri(x.shape[1])[None] > 0)
+                        + np.where(np.tri(x.shape[1])[None] > 0, 0, -np.inf),
+                        axis=2)),
+   f(3, 5), fn_call=lambda x: MT.cummax(x, axis=1))
+c_("cummin", MT.cummin,
+   lambda x: (np.minimum.accumulate(x, 1),
+              np.argmin(np.where(np.tri(x.shape[1])[None] > 0,
+                                 x[:, None, :], np.inf), axis=2)),
+   f(3, 5), fn_call=lambda x: MT.cummin(x, axis=1))
+c_("logcumsumexp", MT.logcumsumexp,
+   lambda x: np.log(np.cumsum(np.exp(x), -1)), f(3, 6),
+   fn_call=lambda x: MT.logcumsumexp(x, axis=-1), grad=True)
+c_("argmax", MT.argmax, lambda x: x.argmax(1), f(4, 6),
+   fn_call=lambda x: MT.argmax(x, axis=1))
+c_("argmin", MT.argmin, lambda x: x.argmin(1), f(4, 6),
+   fn_call=lambda x: MT.argmin(x, axis=1))
+c_("count_nonzero", MT.count_nonzero,
+   lambda x: np.count_nonzero(x, 1), ints((4, 6), 3),
+   fn_call=lambda x: MT.count_nonzero(x, axis=1))
+c_("diff", MT.diff, lambda x: np.diff(x, axis=-1), f(4, 6), grad=True,
+   fn_call=lambda x: MT.diff(x))
+c_("trace", MT.trace, lambda x: np.trace(x), f(5, 5), grad=True)
+c_("addmm", MT.addmm, lambda a, x, y: a + x @ y,
+   lambda rng: (rng.standard_normal((4, 5)).astype(np.float32),
+                rng.standard_normal((4, 3)).astype(np.float32),
+                rng.standard_normal((3, 5)).astype(np.float32)), grad=True)
+c_("clip", MT.clip, lambda x: np.clip(x, -0.5, 0.5), f(4, 6),
+   fn_call=lambda x: MT.clip(x, -0.5, 0.5), grad=True)
+c_("lerp", MT.lerp, lambda x, y: x + 0.3 * (y - x), f2((4, 6), (4, 6)),
+   fn_call=lambda x, y: MT.lerp(x, y, 0.3), grad=True)
+c_("nan_to_num", MT.nan_to_num,
+   lambda x: np.nan_to_num(x, nan=0.0), f(4, 6))
+c_("logit", MT.logit, lambda x: np.log(x / (1 - x)),
+   lambda rng: (rng.uniform(0.1, 0.9, (4, 6)).astype(np.float32),),
+   grad=True)
+c_("scale", MT.scale, lambda x: 2.0 * x + 1.0, f(4, 6),
+   fn_call=lambda x: MT.scale(x, 2.0, 1.0), grad=True)
+c_("stanh", MT.stanh, lambda x: 1.7159 * np.tanh(0.67 * x), f(4, 6),
+   grad=True)
+c_("pow", MT.pow, lambda x: x ** 3.0, f(4, 6),
+   fn_call=lambda x: MT.pow(x, 3.0), grad=True)
+c_("renorm", MT.renorm,
+   lambda x: x * np.minimum(
+       1.0, 2.0 / (np.sqrt((x ** 2).sum((1, 2))) + 1e-7))[:, None, None],
+   f(3, 4, 5), fn_call=lambda x: MT.renorm(x, p=2.0, axis=0, max_norm=2.0))
+c_("floor_divide", MT.floor_divide, np.floor_divide,
+   lambda rng: (rng.integers(1, 20, (4, 6)).astype(np.int32),
+                rng.integers(1, 5, (4, 6)).astype(np.int32),))
+c_("mod", MT.mod, np.mod,
+   lambda rng: (rng.integers(0, 20, (4, 6)).astype(np.int32),
+                rng.integers(1, 5, (4, 6)).astype(np.int32),))
+c_("gcd", MT.gcd, np.gcd,
+   lambda rng: (rng.integers(1, 40, (4, 6)).astype(np.int32),
+                rng.integers(1, 40, (4, 6)).astype(np.int32),))
+c_("lcm", MT.lcm, np.lcm,
+   lambda rng: (rng.integers(1, 12, (4, 6)).astype(np.int32),
+                rng.integers(1, 12, (4, 6)).astype(np.int32),))
+c_("kron", MT.kron, np.kron, f2((3, 4), (2, 5)), grad=True)
+c_("inner", MT.inner, np.inner, f2((4, 6), (5, 6)), grad=True)
+c_("outer", MT.outer, np.outer, f2((4,), (5,)), grad=True)
+c_("fmax", MT.fmax, np.fmax, f2((4, 6), (4, 6)), grad=True)
+c_("fmin", MT.fmin, np.fmin, f2((4, 6), (4, 6)), grad=True)
+c_("copysign", MT.copysign, np.copysign, f2((4, 6), (4, 6)))
+c_("nextafter", MT.nextafter, np.nextafter, f2((4, 6), (4, 6)))
+c_("ldexp", MT.ldexp, lambda x, y: np.ldexp(x, y),
+   lambda rng: (rng.standard_normal((4, 6)).astype(np.float32),
+                rng.integers(-3, 3, (4, 6)).astype(np.int32),))
+c_("combinations", MT.combinations,
+   lambda x: np.array([[x[i], x[j]] for i in range(len(x))
+                       for j in range(i + 1, len(x))], np.float32),
+   f(5,))
+
+
+# =====================================================================
+# linalg (python/paddle/tensor/linalg.py)
+# =====================================================================
+
+def _hi(fn):
+    """Run a matmul-backed op at highest precision for numpy comparison."""
+    def call(*args):
+        from ..core import flags
+        with flags.flag_guard(matmul_precision="highest"):
+            return fn(*args)
+    return call
+
+
+c_("mm", L.mm, lambda x, y: x @ y, f2((4, 6), (6, 5)),
+   fn_call=_hi(L.mm), grad=True)
+c_("bmm", L.bmm, lambda x, y: x @ y, f2((3, 4, 6), (3, 6, 5)),
+   fn_call=_hi(L.bmm), grad=True)
+c_("dot", L.dot, lambda x, y: (x * y).sum(-1), f2((6,), (6,)),
+   fn_call=_hi(L.dot), grad=True)
+c_("vecdot", L.vecdot, lambda x, y: (x * y).sum(-1), f2((4, 6), (4, 6)),
+   fn_call=_hi(L.vecdot), grad=True)
+c_("mv", L.mv, lambda x, y: x @ y, f2((4, 6), (6,)), fn_call=_hi(L.mv),
+   grad=True)
+c_("t", L.t, lambda x: x.T, f(4, 6))
+c_("norm", L.norm, lambda x: np.linalg.norm(x), f(4, 6), grad=True)
+c_("vector_norm", L.vector_norm,
+   lambda x: np.linalg.norm(x, axis=-1), f(4, 6),
+   fn_call=lambda x: L.vector_norm(x, axis=-1), grad=True)
+c_("matrix_norm", L.matrix_norm,
+   lambda x: np.linalg.norm(x, "fro", axis=(-2, -1)), f(3, 4, 5), grad=True)
+c_("dist", L.dist, lambda x, y: np.linalg.norm((x - y).ravel()),
+   f2((4, 6), (4, 6)), grad=True)
+c_("cross", L.cross, lambda x, y: np.cross(x, y), f2((4, 3), (4, 3)),
+   fn_call=lambda x, y: L.cross(x, y, axis=1), grad=True)
+c_("cholesky", L.cholesky, np.linalg.cholesky, spd(5))
+c_("cholesky_solve", L.cholesky_solve,
+   lambda b, l: np.linalg.solve(l @ l.T, b),
+   lambda rng: (rng.standard_normal((5, 2)).astype(np.float32),
+                np.linalg.cholesky(
+                    (lambda a: a @ a.T + 5 * np.eye(5))(
+                        rng.standard_normal((5, 5))).astype(np.float32)),),
+   fn_call=lambda b, l: L.cholesky_solve(b, l, upper=False))
+c_("inv", L.inv, np.linalg.inv, spd(5))
+c_("pinv", L.pinv, np.linalg.pinv, f(5, 3))
+c_("svd", L.svd, lambda x: np.linalg.svd(x, compute_uv=False), f(6, 4),
+   fn_call=lambda x: L.svd(x)[1], notes="singular values (U/V sign-ambiguous)")
+c_("svdvals", L.svdvals, lambda x: np.linalg.svd(x, compute_uv=False),
+   f(6, 4))
+c_("qr", L.qr, lambda x: x, f(6, 4),
+   fn_call=lambda x: (lambda qr: qr[0] @ qr[1])(L.qr(x)),
+   notes="Q@R reconstruction")
+c_("eigh", L.eigh, lambda x: np.linalg.eigh(x)[0], sym(5),
+   fn_call=lambda x: L.eigh(x)[0])
+c_("eigvalsh", L.eigvalsh, lambda x: np.linalg.eigvalsh(x), sym(5))
+c_("det", L.det, np.linalg.det, spd(4), grad=True)
+c_("slogdet", L.slogdet, lambda x: np.stack(np.linalg.slogdet(x)), spd(4))
+c_("solve", L.solve, np.linalg.solve, lambda rng: (
+    (lambda a: a @ a.T + 5 * np.eye(5, dtype=np.float32))(
+        rng.standard_normal((5, 5)).astype(np.float32)),
+    rng.standard_normal((5, 2)).astype(np.float32)))
+c_("triangular_solve", L.triangular_solve,
+   lambda a, b: np.linalg.solve(np.triu(a) + 2 * np.eye(a.shape[0]), b),
+   lambda rng: (rng.standard_normal((4, 4)).astype(np.float32),
+                rng.standard_normal((4, 2)).astype(np.float32)),
+   fn_call=lambda a, b: L.triangular_solve(
+       np.triu(a) + 2 * np.eye(a.shape[0], dtype=np.float32), b, upper=True))
+c_("lstsq", L.lstsq, lambda a, b: np.linalg.lstsq(a, b, rcond=None)[0],
+   f2((6, 4), (6, 2)), fn_call=lambda a, b: L.lstsq(a, b)[0])
+c_("matrix_power", L.matrix_power,
+   lambda x: np.linalg.matrix_power(x, 3), f(4, 4),
+   fn_call=lambda x: L.matrix_power(x, 3))
+c_("matrix_rank", L.matrix_rank,
+   lambda x: np.linalg.matrix_rank(x), spd(4))
+c_("einsum", L.einsum, lambda x, y: np.einsum("ij,jk->ik", x, y),
+   f2((4, 5), (5, 6)), fn_call=_hi(lambda x, y: L.einsum("ij,jk->ik", x, y)),
+   grad=True)
+c_("tensordot", L.tensordot, lambda x, y: np.tensordot(x, y, 2),
+   f2((3, 4, 5), (4, 5, 6)), fn_call=_hi(lambda x, y: L.tensordot(x, y, 2)),
+   grad=True)
+c_("multi_dot", L.multi_dot, lambda a, b, c: a @ b @ c,
+   lambda rng: (rng.standard_normal((3, 4)).astype(np.float32),
+                rng.standard_normal((4, 5)).astype(np.float32),
+                rng.standard_normal((5, 2)).astype(np.float32)),
+   fn_call=_hi(lambda a, b, c: L.multi_dot([a, b, c])))
+c_("histogram", L.histogram,
+   lambda x: np.histogram(x, bins=8, range=(-2, 2))[0], f(64,),
+   fn_call=lambda x: L.histogram(x, bins=8, min=-2, max=2))
+c_("bincount", L.bincount, lambda x: np.bincount(x, minlength=10),
+   ints((32,), 9), fn_call=lambda x: L.bincount(x, minlength=10))
+c_("corrcoef", L.corrcoef, lambda x: np.corrcoef(x), f(4, 16))
+c_("cov", L.cov, lambda x: np.cov(x), f(4, 16))
+c_("matrix_exp", L.matrix_exp,
+   lambda x: __import__("scipy.linalg", fromlist=["expm"]).expm(x),
+   lambda rng: (0.3 * rng.standard_normal((4, 4)).astype(np.float32),))
+c_("cdist", L.cdist,
+   lambda x, y: np.sqrt(((x[:, None] - y[None]) ** 2).sum(-1)),
+   f2((5, 3), (6, 3)))
+c_("diagonal", M.diagonal, lambda x: np.diagonal(x, 1), f(5, 5),
+   fn_call=lambda x: M.diagonal(x, offset=1), grad=True)
+
+
+# =====================================================================
+# creation (python/paddle/tensor/creation.py)
+# =====================================================================
+
+c_("zeros", C.zeros, lambda: np.zeros((3, 4), np.float32),
+   lambda rng: (), fn_call=lambda: C.zeros([3, 4]))
+c_("ones", C.ones, lambda: np.ones((3, 4), np.float32),
+   lambda rng: (), fn_call=lambda: C.ones([3, 4]))
+c_("full", C.full, lambda: np.full((3, 4), 2.5, np.float32),
+   lambda rng: (), fn_call=lambda: C.full([3, 4], 2.5))
+c_("zeros_like", C.zeros_like, np.zeros_like, f(3, 4))
+c_("ones_like", C.ones_like, np.ones_like, f(3, 4))
+c_("full_like", C.full_like, lambda x: np.full_like(x, 7.0), f(3, 4),
+   fn_call=lambda x: C.full_like(x, 7.0))
+c_("arange", C.arange, lambda: np.arange(2, 20, 3),
+   lambda rng: (), fn_call=lambda: C.arange(2, 20, 3))
+c_("linspace", C.linspace,
+   lambda: np.linspace(0, 1, 7, dtype=np.float32),
+   lambda rng: (), fn_call=lambda: C.linspace(0, 1, 7))
+c_("logspace", C.logspace,
+   lambda: np.logspace(0, 2, 5, dtype=np.float32),
+   lambda rng: (), fn_call=lambda: C.logspace(0, 2, 5))
+c_("eye", C.eye, lambda: np.eye(4, 6, dtype=np.float32),
+   lambda rng: (), fn_call=lambda: C.eye(4, 6))
+c_("diag", C.diag, lambda x: np.diag(x), f(5,), grad=True)
+c_("diagflat", C.diagflat, lambda x: np.diag(x.ravel()), f(2, 3))
+c_("tril", C.tril, np.tril, f(5, 5), grad=True)
+c_("triu", C.triu, np.triu, f(5, 5), grad=True)
+c_("tril_indices", C.tril_indices,
+   lambda: np.stack(np.tril_indices(4, 0, 5)),
+   lambda rng: (), fn_call=lambda: C.tril_indices(4, 5, 0))
+c_("triu_indices", C.triu_indices,
+   lambda: np.stack(np.triu_indices(4, 0, 5)),
+   lambda rng: (), fn_call=lambda: C.triu_indices(4, 5, 0))
+c_("meshgrid", C.meshgrid,
+   lambda x, y: list(np.meshgrid(x, y, indexing="ij")), f2((3,), (4,)))
+c_("one_hot", C.one_hot, lambda x: np.eye(8, dtype=np.float32)[x],
+   ints((6,), 8), fn_call=lambda x: C.one_hot(x, 8))
+c_("complex", C.complex, lambda r, i: r + 1j * i, f2((4,), (4,)))
+c_("polar", C.polar, lambda a, t: a * np.exp(1j * t),
+   lambda rng: (np.abs(rng.standard_normal(4)).astype(np.float32),
+                rng.standard_normal(4).astype(np.float32)))
+c_("to_tensor", C.to_tensor, lambda x: x, f(3, 4))
+c_("assign", C.assign, lambda x: x, f(3, 4))
+c_("clone", C.clone, lambda x: x, f(3, 4))
+c_("numel", C.numel, lambda x: np.int64(x.size), f(3, 4))
+
+
+# =====================================================================
+# logic (python/paddle/tensor/logic.py)
+# =====================================================================
+
+c_("logical_not", G.logical_not, np.logical_not, bools(4, 6))
+c_("bitwise_not", G.bitwise_not, np.bitwise_not, ints((4, 6), 100))
+c_("equal_all", G.equal_all, lambda x, y: np.array_equal(x, y),
+   lambda rng: ((a := rng.integers(0, 2, (4,))), a.copy()))
+c_("allclose", G.allclose, lambda x, y: np.allclose(x, y),
+   f2((4, 6), (4, 6)))
+c_("isclose", G.isclose, np.isclose, f2((4, 6), (4, 6)))
+c_("isposinf", G.isposinf, np.isposinf, f(4, 6))
+c_("isneginf", G.isneginf, np.isneginf, f(4, 6))
+c_("isreal", G.isreal, np.isreal, f(4, 6))
+c_("isin", G.isin, np.isin,
+   lambda rng: (rng.integers(0, 10, (4, 6)), rng.integers(0, 10, (8,))))
+
+
+# =====================================================================
+# manipulation (python/paddle/tensor/manipulation.py)
+# =====================================================================
+
+c_("reshape", M.reshape, lambda x: x.reshape(2, 12), f(4, 6),
+   fn_call=lambda x: M.reshape(x, [2, 12]), grad=True)
+c_("flatten", M.flatten, lambda x: x.reshape(-1), f(4, 6), grad=True)
+c_("squeeze", M.squeeze, lambda x: x.squeeze(), f(1, 4, 1, 6))
+c_("unsqueeze", M.unsqueeze, lambda x: x[:, None], f(4, 6),
+   fn_call=lambda x: M.unsqueeze(x, 1))
+c_("transpose", M.transpose, lambda x: x.transpose(1, 0), f(4, 6),
+   fn_call=lambda x: M.transpose(x, [1, 0]), grad=True)
+c_("moveaxis", M.moveaxis, lambda x: np.moveaxis(x, 0, 2), f(3, 4, 5),
+   fn_call=lambda x: M.moveaxis(x, 0, 2))
+c_("swapaxes", M.swapaxes, lambda x: np.swapaxes(x, 0, 1), f(3, 4, 5),
+   fn_call=lambda x: M.swapaxes(x, 0, 1))
+c_("concat", M.concat, lambda x, y: np.concatenate([x, y], 1),
+   f2((4, 3), (4, 5)), fn_call=lambda x, y: M.concat([x, y], axis=1),
+   grad=True)
+c_("stack", M.stack, lambda x, y: np.stack([x, y], 1), f2((4, 3), (4, 3)),
+   fn_call=lambda x, y: M.stack([x, y], axis=1), grad=True)
+c_("split", M.split, lambda x: list(np.split(x, [2, 5], 1)), f(4, 8),
+   fn_call=lambda x: M.split(x, [2, 3, -1], axis=1))
+c_("chunk", M.chunk, lambda x: list(np.array_split(x, 3, 1)), f(4, 8),
+   fn_call=lambda x: M.chunk(x, 3, axis=1))
+c_("tensor_split", M.tensor_split,
+   lambda x: list(np.array_split(x, 3, 0)), f(7, 4),
+   fn_call=lambda x: M.tensor_split(x, 3))
+c_("hsplit", M.hsplit, lambda x: list(np.hsplit(x, 2)), f(4, 8),
+   fn_call=lambda x: M.hsplit(x, 2))
+c_("vsplit", M.vsplit, lambda x: list(np.vsplit(x, 2)), f(8, 4),
+   fn_call=lambda x: M.vsplit(x, 2))
+c_("dsplit", M.dsplit, lambda x: list(np.dsplit(x, 2)), f(3, 4, 8),
+   fn_call=lambda x: M.dsplit(x, 2))
+c_("unbind", M.unbind, lambda x: list(x), f(3, 4))
+c_("tile", M.tile, lambda x: np.tile(x, (2, 3)), f(2, 3),
+   fn_call=lambda x: M.tile(x, (2, 3)))
+c_("expand", M.expand, lambda x: np.broadcast_to(x, (4, 3, 5)), f(3, 5),
+   fn_call=lambda x: M.expand(x, [4, 3, 5]))
+c_("expand_as", M.expand_as, lambda x, y: np.broadcast_to(x, y.shape),
+   f2((1, 5), (4, 5)))
+c_("broadcast_to", M.broadcast_to,
+   lambda x: np.broadcast_to(x, (4, 3, 5)), f(3, 5),
+   fn_call=lambda x: M.broadcast_to(x, [4, 3, 5]))
+c_("broadcast_tensors", M.broadcast_tensors,
+   lambda x, y: list(np.broadcast_arrays(x, y)), f2((1, 5), (4, 1)),
+   fn_call=lambda x, y: M.broadcast_tensors([x, y]))
+c_("flip", M.flip, lambda x: np.flip(x, 1), f(4, 6),
+   fn_call=lambda x: M.flip(x, axis=1), grad=True)
+c_("rot90", M.rot90, lambda x: np.rot90(x), f(4, 6))
+c_("roll", M.roll, lambda x: np.roll(x, 2, 1), f(4, 6),
+   fn_call=lambda x: M.roll(x, 2, axis=1))
+c_("gather", M.gather, lambda x: x[[0, 2, 1]], f(4, 6),
+   fn_call=lambda x: M.gather(x, np.array([0, 2, 1])), grad=True)
+c_("gather_nd", M.gather_nd, lambda x: x[[0, 2], [1, 3]], f(4, 6),
+   fn_call=lambda x: M.gather_nd(x, np.array([[0, 1], [2, 3]])))
+c_("scatter", M.scatter,
+   lambda x, u: (lambda o: (o.__setitem__([1, 3], u), o)[1])(x.copy()),
+   f2((5, 3), (2, 3)),
+   fn_call=lambda x, u: M.scatter(x, np.array([1, 3]), u))
+c_("scatter_nd", M.scatter_nd,
+   lambda u: (lambda o: (np.add.at(o, ([1, 3],), u), o)[1])(
+       np.zeros((5, 3), np.float32)),
+   f(2, 3),
+   fn_call=lambda u: M.scatter_nd(np.array([[1], [3]]), u, [5, 3]))
+c_("scatter_nd_add", M.scatter_nd_add,
+   lambda x, u: (lambda o: (np.add.at(o, ([1, 1],), u), o)[1])(x.copy()),
+   f2((5, 3), (2, 3)),
+   fn_call=lambda x, u: M.scatter_nd_add(x, np.array([[1], [1]]), u))
+c_("index_select", M.index_select, lambda x: x[:, [0, 2]], f(4, 6),
+   fn_call=lambda x: M.index_select(x, np.array([0, 2]), axis=1))
+c_("index_sample", M.index_sample,
+   lambda x: np.take_along_axis(x, np.array([[0, 1], [2, 0], [1, 1],
+                                             [3, 2]]), 1),
+   f(4, 6),
+   fn_call=lambda x: M.index_sample(x, np.array([[0, 1], [2, 0], [1, 1],
+                                                 [3, 2]])))
+c_("index_add", M.index_add,
+   lambda x, v: (lambda o: (np.add.at(o, ([0, 2],), v), o)[1])(x.copy()),
+   f2((4, 6), (2, 6)),
+   fn_call=lambda x, v: M.index_add(x, np.array([0, 2]), 0, v))
+c_("index_put", M.index_put,
+   lambda x, v: (lambda o: (o.__setitem__(([0, 1], [2, 3]), v), o)[1])(
+       x.copy()),
+   f2((4, 6), (2,)),
+   fn_call=lambda x, v: M.index_put(
+       x, (np.array([0, 1]), np.array([2, 3])), v))
+c_("masked_select", M.masked_select,
+   lambda x: x[x > 0], f(4, 6), fn_call=lambda x: M.masked_select(x, x > 0))
+c_("masked_fill", M.masked_fill,
+   lambda x: np.where(x > 0, np.float32(9.0), x), f(4, 6),
+   fn_call=lambda x: M.masked_fill(x, x > 0, 9.0))
+c_("masked_scatter", M.masked_scatter,
+   lambda x, v: (lambda o, m: (o.__setitem__(
+       m, v.ravel()[: m.sum()]), o)[1])(x.copy(), x > 0),
+   f2((4, 6), (24,)),
+   fn_call=lambda x, v: M.masked_scatter(x, x > 0, v))
+c_("where", M.where, lambda c, x, y: np.where(c, x, y),
+   lambda rng: (rng.integers(0, 2, (4, 6)).astype(bool),
+                rng.standard_normal((4, 6)).astype(np.float32),
+                rng.standard_normal((4, 6)).astype(np.float32)))
+c_("nonzero", M.nonzero, lambda x: np.stack(np.nonzero(x), 1),
+   ints((4, 6), 2))
+c_("take", M.take, lambda x: x.ravel()[[0, 5, 11]], f(4, 6),
+   fn_call=lambda x: M.take(x, np.array([0, 5, 11])))
+c_("take_along_axis", M.take_along_axis,
+   lambda x: np.take_along_axis(x, np.array([[0], [2], [1], [3]]), 1),
+   f(4, 6),
+   fn_call=lambda x: M.take_along_axis(x, np.array([[0], [2], [1], [3]]), 1))
+c_("put_along_axis", M.put_along_axis,
+   lambda x: (lambda o: (np.put_along_axis(o, np.array([[0], [2], [1],
+                                                        [3]]), 5.0, 1), o)[1])(
+       x.copy()),
+   f(4, 6),
+   fn_call=lambda x: M.put_along_axis(x, np.array([[0], [2], [1], [3]]),
+                                      5.0, 1))
+c_("sort", M.sort, lambda x: np.sort(x, 1), f(4, 6),
+   fn_call=lambda x: M.sort(x, axis=1), grad=True)
+c_("argsort", M.argsort, lambda x: np.argsort(x, 1), f(4, 6),
+   fn_call=lambda x: M.argsort(x, axis=1))
+c_("topk", M.topk,
+   lambda x: (np.sort(x, 1)[:, ::-1][:, :3],
+              np.argsort(-x, 1, kind="stable")[:, :3]),
+   f(4, 8), fn_call=lambda x: M.topk(x, 3, axis=1))
+c_("searchsorted", M.searchsorted,
+   lambda s, v: np.searchsorted(s, v),
+   lambda rng: (np.sort(rng.standard_normal(8)).astype(np.float32),
+                rng.standard_normal(5).astype(np.float32)))
+c_("bucketize", M.bucketize,
+   lambda v, s: np.searchsorted(s, v),
+   lambda rng: (rng.standard_normal(5).astype(np.float32),
+                np.sort(rng.standard_normal(8)).astype(np.float32)))
+c_("unique", M.unique, lambda x: np.unique(x), ints((12,), 5))
+c_("unique_consecutive", M.unique_consecutive,
+   lambda x: np.array([k for k, g in __import__("itertools").groupby(x)]),
+   lambda rng: (np.sort(rng.integers(0, 5, 12)),))
+c_("repeat_interleave", M.repeat_interleave,
+   lambda x: np.repeat(x, 3, 1), f(4, 6),
+   fn_call=lambda x: M.repeat_interleave(x, 3, axis=1))
+c_("pad", M.pad, lambda x: np.pad(x, ((0, 0), (0, 0), (1, 2), (3, 4))),
+   f(2, 3, 4, 5), fn_call=lambda x: M.pad(x, [3, 4, 1, 2]))
+c_("slice", M.slice, lambda x: x[1:3, 2:5], f(4, 6),
+   fn_call=lambda x: M.slice(x, [0, 1], [1, 2], [3, 5]))
+c_("strided_slice", M.strided_slice, lambda x: x[0:4:2, 1:6:3], f(4, 6),
+   fn_call=lambda x: M.strided_slice(x, [0, 1], [0, 1], [4, 6], [2, 3]))
+c_("crop", M.crop, lambda x: x[1:3, 2:6], f(4, 8),
+   fn_call=lambda x: M.crop(x, shape=[2, 4], offsets=[1, 2]))
+c_("cast", M.cast, lambda x: x.astype(np.int32), f(4, 6),
+   fn_call=lambda x: M.cast(x, "int32"))
+c_("as_real", M.as_real,
+   lambda x: np.stack([x.real, x.imag], -1),
+   lambda rng: ((rng.standard_normal(4) + 1j * rng.standard_normal(4))
+                .astype(np.complex64),))
+c_("as_complex", M.as_complex, lambda x: x[..., 0] + 1j * x[..., 1],
+   f(4, 2))
+c_("view", M.view, lambda x: x.reshape(2, 12), f(4, 6),
+   fn_call=lambda x: M.view(x, [2, 12]))
+c_("view_as", M.view_as, lambda x, y: x.reshape(y.shape),
+   f2((4, 6), (2, 12)))
+c_("unfold", M.unfold,
+   lambda x: np.stack([x[:, i:i + 3] for i in range(0, 4, 2)], 1), f(4, 6),
+   fn_call=lambda x: M.unfold(x, axis=1, size=3, step=2))
+c_("atleast_1d", M.atleast_1d, np.atleast_1d, f(4,))
+c_("atleast_2d", M.atleast_2d, np.atleast_2d, f(4,))
+c_("atleast_3d", M.atleast_3d, np.atleast_3d, f(4, 5))
+c_("diag_embed", M.diag_embed,
+   lambda x: np.stack([np.diag(r) for r in x]), f(3, 4))
+def _mode_ref(x):
+    # paddle tie-break: the LARGER value wins on equal counts; index is the
+    # first occurrence of the winning value
+    vals, idxs = [], []
+    for r in x.astype(np.int64):
+        b = np.bincount(r)
+        v = len(b) - 1 - int(b[::-1].argmax())
+        vals.append(v)
+        idxs.append(int(np.flatnonzero(r == v)[0]))
+    return np.array(vals, x.dtype), np.array(idxs)
+
+
+c_("mode", M.mode, _mode_ref,
+   lambda rng: (rng.integers(0, 3, (4, 9)).astype(np.float32),),
+   fn_call=lambda x: M.mode(x, axis=1),
+   notes="rows of small ints so the mode is well-defined")
+c_("kthvalue", M.kthvalue,
+   lambda x: (np.sort(x, 1)[:, 1], np.argsort(x, 1, kind="stable")[:, 1]),
+   f(4, 6), fn_call=lambda x: M.kthvalue(x, 2, axis=1))
+c_("select_scatter", M.select_scatter,
+   lambda x, v: (lambda o: (o.__setitem__((slice(None), 1), v), o)[1])(
+       x.copy()),
+   f2((4, 6), (4,)),
+   fn_call=lambda x, v: M.select_scatter(x, v, axis=1, index=1))
+c_("slice_scatter", M.slice_scatter,
+   lambda x, v: (lambda o: (o.__setitem__((slice(None), slice(1, 5, 2)), v),
+                            o)[1])(x.copy()),
+   f2((4, 6), (4, 2)),
+   fn_call=lambda x, v: M.slice_scatter(x, v, axes=[1], starts=[1],
+                                        ends=[5], strides=[2]))
+c_("shard_index", M.shard_index,
+   lambda x: np.where((x // 5) == 1, x % 5, -1),
+   ints((8,), 10),
+   fn_call=lambda x: M.shard_index(x, index_num=10, nshards=2, shard_id=1))
+
+
+# =====================================================================
+# random (python/paddle/tensor/random.py) — statistical contracts
+# =====================================================================
+
+def _stat(name, fn, make_call, check, notes=""):
+    register_contract(name, fn, None, lambda rng: (), fn_call=make_call,
+                      category="random", notes=notes)
+    from ..core.registry import get_op
+    get_op(name).extra["check"] = check
+
+
+def _moments(mean, std, shape, mean_tol=0.15, std_tol=0.2):
+    def check(out):
+        out = np.asarray(out, np.float64)
+        assert out.shape == shape, (out.shape, shape)
+        assert abs(out.mean() - mean) < mean_tol * max(1.0, abs(mean)) + 0.1
+        if std:
+            assert abs(out.std() - std) < std_tol * std + 0.1
+    return check
+
+
+_N = (4000,)
+_stat("rand", R.rand, lambda: R.rand(_N), _moments(0.5, 12 ** -0.5, _N))
+_stat("randn", R.randn, lambda: R.randn(_N), _moments(0.0, 1.0, _N))
+_stat("normal", R.normal, lambda: R.normal(2.0, 3.0, _N),
+      _moments(2.0, 3.0, _N))
+_stat("uniform", R.uniform, lambda: R.uniform(_N, min=-2, max=4),
+      _moments(1.0, 6 / 12 ** 0.5, _N))
+_stat("randint", R.randint, lambda: R.randint(0, 10, _N),
+      _moments(4.5, None, _N))
+_stat("randperm", R.randperm,
+      lambda: R.randperm(100),
+      lambda out: np.testing.assert_array_equal(np.sort(np.asarray(out)),
+                                                np.arange(100)))
+_stat("bernoulli", R.bernoulli,
+      lambda: R.bernoulli(np.full(_N, 0.3, np.float32)),
+      _moments(0.3, None, _N))
+_stat("poisson", R.poisson,
+      lambda: R.poisson(np.full(_N, 4.0, np.float32)),
+      _moments(4.0, 2.0, _N))
+_stat("binomial", R.binomial,
+      lambda: R.binomial(np.full(_N, 10.0, np.float32),
+                         np.full(_N, 0.3, np.float32)),
+      _moments(3.0, None, _N))
+_stat("exponential_", R.exponential_,
+      lambda: R.exponential_(np.zeros(_N, np.float32), lam=2.0),
+      _moments(0.5, 0.5, _N))
+_stat("standard_gamma", R.standard_gamma,
+      lambda: R.standard_gamma(np.full(_N, 3.0, np.float32)),
+      _moments(3.0, 3 ** 0.5, _N))
+_stat("log_normal", MT.log_normal,
+      lambda: MT.log_normal(0.0, 0.5, _N),
+      _moments(float(np.exp(0.125)), None, _N))
+_stat("multinomial", R.multinomial,
+      lambda: R.multinomial(np.array([0.1, 0.2, 0.7], np.float32), 4000,
+                            replacement=True),
+      lambda out: abs(float(np.mean(np.asarray(out) == 2)) - 0.7) < 0.1)
+_stat("gumbel_softmax", R.gumbel_softmax,
+      lambda: R.gumbel_softmax(np.log(np.array([[0.2, 0.8]] * 2000,
+                                               np.float32)), hard=True),
+      lambda out: abs(float(np.asarray(out)[:, 1].mean()) - 0.8) < 0.1)

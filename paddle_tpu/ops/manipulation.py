@@ -196,7 +196,8 @@ def index_sample(x, index):
 
 def index_add(x, index, axis, value, name=None):
     x, value = jnp.asarray(x), jnp.asarray(value)
-    idx = [slice(None)] * x.ndim
+    # NB: the paddle-API `slice` op shadows the builtin in this module
+    idx = [slice_obj(None, None, None)] * x.ndim
     idx[axis] = jnp.asarray(index).ravel()
     return x.at[tuple(idx)].add(value)
 

@@ -317,7 +317,7 @@ def logcumsumexp(x, axis=None, name=None):
     x = jnp.asarray(x)
     if axis is None:
         x, axis = x.ravel(), 0
-    return jax.lax.cumlogsumexp(x, axis=axis)
+    return jax.lax.cumlogsumexp(x, axis=int(axis) % x.ndim)
 
 
 def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):

@@ -36,7 +36,7 @@ except Exception:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
-__all__ = ["flash_attention", "flash_attention_with_lse"]
+__all__ = ["flash_attention", "flash_attention_with_lse", "flash_attn_unpadded"]
 
 _LANES = 128  # VPU lane count; scratch row-stat tiles use full lanes
 
@@ -59,8 +59,12 @@ def _block_sizes(seq_q, seq_k, head_dim):
 
 # ---------------- forward ----------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-                scale, causal, bq, bk, nk, off, k_valid):
+def _fwd_kernel(*refs, scale, causal, bq, bk, nk, off, k_valid, has_seg=False):
+    if has_seg:
+        (q_ref, k_ref, v_ref, qs_ref, ks_ref, o_ref, lse_ref,
+         acc_ref, m_ref, l_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     j = pl.program_id(2)
     i = pl.program_id(1)
 
@@ -93,6 +97,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
             s = jnp.where(rows + off >= cols, s, NEG)
         if k_valid is not None:  # ragged non-causal: exclude padded keys
             s = jnp.where(cols < k_valid, s, NEG)
+        if has_seg:  # varlen packing: tokens attend within their sequence
+            s = jnp.where(qs_ref[0, :, 0][:, None] == ks_ref[0, :, 0][None, :],
+                          s, NEG)
         m_prev = m_ref[:, 0]  # [bq]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
         # clamp the subtracted max so fully-masked rows (m_cur == NEG, possible
@@ -112,7 +119,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         lse_ref[0, :, 0] = m_ref[:, 0] + jnp.log(l_safe)
 
 
-def _fwd(q, k, v, scale, causal):
+def _fwd(q, k, v, scale, causal, seg=None):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     qh = jnp.moveaxis(q, 2, 1).reshape(b * h, sq, d)
@@ -133,9 +140,11 @@ def _fwd(q, k, v, scale, causal):
     # Padded keys would otherwise join the softmax (zero-filled keys score 0,
     # not -inf). Under the causal mask they are provably excluded when
     # off >= 0; ragged shapes get an explicit in-kernel validity mask.
-    k_valid = sk if (pk and not causal) else None
+    # Segment (varlen) runs mask padded keys through the mismatched pad ids.
+    k_valid = sk if (pk and not causal and seg is None) else None
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk, nk=nk, off=off, k_valid=k_valid)
+                               bq=bq, bk=bk, nk=nk, off=off, k_valid=k_valid,
+                               has_seg=seg is not None)
 
     if causal:
         # Clamp dead (fully masked) k blocks to the last live block index:
@@ -153,6 +162,14 @@ def _fwd(q, k, v, scale, causal):
         pl.BlockSpec((1, bk, d), kv_index),  # k
         pl.BlockSpec((1, bk, d), kv_index),  # v
     ]
+    inputs = [qh, kh, vh]
+    if seg is not None:
+        sq_arr, sk_arr = _pad_segments(seg, b * h, sq, sk, pq, pk)
+        in_specs += [
+            pl.BlockSpec((1, bq, 1), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, bk, 1), kv_index),
+        ]
+        inputs += [sq_arr, sk_arr]
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, nq, nk),
@@ -173,10 +190,26 @@ def _fwd(q, k, v, scale, causal):
         compiler_params=None if _interpret() else pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(qh, kh, vh)
+    )(*inputs)
     out = out[:, :sq].reshape(b, h, sq, d)
     lse = lse[:, :sq, 0].reshape(b, h, sq)
     return jnp.moveaxis(out, 1, 2), lse
+
+
+def _pad_segments(seg, bh, sq, sk, pq, pk):
+    """Broadcast per-token segment ids to [b*h, S, 1] with mismatching pad
+    ids (-1 for q, -2 for k) so padded rows/cols never join a softmax."""
+    import numpy as np
+    seg_q, seg_k = seg
+    sq_arr = np.full((sq + pq,), -1, np.int32)
+    sq_arr[:sq] = np.asarray(seg_q, np.int32)
+    sk_arr = np.full((sk + pk,), -2, np.int32)
+    sk_arr[:sk] = np.asarray(seg_k, np.int32)
+    sq_b = jnp.broadcast_to(jnp.asarray(sq_arr)[None, :, None],
+                            (bh, sq + pq, 1))
+    sk_b = jnp.broadcast_to(jnp.asarray(sk_arr)[None, :, None],
+                            (bh, sk + pk, 1))
+    return sq_b, sk_b
 
 
 def _scratch(shape):
@@ -187,8 +220,13 @@ def _scratch(shape):
 
 # ---------------- backward ----------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, scale, causal, bq, bk, nk, off):
+def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, off, has_seg=False):
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
+         dq_ref, dq_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+         dq_acc) = refs
     j = pl.program_id(2)
     i = pl.program_id(1)
 
@@ -214,6 +252,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(rows + off >= cols, s, jnp.float32(-1e30))
+        if has_seg:
+            s = jnp.where(qs_ref[0, :, 0][:, None] == ks_ref[0, :, 0][None, :],
+                          s, jnp.float32(-1e30))
         # clamped so fully-masked rows (lse == -1e30 sentinel) give p == 0
         p = jnp.exp(s - jnp.maximum(lse, jnp.float32(-1e25))[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -227,9 +268,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, bq, bk,
-                    nq, off):
+def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, off, has_seg=False):
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+         dk_acc, dv_acc) = refs
     i = pl.program_id(2)  # q block (innermost)
     j = pl.program_id(1)  # k block
 
@@ -256,6 +301,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(rows + off >= cols, s, jnp.float32(-1e30))
+        if has_seg:
+            s = jnp.where(qs_ref[0, :, 0][:, None] == ks_ref[0, :, 0][None, :],
+                          s, jnp.float32(-1e30))
         # clamped so fully-masked rows (lse == -1e30 sentinel) give p == 0
         p = jnp.exp(s - jnp.maximum(lse, jnp.float32(-1e25))[:, None])
         dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
@@ -280,7 +328,7 @@ def _bwd(scale, causal, res, g):
     return flash_block_grads(q, k, v, do, lse, delta, scale=scale, causal=causal)
 
 
-def flash_block_grads(q, k, v, do, lse, delta, *, scale, causal):
+def flash_block_grads(q, k, v, do, lse, delta, *, scale, causal, seg=None):
     """Gradient building block given precomputed row stats.
 
     Inputs: q/do [b,sq,h,d]; k/v [b,sk,h,d]; lse/delta [b,h,sq] where lse is
@@ -316,6 +364,9 @@ def flash_block_grads(q, k, v, do, lse, delta, *, scale, causal):
     SQ, SK = sq + pq_, sk + pk_
     nq, nk = SQ // bq, SK // bk
     common_in = [qh, kh, vh, doh, lseh, deltah]
+    if seg is not None:
+        sq_arr, sk_arr = _pad_segments(seg, b * h, sq, sk, pq_, pk_)
+        common_in += [sq_arr, sk_arr]
     if causal:
         def kv_index(b_, i, j):  # dead k blocks re-use the last live index (no DMA)
             last_live = jnp.maximum((i * bq + bq - 1 + off) // bk, 0)
@@ -337,9 +388,14 @@ def flash_block_grads(q, k, v, do, lse, delta, *, scale, causal):
         pl.BlockSpec((1, bq, 1), lambda b_, i, j: (b_, i, 0)),
         pl.BlockSpec((1, bq, 1), lambda b_, i, j: (b_, i, 0)),
     ]
+    if seg is not None:
+        in_specs_q += [
+            pl.BlockSpec((1, bq, 1), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, bk, 1), kv_index),
+        ]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk, off=off),
+                          bq=bq, bk=bk, nk=nk, off=off, has_seg=seg is not None),
         grid=(b * h, nq, nk),
         in_specs=in_specs_q,
         out_specs=pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0)),
@@ -355,9 +411,14 @@ def flash_block_grads(q, k, v, do, lse, delta, *, scale, causal):
         pl.BlockSpec((1, bq, 1), q_index_kv),
         pl.BlockSpec((1, bq, 1), q_index_kv),
     ]
+    if seg is not None:
+        in_specs_kv += [
+            pl.BlockSpec((1, bq, 1), q_index_kv),
+            pl.BlockSpec((1, bk, 1), lambda b_, j, i: (b_, j, 0)),
+        ]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq, off=off),
+                          bq=bq, bk=bk, nq=nq, off=off, has_seg=seg is not None),
         grid=(b * h, nk, nq),
         in_specs=in_specs_kv,
         out_specs=[
@@ -411,3 +472,59 @@ def flash_attention_with_lse(q, k, v, causal: bool = False,
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     return _fwd(q, k, v, scale, causal)
+
+
+def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                        causal: bool = False, scale: float | None = None):
+    """Varlen flash attention over PACKED sequences (parity:
+    FlashAttnUnpaddedKernel, phi/kernels/gpu/flash_attn_kernel.cu:27).
+
+    q: [total_q, num_heads, head_dim] — b sequences packed along dim 0;
+    cu_seqlens_q/k: HOST-known cumulative lengths [b+1] (list/np array; they
+    define the segment structure of the kernel, so they are static — jit
+    callers treat them like shapes). Tokens attend only within their own
+    sequence; ``causal`` additionally applies per-sequence causal masking
+    (sequences must have seqlen_q == seqlen_k when causal).
+
+    Implementation: segment-ids threaded into the tiled flash kernel — one
+    kernel launch for the whole packed batch, no per-sequence padding.
+    """
+    import numpy as np
+    cu_q = np.asarray(cu_seqlens_q, np.int64)
+    cu_k = np.asarray(cu_seqlens_k, np.int64)
+    if causal and not np.array_equal(np.diff(cu_q), np.diff(cu_k)):
+        raise ValueError("causal varlen requires seqlen_q == seqlen_k "
+                         "per sequence")
+    total_q, h, d = q.shape
+    total_k = k.shape[0]
+    if total_q != cu_q[-1] or total_k != cu_k[-1]:
+        raise ValueError("cu_seqlens totals do not match packed lengths")
+    seg_q = np.searchsorted(cu_q, np.arange(total_q), side="right") - 1
+    seg_k = np.searchsorted(cu_k, np.arange(total_k), side="right") - 1
+    # causal note: with equal per-sequence q/k lengths the packings align, so
+    # the kernel's GLOBAL causal mask restricted to same-segment pairs is
+    # exactly per-sequence causal — no per-segment offset needed.
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    seg = (seg_q, seg_k)
+
+    @jax.custom_vjp
+    def run(q, k, v):
+        out, _ = _fwd(q[None], k[None], v[None], scale, causal, seg=seg)
+        return out[0]
+
+    def run_fwd(q, k, v):
+        out, lse = _fwd(q[None], k[None], v[None], scale, causal, seg=seg)
+        return out[0], (q, k, v, out[0], lse)
+
+    def run_bwd(res, g):
+        q, k, v, out, lse = res
+        delta = jnp.moveaxis(
+            jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)[None], 2, 1)
+        dq, dk, dv = flash_block_grads(q[None], k[None], v[None], g[None],
+                                       lse, delta, scale=scale,
+                                       causal=causal, seg=seg)
+        return dq[0], dk[0], dv[0]
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(q, k, v)

@@ -1,0 +1,109 @@
+"""Signal processing (parity: python/paddle/signal.py — frame, overlap_add,
+stft, istft)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import fft as _fft
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice x into overlapping frames along ``axis``; the new frame_length
+    dim is inserted before the (shortened) frames dim when axis=-1 (paddle
+    layout: [..., frame_length, num_frames])."""
+    x = jnp.asarray(x)
+    if axis not in (-1, x.ndim - 1, 0):
+        raise ValueError("frame: axis must be first or last")
+    if axis == 0:
+        x = jnp.moveaxis(x, 0, -1)
+    n = x.shape[-1]
+    num = 1 + (n - frame_length) // hop_length
+    idx = (np.arange(frame_length)[:, None]
+           + hop_length * np.arange(num)[None, :])
+    out = x[..., idx]  # [..., frame_length, num]
+    if axis == 0:
+        out = jnp.moveaxis(out, (-2, -1), (1, 0))
+    return out
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: x [..., frame_length, num_frames] -> signal."""
+    x = jnp.asarray(x)
+    if axis == 0:
+        x = jnp.moveaxis(x, (0, 1), (-1, -2))
+    fl, num = x.shape[-2], x.shape[-1]
+    n = fl + hop_length * (num - 1)
+    out = jnp.zeros(x.shape[:-2] + (n,), x.dtype)
+    for f in range(num):  # static python loop: num is a static shape
+        out = out.at[..., f * hop_length: f * hop_length + fl].add(x[..., f])
+    if axis == 0:
+        out = jnp.moveaxis(out, -1, 0)
+    return out
+
+
+def _window_arr(window, n_fft, dtype=jnp.float32):
+    if window is None:
+        return jnp.ones((n_fft,), dtype)
+    return jnp.asarray(window, dtype)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (parity: paddle.signal.stft).
+    x: [..., seq_len] real (complex allowed with onesided=False).
+    Returns [..., n_fft(/2+1), num_frames] complex."""
+    x = jnp.asarray(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = _window_arr(window, win_length)
+    if win_length < n_fft:  # center-pad window to n_fft
+        lp = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lp, n_fft - win_length - lp))
+    if center:
+        pad = n_fft // 2
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)], mode=pad_mode)
+    frames = frame(x, n_fft, hop_length)  # [..., n_fft, num]
+    frames = frames * w[:, None]
+    if onesided:
+        out = _fft.rfft(frames, axis=-2)
+    else:
+        out = _fft.fft(frames, axis=-2)
+    if normalized:
+        out = out / jnp.sqrt(jnp.float32(n_fft))
+    return out
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with window-envelope normalization (COLA division)."""
+    x = jnp.asarray(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = _window_arr(window, win_length)
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lp, n_fft - win_length - lp))
+    if normalized:
+        x = x * jnp.sqrt(jnp.float32(n_fft))
+    if onesided:
+        frames = _fft.irfft(x, n=n_fft, axis=-2)
+    else:
+        frames = _fft.ifft(x, axis=-2).real
+    if return_complex:
+        frames = _fft.ifft(x, axis=-2)
+    sig = overlap_add(frames * w[:, None], hop_length)
+    env = overlap_add(jnp.broadcast_to((w * w)[:, None],
+                                       (n_fft, x.shape[-1])), hop_length)
+    sig = sig / jnp.maximum(env, 1e-10)
+    if center:
+        pad = n_fft // 2
+        sig = sig[..., pad:-pad] if pad else sig
+    if length is not None:
+        sig = sig[..., :length]
+    return sig

@@ -1,0 +1,5 @@
+"""paddle_tpu.utils (parity: python/paddle/utils/ — the custom-op toolchain
+lives in utils.cpp_extension in the reference; here in utils.custom_op)."""
+
+from . import custom_op  # noqa: F401
+from . import cpp_extension  # noqa: F401

@@ -1,0 +1,74 @@
+"""cpp_extension compatibility surface (parity:
+python/paddle/utils/cpp_extension/cpp_extension.py — ``setup`` :79,
+``load`` :795, ``CppExtension``/``CUDAExtension``).
+
+On TPU the out-of-tree kernel language is Pallas, not C++/CUDA — the
+equivalent toolchain is :mod:`paddle_tpu.utils.custom_op`. This module keeps
+the reference's entry-point names so ported build scripts fail with an
+actionable message instead of an AttributeError, and supports the one case
+where native code IS still the answer on TPU hosts: building a plain CPU
+C++ extension (data loading / tokenization fast paths) with setuptools.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+import tempfile
+
+__all__ = ["setup", "load", "CppExtension", "CUDAExtension", "load_inline"]
+
+_PALLAS_MSG = (
+    "TPU kernels are written in Pallas, not {kind}: register them with "
+    "paddle_tpu.utils.custom_op.register_custom_op (custom VJP + sharding "
+    "rule + contract-test enrollment). cpp_extension.{fn} only builds "
+    "host-CPU helper extensions."
+)
+
+
+def CppExtension(sources, *args, **kwargs):
+    return {"kind": "cpp", "sources": sources, "kwargs": kwargs}
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    raise RuntimeError(_PALLAS_MSG.format(kind="CUDA", fn="CUDAExtension"))
+
+
+def setup(**attrs):
+    raise RuntimeError(_PALLAS_MSG.format(kind="C++/CUDA", fn="setup"))
+
+
+def load(name, sources, extra_cxx_cflags=None, build_directory=None,
+         verbose=False, **kwargs):
+    """JIT-build a host-CPU shared library from C++ sources and dlopen it
+    via ctypes (the reference's jit ``load`` :795, minus CUDA). Returns the
+    ctypes CDLL — symbol access is the caller's contract."""
+    import ctypes
+
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), f"paddle_tpu_ext_{name}")
+    os.makedirs(build_dir, exist_ok=True)
+    so_path = os.path.join(build_dir, f"{name}.so")
+    srcs = [sources] if isinstance(sources, str) else list(sources)
+    if (not os.path.exists(so_path)
+            or any(os.path.getmtime(s) > os.path.getmtime(so_path)
+                   for s in srcs if os.path.exists(s))):
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+               f"-I{sysconfig.get_paths()['include']}",
+               *(extra_cxx_cflags or []), *srcs, "-o", so_path]
+        if verbose:
+            print(" ".join(cmd))
+        subprocess.run(cmd, check=True)
+    return ctypes.CDLL(so_path)
+
+
+def load_inline(name, cpp_source, functions=None, **kwargs):
+    """Build from an inline C++ source string (torch-style convenience)."""
+    build_dir = os.path.join(tempfile.gettempdir(),
+                             f"paddle_tpu_ext_{name}_src")
+    os.makedirs(build_dir, exist_ok=True)
+    src = os.path.join(build_dir, f"{name}.cc")
+    with open(src, "w") as f:
+        f.write(cpp_source)
+    return load(name, [src], build_directory=build_dir, **kwargs)

@@ -1,0 +1,99 @@
+"""Export / inference path (parity: jit.save -> translated_layer loadable
+without model source; AnalysisPredictor serving contract)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+
+RNG = np.random.default_rng(0)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _make_model():
+    pt.seed(11)
+    return nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+
+
+def test_save_load_same_logits(tmp_path):
+    model = _make_model()
+    model.eval()
+    x = RNG.standard_normal((3, 16)).astype(np.float32)
+    want = np.asarray(model(jnp.asarray(x)))
+    prefix = str(tmp_path / "m")
+    pt.jit.save(model, prefix, input_spec=[pt.jit.InputSpec([3, 16])])
+    loaded = pt.jit.load(prefix)
+    got = np.asarray(loaded(x))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # StableHLO text is exposed for external/C++ consumers
+    assert "stablehlo" in loaded.mlir_module() or "func.func" in loaded.mlir_module()
+
+
+def test_load_runs_in_fresh_process_without_source(tmp_path):
+    """The exported artifact must run in a NEW process that never imports
+    the model-building code — the translated_layer contract."""
+    model = _make_model()
+    model.eval()
+    x = RNG.standard_normal((2, 16)).astype(np.float32)
+    want = np.asarray(model(jnp.asarray(x)))
+    prefix = str(tmp_path / "m")
+    pt.jit.save(model, prefix, input_spec=[pt.jit.InputSpec([2, 16])])
+    np.save(tmp_path / "x.npy", x)
+    code = textwrap.dedent(f"""
+        import sys; sys.path.insert(0, {REPO!r})
+        import jax; jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        from paddle_tpu.jit.save_load import load
+        loaded = load({prefix!r})
+        x = np.load({str(tmp_path / 'x.npy')!r})
+        out = np.asarray(loaded(x))
+        np.save({str(tmp_path / 'out.npy')!r}, out)
+    """)
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    got = np.load(tmp_path / "out.npy")
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_api(tmp_path):
+    model = _make_model()
+    model.eval()
+    x = RNG.standard_normal((2, 16)).astype(np.float32)
+    want = np.asarray(model(jnp.asarray(x)))
+    prefix = str(tmp_path / "m")
+    pt.jit.save(model, prefix, input_spec=[pt.jit.InputSpec([2, 16])])
+    config = pt.inference.Config(prefix + ".pdmodel")
+    predictor = pt.inference.create_predictor(config)
+    h = predictor.get_input_handle(predictor.get_input_names()[0])
+    h.copy_from_cpu(x)
+    outs = predictor.run()
+    np.testing.assert_allclose(outs[0], want, rtol=1e-5, atol=1e-6)
+    out_h = predictor.get_output_handle(predictor.get_output_names()[0])
+    np.testing.assert_allclose(out_h.copy_to_cpu(), want, rtol=1e-5, atol=1e-6)
+
+
+def test_save_llama_reload_same_logits(tmp_path):
+    """Flagship export: save Llama, reload, same logits (verdict done-bar)."""
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    pt.seed(12)
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=96,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=32,
+                      mp_axis=None, fsdp_axis=None)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids = RNG.integers(0, 64, (2, 16))
+    want = np.asarray(model(jnp.asarray(ids)))
+    prefix = str(tmp_path / "llama")
+    pt.jit.save(model, prefix,
+                input_spec=[pt.jit.InputSpec([2, 16], dtype="int64")])
+    loaded = pt.jit.load(prefix)
+    got = np.asarray(loaded(ids))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

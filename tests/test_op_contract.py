@@ -37,10 +37,27 @@ def _arity(info):
 
 
 CASES = [(name, info) for name, info in sorted(all_ops().items()) if info.ref is not None]
+STAT_CASES = [(n, i) for n, i in sorted(all_ops().items())
+              if i.extra.get("check") is not None]
 
 
-@pytest.mark.parametrize("name,info", CASES, ids=[c[0] for c in CASES])
-def test_forward_matches_numpy(name, info):
+def test_contract_inventory_breadth():
+    """The registry must enumerate the whole public op surface — the
+    single-source-of-truth promise (ops.yaml parity): >= 200 rows under
+    contract, spanning every tensor-API family."""
+    ops = all_ops()
+    covered = [n for n, i in ops.items()
+               if i.ref is not None or i.extra.get("check")]
+    assert len(covered) >= 200, f"only {len(covered)} ops under contract"
+    cats = {ops[n].category for n in covered}
+    assert {"elementwise", "contract", "random"} <= cats
+
+
+def _inputs_for(name, info):
+    if info.make_inputs is not None:
+        import zlib
+        rng = np.random.default_rng(zlib.crc32(name.encode()))  # stable seed
+        return list(info.make_inputs(rng))
     xs = _gen_inputs(info)
     if name in ("sqrt", "log", "log2", "log10", "log1p", "rsqrt"):
         xs = [np.abs(x) + 0.1 for x in xs]
@@ -52,33 +69,64 @@ def test_forward_matches_numpy(name, info):
         xs = [np.abs(x * 10).astype(np.int32) + 1 for x in xs]
     if name in ("bitwise_left_shift", "bitwise_right_shift"):
         xs = [np.abs(x * 10).astype(np.int32) % 8 for x in xs]
-    got = np.asarray(info.fn(*xs))
+    return xs
+
+
+def _compare_trees(got, want, rtol, atol):
+    gl = jax.tree.leaves(got)
+    wl = jax.tree.leaves(
+        want if isinstance(want, (tuple, list)) else (want,))
+    assert len(gl) == len(wl), f"output arity {len(gl)} != ref {len(wl)}"
+    for g, w in zip(gl, wl):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("name,info", CASES, ids=[c[0] for c in CASES])
+def test_forward_matches_numpy(name, info):
+    xs = _inputs_for(name, info)
+    call = info.fn_call or info.fn
+    got = call(*xs)
     want = info.ref(*xs)
-    np.testing.assert_allclose(got, want, rtol=5e-4, atol=1e-4)
+    if isinstance(got, jax.Array) and not isinstance(want, (tuple, list)):
+        np.testing.assert_allclose(np.asarray(got), want, rtol=5e-4, atol=1e-4)
+    else:
+        _compare_trees(got, want, rtol=5e-4, atol=1e-4)
 
 
-GRAD_CASES = [(n, i) for n, i in CASES if i.grad_ref and i.category == "elementwise"]
+@pytest.mark.parametrize("name,info", STAT_CASES, ids=[c[0] for c in STAT_CASES])
+def test_random_op_statistics(name, info):
+    """Sampling ops: shape/dtype/moment contracts (the reference tests these
+    the same way — e.g. test_poisson_op.py checks sample moments)."""
+    out = (info.fn_call or info.fn)()
+    info.extra["check"](out)
+
+
+GRAD_CASES = [(n, i) for n, i in CASES if i.grad_ref]
 
 
 @pytest.mark.parametrize("name,info", GRAD_CASES, ids=[c[0] for c in GRAD_CASES])
 def test_grad_matches_numeric(name, info):
     if name in ("gcd", "lcm", "bitwise_left_shift", "bitwise_right_shift"):
         pytest.skip("integer op")
-    xs = _gen_inputs(info)
-    if name in ("sqrt", "log", "log2", "log10", "log1p", "rsqrt"):
-        xs = [np.abs(x) + 0.5 for x in xs]
-    if name in ("asin", "acos", "atanh"):
-        xs = [np.clip(x, -0.8, 0.8) for x in xs]
-    if name == "acosh":
-        xs = [np.abs(x) + 1.5 for x in xs]
+    xs = _inputs_for(name, info)
+    if info.make_inputs is None:
+        if name in ("sqrt", "log", "log2", "log10", "log1p", "rsqrt"):
+            xs = [np.abs(x) + 0.5 for x in xs]
+        if name in ("asin", "acos", "atanh"):
+            xs = [np.clip(x, -0.8, 0.8) for x in xs]
+        if name == "acosh":
+            xs = [np.abs(x) + 1.5 for x in xs]
+    call = info.fn_call or info.fn
 
     def scalar_fn(*args):
-        return jnp.sum(info.fn(*args))
+        return jnp.sum(jnp.concatenate(
+            [jnp.ravel(l).astype(jnp.float32) for l in jax.tree.leaves(
+                call(*args))]))
 
     g = jax.grad(scalar_fn)(*[jnp.asarray(x) for x in xs])
-    # central differences on the first input
-    eps = 1e-3
-    num = np.zeros_like(xs[0])
+    # central differences on a few elements of the first input
+    eps = 1e-2 if name in ("det",) else 1e-3
     it = np.nditer(xs[0], flags=["multi_index"])
     flat_checks = 0
     while not it.finished and flat_checks < 8:
@@ -87,10 +135,28 @@ def test_grad_matches_numeric(name, info):
         xm = [x.copy() for x in xs]
         xp[0][idx] += eps
         xm[0][idx] -= eps
-        num[idx] = (float(scalar_fn(*xp)) - float(scalar_fn(*xm))) / (2 * eps)
-        np.testing.assert_allclose(np.asarray(g)[idx], num[idx], rtol=5e-2, atol=5e-3)
+        num = (float(scalar_fn(*xp)) - float(scalar_fn(*xm))) / (2 * eps)
+        np.testing.assert_allclose(np.asarray(g)[idx], num, rtol=5e-2,
+                                   atol=5e-3)
         flat_checks += 1
         it.iternext()
+
+
+BF16_CASES = [(n, i) for n, i in CASES
+              if i.category == "elementwise" and i.grad_ref
+              and n not in ("tan",)]  # poles blow past bf16 tolerance
+
+
+@pytest.mark.parametrize("name,info", BF16_CASES, ids=[c[0] for c in BF16_CASES])
+def test_forward_bfloat16(name, info):
+    """bf16 dtype pass (the MXU-native dtype): loose tolerance vs the fp32
+    numpy reference — parity with OpTest's bf16 place/dtype matrix."""
+    xs = _inputs_for(name, info)
+    xs16 = [jnp.asarray(x, jnp.bfloat16) if x.dtype == np.float32 else x
+            for x in xs]
+    got = np.asarray((info.fn_call or info.fn)(*xs16), np.float32)
+    want = np.asarray(info.ref(*xs), np.float32)
+    np.testing.assert_allclose(got, want, rtol=5e-2, atol=5e-2)
 
 
 def test_matmul_against_numpy():
