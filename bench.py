@@ -4,9 +4,12 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Config: a ~420M-param Llama (hidden 2048, 8 layers) at seq 2048, bf16 params
 and compute, fused train step (forward+backward+AdamW in one XLA program with
-buffer donation), flash-attention Pallas kernel on the causal path. MFU is
-computed against the v5e nominal bf16 peak (197 TFLOP/s). vs_baseline is
-MFU / 0.40 (the BASELINE.md north-star target).
+buffer donation), flash-attention Pallas kernel on the causal path, fused
+Pallas RMS-norm. Batch 4 with NO activation recompute — measured fastest on
+this chip (sweep 2026-07: b4/no-remat 25.7k tok/s vs b8/remat 22.1k, b6/
+no-remat 24.1k; b8/no-remat exceeds compile memory). MFU against the v5e
+nominal bf16 peak (197 TFLOP/s); vs_baseline is MFU / 0.40 (the BASELINE.md
+north-star target).
 """
 
 from __future__ import annotations
@@ -25,12 +28,12 @@ def main():
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 
     pt.seed(0)
-    batch, seq = 8, 2048
+    batch, seq = 4, 2048
     cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
                       num_hidden_layers=8, num_attention_heads=16,
                       num_key_value_heads=8, max_position_embeddings=seq,
                       dtype="bfloat16", mp_axis=None, fsdp_axis=None,
-                      recompute=True)
+                      recompute=False)
     model = LlamaForCausalLM(cfg)
     n_params = model.num_params()
     opt = pt.optimizer.AdamW(learning_rate=1e-4, parameters=model)

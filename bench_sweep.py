@@ -1,0 +1,73 @@
+"""Bench config sweep (dev tool, not the driver's bench.py): measures step
+time for several remat/batch configurations on the real chip to pick the
+honest best for bench.py."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def run_one(batch, seq, recompute, policy, interval=1, iters=6):
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                      intermediate_size=5632, num_hidden_layers=8,
+                      num_attention_heads=16, num_key_value_heads=8,
+                      max_position_embeddings=seq, dtype="bfloat16",
+                      mp_axis=None, fsdp_axis=None, recompute=recompute,
+                      recompute_policy=policy, recompute_interval=interval)
+    model = LlamaForCausalLM(cfg)
+    n_params = model.num_params()
+    opt = pt.optimizer.AdamW(learning_rate=1e-4, parameters=model)
+    step = pt.jit.TrainStep(model, opt,
+                            lambda logits, labels: model.loss(logits, labels))
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    _ = float(step(ids, ids))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, ids)
+    _ = float(loss)
+    dt = (time.perf_counter() - t0) / iters
+    tokens_per_sec = batch * seq / dt
+    mfu = 6.0 * n_params * tokens_per_sec / 197e12
+    return dict(batch=batch, seq=seq, recompute=recompute, policy=policy,
+                step_ms=round(dt * 1000, 1),
+                tokens_per_sec=round(tokens_per_sec, 0), mfu=round(mfu, 4))
+
+
+def main():
+    spec = sys.argv[1] if len(sys.argv) > 1 else "all"
+    combos = {
+        "base": (8, 2048, True, "full"),
+        "dots": (8, 2048, True, "dots"),
+        "noremat": (8, 2048, False, "full"),
+        "b16dots": (16, 2048, True, "dots"),
+        "b16": (16, 2048, True, "full"),
+        "int2": (8, 2048, True, "full", 2),
+        "int4": (8, 2048, True, "full", 4),
+        "b4nore": (4, 2048, False, "full"),
+        "b12": (12, 2048, True, "full"),
+        "b6nore": (6, 2048, False, "full"),
+        "b5nore": (5, 2048, False, "full"),
+    }
+    picks = combos.keys() if spec == "all" else spec.split(",")
+    for name in picks:
+        try:
+            print(name, json.dumps(run_one(*combos[name])), flush=True)
+        except Exception as e:  # OOM etc.
+            print(name, "FAILED:", type(e).__name__, str(e)[:200], flush=True)
+
+
+if __name__ == "__main__":
+    main()
+# extra combos appended during tuning

@@ -51,6 +51,13 @@ from . import distribution  # noqa: E402
 from . import fft  # noqa: E402
 from . import signal  # noqa: E402
 from . import utils  # noqa: E402
+from . import autograd  # noqa: E402
+from .autograd import no_grad  # noqa: E402  (paddle.no_grad parity)
+from . import sparse  # noqa: E402
+from . import quantization  # noqa: E402
+from . import audio  # noqa: E402
+from . import text  # noqa: E402
+from . import device  # noqa: E402
 from .hapi import Model  # noqa: E402  (paddle.Model parity)
 from .hapi import callbacks  # noqa: E402  (paddle.callbacks parity)
 

@@ -1,0 +1,10 @@
+"""Audio feature extraction (parity: python/paddle/audio/ — functional
+{window, mel, spectrum} + features {Spectrogram, MelSpectrogram,
+LogMelSpectrogram, MFCC})."""
+
+from . import functional  # noqa: F401
+from .features import (LogMelSpectrogram, MelSpectrogram, MFCC,  # noqa: F401
+                       Spectrogram)
+
+__all__ = ["functional", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

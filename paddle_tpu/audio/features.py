@@ -1,0 +1,83 @@
+"""Audio feature layers (parity: python/paddle/audio/features/layers.py —
+Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import signal as _signal
+from ..nn.module import Layer
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512, hop_length: int | None = None,
+                 win_length: int | None = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.register_buffer("window",
+                             AF.get_window(window, self.win_length),
+                             persistable=False)
+
+    def forward(self, x):
+        spec = _signal.stft(x, self.n_fft, hop_length=self.hop_length,
+                            win_length=self.win_length, window=self.window,
+                            center=self.center, pad_mode=self.pad_mode)
+        return jnp.abs(spec) ** self.power
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: int | None = None, win_length: int | None = None,
+                 window: str = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0,
+                 f_max: float | None = None, htk: bool = False,
+                 norm: str = "slaney", dtype: str = "float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center, pad_mode)
+        self.register_buffer(
+            "fbank", AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min,
+                                             f_max, htk, norm),
+            persistable=False)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)  # [..., n_fft//2+1, frames]
+        return jnp.einsum("mf,...ft->...mt", self.fbank, spec)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: float | None = None, **kw):
+        super().__init__()
+        self.mel = MelSpectrogram(sr=sr, **kw)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return AF.power_to_db(self.mel(x), self.ref_value, self.amin,
+                              self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_mels: int = 64,
+                 **kw):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(sr=sr, n_mels=n_mels, **kw)
+        self.register_buffer("dct", AF.create_dct(n_mfcc, n_mels),
+                             persistable=False)
+
+    def forward(self, x):
+        logmel = self.log_mel(x)  # [..., n_mels, frames]
+        return jnp.einsum("mk,...mt->...kt", self.dct, logmel)

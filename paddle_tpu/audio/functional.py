@@ -1,0 +1,117 @@
+"""Audio functional ops (parity: python/paddle/audio/functional/
+{window.py, functional.py} — hz/mel conversion, fbank matrix, dct matrix,
+power_to_db, get_window)."""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "create_dct", "power_to_db", "get_window"]
+
+
+def hz_to_mel(freq, htk: bool = False):
+    freq = jnp.asarray(freq, jnp.float32)
+    if htk:
+        return 2595.0 * jnp.log10(1.0 + freq / 700.0)
+    # Slaney formula (librosa default, matches the reference)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (freq - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(freq >= min_log_hz,
+                     min_log_mel + jnp.log(freq / min_log_hz) / logstep,
+                     mels)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    mel = jnp.asarray(mel, jnp.float32)
+    if htk:
+        return 700.0 * (10.0 ** (mel / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * mel
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(mel >= min_log_mel,
+                     min_log_hz * jnp.exp(logstep * (mel - min_log_mel)),
+                     freqs)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False):
+    low = hz_to_mel(f_min, htk)
+    high = hz_to_mel(f_max, htk)
+    return mel_to_hz(jnp.linspace(low, high, n_mels), htk)
+
+
+def fft_frequencies(sr: int, n_fft: int):
+    return jnp.linspace(0, sr / 2, 1 + n_fft // 2)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: float | None = None,
+                         htk: bool = False, norm: str = "slaney"):
+    """Triangular mel filterbank [n_mels, 1 + n_fft//2]."""
+    f_max = f_max or sr / 2.0
+    fftfreqs = fft_frequencies(sr, n_fft)
+    melfreqs = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = jnp.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2: n_mels + 2] - melfreqs[:n_mels])
+        weights = weights * enorm[:, None]
+    return weights
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: str | None = "ortho"):
+    """DCT-II matrix [n_mels, n_mfcc] (parity: audio/functional create_dct)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)[None, :]
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct = dct * jnp.where(k == 0, 1.0 / math.sqrt(n_mels),
+                              math.sqrt(2.0 / n_mels))
+    else:
+        dct = dct * 2.0
+    return dct
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: float | None = 80.0):
+    s = jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return log_spec
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True):
+    """hann/hamming/blackman/bartlett/kaiser/... (scipy-free subset)."""
+    n = win_length
+    sym = not fftbins
+    m = n if sym else n + 1
+    t = np.arange(m)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * t / (m - 1))
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * t / (m - 1))
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * t / (m - 1))
+             + 0.08 * np.cos(4 * np.pi * t / (m - 1)))
+    elif window == "bartlett":
+        w = 1.0 - np.abs(2 * t / (m - 1) - 1)
+    elif window in ("rect", "boxcar", "ones"):
+        w = np.ones(m)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    w = w[:n]
+    return jnp.asarray(w, jnp.float32)

@@ -1,0 +1,249 @@
+"""paddle_tpu.autograd — user-facing autodiff extension points (parity:
+python/paddle/autograd/ — py_layer.py PyLayer/PyLayerContext,
+saved_tensors_hooks, backward(), and the functional grad/jacobian/hessian
+family the reference exposes via paddle.autograd + paddle.incubate.autograd).
+
+TPU-native collapse: there is no tape — jax.grad IS the engine — so
+``PyLayer`` lowers to jax.custom_vjp, ``saved_tensors_hooks`` intercepts
+``ctx.save_for_backward`` (the one place a user can touch saved
+activations), and ``.backward()`` UX lives in jit.TrainStep. Gradient
+hooks on parameters are applied by TrainStep between the vjp and the
+optimizer (the GradNode-hook slot, fluid/eager/grad_node_info.h:197).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["PyLayer", "PyLayerContext", "saved_tensors_hooks", "no_grad",
+           "grad", "jacobian", "hessian", "vjp", "jvp",
+           "register_param_grad_hook", "clear_param_grad_hooks",
+           "apply_param_grad_hooks"]
+
+
+# ---------------- saved-tensor hooks ----------------
+
+_SAVED_HOOKS: list[tuple[Callable, Callable]] = []
+
+
+@contextlib.contextmanager
+def saved_tensors_hooks(pack_hook: Callable, unpack_hook: Callable):
+    """Parity: paddle.autograd.saved_tensors_hooks — transform tensors as
+    PyLayer saves them for backward (e.g. fp8-compress, host-offload) and
+    invert on read. Active for PyLayers *traced* inside the context."""
+    _SAVED_HOOKS.append((pack_hook, unpack_hook))
+    try:
+        yield
+    finally:
+        _SAVED_HOOKS.pop()
+
+
+class PyLayerContext:
+    """Parity: py_layer.py PyLayerContext."""
+
+    def __init__(self):
+        self._saved = ()
+        self._packed = False
+        self.__dict__["_attrs"] = {}
+
+    def save_for_backward(self, *tensors):
+        if _SAVED_HOOKS:
+            pack, _ = _SAVED_HOOKS[-1]
+            tensors = tuple(pack(t) for t in tensors)
+            self._packed = True
+        self._saved = tensors
+
+    def saved_tensor(self):
+        saved = self._saved
+        if self._packed and _SAVED_HOOKS:
+            _, unpack = _SAVED_HOOKS[-1]
+            saved = tuple(unpack(t) for t in saved)
+        return saved
+
+    # arbitrary attribute stash (ctx.alpha = ... pattern)
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+
+class PyLayer:
+    """Parity: paddle.autograd.PyLayer (py_layer.py).
+
+    Subclass with static ``forward(ctx, *args)`` and ``backward(ctx,
+    *grad_outputs)``; call via ``.apply(*args)``. Lowered to jax.custom_vjp:
+    forward runs once per trace, ctx state (saved tensors + attributes)
+    becomes the vjp residual, backward returns grads for every tensor
+    input (non-tensor inputs receive None and must come AFTER tensor args
+    or be passed as keywords)::
+
+        class Scale(PyLayer):
+            @staticmethod
+            def forward(ctx, x, alpha):
+                ctx.save_for_backward(x)
+                ctx.alpha = alpha
+                return x * alpha
+
+            @staticmethod
+            def backward(ctx, g):
+                (x,) = ctx.saved_tensor()
+                return g * ctx.alpha   # one grad per tensor input
+
+        y = Scale.apply(x, 2.0)
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grad_outputs):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        is_tensor = [isinstance(a, (jax.Array, jnp.ndarray)) or
+                     hasattr(a, "shape") and hasattr(a, "dtype")
+                     for a in args]
+        tensor_idx = [i for i, t in enumerate(is_tensor) if t]
+        static_args = {i: a for i, a in enumerate(args) if not is_tensor[i]}
+
+        @jax.custom_vjp
+        def run(*tensors):
+            ctx = PyLayerContext()
+            full = list(args)
+            for i, t in zip(tensor_idx, tensors):
+                full[i] = t
+            return cls.forward(ctx, *full, **kwargs)
+
+        def run_fwd(*tensors):
+            ctx = PyLayerContext()
+            full = list(args)
+            for i, t in zip(tensor_idx, tensors):
+                full[i] = t
+            out = cls.forward(ctx, *full, **kwargs)
+            res = (ctx._saved, ctx._packed,
+                   {k: v for k, v in ctx.__dict__.items()
+                    if k not in ("_saved", "_packed", "_attrs")})
+            return out, res
+
+        def run_bwd(res, g):
+            ctx = PyLayerContext()
+            object.__setattr__(ctx, "_saved", res[0])
+            object.__setattr__(ctx, "_packed", res[1])
+            for k, v in res[2].items():
+                object.__setattr__(ctx, k, v)
+            gs = g if isinstance(g, tuple) else (g,)
+            grads = cls.backward(ctx, *gs)
+            if not isinstance(grads, tuple):
+                grads = (grads,)
+            # grads correspond to tensor inputs in order
+            if len(grads) == len(args):  # user returned per-ALL-args grads
+                grads = tuple(grads[i] for i in tensor_idx)
+            if len(grads) != len(tensor_idx):
+                raise ValueError(
+                    f"backward returned {len(grads)} grads for "
+                    f"{len(tensor_idx)} tensor inputs")
+            return tuple(
+                jnp.zeros_like(t) if gr is None else gr
+                for gr, t in zip(grads, [args[i] for i in tensor_idx]))
+
+        run.defvjp(run_fwd, run_bwd)
+        return run(*[args[i] for i in tensor_idx])
+
+
+# ---------------- no_grad / functional transforms ----------------
+
+class no_grad:
+    """Parity: paddle.no_grad — context AND decorator. Under jax, gradients
+    only flow where jax.grad traces; stop_gradient on results gives the
+    same semantics for mixed usage."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            return jax.tree.map(
+                lambda x: jax.lax.stop_gradient(x)
+                if isinstance(x, jax.Array) else x, fn(*a, **k))
+        return wrapper
+
+
+def grad(outputs_fn=None, inputs=None, *args, **kwargs):
+    """paddle.grad-style functional gradient: grad(fn)(x) == jax.grad."""
+    return jax.grad(outputs_fn, *args, **kwargs)
+
+
+def jacobian(fn, xs, create_graph=False):
+    return jax.jacrev(fn)(xs)
+
+
+def hessian(fn, xs, create_graph=False):
+    return jax.hessian(fn)(xs)
+
+
+def vjp(fn, xs, v=None):
+    out, vjp_fn = jax.vjp(fn, xs)
+    if v is None:
+        v = jax.tree.map(jnp.ones_like, out)
+    return out, vjp_fn(v)[0]
+
+
+def jvp(fn, xs, v=None):
+    if v is None:
+        v = jax.tree.map(jnp.ones_like, xs)
+    return jax.jvp(fn, (xs,), (v,))
+
+
+# ---------------- parameter gradient hooks ----------------
+
+# path-keyed hooks applied by TrainStep between backward and optimizer —
+# the GradNode/EagerReducer hook slot (reducer.cc:506 AddDistHook).
+# _PARAM_HOOKS_VERSION lets compiled TrainSteps detect registry changes and
+# retrace (hooks are baked into the traced program).
+_PARAM_HOOKS: dict[str, list[Callable]] = {}
+_PARAM_HOOKS_VERSION = [0]
+
+
+def param_grad_hooks_version() -> int:
+    return _PARAM_HOOKS_VERSION[0]
+
+
+def register_param_grad_hook(param_path: str, hook: Callable):
+    """Register ``hook(grad) -> grad`` for the parameter at ``param_path``
+    (the state-dict key). Parity: Tensor.register_hook on a parameter.
+    Returns a removal handle."""
+    _PARAM_HOOKS.setdefault(param_path, []).append(hook)
+    _PARAM_HOOKS_VERSION[0] += 1
+
+    class _Handle:
+        def remove(self):
+            _PARAM_HOOKS[param_path].remove(hook)
+            _PARAM_HOOKS_VERSION[0] += 1
+
+    return _Handle()
+
+
+def clear_param_grad_hooks():
+    _PARAM_HOOKS.clear()
+    _PARAM_HOOKS_VERSION[0] += 1
+
+
+def apply_param_grad_hooks(grads: dict):
+    if not _PARAM_HOOKS:
+        return grads
+    out = dict(grads)
+    for path, hooks in _PARAM_HOOKS.items():
+        if path in out:
+            for h in hooks:
+                out[path] = h(out[path])
+    return out

@@ -1,0 +1,134 @@
+"""Device management + memory stats (parity: python/paddle/device/ —
+set_device/get_device, cuda.max_memory_allocated-style stats over
+fluid/memory/stats.cc; here jax device objects + PJRT memory_stats).
+
+The ``cuda`` submodule name is kept so reference code probing
+``paddle.device.cuda.max_memory_allocated()`` ports by substitution; on
+TPU the numbers come from the device's PJRT allocator.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..core.mesh import (device_count, get_device, is_compiled_with_tpu,  # noqa: F401
+                         set_device)
+
+__all__ = ["set_device", "get_device", "device_count", "is_compiled_with_tpu",
+           "get_all_device_type", "get_device_properties",
+           "memory_allocated", "max_memory_allocated", "memory_reserved",
+           "max_memory_reserved", "empty_cache", "synchronize", "cuda",
+           "Stream", "Event"]
+
+
+def _dev(device=None):
+    if device is None:
+        return get_device()
+    if isinstance(device, int):
+        return jax.devices()[device]
+    if isinstance(device, str):
+        return set_device(device)
+    return device
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_device_properties(device=None):
+    d = _dev(device)
+    stats = _stats(d)
+    class _Props:
+        name = f"{d.platform}:{d.id}"
+        total_memory = stats.get("bytes_limit", 0)
+        platform = d.platform
+        device_kind = getattr(d, "device_kind", d.platform)
+    return _Props()
+
+
+def _stats(device=None) -> dict:
+    d = _dev(device)
+    try:
+        return d.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently allocated on the device (parity:
+    paddle.device.cuda.memory_allocated / fluid memory stats)."""
+    return int(_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    return int(_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None) -> int:
+    s = _stats(device)
+    return int(s.get("bytes_reserved", s.get("bytes_in_use", 0)))
+
+
+def max_memory_reserved(device=None) -> int:
+    s = _stats(device)
+    return int(s.get("peak_bytes_reserved", s.get("peak_bytes_in_use", 0)))
+
+
+def empty_cache():
+    """XLA owns the allocator; nothing to drop eagerly (documented no-op,
+    the reference's release-cached-blocks has no PJRT equivalent)."""
+
+
+def synchronize(device=None):
+    """Block until pending work on the device is done."""
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass
+    import jax.numpy as jnp
+    jnp.zeros(()).block_until_ready()
+
+
+class Stream:
+    """XLA orders execution itself; Stream is an API-parity no-op token."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = _dev(device)
+
+    def synchronize(self):
+        synchronize(self.device)
+
+
+class Event:
+    def __init__(self, enable_timing=False):
+        self._t = None
+
+    def record(self, stream=None):
+        import time
+        synchronize()
+        self._t = time.perf_counter()
+
+    def synchronize(self):
+        synchronize()
+
+    def elapsed_time(self, end: "Event") -> float:
+        return (end._t - self._t) * 1000.0
+
+
+class _CudaShim:
+    """paddle.device.cuda.* name-compat routed to the TPU device."""
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+    empty_cache = staticmethod(empty_cache)
+    synchronize = staticmethod(synchronize)
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+
+cuda = _CudaShim()

@@ -1,0 +1,171 @@
+"""Distributed-config auto-tuner + sharding planner (parity:
+python/paddle/distributed/auto_tuner/ — AutoTuner tuner.py:21, search.py,
+prune.py, cost_model.py/memory_cost_model.py — and the static Engine
+planner's cost-model role, auto_parallel/static/engine.py:62 + tuner/).
+
+TPU-native shape: the search space is mesh factorizations
+(dp, fsdp, mp, pp, sep) over a chip count; the cost model is analytic —
+per-config estimates of HBM footprint and step communication volume over
+ICI — and candidates that fit memory are ranked by modeled step time.
+``measure=`` hooks a real dry-run (compile + time one step) for the top-k,
+the analogue of the reference's profile-based refinement.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from dataclasses import dataclass, field
+
+__all__ = ["ModelSpec", "HardwareSpec", "Candidate", "AutoTuner", "plan"]
+
+
+@dataclass
+class ModelSpec:
+    """What the cost model needs to know about the model."""
+    n_params: int
+    num_layers: int
+    hidden: int
+    seq_len: int
+    vocab: int = 32000
+    global_batch: int = 8
+    bytes_per_param: int = 2          # bf16
+    optimizer_bytes_per_param: int = 8  # AdamW fp32 moments
+
+
+@dataclass
+class HardwareSpec:
+    n_devices: int = 8
+    hbm_bytes: float = 16e9            # v5e
+    flops: float = 197e12              # bf16 peak
+    ici_bw: float = 4.5e10             # bytes/s per link (v5e ~45 GB/s)
+    dcn_bw: float = 2.5e9
+
+
+@dataclass
+class Candidate:
+    dp: int = 1
+    fsdp: int = 1
+    mp: int = 1
+    pp: int = 1
+    sep: int = 1
+    micro_batch: int = 1
+    mem_bytes: float = 0.0
+    step_time: float = 0.0
+    fits: bool = True
+    notes: list = field(default_factory=list)
+
+    @property
+    def degrees(self):
+        return dict(dp=self.dp, fsdp=self.fsdp, mp=self.mp, pp=self.pp,
+                    sep=self.sep)
+
+
+class AutoTuner:
+    """Search mesh factorizations; prune infeasible; rank by modeled cost."""
+
+    def __init__(self, model: ModelSpec, hardware: HardwareSpec | None = None,
+                 max_mp: int = 8, enable_sep: bool = False):
+        self.model = model
+        self.hw = hardware or HardwareSpec()
+        self.max_mp = max_mp
+        self.enable_sep = enable_sep
+
+    # ---- search (search.py parity) ----
+
+    def candidates(self):
+        n = self.hw.n_devices
+        axes_opts = []
+        divisors = [d for d in range(1, n + 1) if n % d == 0]
+        for dp, fsdp, mp, pp in itertools.product(divisors, repeat=4):
+            rest = dp * fsdp * mp * pp
+            if rest > n or n % rest:
+                continue
+            sep = n // rest
+            if sep > 1 and not self.enable_sep:
+                continue
+            axes_opts.append(Candidate(dp=dp, fsdp=fsdp, mp=mp, pp=pp,
+                                       sep=sep))
+        return axes_opts
+
+    # ---- prune (prune.py heuristic parity) ----
+
+    def prune(self, cands):
+        m = self.model
+        out = []
+        for c in cands:
+            if c.mp > self.max_mp:
+                continue  # TP beyond a node's fast domain
+            if m.num_layers % c.pp:
+                continue  # stages must divide layers
+            if m.hidden % c.mp:
+                continue
+            if m.seq_len % max(c.sep, 1):
+                continue
+            world_dp = c.dp * c.fsdp
+            if m.global_batch % max(world_dp, 1):
+                continue
+            if c.pp > 1:
+                c.micro_batch = max(2 * c.pp // max(1, c.dp), 1)
+            out.append(c)
+        return out
+
+    # ---- cost model (cost_model.py + memory_cost_model.py parity) ----
+
+    def estimate(self, c: Candidate) -> Candidate:
+        m, hw = self.model, self.hw
+        shard = c.fsdp * c.pp * c.mp  # param-shards per device
+        param_mem = m.n_params * m.bytes_per_param / shard
+        opt_mem = m.n_params * m.optimizer_bytes_per_param / (c.fsdp * c.pp * c.mp)
+        # activation memory under per-layer remat (the framework's default
+        # for large models): ~3 saved tensors of [b, s, h] per layer
+        # boundary; batch split by dp, seq by sep, hidden by mp; 1F1B keeps
+        # O(pp) stage inputs in flight
+        local_bs = m.global_batch / max(c.dp * c.fsdp, 1)
+        act_per_layer = local_bs * m.seq_len / max(c.sep, 1) \
+            * m.hidden / max(c.mp, 1) * 2 * 3
+        act_mem = act_per_layer * (m.num_layers / c.pp) \
+            * (min(c.pp, 2) if c.pp > 1 else 1)
+        logits_mem = local_bs * m.seq_len * m.vocab / max(c.mp, 1) * 4
+        c.mem_bytes = param_mem + opt_mem + act_mem + logits_mem
+        c.fits = c.mem_bytes < hw.hbm_bytes * 0.9
+        # compute time: 6ND split over all devices
+        flops = 6.0 * m.n_params * m.global_batch * m.seq_len
+        compute_t = flops / (hw.flops * hw.n_devices) / 0.4  # 40% MFU prior
+        # comm time: dp grad allreduce + mp per-layer collectives + pp bubble
+        grad_bytes = m.n_params * m.bytes_per_param / (c.pp * c.mp)
+        dp_t = (2 * grad_bytes * (c.dp * c.fsdp - 1) /
+                max(c.dp * c.fsdp, 1) / hw.ici_bw if c.dp * c.fsdp > 1 else 0)
+        mp_t = (4 * m.num_layers * local_bs * m.seq_len * m.hidden * 2
+                / hw.ici_bw if c.mp > 1 else 0)
+        bubble = (c.pp - 1) / max(c.micro_batch + c.pp - 1, 1)
+        c.step_time = (compute_t + dp_t + mp_t) / max(1 - bubble, 0.1)
+        if not c.fits:
+            c.notes.append(f"OOM: {c.mem_bytes / 1e9:.1f} GB")
+        return c
+
+    # ---- tune (tuner.py parity) ----
+
+    def tune(self, top_k: int = 5, measure=None):
+        cands = [self.estimate(c) for c in self.prune(self.candidates())]
+        fitting = [c for c in cands if c.fits]
+        ranked = sorted(fitting or cands, key=lambda c: c.step_time)
+        if measure is not None:
+            for c in ranked[:top_k]:
+                try:
+                    c.step_time = measure(c)
+                except Exception as e:  # noqa: BLE001
+                    c.notes.append(f"measure failed: {e}")
+                    c.step_time = math.inf
+            ranked = sorted(ranked[:top_k], key=lambda c: c.step_time) \
+                + ranked[top_k:]
+        return ranked
+
+
+def plan(model_spec: ModelSpec, n_devices: int = 8, **kw) -> Candidate:
+    """One-call planner: best modeled config for a model on n devices."""
+    hw = HardwareSpec(n_devices=n_devices)
+    ranked = AutoTuner(model_spec, hw, **kw).tune()
+    if not ranked:
+        raise ValueError("no feasible parallel configuration found")
+    return ranked[0]

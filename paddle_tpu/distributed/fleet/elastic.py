@@ -1,0 +1,55 @@
+"""Elastic training manager (parity: fleet/elastic/manager.py:124
+ElasticManager — etcd host registry, fault watching, np range scaling,
+rendezvous reset + relaunch).
+
+TPU-native scope: on TPU pods membership is fixed by the slice topology, so
+"elastic" means **checkpoint-restart**: detect death (launcher), gang
+restart (launch --max_restarts), resume from the newest checkpoint
+(``ElasticManager.latest_checkpoint``). The etcd registry collapses to the
+launcher's process table; np scale-in-range is not meaningful on a fixed
+slice and is intentionally not implemented (documented deviation).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+__all__ = ["ElasticManager", "ElasticStatus"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Worker-side elastic helper: restart-epoch awareness + checkpoint
+    discovery, the two things a training script needs to survive a gang
+    restart."""
+
+    def __init__(self, checkpoint_dir: str | None = None):
+        self.checkpoint_dir = checkpoint_dir
+        self.restart_epoch = int(os.environ.get("PADDLE_RESTART_EPOCH", "0"))
+        self.rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+    @property
+    def is_restart(self) -> bool:
+        return self.restart_epoch > 0
+
+    def latest_checkpoint(self) -> str | None:
+        """Newest step-numbered checkpoint under checkpoint_dir (files or
+        dirs named ``step_<n>`` / ``<n>`` / ``*-<n>``), or None."""
+        d = self.checkpoint_dir
+        if not d or not os.path.isdir(d):
+            return None
+        best, best_n = None, -1
+        for name in os.listdir(d):
+            m = re.search(r"(\d+)", name)
+            if m and int(m.group(1)) > best_n:
+                best, best_n = os.path.join(d, name), int(m.group(1))
+        return best

@@ -1,4 +1,8 @@
-"""Launcher implementation (parity: distributed/launch/main.py:20 launch())."""
+"""Launcher implementation (parity: distributed/launch/main.py:20 launch(),
+plus the elastic gang-restart loop of ElasticManager,
+fleet/elastic/manager.py:124 — a worker death triggers a collective
+relaunch of the whole gang up to --max_restarts times, with the restart
+epoch exported so workers can resume from their latest checkpoint)."""
 
 from __future__ import annotations
 
@@ -12,23 +16,8 @@ import time
 __all__ = ["launch", "main"]
 
 
-def launch(argv=None):
-    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
-    parser.add_argument("--nproc_per_node", "--nprocs", type=int, default=1)
-    parser.add_argument("--master", default="127.0.0.1:12355",
-                        help="coordinator address (host:port)")
-    parser.add_argument("--log_dir", default=None)
-    parser.add_argument("--devices", default=None,
-                        help="devices per process (cpu simulation: count)")
-    parser.add_argument("script", help="training script")
-    parser.add_argument("script_args", nargs=argparse.REMAINDER)
-    args = parser.parse_args(argv)
-
-    n = args.nproc_per_node
-    procs: list[subprocess.Popen] = []
-    log_files = []
-    if args.log_dir:
-        os.makedirs(args.log_dir, exist_ok=True)
+def _spawn_gang(args, n, restart_epoch, log_files):
+    procs = []
     for rank in range(n):
         env = dict(os.environ)
         env.update({
@@ -38,13 +27,17 @@ def launch(argv=None):
             # reference-compatible names
             "PADDLE_TRAINERS_NUM": str(n),
             "PADDLE_TRAINER_ID": str(rank),
+            # elastic: restart counter (PADDLE_ELASTIC-style signal for the
+            # training script to resume from its latest checkpoint)
+            "PADDLE_RESTART_EPOCH": str(restart_epoch),
         })
         if args.devices:
             env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                                 f" --xla_force_host_platform_device_count={args.devices}").strip()
         stdout = None
         if args.log_dir:
-            f = open(os.path.join(args.log_dir, f"worker.{rank}.log"), "w")
+            f = open(os.path.join(
+                args.log_dir, f"worker.{rank}.r{restart_epoch}.log"), "w")
             log_files.append(f)
             stdout = f
         elif rank != 0:
@@ -52,24 +45,73 @@ def launch(argv=None):
         procs.append(subprocess.Popen(
             [sys.executable, args.script, *args.script_args], env=env,
             stdout=stdout, stderr=subprocess.STDOUT if stdout else None))
+    return procs
+
+
+def launch(argv=None):
+    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    parser.add_argument("--nproc_per_node", "--nprocs", type=int, default=1)
+    parser.add_argument("--master", default="127.0.0.1:12355",
+                        help="coordinator address (host:port)")
+    parser.add_argument("--log_dir", default=None)
+    parser.add_argument("--devices", default=None,
+                        help="devices per process (cpu simulation: count)")
+    parser.add_argument("--max_restarts", type=int, default=0,
+                        help="elastic: gang-restart the job up to this many "
+                             "times when a worker dies (0 = fail fast)")
+    parser.add_argument("script", help="training script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    n = args.nproc_per_node
+    log_files: list = []
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+    restart_epoch = 0
+    procs = _spawn_gang(args, n, restart_epoch, log_files)
 
     def _kill_all(*_):
         for p in procs:
             if p.poll() is None:
                 p.terminate()
+        deadline = time.time() + 10
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
 
-    signal.signal(signal.SIGTERM, _kill_all)
+    shutting_down = [False]
+
+    def _on_sigterm(*_):
+        # graceful shutdown (preemption): do NOT treat the resulting worker
+        # exits as failures needing an elastic restart
+        shutting_down[0] = True
+        _kill_all()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
     code = 0
     try:
         while procs:
+            failed = False
             for p in list(procs):
                 rc = p.poll()
                 if rc is not None:
                     procs.remove(p)
                     if rc != 0:
+                        failed = True
                         if code == 0:  # keep the first real failure code,
                             code = rc  # not the SIGTERM of siblings we kill
-                        _kill_all()
+            if failed and not shutting_down[0]:
+                _kill_all()
+                procs.clear()
+                if restart_epoch < args.max_restarts:
+                    restart_epoch += 1
+                    print(f"[elastic] worker failure (rc={code}); gang "
+                          f"restart {restart_epoch}/{args.max_restarts}",
+                          file=sys.stderr)
+                    code = 0
+                    procs = _spawn_gang(args, n, restart_epoch, log_files)
             time.sleep(0.2)
     finally:
         _kill_all()

@@ -1,0 +1,50 @@
+"""Parameter-server training — DEPRIORITIZATION NOTE (SURVEY §A.2, §2.3
+rows "Parameter server", "PS (python)", "transpiler").
+
+The reference ships an industrial async-PS stack (~35k LoC C++:
+fluid/distributed/ps/ brpc services + dense/sparse tables + SSD-backed
+embeddings, plus the fluid/framework C++ trainer/DataFeed hierarchy and the
+legacy Python DistributeTranspiler). That stack exists to serve
+**sparse-recommendation workloads on CPU clusters**: hundred-billion-row
+embedding tables sharded across parameter servers, updated asynchronously
+by Hogwild-style trainers.
+
+Decision: NOT rebuilt for the TPU framework, deliberately.
+
+1. **Hardware mismatch.** The PS architecture exists because commodity CPU
+   clusters have no fast collective fabric; TPU slices have ICI. Dense
+   training that the reference runs over PS is strictly better expressed
+   here as data/FSDP parallelism over the mesh (distributed/sharding.py).
+2. **The sparse path has a different TPU-native answer.** Giant embedding
+   tables on TPU use SparseCore/embedding-partitioning via GSPMD sharded
+   `nn.Embedding` (vocab-sharded on mp/fsdp axes — already supported), or
+   host-RAM lookups feeding the device via the input pipeline. An
+   async-PS rebuild would be slower than either.
+3. **Deprecated upstream.** The fluid transpiler path is legacy in the
+   reference itself (superseded by fleet collective mode).
+
+What IS provided for the workloads PS served:
+- vocab-sharded `VocabParallelEmbedding` (fleet/mp_layers.py) for large
+  embedding tables under collective training;
+- distributed checkpoint with reshard-on-load for huge model state;
+- the launch/elastic stack for multi-host orchestration.
+
+Importing the symbols below raises with this explanation.
+"""
+
+from __future__ import annotations
+
+__all__ = ["DistributedTranspiler", "fleet_ps_mode"]
+
+_MSG = ("parameter-server training is deliberately not implemented in the "
+        "TPU framework: use collective (dp/fsdp/mp) training over the mesh; "
+        "see paddle_tpu/distributed/ps/__init__.py for the full rationale")
+
+
+class DistributedTranspiler:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_MSG)
+
+
+def fleet_ps_mode(*a, **k):
+    raise NotImplementedError(_MSG)

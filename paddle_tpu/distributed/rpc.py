@@ -1,0 +1,149 @@
+"""Minimal RPC (parity: python/paddle/distributed/rpc + the brpc-based
+fluid/distributed/rpc agent — init_rpc, rpc_sync, rpc_async, shutdown).
+
+TPU-native scope: control-plane RPC between host processes (data-plane
+communication is XLA collectives). Implementation is a small TCP +
+pickle request/response server per worker — the structural equivalent of
+the reference's brpc agent, standard library only.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown", "get_worker_info",
+           "get_all_worker_infos", "WorkerInfo"]
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+_STATE: dict = {"server": None, "workers": {}, "me": None, "pool": None}
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj)
+    sock.sendall(struct.pack("!I", len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("!I", hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(65536, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return pickle.loads(buf)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            fn, args, kwargs = _recv_msg(self.request)
+            try:
+                result = fn(*args, **kwargs)
+                _send_msg(self.request, ("ok", result))
+            except BaseException as e:  # noqa: BLE001 — ship to caller
+                _send_msg(self.request, ("err", e))
+        except ConnectionError:
+            pass
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def init_rpc(name: str, rank: int | None = None, world_size: int | None = None,
+             master_endpoint: str | None = None, workers: list | None = None):
+    """Start this process's RPC server and learn the peer table.
+
+    Simplified rendezvous: pass ``workers`` as a list of "name:ip:port"
+    strings (every process passes the same list), or rely on
+    PADDLE_TRAINER_ID + a master_endpoint-derived port block.
+    """
+    if workers is not None:
+        table = {}
+        for i, spec in enumerate(workers):
+            wname, ip, port = spec.split(":")
+            table[wname] = WorkerInfo(wname, i, ip, int(port))
+        me = table[name]
+    else:
+        import os
+        rank = rank if rank is not None else int(
+            os.environ.get("PADDLE_TRAINER_ID", "0"))
+        world_size = world_size or int(
+            os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        host, base = (master_endpoint or "127.0.0.1:18765").split(":")
+        table = {f"worker{i}": WorkerInfo(f"worker{i}", i, host,
+                                          int(base) + i)
+                 for i in range(world_size)}
+        me = table.get(name) or WorkerInfo(name, rank, host,
+                                           int(base) + rank)
+        table[name] = me
+    server = _Server((me.ip, me.port), _Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    _STATE.update(server=server, workers=table, me=me,
+                  pool=ThreadPoolExecutor(max_workers=8))
+    return me
+
+
+def _call(to: str, fn, args, kwargs, timeout):
+    info = _STATE["workers"][to]
+    with socket.create_connection((info.ip, info.port), timeout=timeout) as s:
+        _send_msg(s, (fn, args or (), kwargs or {}))
+        s.settimeout(timeout)
+        status, payload = _recv_msg(s)
+    if status == "err":
+        raise payload
+    return payload
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout: float = 60.0):
+    """Call ``fn(*args, **kwargs)`` on worker ``to``; blocks for the result
+    (parity: paddle.distributed.rpc.rpc_sync)."""
+    return _call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None,
+              timeout: float = 60.0) -> Future:
+    """Async variant returning a Future with .result()/.wait()."""
+    fut = _STATE["pool"].submit(_call, to, fn, args, kwargs, timeout)
+    fut.wait = fut.result  # paddle API alias
+    return fut
+
+
+def get_worker_info(name: str | None = None) -> WorkerInfo:
+    return _STATE["workers"][name] if name else _STATE["me"]
+
+
+def get_all_worker_infos():
+    return list(_STATE["workers"].values())
+
+
+def shutdown():
+    if _STATE["server"] is not None:
+        _STATE["server"].shutdown()
+        _STATE["server"].server_close()
+        _STATE["server"] = None
+    if _STATE["pool"] is not None:
+        _STATE["pool"].shutdown(wait=False)
+        _STATE["pool"] = None

@@ -44,6 +44,10 @@ def group_sharded_parallel(model: Layer, optimizer, level: str = "p_g_os",
         raise ValueError("group_sharded_parallel requires an active mesh")
     if level not in ("os", "os_g", "p_g_os"):
         raise ValueError(f"unknown sharding level {level!r}")
+    if offload and not _host_memory_available():
+        raise NotImplementedError(
+            "offload=True requires a backend with 'pinned_host' memory "
+            "(TPU/GPU PJRT or jax CPU); this backend reports none")
     params = model.param_dict()
     if level == "p_g_os":
         specs = fsdp_rules(params, axis=axis, min_size=segment_size)
@@ -53,16 +57,36 @@ def group_sharded_parallel(model: Layer, optimizer, level: str = "p_g_os",
         for k, s in specs.items():
             mod, leaf = model._resolve(k)
             mod.set_param_spec(leaf, tuple(s))
+        if offload:
+            optimizer._state_sharding = {
+                k: NamedSharding(mesh, specs[k],
+                                 memory_kind="pinned_host")
+                for k, v in params.items()}
+            _patch_optimizer_state_sharding(optimizer)
     else:
         # os / os_g: params stay replicated; mark the intended opt-state
-        # sharding so init_state places slots sharded
+        # sharding so init_state places slots sharded. offload additionally
+        # parks the slots (master weights + moments) in pinned host memory —
+        # the reference's GroupShardedStage3 offload (group_sharded_stage3.py
+        # keeps master weights on CPU), expressed via PJRT memory kinds;
+        # XLA streams them in for the update.
         optimizer._state_sharding = {
-            k: (NamedSharding(mesh, fsdp_rules({k: v}, axis=axis,
-                                               min_size=segment_size)[k]))
+            k: NamedSharding(
+                mesh,
+                fsdp_rules({k: v}, axis=axis, min_size=segment_size)[k],
+                memory_kind="pinned_host" if offload else None)
             for k, v in params.items()
         }
         _patch_optimizer_state_sharding(optimizer)
     return model, optimizer, scaler
+
+
+def _host_memory_available() -> bool:
+    try:
+        return any(m.kind == "pinned_host"
+                   for m in jax.devices()[0].addressable_memories())
+    except Exception:
+        return False
 
 
 def _patch_optimizer_state_sharding(optimizer):

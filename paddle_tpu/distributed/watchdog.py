@@ -1,0 +1,110 @@
+"""Communication watchdog — hung-collective detection (parity:
+phi/core/distributed/comm_task_manager.cc:142-169 CommTaskManager +
+NCCLCommTask::IsTimeout nccl_comm_task.cc:233).
+
+The reference runs a background thread polling per-collective start events
+and logs op/rank/shape detail when a collective exceeds its timeout. On TPU
+collectives are compiled into the XLA program, so the observable unit is a
+blocking host call (device sync, barrier, checkpoint gather, eager
+collective). ``CommWatchdog.task(...)`` wraps any such call: a daemon timer
+fires if the body does not complete in time, recording a diagnosis (op
+name, elapsed, metadata) and optionally raising in the main thread or
+killing the process (the reference's FLAGS_enable_async_trace + abort
+behavior).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+__all__ = ["CommWatchdog", "default_watchdog", "watch"]
+
+logger = logging.getLogger("paddle_tpu.watchdog")
+
+
+@dataclass
+class _TaskRecord:
+    name: str
+    started: float
+    meta: dict = field(default_factory=dict)
+    timed_out: bool = False
+    finished: bool = False
+    elapsed: float = 0.0
+
+
+class CommWatchdog:
+    """Barrier-timeout watchdog around blocking comm/sync calls.
+
+    action: 'log' (record + warn), 'raise' (raise TimeoutError in the
+    waiting thread after the body completes — blocking host calls cannot be
+    preempted), or 'kill' (os._exit, the reference's abort-on-hang mode for
+    collective deadlocks where only a gang restart recovers).
+    """
+
+    def __init__(self, timeout: float = 300.0, action: str = "log",
+                 poll_interval: float = 0.05):
+        if action not in ("log", "raise", "kill"):
+            raise ValueError(action)
+        self.timeout = timeout
+        self.action = action
+        self.poll_interval = poll_interval
+        self.records: list[_TaskRecord] = []
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def task(self, name: str, **meta):
+        rec = _TaskRecord(name=name, started=time.monotonic(), meta=meta)
+        with self._lock:
+            self.records.append(rec)
+        done = threading.Event()
+
+        def monitor():
+            if not done.wait(self.timeout):
+                rec.timed_out = True
+                msg = (f"[comm watchdog] task {name!r} exceeded "
+                       f"{self.timeout:.1f}s "
+                       f"(rank={os.environ.get('PROCESS_ID', '0')}, "
+                       f"meta={meta}) — possible hung collective")
+                logger.error(msg)
+                if self.action == "kill":
+                    logger.error("[comm watchdog] aborting process for "
+                                 "gang restart")
+                    os._exit(17)
+
+        t = threading.Thread(target=monitor, daemon=True)
+        t.start()
+        try:
+            yield rec
+        finally:
+            done.set()
+            rec.finished = True
+            rec.elapsed = time.monotonic() - rec.started
+            if rec.timed_out and self.action == "raise":
+                raise TimeoutError(
+                    f"comm task {name!r} took {rec.elapsed:.1f}s "
+                    f"(timeout {self.timeout:.1f}s)")
+
+    def timed_out_tasks(self):
+        with self._lock:
+            return [r for r in self.records if r.timed_out]
+
+
+_default: list[CommWatchdog | None] = [None]
+
+
+def default_watchdog() -> CommWatchdog:
+    if _default[0] is None:
+        from ..core import flags
+        timeout = float(flags.get_flag("comm_watchdog_timeout") or 300.0)
+        _default[0] = CommWatchdog(timeout=timeout)
+    return _default[0]
+
+
+def watch(name: str, **meta):
+    """Convenience: ``with watch('barrier'):`` on the default watchdog."""
+    return default_watchdog().task(name, **meta)

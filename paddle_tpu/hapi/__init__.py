@@ -41,10 +41,20 @@ class Model:
         self._loss = loss
         ms = metrics or []
         self._metrics = ms if isinstance(ms, (list, tuple)) else [ms]
-        if optimizer is not None and loss is not None:
-            self._train_step = TrainStep(self.network, optimizer,
-                                         lambda out, y: self._loss(out, y))
+        # TrainStep is built lazily on the first batch so n_inputs matches
+        # the dataset arity (multi-input models get every input forwarded)
         self._eval_step = EvalStep(self.network)
+
+    def _ensure_train_step(self, n_inputs: int):
+        if self._train_step is None:
+            if self._optimizer is None or self._loss is None:
+                raise RuntimeError("call prepare(optimizer=..., loss=...) "
+                                   "before fit()")
+            self._train_step = TrainStep(
+                self.network, self._optimizer,
+                lambda out, *labels: self._loss(out, *labels),
+                n_inputs=n_inputs)
+        return self._train_step
 
     # ---- training ----
 
@@ -79,7 +89,7 @@ class Model:
             for step, batch in enumerate(loader):
                 cblist.on_train_batch_begin(step)
                 inputs, labels = self._split_batch(batch)
-                loss = self._train_step(*inputs, *labels)
+                loss = self._ensure_train_step(len(inputs))(*inputs, *labels)
                 last_loss = float(loss)
                 cblist.on_train_batch_end(step, {"loss": last_loss})
             logs = {"loss": last_loss}
@@ -132,6 +142,7 @@ class Model:
         logs = {}
         if losses:
             logs["eval_loss"] = float(np.mean(losses))
+            logs["loss"] = logs["eval_loss"]  # EarlyStopping default monitor
         for m in self._metrics:
             res = m.accumulate()
             name = m.name() if callable(getattr(m, "name", None)) else str(m)

@@ -211,6 +211,138 @@ class _Prefetcher:
             yield item
 
 
+def _mp_worker_loop(dataset, collate_fn, task_q, result_q, use_shm,
+                    worker_init_fn, worker_id):
+    """Worker process: fetch + collate batches; ship arrays back through
+    POSIX shared memory (parity: the reference's multiprocess workers with
+    shared-memory tensor transport, io/reader.py:216 + dataloader/worker.py)."""
+    from multiprocessing import shared_memory
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        task = task_q.get()
+        if task is None:
+            return
+        eid, bid, idxs = task
+        try:
+            batch = collate_fn([dataset[i] for i in idxs])
+            if use_shm:
+                def pack(a):
+                    if isinstance(a, np.ndarray) and a.nbytes > 0:
+                        shm = shared_memory.SharedMemory(create=True,
+                                                         size=a.nbytes)
+                        np.ndarray(a.shape, a.dtype, buffer=shm.buf)[...] = a
+                        name = shm.name
+                        shm.close()
+                        return ("__shm__", name, a.shape, str(a.dtype))
+                    return a
+                batch = [pack(b) for b in batch] if isinstance(batch, list) \
+                    else pack(batch)
+            result_q.put((eid, bid, batch, None))
+        except BaseException as e:  # noqa: BLE001 - ship to parent
+            result_q.put((eid, bid, None, e))
+
+
+class _MPWorkers:
+    """Persistent multiprocess fetch pool with in-order delivery."""
+
+    def __init__(self, dataset, collate_fn, num_workers, use_shared_memory,
+                 worker_init_fn):
+        import multiprocessing as mp
+        ctx = mp.get_context("fork")
+        self.task_q = ctx.Queue()
+        self.result_q = ctx.Queue()
+        self.use_shm = use_shared_memory
+        self.epoch = 0
+        self.procs = [
+            ctx.Process(target=_mp_worker_loop,
+                        args=(dataset, collate_fn, self.task_q,
+                              self.result_q, use_shared_memory,
+                              worker_init_fn, i), daemon=True)
+            for i in range(num_workers)]
+        for p in self.procs:
+            p.start()
+
+    def _unpack(self, batch):
+        from multiprocessing import shared_memory
+
+        def un(a):
+            if isinstance(a, tuple) and len(a) == 4 and a[0] == "__shm__":
+                _, name, shape, dtype = a
+                shm = shared_memory.SharedMemory(name=name)
+                arr = np.array(np.ndarray(shape, dtype, buffer=shm.buf),
+                               copy=True)
+                shm.close()
+                shm.unlink()
+                return arr
+            return a
+        return [un(b) for b in batch] if isinstance(batch, list) else un(batch)
+
+    def _discard(self, batch):
+        """Unlink shm segments of a batch that will never be consumed."""
+        from multiprocessing import shared_memory
+        items = batch if isinstance(batch, list) else [batch]
+        for a in items:
+            if isinstance(a, tuple) and len(a) == 4 and a[0] == "__shm__":
+                try:
+                    shm = shared_memory.SharedMemory(name=a[1])
+                    shm.close()
+                    shm.unlink()
+                except FileNotFoundError:
+                    pass
+
+    def run_epoch(self, index_batches):
+        # epoch ids isolate reused pools from a partially-consumed previous
+        # epoch: stale results are drained (and their shm unlinked) instead
+        # of being served as this epoch's data
+        self.epoch += 1
+        epoch = self.epoch
+        n = 0
+        for bid, idxs in enumerate(index_batches):
+            self.task_q.put((epoch, bid, list(idxs)))
+            n += 1
+        pending = {}
+        want = 0
+        try:
+            while want < n:
+                if want in pending:
+                    batch, err = pending.pop(want)
+                else:
+                    eid, bid, batch, err = self.result_q.get()
+                    if eid != epoch:  # stale from an abandoned epoch
+                        if err is None:
+                            self._discard(batch)
+                        continue
+                    if bid != want:
+                        pending[bid] = (batch, err)
+                        continue
+                if err is not None:
+                    raise err
+                yield self._unpack(batch)
+                want += 1
+        finally:
+            for batch, err in pending.values():
+                if err is None:
+                    self._discard(batch)
+
+    def shutdown(self):
+        for _ in self.procs:
+            try:
+                self.task_q.put(None)
+            except Exception:
+                pass
+        for p in self.procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+
+    def __del__(self):  # pragma: no cover - best effort
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+
 class DataLoader:
     def __init__(self, dataset: Dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1, shuffle=False,
@@ -222,7 +354,10 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = max(1, prefetch_factor)
         self.use_buffer_reader = use_buffer_reader
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
         self.to_device = to_device
+        self._mp_pool = None
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -244,6 +379,15 @@ class DataLoader:
                 if len(batch) < self.batch_size and self.drop_last:
                     return
                 yield self.collate_fn(batch)
+        elif self.num_workers > 0:
+            # multiprocess fetch + shared-memory transport (parity:
+            # io/reader.py:216 multiprocess DataLoader)
+            if self._mp_pool is None:
+                self._mp_pool = _MPWorkers(self.dataset, self.collate_fn,
+                                           self.num_workers,
+                                           self.use_shared_memory,
+                                           self.worker_init_fn)
+            yield from self._mp_pool.run_epoch(list(self.batch_sampler))
         else:
             for idxs in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in idxs])

@@ -108,7 +108,11 @@ class TrainStep:
             return loss, aux, new_params, new_buffers, new_opt_state
 
         donate_argnums = (0, 1, 2) if donate else ()
+        self._pure_step = pure_step
+        self._donate_argnums = donate_argnums
         self._compiled = jax.jit(pure_step, donate_argnums=donate_argnums)
+        from ..autograd import param_grad_hooks_version
+        self._hooks_version = param_grad_hooks_version()
 
     def _loss_and_grads(self, params, buffers, key, *batch):
         """Default: jax.value_and_grad of loss_fn(model(*inputs), *labels).
@@ -126,9 +130,20 @@ class TrainStep:
 
         (loss, (aux, new_buffers)), grads = jax.value_and_grad(
             loss_of, has_aux=True)(params)
+        # parameter grad hooks (parity: Tensor.register_hook via the
+        # GradNode hook slot) run between backward and optimizer
+        from ..autograd import apply_param_grad_hooks
+        grads = apply_param_grad_hooks(grads)
         return loss, aux, grads, new_buffers
 
     def __call__(self, *batch):
+        # grad hooks are baked into the traced program; retrace when the
+        # registry changed after compilation
+        from ..autograd import param_grad_hooks_version
+        if param_grad_hooks_version() != self._hooks_version:
+            self._compiled = jax.jit(self._pure_step,
+                                     donate_argnums=self._donate_argnums)
+            self._hooks_version = param_grad_hooks_version()
         params = self.model.param_dict(trainable_only=True)
         buffers = self.model.buffer_dict()
         if self._opt_state is None:

@@ -42,6 +42,14 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     recompute: bool = False  # remat each decoder layer (fleet recompute parity)
+    # remat granularity: "full" re-runs the whole layer in backward;
+    # "dots" saves matmul outputs and recomputes only elementwise chains
+    # (jax dots_with_no_batch_dims_saveable) — less recompute FLOPs for a
+    # modest activation-memory increase
+    recompute_policy: str = "full"
+    # remat every k-th layer only (parity: fleet recompute_interval) —
+    # k=2 halves recompute FLOPs for ~2x boundary activation memory
+    recompute_interval: int = 1
     dtype: str = "float32"
     # parallel axes (None disables the annotation; degrees of 1 are no-ops)
     mp_axis: str | None = "mp"
@@ -238,10 +246,17 @@ class LlamaModel(Layer):
             if kv_caches is not None:
                 x, c = layer(x, cos, sin, attn_mask, kv_caches[i], position_offset)
                 new_caches.append(c)
-            elif self.config.recompute and self.training:
+            elif (self.config.recompute and self.training
+                  and i % max(self.config.recompute_interval, 1) == 0):
                 # trade FLOPs for HBM: re-run the layer in backward
-                x = jax.checkpoint(
-                    lambda x, layer=layer: layer(x, cos, sin, attn_mask))(x)
+                if self.config.recompute_policy == "dots":
+                    policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                    x = jax.checkpoint(
+                        lambda x, layer=layer: layer(x, cos, sin, attn_mask),
+                        policy=policy)(x)
+                else:
+                    x = jax.checkpoint(
+                        lambda x, layer=layer: layer(x, cos, sin, attn_mask))(x)
             else:
                 x = layer(x, cos, sin, attn_mask)
         x = self.norm(x)

@@ -1,0 +1,178 @@
+"""Quantization (parity: python/paddle/quantization/ — QuantConfig, QAT
+:qat.py:23, PTQ :ptq.py:24, observers + fake quanters).
+
+TPU-native: int8 simulation runs as fake-quant (quantize→dequantize) in
+fp32/bf16 — the straight-through estimator makes QAT differentiable, and
+XLA fuses the rounding chain into the surrounding matmuls. PTQ collects
+absmax statistics with observer wrappers, then freezes scales.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.module import Layer
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMaxObserver",
+           "AbsmaxObserver", "quant_dequant", "QuantedLinear"]
+
+
+def quant_dequant(x, scale, bits: int = 8):
+    """Symmetric fake quantization with a straight-through estimator:
+    forward rounds to the int grid, backward is identity within range."""
+    qmax = 2.0 ** (bits - 1) - 1
+    s = jnp.maximum(scale, 1e-8) / qmax
+    q = jnp.clip(jnp.round(x / s), -qmax - 1, qmax)
+    out = q * s
+    # STE: gradient flows as identity (stop_gradient on the rounding delta)
+    return x + jax.lax.stop_gradient(out - x)
+
+
+class AbsmaxObserver:
+    """Parity: quantization/observers/abs_max.py — running absmax."""
+
+    def __init__(self, moving_rate: float = 0.9):
+        self.moving_rate = moving_rate
+        self.absmax = None
+
+    def observe(self, x):
+        cur = float(jnp.max(jnp.abs(x)))
+        if self.absmax is None:
+            self.absmax = cur
+        else:
+            self.absmax = (self.moving_rate * self.absmax
+                           + (1 - self.moving_rate) * cur)
+        return self.absmax
+
+    def scale(self):
+        return self.absmax if self.absmax is not None else 1.0
+
+
+class FakeQuanterWithAbsMaxObserver(Layer):
+    """Parity: FakeQuanterWithAbsMaxObserverLayer — observes a moving absmax
+    and fake-quantizes with it. The scale lives in a BUFFER (like BN running
+    stats) so observation is trace-safe inside a jitted TrainStep and the
+    state persists through the functional_call writeback."""
+
+    def __init__(self, moving_rate: float = 0.9, bit_length: int = 8,
+                 name=None):
+        super().__init__()
+        self.bits = bit_length
+        self.moving_rate = moving_rate
+        self.register_buffer("scale_state", jnp.ones((), jnp.float32))
+        self.register_buffer("initialized", jnp.zeros((), jnp.float32))
+
+    def forward(self, x):
+        if self.training:
+            cur = jnp.max(jnp.abs(jax.lax.stop_gradient(x))).astype(
+                jnp.float32)
+            new = jnp.where(self.initialized > 0,
+                            self.moving_rate * self.scale_state
+                            + (1 - self.moving_rate) * cur, cur)
+            self.scale_state = new
+            self.initialized = jnp.ones((), jnp.float32)
+            scale = new
+        else:
+            scale = self.scale_state
+        return quant_dequant(x, scale, self.bits)
+
+
+class QuantConfig:
+    """Parity: quantization/config.py QuantConfig — which layer types get
+    activation/weight quanters."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation or (
+            lambda: FakeQuanterWithAbsMaxObserver())
+        self.weight = weight or (lambda: FakeQuanterWithAbsMaxObserver())
+        self._types = []
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        if not isinstance(layer_types, (list, tuple)):
+            layer_types = [layer_types]
+        self._types.extend(layer_types)
+        if activation:
+            self.activation = activation
+        if weight:
+            self.weight = weight
+
+    def quantable_types(self):
+        from .. import nn
+        return tuple(self._types) or (nn.Linear, nn.Conv2D)
+
+
+class QuantedLinear(Layer):
+    """A Linear wrapped with weight + activation fake quanters."""
+
+    def __init__(self, inner, config: QuantConfig):
+        super().__init__()
+        self.inner = inner
+        self.act_quanter = config.activation()
+        self.w_quanter = config.weight()
+
+    def forward(self, x):
+        x = self.act_quanter(x)
+        w = self.w_quanter(self.inner.weight)
+        out = x @ w
+        if getattr(self.inner, "bias", None) is not None:
+            out = out + self.inner.bias
+        return out
+
+
+class QAT:
+    """Parity: quantization/qat.py:23 — wrap quantable layers with fake
+    quanters for quantization-aware training."""
+
+    def __init__(self, config: QuantConfig | None = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        from .. import nn
+        if not inplace:
+            model = copy.deepcopy(model)
+        self._convert(model)
+        return model
+
+    def _convert(self, layer: Layer):
+        from .. import nn
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, nn.Linear):
+                layer._sub_layers[name] = QuantedLinear(sub, self.config)
+            else:
+                self._convert(sub)
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Freeze observers (eval mode) — the deploy-side conversion."""
+        if not inplace:
+            model = copy.deepcopy(model)
+        model.eval()
+        return model
+
+
+class PTQ:
+    """Parity: quantization/ptq.py:24 — post-training quantization: insert
+    observers, run calibration batches through ``sample``, then freeze."""
+
+    def __init__(self, config: QuantConfig | None = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        qat = QAT(self.config)
+        m = qat.quantize(model, inplace=inplace)
+        m.train()  # observers active
+        return m
+
+    def sample(self, model: Layer, *batches):
+        for b in batches:
+            model(b)
+        return model
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+        model.eval()
+        return model

@@ -1,0 +1,187 @@
+"""Sparse tensors (parity: python/paddle/sparse/ — COO/CSR creation,
+conversion, unary/binary math, sparse @ dense matmul; backed by
+phi SparseCoo/CsrTensor + sparse kernels in the reference).
+
+TPU-native: jax.experimental.sparse BCOO/BCSR are the storage formats —
+XLA compiles gather/scatter-based kernels; unary ops apply to the stored
+values (preserving the zero-pattern contract of the reference's sparse
+unary kernels)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "to_dense", "to_sparse_coo",
+    "to_sparse_csr", "is_sparse", "is_sparse_coo", "is_sparse_csr",
+    "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
+    "relu", "abs", "sin", "tanh", "sqrt", "square", "pow", "neg", "cast",
+    "transpose", "sum", "nnz", "values", "indices",
+]
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """COO tensor from [sparse_ndim, nnz] indices + [nnz] values (parity:
+    paddle.sparse.sparse_coo_tensor)."""
+    idx = jnp.asarray(indices)
+    vals = jnp.asarray(values, dtype)
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in idx.max(axis=1))
+    return jsparse.BCOO((vals, idx.T), shape=tuple(shape))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    return jsparse.BCSR((jnp.asarray(values, dtype), jnp.asarray(cols),
+                         jnp.asarray(crows)), shape=tuple(shape))
+
+
+def is_sparse(x):
+    return isinstance(x, (jsparse.BCOO, jsparse.BCSR))
+
+
+def is_sparse_coo(x):
+    return isinstance(x, jsparse.BCOO)
+
+
+def is_sparse_csr(x):
+    return isinstance(x, jsparse.BCSR)
+
+
+def to_dense(x):
+    return x.todense() if is_sparse(x) else jnp.asarray(x)
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    if is_sparse_csr(x):
+        return x.to_bcoo()
+    return jsparse.BCOO.fromdense(jnp.asarray(x))
+
+
+def to_sparse_csr(x):
+    if is_sparse_coo(x):
+        return jsparse.BCSR.from_bcoo(x)
+    return jsparse.BCSR.fromdense(jnp.asarray(x))
+
+
+def nnz(x):
+    return x.nse
+
+
+def values(x):
+    return x.data
+
+
+def indices(x):
+    return x.indices.T if is_sparse_coo(x) else x.indices
+
+
+# ---- elementwise (zero-preserving applied to values; parity:
+# paddle/phi/kernels/sparse/unary_kernel.h) ----
+
+def _unary(fn):
+    def op(x, name=None):
+        if is_sparse_coo(x):
+            return jsparse.BCOO((fn(x.data), x.indices), shape=x.shape)
+        if is_sparse_csr(x):
+            return jsparse.BCSR((fn(x.data), x.indices, x.indptr),
+                                shape=x.shape)
+        return fn(jnp.asarray(x))
+    return op
+
+
+relu = _unary(jax.nn.relu)
+abs = _unary(jnp.abs)  # noqa: A001
+sin = _unary(jnp.sin)
+tanh = _unary(jnp.tanh)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+neg = _unary(jnp.negative)
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    return _unary(lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    if is_sparse_coo(x):
+        return jsparse.BCOO(
+            (x.data.astype(value_dtype) if value_dtype else x.data,
+             x.indices.astype(index_dtype) if index_dtype else x.indices),
+            shape=x.shape)
+    return _unary(lambda v: v.astype(value_dtype))(x)
+
+
+# ---- binary / matmul ----
+
+def _coerce_pair(x, y):
+    xd = to_dense(x)
+    yd = to_dense(y)
+    return xd, yd
+
+
+def add(x, y, name=None):
+    if is_sparse_coo(x) and is_sparse_coo(y):
+        # concatenate index/value lists; duplicate coordinates sum on
+        # densify (the COO semantics the reference's sparse add relies on)
+        idx = jnp.concatenate([x.indices, y.indices], axis=0)
+        val = jnp.concatenate([x.data, y.data], axis=0)
+        return jsparse.BCOO((val, idx), shape=x.shape)
+    xd, yd = _coerce_pair(x, y)
+    return to_sparse_coo(xd + yd) if is_sparse(x) else xd + yd
+
+
+def subtract(x, y, name=None):
+    xd, yd = _coerce_pair(x, y)
+    return to_sparse_coo(xd - yd) if is_sparse(x) else xd - yd
+
+
+def multiply(x, y, name=None):
+    xd, yd = _coerce_pair(x, y)
+    return to_sparse_coo(xd * yd) if is_sparse(x) else xd * yd
+
+
+def divide(x, y, name=None):
+    xd, yd = _coerce_pair(x, y)
+    return xd / yd
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense (and sparse @ sparse via densify) — parity:
+    paddle.sparse.matmul; BCOO dot_general compiles to gather+MXU."""
+    if is_sparse(x) and not is_sparse(y):
+        return x @ jnp.asarray(y)
+    if is_sparse(x) and is_sparse(y):
+        return to_sparse_coo(to_dense(x) @ to_dense(y))
+    return jnp.asarray(x) @ to_dense(y)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """Dense @ dense computed only at mask's nonzero positions (parity:
+    paddle.sparse.masked_matmul; the SDDMM pattern)."""
+    dense = jnp.asarray(x) @ jnp.asarray(y)
+    m = mask if is_sparse_coo(mask) else to_sparse_coo(mask)
+    rows, cols = m.indices[:, 0], m.indices[:, 1]
+    return jsparse.BCOO((dense[rows, cols], m.indices), shape=dense.shape)
+
+
+def transpose(x, perm, name=None):
+    if is_sparse_coo(x):
+        return jsparse.BCOO((x.data, x.indices[:, jnp.asarray(perm)]),
+                            shape=tuple(np.asarray(x.shape)[list(perm)]))
+    return jnp.transpose(to_dense(x), perm)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    vals = x.data if is_sparse(x) else jnp.asarray(x)
+    if axis is None:
+        out = jnp.sum(vals, dtype=dtype)
+        return out[None] if keepdim else out
+    return jnp.sum(to_dense(x), axis=axis, dtype=dtype, keepdims=keepdim)
+
+
+from . import nn  # noqa: F401,E402  (after op definitions it depends on)

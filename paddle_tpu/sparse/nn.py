@@ -1,0 +1,15 @@
+"""Sparse nn layers (parity: python/paddle/sparse/nn/ — activation layers
+operating on sparse tensors)."""
+
+from __future__ import annotations
+
+from ..nn.module import Layer
+from . import relu as _relu
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return _relu(x)
+
+
+__all__ = ["ReLU"]

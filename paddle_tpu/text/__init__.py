@@ -1,0 +1,82 @@
+"""paddle_tpu.text (parity: python/paddle/text/ — the ops surface is
+viterbi_decode/ViterbiDecoder; the dataset zoo of the reference is
+deprecated upstream and represented here by the vision/io dataset
+machinery)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.module import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag: bool = True, name=None):
+    """CRF Viterbi decoding (parity: paddle.text.viterbi_decode).
+
+    potentials: [batch, seq, num_tags] unary emission scores;
+    transition_params: [num_tags, num_tags] (with BOS/EOS as the last two
+    tags when include_bos_eos_tag); lengths: [batch] valid lengths.
+    Returns (scores [batch], paths [batch, seq]).
+    """
+    pot = jnp.asarray(potentials, jnp.float32)
+    trans = jnp.asarray(transition_params, jnp.float32)
+    b, s, n = pot.shape
+    lengths = (jnp.full((b,), s, jnp.int32) if lengths is None
+               else jnp.asarray(lengths, jnp.int32))
+
+    if include_bos_eos_tag:
+        bos, eos = n - 2, n - 1
+        init = pot[:, 0] + trans[bos][None, :]
+    else:
+        init = pot[:, 0]
+
+    def step(carry, t):
+        alpha, hist_dummy = carry
+        # alpha: [b, n]; scores of best path ending in each tag
+        scores = alpha[:, :, None] + trans[None, :, :] + pot[:, t][:, None, :]
+        best_prev = jnp.argmax(scores, axis=1)            # [b, n]
+        new_alpha = jnp.max(scores, axis=1)               # [b, n]
+        # positions past the sequence keep their alpha (masked)
+        live = (t < lengths)[:, None]
+        new_alpha = jnp.where(live, new_alpha, alpha)
+        best_prev = jnp.where(live, best_prev,
+                              jnp.arange(n)[None, :])
+        return (new_alpha, None), best_prev
+
+    (alpha, _), history = jax.lax.scan(step, (init, None), jnp.arange(1, s))
+    # history: [s-1, b, n] backpointers
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, eos][None, :]
+    last_tag = jnp.argmax(alpha, axis=-1)                 # [b]
+    scores = jnp.max(alpha, axis=-1)
+
+    def backtrace(carry, bp):
+        tag = carry
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    # reverse scan emits tag_{t} while stepping to tag_{t-1}; the final
+    # carry is tag_0, prepended to the emitted tags [tag_1 .. tag_{s-1}]
+    first_tag, path_tail = jax.lax.scan(backtrace, last_tag, history,
+                                        reverse=True)
+    paths = jnp.concatenate([first_tag[None], path_tail], axis=0).T  # [b, s]
+    return scores, paths
+
+
+class ViterbiDecoder(Layer):
+    """Parity: paddle.text.ViterbiDecoder — holds the transition matrix."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        super().__init__()
+        self.register_buffer("transitions", jnp.asarray(transitions,
+                                                        jnp.float32))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,9 +1,10 @@
 from .lenet import LeNet  # noqa: F401
-
-try:  # resnet family lands with the model-zoo milestone
-    from .resnet import (  # noqa: F401
-        ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
-        wide_resnet50_2, wide_resnet101_2, resnext50_32x4d, resnext101_64x4d,
-    )
-except ImportError:  # pragma: no cover
-    pass
+from .resnet import (  # noqa: F401
+    ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
+    wide_resnet50_2, wide_resnet101_2, resnext50_32x4d, resnext101_64x4d,
+)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from .mobilenet import (MobileNetV1, MobileNetV2, mobilenet_v1,  # noqa: F401
+                        mobilenet_v2)
+from .alexnet import (AlexNet, SqueezeNet, alexnet, squeezenet1_0,  # noqa: F401
+                      squeezenet1_1)

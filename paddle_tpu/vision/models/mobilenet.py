@@ -1,0 +1,122 @@
+"""MobileNet v1/v2 (parity: python/paddle/vision/models/
+mobilenetv1.py, mobilenetv2.py)."""
+
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2"]
+
+
+def _conv_bn(in_ch, out_ch, kernel, stride=1, padding=0, groups=1,
+             act="relu6"):
+    layers = [nn.Conv2D(in_ch, out_ch, kernel, stride=stride,
+                        padding=padding, groups=groups, bias_attr=False),
+              nn.BatchNorm2D(out_ch)]
+    if act == "relu6":
+        layers.append(nn.ReLU6())
+    elif act == "relu":
+        layers.append(nn.ReLU())
+    return nn.Sequential(*layers)
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(8, int(ch * scale))
+
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2), *[(512, 512, 1)] * 5,
+               (512, 1024, 2), (1024, 1024, 1)]
+        layers = [_conv_bn(3, c(32), 3, stride=2, padding=1, act="relu")]
+        for in_ch, out_ch, stride in cfg:
+            # depthwise + pointwise
+            layers.append(_conv_bn(c(in_ch), c(in_ch), 3, stride=stride,
+                                   padding=1, groups=c(in_ch), act="relu"))
+            layers.append(_conv_bn(c(in_ch), c(out_ch), 1, act="relu"))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape(x.shape[0], -1)
+            x = self.fc(x)
+        return x
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, in_ch, out_ch, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(in_ch * expand_ratio))
+        self.use_res = stride == 1 and in_ch == out_ch
+        layers = []
+        if expand_ratio != 1:
+            layers.append(_conv_bn(in_ch, hidden, 1))
+        layers.append(_conv_bn(hidden, hidden, 3, stride=stride, padding=1,
+                               groups=hidden))
+        layers.append(nn.Conv2D(hidden, out_ch, 1, bias_attr=False))
+        layers.append(nn.BatchNorm2D(out_ch))
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+
+        def c(ch):
+            return max(8, int(ch * scale))
+
+        layers = [_conv_bn(3, c(32), 3, stride=2, padding=1)]
+        in_ch = c(32)
+        for t, ch, n, s in cfg:
+            for i in range(n):
+                layers.append(InvertedResidual(in_ch, c(ch),
+                                               s if i == 0 else 1, t))
+                in_ch = c(ch)
+        out_ch = max(1280, int(1280 * scale))
+        layers.append(_conv_bn(in_ch, out_ch, 1))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(nn.Dropout(0.2),
+                                            nn.Linear(out_ch, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape(x.shape[0], -1)
+            x = self.classifier(x)
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("no hub weights in this environment")
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("no hub weights in this environment")
+    return MobileNetV2(scale=scale, **kwargs)

@@ -1,0 +1,143 @@
+"""autograd extension points (parity: test/legacy_test/test_pylayer_op.py,
+test_saved_tensors_hooks.py, tensor register_hook tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.autograd import (PyLayer, register_param_grad_hook,
+                                 clear_param_grad_hooks, saved_tensors_hooks)
+
+RNG = np.random.default_rng(0)
+
+
+class _Scale(PyLayer):
+    @staticmethod
+    def forward(ctx, x, alpha):
+        ctx.save_for_backward(x)
+        ctx.alpha = alpha
+        return x * alpha
+
+    @staticmethod
+    def backward(ctx, g):
+        (x,) = ctx.saved_tensor()
+        return g * ctx.alpha
+
+
+class _TanhCustom(PyLayer):
+    """Custom backward that intentionally differs (x2 factor) to prove the
+    custom path is taken, not jax's builtin rule."""
+
+    @staticmethod
+    def forward(ctx, x):
+        y = jnp.tanh(x)
+        ctx.save_for_backward(y)
+        return y
+
+    @staticmethod
+    def backward(ctx, g):
+        (y,) = ctx.saved_tensor()
+        return 2.0 * g * (1 - y * y)
+
+
+def test_pylayer_forward_and_custom_backward():
+    x = jnp.asarray(RNG.standard_normal((4, 5)), jnp.float32)
+    y = _Scale.apply(x, 3.0)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x) * 3.0, rtol=1e-6)
+    g = jax.grad(lambda x: jnp.sum(_Scale.apply(x, 3.0)))(x)
+    np.testing.assert_allclose(np.asarray(g), 3.0, rtol=1e-6)
+    g2 = jax.grad(lambda x: jnp.sum(_TanhCustom.apply(x)))(x)
+    t = np.tanh(np.asarray(x))
+    np.testing.assert_allclose(np.asarray(g2), 2.0 * (1 - t * t), rtol=1e-5)
+
+
+def test_pylayer_multi_tensor_inputs():
+    class Mul(PyLayer):
+        @staticmethod
+        def forward(ctx, a, b):
+            ctx.save_for_backward(a, b)
+            return a * b
+
+        @staticmethod
+        def backward(ctx, g):
+            a, b = ctx.saved_tensor()
+            return g * b, g * a
+
+    a = jnp.asarray(RNG.standard_normal(6), jnp.float32)
+    b = jnp.asarray(RNG.standard_normal(6), jnp.float32)
+    ga, gb = jax.grad(lambda a, b: jnp.sum(Mul.apply(a, b)),
+                      argnums=(0, 1))(a, b)
+    np.testing.assert_allclose(np.asarray(ga), np.asarray(b), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(a), rtol=1e-6)
+
+
+def test_pylayer_inside_jit_and_layer():
+    x = jnp.asarray(RNG.standard_normal((3, 3)), jnp.float32)
+    out = jax.jit(lambda x: _Scale.apply(x, 2.0))(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) * 2, rtol=1e-6)
+
+
+def test_saved_tensors_hooks_pack_unpack():
+    calls = {"pack": 0, "unpack": 0}
+
+    def pack(t):
+        calls["pack"] += 1
+        return t.astype(jnp.bfloat16)  # compress saved activation
+
+    def unpack(t):
+        calls["unpack"] += 1
+        return t.astype(jnp.float32)
+
+    x = jnp.asarray(RNG.standard_normal(8), jnp.float32)
+    with saved_tensors_hooks(pack, unpack):
+        g = jax.grad(lambda x: jnp.sum(_TanhCustom.apply(x)))(x)
+    assert calls["pack"] >= 1 and calls["unpack"] >= 1
+    t = np.tanh(np.asarray(x), dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(g), 2.0 * (1 - t * t),
+                               rtol=5e-2, atol=5e-2)  # bf16 saved
+
+
+def test_param_grad_hook_in_train_step():
+    """A registered hook that zeroes a param's grad freezes that param."""
+    from paddle_tpu import nn
+    import paddle_tpu.nn.functional as F
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 3))
+    w0_before = np.asarray(net.param_dict()["0.weight"]).copy()
+    w2_before = np.asarray(net.param_dict()["2.weight"]).copy()
+    register_param_grad_hook("0.weight", lambda g: jnp.zeros_like(g))
+    try:
+        opt = pt.optimizer.SGD(learning_rate=0.1, parameters=net)
+        step = pt.jit.TrainStep(net, opt, lambda o, y: F.cross_entropy(o, y))
+        x = RNG.standard_normal((16, 8)).astype(np.float32)
+        y = RNG.integers(0, 3, 16)
+        for _ in range(3):
+            step(x, y)
+        np.testing.assert_allclose(np.asarray(net.param_dict()["0.weight"]),
+                                   w0_before)  # frozen by hook
+        assert not np.allclose(np.asarray(net.param_dict()["2.weight"]),
+                               w2_before)  # others trained
+    finally:
+        clear_param_grad_hooks()
+
+
+def test_no_grad():
+    @pt.no_grad()
+    def f(x):
+        return x * 3.0
+
+    x = jnp.asarray(RNG.standard_normal(4), jnp.float32)
+    g = jax.grad(lambda x: jnp.sum(f(x)) + jnp.sum(x * 2.0))(x)
+    np.testing.assert_allclose(np.asarray(g), 2.0, rtol=1e-6)
+
+
+def test_functional_transforms():
+    f = lambda x: jnp.sum(jnp.sin(x))  # noqa: E731
+    x = jnp.asarray(RNG.standard_normal(4), jnp.float32)
+    j = pt.autograd.jacobian(f, x)
+    np.testing.assert_allclose(np.asarray(j), np.cos(np.asarray(x)),
+                               rtol=1e-5)
+    h = pt.autograd.hessian(f, x)
+    np.testing.assert_allclose(np.asarray(h),
+                               np.diag(-np.sin(np.asarray(x))), atol=1e-5)

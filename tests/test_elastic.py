@@ -1,0 +1,94 @@
+"""Failure detection + elastic relaunch (parity: comm_task_manager.cc
+watchdog + fleet/elastic/manager.py gang restart; verdict done-bar: kill a
+worker and observe relaunch)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_watchdog_flags_hung_task():
+    from paddle_tpu.distributed.watchdog import CommWatchdog
+    wd = CommWatchdog(timeout=0.2, action="log")
+    with wd.task("fast_op"):
+        pass
+    assert not wd.timed_out_tasks()
+    with wd.task("slow_allreduce", shape=(1024,)):
+        time.sleep(0.5)
+    bad = wd.timed_out_tasks()
+    assert len(bad) == 1 and bad[0].name == "slow_allreduce"
+    assert bad[0].meta["shape"] == (1024,)
+
+
+def test_watchdog_raise_mode():
+    from paddle_tpu.distributed.watchdog import CommWatchdog
+    wd = CommWatchdog(timeout=0.1, action="raise")
+    with pytest.raises(TimeoutError):
+        with wd.task("hung"):
+            time.sleep(0.3)
+
+
+def test_elastic_gang_restart(tmp_path):
+    """Worker 1 dies on the first run; the launcher must gang-restart and
+    the job succeeds on the retry (PADDLE_RESTART_EPOCH visible)."""
+    script = tmp_path / "worker.py"
+    marker = tmp_path / "attempted"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        rank = int(os.environ["PADDLE_TRAINER_ID"])
+        epoch = int(os.environ["PADDLE_RESTART_EPOCH"])
+        # first attempt: rank 1 crashes
+        if epoch == 0 and rank == 1:
+            sys.exit(3)
+        open({str(marker)!r} + f".r{{epoch}}.{{rank}}", "w").write("ok")
+    """))
+    code = textwrap.dedent(f"""
+        import sys; sys.path.insert(0, {REPO!r})
+        from paddle_tpu.distributed.launch.main import launch
+        sys.exit(launch(["--nproc_per_node", "2", "--max_restarts", "2",
+                         {str(script)!r}]))
+    """)
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=120)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "gang restart 1/2" in proc.stderr
+    # retry ran both ranks with the bumped restart epoch
+    assert (tmp_path / "attempted.r1.0").exists()
+    assert (tmp_path / "attempted.r1.1").exists()
+
+
+def test_elastic_exhausted_restarts_fails(tmp_path):
+    script = tmp_path / "always_fail.py"
+    script.write_text("import sys; sys.exit(5)\n")
+    code = textwrap.dedent(f"""
+        import sys; sys.path.insert(0, {REPO!r})
+        from paddle_tpu.distributed.launch.main import launch
+        sys.exit(launch(["--nproc_per_node", "2", "--max_restarts", "1",
+                         {str(script)!r}]))
+    """)
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=120)
+    assert proc.returncode == 5
+
+
+def test_elastic_manager_checkpoint_discovery(tmp_path):
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+    (tmp_path / "step_10").mkdir()
+    (tmp_path / "step_200").mkdir()
+    (tmp_path / "step_30").mkdir()
+    em = ElasticManager(checkpoint_dir=str(tmp_path))
+    assert em.latest_checkpoint().endswith("step_200")
+    assert not em.is_restart
+
+
+def test_ps_deprioritization_note():
+    from paddle_tpu.distributed import ps
+    assert "deliberately" in ps.__doc__ or "NOT rebuilt" in ps.__doc__
+    with pytest.raises(NotImplementedError):
+        ps.DistributedTranspiler()

@@ -1,0 +1,85 @@
+"""RPC agent + profiler scheduler/statistics (parity:
+python/paddle/distributed/rpc tests; profiler scheduler windows,
+profiler.py:346)."""
+
+import time
+
+import numpy as np
+import pytest
+
+
+def _add(a, b):
+    return a + b
+
+
+def _boom():
+    raise ValueError("remote failure")
+
+
+def test_rpc_sync_async_roundtrip():
+    from paddle_tpu.distributed import rpc
+    me = rpc.init_rpc("worker0",
+                      workers=["worker0:127.0.0.1:29551",
+                               "worker1:127.0.0.1:29552"])
+    try:
+        # second "worker" in the same process (separate server socket)
+        import threading
+        from paddle_tpu.distributed.rpc import _Handler, _Server
+        srv = _Server(("127.0.0.1", 29552), _Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            assert rpc.rpc_sync("worker1", _add, args=(2, 3)) == 5
+            fut = rpc.rpc_async("worker1", _add, args=(np.ones(4), 1.0))
+            np.testing.assert_allclose(fut.result(), 2 * np.ones(4))
+            with pytest.raises(ValueError, match="remote failure"):
+                rpc.rpc_sync("worker1", _boom)
+            infos = rpc.get_all_worker_infos()
+            assert {w.name for w in infos} == {"worker0", "worker1"}
+            assert rpc.get_worker_info().name == "worker0"
+        finally:
+            srv.shutdown()
+            srv.server_close()
+    finally:
+        rpc.shutdown()
+
+
+def test_profiler_scheduler_state_machine():
+    from paddle_tpu.profiler import ProfilerState, make_scheduler
+    sched = make_scheduler(closed=2, ready=1, record=2, repeat=1,
+                           skip_first=1)
+    states = [sched(i) for i in range(8)]
+    S = ProfilerState
+    assert states[0] == S.CLOSED            # skip_first
+    assert states[1:3] == [S.CLOSED, S.CLOSED]
+    assert states[3] == S.READY
+    assert states[4] == S.RECORD
+    assert states[5] == S.RECORD_AND_RETURN
+    assert states[6] == S.CLOSED            # repeat=1 exhausted
+    assert states[7] == S.CLOSED
+
+
+def test_profiler_event_statistics():
+    import paddle_tpu.profiler as profiler
+    prof = profiler.Profiler(timer_only=True)
+    prof.start()
+    for _ in range(3):
+        with profiler.RecordEvent("my_op"):
+            time.sleep(0.01)
+        prof.step()
+    stats = prof.event_stats()
+    prof.stop()
+    assert stats["my_op"]["calls"] == 3
+    assert stats["my_op"]["avg_ms"] >= 8
+    text = prof.summary()
+    assert "my_op" in text and "avg step" in text
+
+
+def test_profiler_trace_windows_timer_only():
+    import paddle_tpu.profiler as profiler
+    sched = profiler.make_scheduler(closed=1, ready=0, record=1)
+    prof = profiler.Profiler(timer_only=True, scheduler=sched)
+    prof.start()
+    for _ in range(4):
+        prof.step()
+    prof.stop()
+    assert prof._step_num == 4
