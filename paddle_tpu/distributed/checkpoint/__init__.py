@@ -11,4 +11,5 @@ reference's load_state_dict.py, with jax.Arrays instead of DenseTensors.
 """
 
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata  # noqa: F401
-from .save_load import load_state_dict, save_state_dict  # noqa: F401
+from .save_load import (AsyncSaveHandle, load_state_dict,  # noqa: F401
+                        save_state_dict)

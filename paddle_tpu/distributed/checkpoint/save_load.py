@@ -42,13 +42,41 @@ def _barrier(tag: str) -> None:
         multihost_utils.sync_global_devices(tag)
 
 
-def save_state_dict(state_dict: dict, path: str, process_group=None,
-                    coordinator_rank: int = 0) -> None:
-    os.makedirs(path, exist_ok=True)
-    rank = jax.process_index()
+class AsyncSaveHandle:
+    """Handle for an in-flight async checkpoint save (orbax-style async —
+    the SURVEY §7 target for the distributed-checkpoint row). The device
+    arrays are snapshotted to host (per shard) BEFORE the background thread
+    starts, so training can mutate (donate) them immediately."""
+
+    def __init__(self, thread, err_cell):
+        self._thread = thread
+        self._err = err_cell
+
+    def result(self, timeout=None):
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("async checkpoint save still running")
+        if self._err[0] is not None:
+            raise self._err[0]
+
+    wait = result
+
+    def done(self) -> bool:
+        """True once the background write finished; raises the background
+        error (failed saves must not read as completed)."""
+        if self._thread.is_alive():
+            return False
+        if self._err[0] is not None:
+            raise self._err[0]
+        return True
+
+
+def _build_rank_payload(state_dict: dict, fname: str):
+    """Device→host per-shard extraction (shared by sync and async paths:
+    async runs this on the MAIN thread so only file IO goes background,
+    preserving the sharded file layout and per-shard host copies)."""
     meta = Metadata()
     payload = {}
-    fname = f"{rank}.distcp.npz"
     for key, arr in state_dict.items():
         if arr is None:
             continue
@@ -61,8 +89,45 @@ def save_state_dict(state_dict: dict, path: str, process_group=None,
             shard_metas.append(lm)
             li = LocalTensorIndex(key, offset)
             meta.storage_metadata[li] = fname
-            payload[f"{key}|{','.join(map(str, offset))}"] = data
+            payload[f"{key}|{','.join(map(str, offset))}"] = np.asarray(data)
         meta.state_dict_metadata[key] = shard_metas
+    return meta, payload
+
+
+def save_state_dict(state_dict: dict, path: str, process_group=None,
+                    coordinator_rank: int = 0, async_save: bool = False):
+    """Write a sharded checkpoint. With ``async_save=True``, device→host
+    shard transfer happens now but file IO + metadata write run in a
+    background thread; returns an AsyncSaveHandle (call .result() before
+    relying on the files). Single-process only for async (multi-process
+    coordination uses the synchronous path's barriers)."""
+    if async_save:
+        import threading
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "async_save is single-process; multi-process saves "
+                "coordinate through barriers and must be synchronous")
+        os.makedirs(path, exist_ok=True)
+        fname = f"{jax.process_index()}.distcp.npz"
+        meta, payload = _build_rank_payload(state_dict, fname)
+        err_cell = [None]
+
+        def work():
+            try:
+                np.savez(os.path.join(path, fname), **payload)
+                with open(os.path.join(path, "metadata.pkl"), "wb") as f:
+                    pickle.dump(meta, f)
+            except BaseException as e:  # noqa: BLE001
+                err_cell[0] = e
+
+        t = threading.Thread(target=work, daemon=True)
+        handle = AsyncSaveHandle(t, err_cell)
+        t.start()
+        return handle
+    os.makedirs(path, exist_ok=True)
+    rank = jax.process_index()
+    fname = f"{rank}.distcp.npz"
+    meta, payload = _build_rank_payload(state_dict, fname)
     np.savez(os.path.join(path, fname), **payload)
     with open(os.path.join(path, f"{rank}.meta.pkl"), "wb") as f:
         pickle.dump(meta, f)

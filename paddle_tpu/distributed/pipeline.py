@@ -137,7 +137,7 @@ def pipeline_train_1f1b(stage_params, extra_params, micro_inputs,
                         last_fn: Callable, *, mesh: Mesh | None = None,
                         axis: str = "pp", remat: bool = True,
                         extra_manual_axes: Sequence[str] = (),
-                        micro_in_specs=None):
+                        micro_in_specs=None, vpp: int = 1):
     """One pipelined forward+backward over microbatches with the 1F1B
     schedule (parity: PipelineParallel.forward_backward_pipeline,
     pipeline_parallel.py:455; spec SURVEY §B.1).
@@ -168,12 +168,24 @@ def pipeline_train_1f1b(stage_params, extra_params, micro_inputs,
       last_fn(extra, h, micro_in) -> (num, den): loss numerator/denominator
         (sum & token count); total loss = Σnum/Σden, gradients are of the
         total loss.
+      vpp: virtual-pipeline chunks per device (parity: interleaved
+        PipelineParallelWithInterleave, pipeline_parallel.py:942). With
+        V = vpp > 1 each device owns V NON-adjacent stage chunks
+        (stage s = c*P + r): forward of microbatch m = g*P + i runs at tick
+        ``i + s + g*V*P`` and its backward at
+        ``(S-1) + i + (S-1-s) + g*V*P`` — a closed-form interleaved
+        timetable where every stage handoff is produced exactly one tick
+        before its consumption on the adjacent device, so the same two ring
+        ppermutes serve all chunks with NO in-transit buffering, and the
+        warm-up/cool-down bubble shrinks from 2P to (1+1/V)P ticks.
+        vpp=1 reduces to the plain 1F1B schedule.
 
     Returns (loss, d_stage_params, d_extra_params); d_stage stays sharded on
     ``axis`` like the params, d_extra is replicated over ``axis``.
     """
     mesh = mesh or mesh_lib.current_mesh()
     pp = mesh_lib.axis_size(axis, mesh) if mesh else 1
+    V = int(vpp)
     apply_one = jax.checkpoint(layer_apply) if remat else layer_apply
 
     def stage_fn(local_params, h):
@@ -200,8 +212,26 @@ def pipeline_train_1f1b(stage_params, extra_params, micro_inputs,
             stage_params, extra_params)
         return loss, grads[0], grads[1]
 
-    T = M + 2 * pp - 2
-    B = 2 * pp + 1          # input ring buffer; slot B-1 is the trash slot
+    S = pp * V                       # virtual stages
+    L_total = jax.tree.leaves(stage_params)[0].shape[0]
+    if L_total % S:
+        raise ValueError(f"stacked layer dim {L_total} must divide over "
+                         f"{S} virtual stages (pp={pp} x vpp={V})")
+    Lc = L_total // S                # layers per chunk
+    if V > 1:
+        # reorder stages so each device's V chunks are CONTIGUOUS under the
+        # P(axis) leading-dim sharding: position (r, c, j) <- stage c*P+r
+        import numpy as _np
+        perm = _np.concatenate([
+            _np.arange(Lc) + (c * pp + r) * Lc
+            for r in range(pp) for c in range(V)])
+        stage_params = jax.tree.map(lambda a: jnp.take(a, perm, axis=0),
+                                    stage_params)
+    # last tick = backward of stage 0 for the last microbatch:
+    # b(0, M-1) = 2(S-1) + (M-1)%P + ((M-1)//P)*V*P  (partial groups still
+    # advance a full V*P ticks, so ceil-group accounting, not M*V)
+    T = 2 * (S - 1) + (M - 1) % pp + ((M - 1) // pp) * V * pp + 1
+    B = 2 * pp + 1       # per-chunk input ring buffer; slot B-1 is trash
     perm_fwd = [(r, (r + 1) % pp) for r in range(pp)]
     perm_bwd = [(r, (r - 1) % pp) for r in range(pp)]
     manual = {axis, *extra_manual_axes}
@@ -211,7 +241,10 @@ def pipeline_train_1f1b(stage_params, extra_params, micro_inputs,
         m0 = jax.tree.map(lambda a: a[0], micros)
         h_struct = jax.eval_shape(first_fn, extra, m0)
         zero_h = jnp.zeros(h_struct.shape, h_struct.dtype)
-        zeros_sp = jax.tree.map(jnp.zeros_like, sp_local)
+        # local stacked params as [V, Lc, ...] chunk-major
+        sp_ch = jax.tree.map(
+            lambda a: a.reshape((V, Lc) + a.shape[1:]), sp_local)
+        zeros_sp = jax.tree.map(jnp.zeros_like, sp_ch)
         zeros_ex = jax.tree.map(jnp.zeros_like, extra)
 
         def tick(carry, t):
@@ -220,74 +253,97 @@ def pipeline_train_1f1b(stage_params, extra_params, micro_inputs,
             # must be reached by EVERY device in lockstep — stage-dependent
             # work is expressed through masked VJP cotangents instead, so
             # masked contributions are exactly zero without divergent control
-            # flow (the SPMD-safe formulation of the 1F1B schedule).
+            # flow (the SPMD-safe formulation of the 1F1B/VPP schedule).
             h_in, g_in, buf, gsp, gex, num_acc, den_acc = carry
-            mf = t - r
-            valid_f = (mf >= 0) & (mf < M)
+
+            # ---- decode the forward item: tick t = i + s + g*V*P with
+            # s = c*P + r  =>  q = t - r = i + (c + g*V)*P
+            qf = t - r
+            i_f = jnp.mod(qf, pp)
+            c_f = jnp.mod(qf // pp, V)
+            g_f = qf // (V * pp)
+            mf = g_f * pp + i_f
+            valid_f = (qf >= 0) & (mf >= 0) & (mf < M)
             mf_c = jnp.clip(mf, 0, M - 1)
-            mb_ = t - (2 * pp - 2 - r)
-            valid_b = (mb_ >= 0) & (mb_ < M)
+
+            # ---- decode the backward item: t = 2(S-1) - c*P - r + i + g*V*P
+            # =>  u = t + r - 2(S-1) + (V-1)*P = i + (V-1-c)*P + g*V*P
+            u = t + r - 2 * (S - 1) + (V - 1) * pp
+            i_b = jnp.mod(u, pp)
+            cb = V - 1 - jnp.mod(u // pp, V)
+            g_b = u // (V * pp)
+            mb_ = g_b * pp + i_b
+            valid_b = (u >= 0) & (mb_ >= 0) & (mb_ < M)
             mb_c = jnp.clip(mb_, 0, M - 1)
-            is_last = r == pp - 1
+            cb_c = jnp.clip(cb, 0, V - 1)
+            is_last_b = (r == pp - 1) & (cb_c == V - 1)
+
             mi_f = jax.tree.map(lambda a: lax.dynamic_index_in_dim(
                 a, mf_c, 0, keepdims=False), micros)
             mi_b = jax.tree.map(lambda a: lax.dynamic_index_in_dim(
                 a, mb_c, 0, keepdims=False), micros)
 
-            # ---- forward: stage 0 sources from the embedding, others from
-            # the act received over the ring
+            # ---- forward: stage 0 (chunk 0 on device 0) sources from the
+            # embedding, every other stage from the act received on the ring
             emb = first_fn(extra, mi_f)
-            src = jnp.where(r == 0, emb, h_in)
+            src = jnp.where((r == 0) & (c_f == 0), emb, h_in)
             slot_f = jnp.where(valid_f, mf_c % (B - 1), B - 1)
-            buf = lax.dynamic_update_index_in_dim(buf, src, slot_f, 0)
-            y = stage_fn(sp_local, src)
+            buf = buf.at[c_f, slot_f].set(src)
+            sp_f = jax.tree.map(lambda a: lax.dynamic_index_in_dim(
+                a, c_f, 0, keepdims=False), sp_ch)
+            y = stage_fn(sp_f, src)
 
             # ---- backward: ONE vjp serves both roles. The last stage
-            # differentiates loss(stage(src_f)) seeded with cot_n=1; middle
+            # differentiates loss(stage(src_f)) seeded with cot_n=1; other
             # stages differentiate stage(saved input) seeded with the grad
-            # received from downstream (cot_y). The other cotangent is zero,
-            # so the unused path contributes exactly 0 to every gradient.
-            slot_b = mb_c % (B - 1)
-            src_saved = lax.dynamic_index_in_dim(buf, slot_b, 0,
-                                                 keepdims=False)
-            src_bwd = jnp.where(is_last, src, src_saved)
+            # received from downstream (cot_y). The unused cotangent is
+            # zero, so the unused path contributes exactly 0 everywhere.
+            slot_b = jnp.where(valid_b, mb_c % (B - 1), B - 1)
+            src_saved = buf[cb_c, slot_b]
+            src_bwd = jnp.where(is_last_b, src, src_saved)
+            sp_b = jax.tree.map(lambda a: lax.dynamic_index_in_dim(
+                a, cb_c, 0, keepdims=False), sp_ch)
             mi_bwd = jax.tree.map(
-                lambda a, b_: jnp.where(is_last, a, b_), mi_f, mi_b)
+                lambda a, b_: jnp.where(is_last_b, a, b_), mi_f, mi_b)
 
             def composite(sp, s, ex):
                 y2 = stage_fn(sp, s)
                 n, d = last_fn(ex, y2, mi_bwd)
                 return (y2, n), d
 
-            (_, n), vjp_fn, d = jax.vjp(composite, sp_local, src_bwd, extra,
+            (_, n), vjp_fn, d = jax.vjp(composite, sp_b, src_bwd, extra,
                                         has_aux=True)
-            cot_n = jnp.where(is_last & valid_f, jnp.float32(1),
+            cot_n = jnp.where(is_last_b & valid_b, jnp.float32(1),
                               jnp.float32(0))
-            cot_y = jnp.where((~is_last) & valid_b, g_in,
+            cot_y = jnp.where((~is_last_b) & valid_b, g_in,
                               jnp.zeros_like(g_in))
             dsp, dsrc, dex = vjp_fn((cot_y, cot_n))
 
             # ---- stage-0 embedding backward (masked seed => exact zeros
             # elsewhere); shared (tied) params get both contributions summed
-            seed = jnp.where((r == 0) & valid_b, dsrc, jnp.zeros_like(dsrc))
+            seed = jnp.where((r == 0) & (cb_c == 0) & valid_b, dsrc,
+                             jnp.zeros_like(dsrc))
             _, evjp = jax.vjp(lambda ex: first_fn(ex, mi_b), extra)
             (dex0,) = evjp(seed)
 
-            # ---- accumulate + hand off
-            gsp = jax.tree.map(jnp.add, gsp, dsp)
+            # ---- accumulate (into the bwd item's chunk) + hand off
+            gsp = jax.tree.map(
+                lambda G, dd: G.at[cb_c].add(dd), gsp, dsp)
             gex = jax.tree.map(lambda a, x, yy: a + x + yy, gex, dex, dex0)
-            num_acc = num_acc + jnp.where(is_last & valid_f, n, 0.0)
-            den_acc = den_acc + jnp.where(is_last & valid_f, d, 0.0)
+            num_acc = num_acc + jnp.where(is_last_b & valid_b, n, 0.0)
+            den_acc = den_acc + jnp.where(is_last_b & valid_b, d, 0.0)
             y_send = jnp.where(valid_f, y, jnp.zeros_like(y))
             h_next = lax.ppermute(y_send, axis, perm_fwd)
             g_next = lax.ppermute(dsrc, axis, perm_bwd)
             return (h_next, g_next, buf, gsp, gex, num_acc, den_acc), None
 
-        buf0 = jnp.zeros((B,) + h_struct.shape, h_struct.dtype)
+        buf0 = jnp.zeros((V, B) + h_struct.shape, h_struct.dtype)
         carry0 = (zero_h, jnp.zeros_like(zero_h), buf0, zeros_sp, zeros_ex,
                   jnp.float32(0), jnp.float32(0))
         (_, _, _, gsp, gex, num, den), _ = lax.scan(tick, carry0,
                                                     jnp.arange(T))
+        gsp = jax.tree.map(
+            lambda G: G.reshape((V * Lc,) + G.shape[2:]), gsp)
         axes = tuple(manual)
         num = lax.psum(num, axes)
         den = lax.psum(den, axes)
@@ -315,7 +371,14 @@ def pipeline_train_1f1b(stage_params, extra_params, micro_inputs,
                            in_specs=(sp_spec, ex_spec, micro_in_specs),
                            out_specs=out_specs, axis_names=frozenset(manual),
                            check_vma=False))
-    return fn(stage_params, extra_params, micro_inputs)
+    loss, d_stage, d_extra = fn(stage_params, extra_params, micro_inputs)
+    if V > 1:
+        # undo the chunk-contiguous reorder so grads match the caller's
+        # original layer order
+        import numpy as _np
+        inv = _np.argsort(perm)
+        d_stage = jax.tree.map(lambda a: jnp.take(a, inv, axis=0), d_stage)
+    return loss, d_stage, d_extra
 
 
 class PipelineStagedLayers(Layer):

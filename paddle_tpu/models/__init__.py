@@ -2,7 +2,9 @@
 (PaddleNLP llama/gpt/bert + MoE configs). Vision models live in
 paddle_tpu.vision.models."""
 
-from . import bert, gpt, llama  # noqa: F401
+from . import bert, gpt, llama, qwen2_moe  # noqa: F401
 from .bert import BertConfig, BertForPreTraining, BertModel  # noqa: F401
 from .gpt import GPTConfig, GPTForCausalLM  # noqa: F401
 from .llama import LlamaConfig, LlamaForCausalLM, llama_3_8b, llama_tiny  # noqa: F401
+from .llama_pipe import LlamaForCausalLMPipe  # noqa: F401
+from .qwen2_moe import Qwen2MoeConfig, Qwen2MoeForCausalLM, qwen2_moe_tiny  # noqa: F401

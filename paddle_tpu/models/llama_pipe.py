@@ -41,18 +41,20 @@ class LlamaForCausalLMPipe(Layer):
     is shared and its two gradient contributions merge in one psum).
     """
 
-    def __init__(self, config: LlamaConfig, num_micro: int = 1):
+    def __init__(self, config: LlamaConfig, num_micro: int = 1,
+                 vpp: int = 1):
         super().__init__(dtype=config.dtype)
         if config.pp_axis is None:
             import dataclasses
             config = dataclasses.replace(config, pp_axis="pp")
         self.config = config
         self.num_micro = num_micro
+        self.vpp = vpp
         pp = mesh_lib.axis_size(config.pp_axis)
-        if config.num_hidden_layers % max(pp, 1):
+        if config.num_hidden_layers % max(pp * vpp, 1):
             raise ValueError(
                 f"num_hidden_layers={config.num_hidden_layers} must divide "
-                f"evenly over pp={pp} stages")
+                f"evenly over pp={pp} x vpp={vpp} virtual stages")
         self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size,
                                          weight_spec=(config.mp_axis, None))
         self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
@@ -162,7 +164,7 @@ class LlamaForCausalLMPipe(Layer):
             stage, extra, micros, first_fn, layer_apply, last_fn,
             axis=cfg.pp_axis, remat=True,
             extra_manual_axes=(sep,) if sep else (),
-            micro_in_specs=micro_specs)
+            micro_in_specs=micro_specs, vpp=self.vpp)
         grads = {("stage__" + k.replace(".", "__")): v
                  for k, v in g_stage.items()}
         grads.update(g_extra)
@@ -191,11 +193,11 @@ class LlamaForCausalLMPipe(Layer):
             shift_labels.reshape(-1), ignore_index=ignore_index)
 
     @classmethod
-    def from_unstacked(cls, model, num_micro: int = 1):
+    def from_unstacked(cls, model, num_micro: int = 1, vpp: int = 1):
         """Build a pipe model from a LlamaForCausalLM, copying weights
         (stacking the per-layer decoder params)."""
         cfg = model.config
-        pipe = cls(cfg, num_micro=num_micro)
+        pipe = cls(cfg, num_micro=num_micro, vpp=vpp)
         src = model.param_dict()
         new = {}
         for k, v in pipe.param_dict().items():

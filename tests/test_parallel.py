@@ -192,6 +192,22 @@ def test_ulysses_and_ring_match_reference(sep_mesh):
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-3, atol=1e-4)
 
 
+def test_async_checkpoint_save(tmp_path):
+    """async_save snapshots to host and writes in the background; the files
+    must load back identically after .result()."""
+    from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                   save_state_dict)
+    state = {"w": jnp.asarray(RNG.standard_normal((16, 8)), jnp.float32),
+             "b": jnp.asarray(RNG.standard_normal((8,)), jnp.float32)}
+    handle = save_state_dict(state, str(tmp_path / "ck"), async_save=True)
+    handle.result(timeout=60)
+    assert handle.done()
+    dst = {"w": jnp.zeros((16, 8)), "b": jnp.zeros((8,))}
+    out = load_state_dict(dst, str(tmp_path / "ck"))
+    np.testing.assert_allclose(np.asarray(out["w"]), np.asarray(state["w"]))
+    np.testing.assert_allclose(np.asarray(out["b"]), np.asarray(state["b"]))
+
+
 def test_ring_attention_gqa(sep_mesh):
     """GQA ring: k/v travel at kv-head width, repeated per step — must match
     the pre-repeated full-head reference, values and grads."""

@@ -18,7 +18,7 @@ from paddle_tpu.nn.module import functional_call
 
 def _toy_setup():
     rng = np.random.default_rng(0)
-    L, H, I, O, M, mb = 4, 16, 8, 4, 6, 4
+    L, H, I, O, M, mb = 8, 16, 8, 4, 6, 4
     sp = {"w": jnp.asarray(rng.standard_normal((L, H, H)), jnp.float32) * 0.1,
           "b": jnp.asarray(rng.standard_normal((L, H)), jnp.float32) * 0.1}
     ex = {"emb": jnp.asarray(rng.standard_normal((I, H)), jnp.float32) * 0.3,
@@ -52,16 +52,21 @@ def _toy_setup():
     return sp, ex, micros, first_fn, layer_apply, last_fn, ref_loss
 
 
-@pytest.mark.parametrize("pp", [2, 4])
-def test_1f1b_matches_single_device(pp):
+@pytest.mark.parametrize("pp,vpp", [(2, 1), (4, 1), (2, 2), (4, 2), (2, 4)])
+def test_1f1b_matches_single_device(pp, vpp):
+    """Plain 1F1B (vpp=1) and interleaved VPP (vpp>1, the
+    PipelineParallelWithInterleave parity) must both reproduce the
+    single-device loss and gradients exactly."""
     sp, ex, micros, first_fn, layer_apply, last_fn, ref_loss = _toy_setup()
+    if sp["w"].shape[0] % (pp * vpp):
+        pytest.skip("layers not divisible")
     ref_l, (ref_gsp, ref_gex) = jax.value_and_grad(
         ref_loss, argnums=(0, 1))(sp, ex)
     mesh = Mesh(np.array(jax.devices()).reshape(8 // pp, pp), ("dp", "pp"))
     with mesh_lib.use_mesh(mesh):
         spd = jax.device_put(sp, NamedSharding(mesh, P("pp")))
         loss, gsp, gex = jax.jit(lambda a, b, c: pipeline_train_1f1b(
-            a, b, c, first_fn, layer_apply, last_fn, axis="pp"))(
+            a, b, c, first_fn, layer_apply, last_fn, axis="pp", vpp=vpp))(
                 spd, ex, micros)
     assert abs(float(loss) - float(ref_l)) < 1e-5
     for k in gsp:
@@ -125,6 +130,25 @@ def test_llama_pipe_matches_reference(sep_axis):
              for i in range(cfg.num_hidden_layers)])
         got = grads["stage__" + path.replace(".", "__")]
         np.testing.assert_allclose(got, stacked_ref, atol=1e-3)
+
+
+def test_llama_pipe_vpp_matches_reference():
+    """Interleaved VPP on the flagship: pp=2 x vpp=2 virtual stages."""
+    cfg, ref, ids, rl, rg = _llama_pair(None)
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("dp", "pp"))
+    with mesh_lib.use_mesh(mesh):
+        pipe = LlamaForCausalLMPipe.from_unstacked(ref, num_micro=2, vpp=2)
+        loss, grads = jax.jit(
+            lambda p, b: pipe.pipeline_loss_and_grads(p, b, ids, ids))(
+                pipe.param_dict(), pipe.buffer_dict())
+    assert abs(float(loss) - float(rl)) < 3e-4
+    np.testing.assert_allclose(grads["embed_tokens.weight"],
+                               rg["model.embed_tokens.weight"], atol=1e-3)
+    stacked_ref = np.stack(
+        [np.asarray(rg[f"model.layers.{i}.self_attn.q_proj.weight"])
+         for i in range(cfg.num_hidden_layers)])
+    np.testing.assert_allclose(grads["stage__self_attn__q_proj__weight"],
+                               stacked_ref, atol=1e-3)
 
 
 def test_llama_pipe_tied_embeddings_shared_grad():
