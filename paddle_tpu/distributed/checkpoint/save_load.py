@@ -120,7 +120,10 @@ def save_state_dict(state_dict: dict, path: str, process_group=None,
             except BaseException as e:  # noqa: BLE001
                 err_cell[0] = e
 
-        t = threading.Thread(target=work, daemon=True)
+        # non-daemon: interpreter exit joins the writer, so a script that
+        # forgets handle.result() still gets a complete checkpoint instead
+        # of a silently truncated one
+        t = threading.Thread(target=work, daemon=False)
         handle = AsyncSaveHandle(t, err_cell)
         t.start()
         return handle

@@ -53,6 +53,74 @@ def _xla_attention(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
     return out.astype(q.dtype)
 
 
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=64)
+def _flash_sharded_fn(mesh, batch_axes, head_axes, is_causal):
+    """Compiled shard_map wrapper cache — keyed so repeated attention calls
+    (every layer, every step, eager decode loops) reuse one executable."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from ...ops.pallas.flash_attention import flash_attention as _fa
+    spec = P(batch_axes or None, None, head_axes or None, None)
+    return jax.jit(shard_map(
+        lambda q, k, v: _fa(q, k, v, causal=is_causal), mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names=frozenset([*batch_axes, *head_axes]), check_vma=False))
+
+
+def _flash_sharded(q, k, v, is_causal):
+    """SPMD rule for the Pallas flash kernel (parity:
+    phi/infermeta/spmd_rules/flash_attention.cc — shard batch and heads,
+    replicate seq/head_dim): under an active mesh the kernel runs inside a
+    shard_map over the data/model axes so GSPMD programs keep the fused
+    kernel instead of falling off the partitioning path. Axes come from the
+    array's actual sharding when concrete (eager path), else the canonical
+    dp/mp names. Returns None when no rule applies (caller falls back to
+    XLA attention)."""
+    from ...core import mesh as mesh_lib
+    from ...ops.pallas.flash_attention import flash_attention as _fa
+    mesh = mesh_lib.current_mesh()
+    if mesh is None or all(s == 1 for s in mesh.shape.values()):
+        return _fa(q, k, v, causal=is_causal)
+
+    def _axes(default):
+        # concrete arrays carry their placement; tracers fall back to the
+        # canonical hybrid axis names
+        sh = getattr(q, "sharding", None)
+        spec = getattr(sh, "spec", None)
+        if spec is not None and len(spec) >= 3:
+            ent = spec[default[1]]
+            if ent is None:
+                return ()
+            return tuple(ent) if isinstance(ent, tuple) else (ent,)
+        return tuple(a for a in default[0]
+                     if mesh_lib.axis_size(a, mesh) > 1)
+
+    batch_axes = _axes((("dp",), 0))
+    head_axes = _axes((("mp",), 2))
+    from ...distributed.pipeline import in_manual_region
+    if in_manual_region():
+        # already inside the pipeline's shard_map body: dp/mp are auto
+        # (global-view) axes here — no nested shard_map; the plain kernel is
+        # only safe when those axes are unsized, else use XLA attention
+        if not batch_axes and not head_axes:
+            return _fa(q, k, v, causal=is_causal)
+        return None
+    bdeg = 1
+    for a in batch_axes:
+        bdeg *= mesh_lib.axis_size(a, mesh)
+    hdeg = 1
+    for a in head_axes:
+        hdeg *= mesh_lib.axis_size(a, mesh)
+    if q.shape[0] % max(bdeg, 1) or q.shape[2] % max(hdeg, 1) or \
+            k.shape[2] % max(hdeg, 1):
+        return None
+    fn = _flash_sharded_fn(mesh, batch_axes, head_axes, bool(is_causal))
+    return fn(q, k, v)
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
                                  is_causal=False, training=True, name=None):
     """Inputs [batch, seq, num_heads, head_dim] (paddle convention)."""
@@ -64,8 +132,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
         and jax.default_backend() == "tpu"
     )
     if use_flash:
-        from ...ops.pallas.flash_attention import flash_attention as _fa
-        return _fa(q, k, v, causal=is_causal)
+        out = _flash_sharded(q, k, v, is_causal)
+        if out is not None:
+            return out
     return _xla_attention(q, k, v, attn_mask, dropout_p, is_causal, training=training)
 
 

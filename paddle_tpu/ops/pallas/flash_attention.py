@@ -59,12 +59,19 @@ def _block_sizes(seq_q, seq_k, head_dim):
 
 # ---------------- forward ----------------
 
-def _fwd_kernel(*refs, scale, causal, bq, bk, nk, off, k_valid, has_seg=False):
+def _fwd_kernel(*refs, scale, causal, bq, bk, nk, off, k_valid, has_seg=False,
+                has_bias=False):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    refs = refs[3:]
+    qs_ref = ks_ref = bias_ref = None
     if has_seg:
-        (q_ref, k_ref, v_ref, qs_ref, ks_ref, o_ref, lse_ref,
-         acc_ref, m_ref, l_ref) = refs
-    else:
-        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+        qs_ref, ks_ref = refs[:2]
+        refs = refs[2:]
+    if has_bias:
+        bias_ref = refs[0]
+        refs = refs[1:]
+    o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     j = pl.program_id(2)
     i = pl.program_id(1)
 
@@ -100,6 +107,8 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, nk, off, k_valid, has_seg=False):
         if has_seg:  # varlen packing: tokens attend within their sequence
             s = jnp.where(qs_ref[0, :, 0][:, None] == ks_ref[0, :, 0][None, :],
                           s, NEG)
+        if has_bias:  # additive attn_mask (reference flash attn_mask attr)
+            s = s + bias_ref[0].astype(jnp.float32)
         m_prev = m_ref[:, 0]  # [bq]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
         # clamp the subtracted max so fully-masked rows (m_cur == NEG, possible
@@ -119,7 +128,7 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, nk, off, k_valid, has_seg=False):
         lse_ref[0, :, 0] = m_ref[:, 0] + jnp.log(l_safe)
 
 
-def _fwd(q, k, v, scale, causal, seg=None):
+def _fwd(q, k, v, scale, causal, seg=None, bias=None):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     qh = jnp.moveaxis(q, 2, 1).reshape(b * h, sq, d)
@@ -141,10 +150,12 @@ def _fwd(q, k, v, scale, causal, seg=None):
     # not -inf). Under the causal mask they are provably excluded when
     # off >= 0; ragged shapes get an explicit in-kernel validity mask.
     # Segment (varlen) runs mask padded keys through the mismatched pad ids.
-    k_valid = sk if (pk and not causal and seg is None) else None
+    k_valid = sk if (pk and not causal and seg is None and bias is None) \
+        else None
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                bq=bq, bk=bk, nk=nk, off=off, k_valid=k_valid,
-                               has_seg=seg is not None)
+                               has_seg=seg is not None,
+                               has_bias=bias is not None)
 
     if causal:
         # Clamp dead (fully masked) k blocks to the last live block index:
@@ -170,6 +181,10 @@ def _fwd(q, k, v, scale, causal, seg=None):
             pl.BlockSpec((1, bk, 1), kv_index),
         ]
         inputs += [sq_arr, sk_arr]
+    if bias is not None:
+        in_specs.append(pl.BlockSpec(
+            (1, bq, bk), lambda b_, i, j: (b_, i, j)))
+        inputs.append(_pad_bias(bias, b * h, sq, sk, pq, pk))
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, nq, nk),
@@ -196,6 +211,22 @@ def _fwd(q, k, v, scale, causal, seg=None):
     return jnp.moveaxis(out, 1, 2), lse
 
 
+def _pad_bias(bias, bh, sq, sk, pq, pk):
+    """Normalize an additive mask to [b*h, SQ, SK] f32; padded key columns
+    get -1e30 so they never join a softmax."""
+    bias = jnp.asarray(bias, jnp.float32)
+    if bias.ndim == 4:  # [b, h|1, sq, sk]
+        b = bias.shape[0]
+        h = bh // b
+        bias = jnp.broadcast_to(bias, (b, h, sq, sk)).reshape(bh, sq, sk)
+    elif bias.ndim == 2:  # [sq, sk]
+        bias = jnp.broadcast_to(bias[None], (bh, sq, sk))
+    if pq or pk:
+        bias = jnp.pad(bias, ((0, 0), (0, pq), (0, pk)),
+                       constant_values=jnp.float32(-1e30))
+    return bias
+
+
 def _pad_segments(seg, bh, sq, sk, pq, pk):
     """Broadcast per-token segment ids to [b*h, S, 1] with mismatching pad
     ids (-1 for q, -2 for k) so padded rows/cols never join a softmax."""
@@ -220,13 +251,19 @@ def _scratch(shape):
 
 # ---------------- backward ----------------
 
-def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, off, has_seg=False):
+def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, off, has_seg=False,
+                   has_bias=False):
+    refs = list(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    refs = refs[6:]
+    qs_ref = ks_ref = bias_ref = None
     if has_seg:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
-         dq_ref, dq_acc) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-         dq_acc) = refs
+        qs_ref, ks_ref = refs[:2]
+        refs = refs[2:]
+    if has_bias:
+        bias_ref = refs[0]
+        refs = refs[1:]
+    dq_ref, dq_acc = refs
     j = pl.program_id(2)
     i = pl.program_id(1)
 
@@ -255,6 +292,8 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, off, has_seg=False):
         if has_seg:
             s = jnp.where(qs_ref[0, :, 0][:, None] == ks_ref[0, :, 0][None, :],
                           s, jnp.float32(-1e30))
+        if has_bias:
+            s = s + bias_ref[0].astype(jnp.float32)
         # clamped so fully-masked rows (lse == -1e30 sentinel) give p == 0
         p = jnp.exp(s - jnp.maximum(lse, jnp.float32(-1e25))[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -268,13 +307,19 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, off, has_seg=False):
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, off, has_seg=False):
+def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, off, has_seg=False,
+                    has_bias=False):
+    refs = list(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    refs = refs[6:]
+    qs_ref = ks_ref = bias_ref = None
     if has_seg:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
-         dk_ref, dv_ref, dk_acc, dv_acc) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-         dk_acc, dv_acc) = refs
+        qs_ref, ks_ref = refs[:2]
+        refs = refs[2:]
+    if has_bias:
+        bias_ref = refs[0]
+        refs = refs[1:]
+    dk_ref, dv_ref, dk_acc, dv_acc = refs
     i = pl.program_id(2)  # q block (innermost)
     j = pl.program_id(1)  # k block
 
@@ -304,6 +349,8 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, off, has_seg=False):
         if has_seg:
             s = jnp.where(qs_ref[0, :, 0][:, None] == ks_ref[0, :, 0][None, :],
                           s, jnp.float32(-1e30))
+        if has_bias:
+            s = s + bias_ref[0].astype(jnp.float32)
         # clamped so fully-masked rows (lse == -1e30 sentinel) give p == 0
         p = jnp.exp(s - jnp.maximum(lse, jnp.float32(-1e25))[:, None])
         dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
@@ -328,7 +375,8 @@ def _bwd(scale, causal, res, g):
     return flash_block_grads(q, k, v, do, lse, delta, scale=scale, causal=causal)
 
 
-def flash_block_grads(q, k, v, do, lse, delta, *, scale, causal, seg=None):
+def flash_block_grads(q, k, v, do, lse, delta, *, scale, causal, seg=None,
+                      bias=None):
     """Gradient building block given precomputed row stats.
 
     Inputs: q/do [b,sq,h,d]; k/v [b,sk,h,d]; lse/delta [b,h,sq] where lse is
@@ -367,6 +415,8 @@ def flash_block_grads(q, k, v, do, lse, delta, *, scale, causal, seg=None):
     if seg is not None:
         sq_arr, sk_arr = _pad_segments(seg, b * h, sq, sk, pq_, pk_)
         common_in += [sq_arr, sk_arr]
+    if bias is not None:
+        common_in.append(_pad_bias(bias, b * h, sq, sk, pq_, pk_))
     if causal:
         def kv_index(b_, i, j):  # dead k blocks re-use the last live index (no DMA)
             last_live = jnp.maximum((i * bq + bq - 1 + off) // bk, 0)
@@ -393,9 +443,14 @@ def flash_block_grads(q, k, v, do, lse, delta, *, scale, causal, seg=None):
             pl.BlockSpec((1, bq, 1), lambda b_, i, j: (b_, i, 0)),
             pl.BlockSpec((1, bk, 1), kv_index),
         ]
+    if bias is not None:
+        in_specs_q.append(pl.BlockSpec((1, bq, bk),
+                                       lambda b_, i, j: (b_, i, j)))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk, off=off, has_seg=seg is not None),
+                          bq=bq, bk=bk, nk=nk, off=off,
+                          has_seg=seg is not None,
+                          has_bias=bias is not None),
         grid=(b * h, nq, nk),
         in_specs=in_specs_q,
         out_specs=pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0)),
@@ -416,9 +471,15 @@ def flash_block_grads(q, k, v, do, lse, delta, *, scale, causal, seg=None):
             pl.BlockSpec((1, bq, 1), q_index_kv),
             pl.BlockSpec((1, bk, 1), lambda b_, j, i: (b_, j, 0)),
         ]
+    if bias is not None:
+        in_specs_kv.append(pl.BlockSpec(
+            (1, bq, bk), lambda b_, j, i: (q_index_kv(b_, j, i)[0],
+                                           q_index_kv(b_, j, i)[1], j)))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq, off=off, has_seg=seg is not None),
+                          bq=bq, bk=bk, nq=nq, off=off,
+                          has_seg=seg is not None,
+                          has_bias=bias is not None),
         grid=(b * h, nk, nq),
         in_specs=in_specs_kv,
         out_specs=[
@@ -458,10 +519,44 @@ def _flash_bwd(scale, causal, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, causal: bool = False, scale: float | None = None):
-    """Differentiable flash attention; layout [batch, seq, heads, head_dim]."""
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_bias(q, k, v, bias, scale, causal):
+    out, _ = _fwd(q, k, v, scale, causal, bias=bias)
+    return out
+
+
+def _flash_bias_fwd(q, k, v, bias, scale, causal):
+    out, lse = _fwd(q, k, v, scale, causal, bias=bias)
+    return out, (q, k, v, bias, out, lse)
+
+
+def _flash_bias_bwd(scale, causal, res, g):
+    q, k, v, bias, out, lse = res
+    delta = jnp.moveaxis(
+        jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1),
+        2, 1)
+    dq, dk, dv = flash_block_grads(q, k, v, g, lse, delta, scale=scale,
+                                   causal=causal, bias=bias)
+    # attn_mask carries no meaningful gradient (reference treats it as data)
+    return dq, dk, dv, jnp.zeros_like(bias)
+
+
+_flash_bias.defvjp(_flash_bias_fwd, _flash_bias_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
+                    attn_mask=None):
+    """Differentiable flash attention; layout [batch, seq, heads, head_dim].
+    ``attn_mask``: optional additive mask (bool masks converted to 0/-1e30),
+    broadcastable [b, h|1, sq, sk] or [sq, sk] — the reference kernel's
+    attn_mask attr, applied INSIDE the tiled kernel."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    if attn_mask is not None:
+        m = jnp.asarray(attn_mask)
+        if m.dtype == jnp.bool_:
+            m = jnp.where(m, jnp.float32(0), jnp.float32(-1e30))
+        return _flash_bias(q, k, v, m, scale, causal)
     return _flash(q, k, v, scale, causal)
 
 

@@ -54,7 +54,7 @@ def load(name, sources, extra_cxx_cflags=None, build_directory=None,
     if (not os.path.exists(so_path)
             or any(os.path.getmtime(s) > os.path.getmtime(so_path)
                    for s in srcs if os.path.exists(s))):
-        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
                f"-I{sysconfig.get_paths()['include']}",
                *(extra_cxx_cflags or []), *srcs, "-o", so_path]
         if verbose:
@@ -64,11 +64,19 @@ def load(name, sources, extra_cxx_cflags=None, build_directory=None,
 
 
 def load_inline(name, cpp_source, functions=None, **kwargs):
-    """Build from an inline C++ source string (torch-style convenience)."""
+    """Build from an inline C++ source string (torch-style convenience).
+    The source file is only rewritten when its content changed, so the
+    compiled .so stays cached across processes (multiprocess DataLoader
+    workers must not each trigger a rebuild/race)."""
     build_dir = os.path.join(tempfile.gettempdir(),
                              f"paddle_tpu_ext_{name}_src")
     os.makedirs(build_dir, exist_ok=True)
     src = os.path.join(build_dir, f"{name}.cc")
-    with open(src, "w") as f:
-        f.write(cpp_source)
+    existing = None
+    if os.path.exists(src):
+        with open(src) as f:
+            existing = f.read()
+    if existing != cpp_source:
+        with open(src, "w") as f:
+            f.write(cpp_source)
     return load(name, [src], build_directory=build_dir, **kwargs)
