@@ -106,6 +106,11 @@ define_flag("enable_x64", False, "Allow 64-bit dtypes (maps to jax_enable_x64)."
 define_flag("benchmark", False, "Synchronize after each op for timing.")
 define_flag("matmul_precision", "default", "XLA matmul precision: default|high|highest.")
 define_flag("log_level", 1, "VLOG-style verbosity for paddle_tpu logging.")
+define_flag("flash_block_q", 1024, "Flash attention q-block rows (read at "
+            "TRACE time: set before the first jit of a shape, or sweep in "
+            "separate processes).")
+define_flag("flash_block_k", 1024, "Flash attention k-block cols (trace-time,"
+            " see flash_block_q).")
 define_flag("comm_watchdog_timeout", 300.0,
             "Seconds before the comm watchdog flags a blocking comm/sync "
             "call as hung (parity: FLAGS_enable_async_trace timeout).")

@@ -59,11 +59,33 @@ def launch(argv=None):
     parser.add_argument("--max_restarts", type=int, default=0,
                         help="elastic: gang-restart the job up to this many "
                              "times when a worker dies (0 = fail fast)")
+    parser.add_argument("--auto_tuner_json", default=None,
+                        help="parity: launch --auto_tuner_json — a JSON "
+                             "model spec; the planner picks dp/fsdp/mp/pp "
+                             "degrees and exports them as PADDLE_AUTO_* env")
     parser.add_argument("script", help="training script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
 
     n = args.nproc_per_node
+    if args.auto_tuner_json:
+        # launch-time distributed-config search (parity:
+        # distributed/auto_tuner/tuner.py:21 driven from launch)
+        import json as _json
+        from ..auto_tuner import AutoTuner, HardwareSpec, ModelSpec
+        with open(args.auto_tuner_json) as f:
+            spec = _json.load(f)
+        hw = HardwareSpec(n_devices=int(spec.pop("n_devices", n)),
+                          **{k: spec.pop(k) for k in
+                             ("hbm_bytes", "flops", "ici_bw")
+                             if k in spec})
+        best = AutoTuner(ModelSpec(**spec), hw).tune()[0]
+        print(f"[auto_tuner] selected {best.degrees} "
+              f"(modeled step {best.step_time:.3f}s, "
+              f"mem {best.mem_bytes / 1e9:.1f} GB)", file=sys.stderr)
+        for k, v in best.degrees.items():
+            os.environ[f"PADDLE_AUTO_{k.upper()}_DEGREE"] = str(v)
+        os.environ["PADDLE_AUTO_MICRO_BATCH"] = str(best.micro_batch)
     log_files: list = []
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)

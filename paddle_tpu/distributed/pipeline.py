@@ -40,16 +40,7 @@ from ..core import mesh as mesh_lib
 from ..nn.module import Layer, functional_call
 
 __all__ = ["pipeline_forward", "stack_layer_params", "PipelineStagedLayers",
-           "pipeline_train_1f1b", "in_manual_region"]
-
-# Trace-time flag: true while tracing code INSIDE the pipeline's shard_map
-# body — kernels with their own shard_map-based sharding rules (flash
-# attention) must not nest another partial-manual shard_map there.
-_MANUAL_REGION = [False]
-
-
-def in_manual_region() -> bool:
-    return _MANUAL_REGION[0]
+           "pipeline_train_1f1b"]
 
 
 def stack_layer_params(layers: Sequence[Layer]) -> dict[str, jax.Array]:
@@ -380,12 +371,7 @@ def pipeline_train_1f1b(stage_params, extra_params, micro_inputs,
                            in_specs=(sp_spec, ex_spec, micro_in_specs),
                            out_specs=out_specs, axis_names=frozenset(manual),
                            check_vma=False))
-    prev = _MANUAL_REGION[0]
-    _MANUAL_REGION[0] = True
-    try:
-        loss, d_stage, d_extra = fn(stage_params, extra_params, micro_inputs)
-    finally:
-        _MANUAL_REGION[0] = prev
+    loss, d_stage, d_extra = fn(stage_params, extra_params, micro_inputs)
     if V > 1:
         # undo the chunk-contiguous reorder so grads match the caller's
         # original layer order

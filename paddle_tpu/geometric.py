@@ -1,0 +1,93 @@
+"""Graph message passing (parity: python/paddle/geometric/ —
+send_u_recv/send_ue_recv/send_uv, segment_{sum,mean,max,min}).
+
+TPU-native: all of these are segment reductions — jax.ops.segment_* with a
+static num_segments (graphs under jit are padded to static sizes, the usual
+jraph-style contract)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_max", "segment_min"]
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # composed below
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def _segment_reduce(data, segment_ids, pool, num_segments):
+    if pool == "mean":
+        s = jax.ops.segment_sum(data, segment_ids, num_segments)
+        cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype),
+                                  segment_ids, num_segments)
+        return s / jnp.maximum(cnt, 1)[(...,) + (None,) * (data.ndim - 1)]
+    fn = _REDUCERS[pool]
+    out = fn(data, segment_ids, num_segments)
+    if pool in ("max", "min"):
+        # empty segments come back +/-inf; the reference zeros them
+        return jnp.where(jnp.isfinite(out), out, 0)
+    return out
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size=None, name=None):
+    """Gather x at src, reduce onto dst (parity: geometric.send_u_recv)."""
+    x = jnp.asarray(x)
+    src = jnp.asarray(src_index)
+    dst = jnp.asarray(dst_index)
+    n = int(out_size) if out_size is not None else x.shape[0]
+    return _segment_reduce(x[src], dst, reduce_op.lower(), n)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size=None, name=None):
+    """Node-edge fused messaging: combine x[src] with edge feature y, then
+    reduce onto dst (parity: geometric.send_ue_recv)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    src = jnp.asarray(src_index)
+    dst = jnp.asarray(dst_index)
+    m = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+         "div": jnp.divide}[message_op.lower()](x[src], y)
+    n = int(out_size) if out_size is not None else x.shape[0]
+    return _segment_reduce(m, dst, reduce_op.lower(), n)
+
+
+def send_uv(x, y, src_index, dst_index, message_op: str = "add", name=None):
+    """Per-edge message from both endpoints (parity: geometric.send_uv)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    src = jnp.asarray(src_index)
+    dst = jnp.asarray(dst_index)
+    return {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+            "div": jnp.divide}[message_op.lower()](x[src], y[dst])
+
+
+def segment_sum(data, segment_ids, name=None):
+    n = int(jnp.max(jnp.asarray(segment_ids))) + 1
+    return jax.ops.segment_sum(jnp.asarray(data),
+                               jnp.asarray(segment_ids), n)
+
+
+def segment_mean(data, segment_ids, name=None):
+    n = int(jnp.max(jnp.asarray(segment_ids))) + 1
+    return _segment_reduce(jnp.asarray(data), jnp.asarray(segment_ids),
+                           "mean", n)
+
+
+def segment_max(data, segment_ids, name=None):
+    n = int(jnp.max(jnp.asarray(segment_ids))) + 1
+    return _segment_reduce(jnp.asarray(data), jnp.asarray(segment_ids),
+                           "max", n)
+
+
+def segment_min(data, segment_ids, name=None):
+    n = int(jnp.max(jnp.asarray(segment_ids))) + 1
+    return _segment_reduce(jnp.asarray(data), jnp.asarray(segment_ids),
+                           "min", n)

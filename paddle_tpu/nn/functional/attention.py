@@ -56,6 +56,18 @@ def _xla_attention(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
 import functools as _functools
 
 
+def _in_manual_trace() -> bool:
+    """True while tracing inside ANY shard_map body with manual axes —
+    detected from the abstract mesh's axis types, so every shard_map entry
+    point (pipeline, sequence parallel, user code) is covered without
+    per-call-site flags."""
+    try:
+        am = jax.sharding.get_abstract_mesh()
+        return any("Manual" in str(t) for t in getattr(am, "axis_types", ()))
+    except Exception:
+        return False
+
+
 @_functools.lru_cache(maxsize=64)
 def _flash_sharded_fn(mesh, batch_axes, head_axes, is_causal):
     """Compiled shard_map wrapper cache — keyed so repeated attention calls
@@ -100,13 +112,18 @@ def _flash_sharded(q, k, v, is_causal):
 
     batch_axes = _axes((("dp",), 0))
     head_axes = _axes((("mp",), 2))
-    from ...distributed.pipeline import in_manual_region
-    if in_manual_region():
-        # already inside the pipeline's shard_map body: dp/mp are auto
-        # (global-view) axes here — no nested shard_map; the plain kernel is
-        # only safe when those axes are unsized, else use XLA attention
+    if _in_manual_trace():
+        # already inside a shard_map body (pipeline / sequence parallel):
+        # dp/mp are auto (global-view) axes here — no nested shard_map; the
+        # plain kernel is only safe when those axes are unsized, else use
+        # XLA attention
         if not batch_axes and not head_axes:
             return _fa(q, k, v, causal=is_causal)
+        return None
+    if not batch_axes and not head_axes:
+        # mesh is sized but not along the canonical batch/head axes (pure
+        # fsdp/pp/sep meshes): an empty-manual shard_map would REPLICATE
+        # q/k/v everywhere — let GSPMD partition the XLA path instead
         return None
     bdeg = 1
     for a in batch_axes:
@@ -127,14 +144,22 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     q, k, v = jnp.asarray(query), jnp.asarray(key), jnp.asarray(value)
     use_flash = (
         q.shape[1] >= _FLASH_MIN_SEQ
-        and attn_mask is None
         and dropout_p == 0.0
         and jax.default_backend() == "tpu"
     )
     if use_flash:
-        out = _flash_sharded(q, k, v, is_causal)
-        if out is not None:
-            return out
+        if attn_mask is None:
+            out = _flash_sharded(q, k, v, is_causal)
+            if out is not None:
+                return out
+        else:
+            # masked flash: single-device route only (the in-kernel bias has
+            # no shard_map rule yet); mesh/manual contexts use XLA
+            from ..._mesh_gate import no_mesh_active
+            if no_mesh_active() and not _in_manual_trace():
+                from ...ops.pallas.flash_attention import \
+                    flash_attention as _fa
+                return _fa(q, k, v, causal=is_causal, attn_mask=attn_mask)
     return _xla_attention(q, k, v, attn_mask, dropout_p, is_causal, training=training)
 
 

@@ -1,0 +1,26 @@
+"""ONNX export (parity surface: python/paddle/onnx/export.py).
+
+On TPU the portable artifact is StableHLO, not ONNX: ``paddle_tpu.jit.save``
+exports a serialized multi-platform StableHLO program + weights that any
+PJRT runtime (or MLIR toolchain) consumes — strictly more faithful to the
+compiled program than an ONNX graph re-translation. ``export`` keeps the
+reference's entry-point name and produces that artifact, raising only if a
+literal .onnx file is demanded.
+"""
+
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path: str, input_spec=None, opset_version=None, **kwargs):
+    """Export ``layer`` as a StableHLO artifact at ``path`` (the TPU-native
+    interchange format). See paddle_tpu.jit.save for the file layout."""
+    if path.endswith(".onnx"):
+        raise NotImplementedError(
+            "ONNX graph translation is not provided: the TPU-native "
+            "interchange format is StableHLO (paddle_tpu.jit.save / "
+            "TranslatedLayer.mlir_module). Pass a path without the .onnx "
+            "suffix to export that artifact.")
+    from ..jit.save_load import save
+    return save(layer, path, input_spec=input_spec)

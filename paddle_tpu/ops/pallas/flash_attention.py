@@ -46,12 +46,24 @@ def _interpret() -> bool:
 
 
 def _block_sizes(seq_q, seq_k, head_dim):
-    """Tuned on v5e (bench 2026-07): bq=512, bk=256 ≈ XLA-fused parity before
-    causal DMA elision; elision adds the causal ~2x."""
-    bq = 512
+    """Tuned on v5e (sweep 2026-07): bq=bk=1024 is ~9% faster end-to-end
+    than the round-1 512/256 at seq 2048 (fewer grid steps, larger MXU
+    tiles); 2048-row blocks exceed VMEM. Overridable via
+    FLAGS_flash_block_q / FLAGS_flash_block_k for autotuning sweeps."""
+    from ...core import flags
+
+    def pow2_floor(n):
+        p = 8
+        while p * 2 <= n:
+            p *= 2
+        return p
+
+    # flag values are rounded down to a power of two so the halving loop
+    # always lands on a valid >=8 tile (768 -> 512, never 6)
+    bq = pow2_floor(max(int(flags.get_flag("flash_block_q") or 1024), 8))
     while bq > 8 and seq_q % bq:
         bq //= 2
-    bk = 256
+    bk = pow2_floor(max(int(flags.get_flag("flash_block_k") or 1024), 8))
     while bk > 8 and seq_k % bk:
         bk //= 2
     return min(bq, seq_q), min(bk, seq_k)
@@ -108,7 +120,7 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, nk, off, k_valid, has_seg=False,
             s = jnp.where(qs_ref[0, :, 0][:, None] == ks_ref[0, :, 0][None, :],
                           s, NEG)
         if has_bias:  # additive attn_mask (reference flash attn_mask attr)
-            s = s + bias_ref[0].astype(jnp.float32)
+            s = s + bias_ref[0, 0].astype(jnp.float32)
         m_prev = m_ref[:, 0]  # [bq]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
         # clamp the subtracted max so fully-masked rows (m_cur == NEG, possible
@@ -135,6 +147,10 @@ def _fwd(q, k, v, scale, causal, seg=None, bias=None):
     kh = jnp.moveaxis(k, 2, 1).reshape(b * h, sk, d)
     vh = jnp.moveaxis(v, 2, 1).reshape(b * h, sk, d)
     bq, bk = _block_sizes(sq, sk, d)
+    if bias is not None:
+        # the streamed bias block shares VMEM with the s/p tiles — cap at
+        # the round-1-swept 512 blocks (1024 blocks fit only without bias)
+        bq, bk = min(bq, 512), min(bk, 512)
     # pad seq dims to block multiples
     pq = (-sq) % bq
     pk = (-sk) % bk
@@ -182,9 +198,11 @@ def _fwd(q, k, v, scale, causal, seg=None, bias=None):
         ]
         inputs += [sq_arr, sk_arr]
     if bias is not None:
-        in_specs.append(pl.BlockSpec(
-            (1, bq, bk), lambda b_, i, j: (b_, i, j)))
-        inputs.append(_pad_bias(bias, b * h, sq, sk, pq, pk))
+        biasp = _pad_bias(bias, b, h, sq, sk, pq, pk)
+        in_specs.append(_bias_spec(
+            biasp, h, bq, bk,
+            lambda b_, i, j: (i, kv_index(b_, i, j)[1])))
+        inputs.append(biasp)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, nq, nk),
@@ -211,20 +229,41 @@ def _fwd(q, k, v, scale, causal, seg=None, bias=None):
     return jnp.moveaxis(out, 1, 2), lse
 
 
-def _pad_bias(bias, bh, sq, sk, pq, pk):
-    """Normalize an additive mask to [b*h, SQ, SK] f32; padded key columns
-    get -1e30 so they never join a softmax."""
+def _pad_bias(bias, b, h, sq, sk, pq, pk):
+    """Normalize an additive mask to [B, H, SQ, SK] f32 with B in {1, b}
+    and H in {1, h} — broadcast dims stay size 1 (the BlockSpec index map
+    clamps them), so a shared [sq, sk] mask costs O(S^2), not O(b*h*S^2).
+    Padded key columns get -1e30 so they never join a softmax."""
     bias = jnp.asarray(bias, jnp.float32)
-    if bias.ndim == 4:  # [b, h|1, sq, sk]
-        b = bias.shape[0]
-        h = bh // b
-        bias = jnp.broadcast_to(bias, (b, h, sq, sk)).reshape(bh, sq, sk)
-    elif bias.ndim == 2:  # [sq, sk]
-        bias = jnp.broadcast_to(bias[None], (bh, sq, sk))
+    if bias.ndim == 2:          # [sq, sk]
+        bias = bias[None, None]
+    elif bias.ndim == 3:        # [b, sq, sk] (paddle-style)
+        bias = bias[:, None]
+    elif bias.ndim != 4:        # [b|1, h|1, sq, sk]
+        raise ValueError(f"attn_mask rank {bias.ndim} unsupported: expected "
+                         f"[sq,sk], [b,sq,sk] or [b,h|1,sq,sk]")
+    B, H = bias.shape[:2]
+    if B not in (1, b) or H not in (1, h) or bias.shape[2:] != (sq, sk):
+        raise ValueError(f"attn_mask shape {bias.shape} does not broadcast "
+                         f"to [{b}, {h}, {sq}, {sk}]")
     if pq or pk:
-        bias = jnp.pad(bias, ((0, 0), (0, pq), (0, pk)),
+        bias = jnp.pad(bias, ((0, 0), (0, 0), (0, pq), (0, pk)),
                        constant_values=jnp.float32(-1e30))
     return bias
+
+
+def _bias_spec(bias, h, bq, bk, qj_index):
+    """BlockSpec for the [B, H, SQ, SK] bias under a (b*h, x, y) grid —
+    broadcast dims (B or H == 1) index 0; ``qj_index(b_, x, y) -> (qi, kj)``
+    maps grid coords to (q-block, k-block) indices, letting callers reuse
+    their dead-block clamping (causal DMA elision) for the bias operand."""
+    B, H = bias.shape[:2]
+
+    def im(b_, x, y):
+        qi, kj = qj_index(b_, x, y)
+        return ((b_ // h) if B > 1 else 0, (b_ % h) if H > 1 else 0, qi, kj)
+
+    return pl.BlockSpec((1, 1, bq, bk), im)
 
 
 def _pad_segments(seg, bh, sq, sk, pq, pk):
@@ -293,7 +332,7 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, off, has_seg=False,
             s = jnp.where(qs_ref[0, :, 0][:, None] == ks_ref[0, :, 0][None, :],
                           s, jnp.float32(-1e30))
         if has_bias:
-            s = s + bias_ref[0].astype(jnp.float32)
+            s = s + bias_ref[0, 0].astype(jnp.float32)
         # clamped so fully-masked rows (lse == -1e30 sentinel) give p == 0
         p = jnp.exp(s - jnp.maximum(lse, jnp.float32(-1e25))[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -350,7 +389,7 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, off, has_seg=False,
             s = jnp.where(qs_ref[0, :, 0][:, None] == ks_ref[0, :, 0][None, :],
                           s, jnp.float32(-1e30))
         if has_bias:
-            s = s + bias_ref[0].astype(jnp.float32)
+            s = s + bias_ref[0, 0].astype(jnp.float32)
         # clamped so fully-masked rows (lse == -1e30 sentinel) give p == 0
         p = jnp.exp(s - jnp.maximum(lse, jnp.float32(-1e25))[:, None])
         dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
@@ -367,12 +406,16 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, off, has_seg=False,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _delta(do, out):
+    """delta = rowsum(do * out) in [b, h, sq] — shared by every backward."""
+    d = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    return jnp.moveaxis(d, 2, 1)
+
+
 def _bwd(scale, causal, res, g):
     q, k, v, out, lse = res
-    do = g
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [b,sq,h]
-    delta = jnp.moveaxis(delta, 2, 1)  # [b,h,sq]
-    return flash_block_grads(q, k, v, do, lse, delta, scale=scale, causal=causal)
+    return flash_block_grads(q, k, v, g, lse, _delta(g, out), scale=scale,
+                             causal=causal)
 
 
 def flash_block_grads(q, k, v, do, lse, delta, *, scale, causal, seg=None,
@@ -393,6 +436,8 @@ def flash_block_grads(q, k, v, do, lse, delta, *, scale, causal, seg=None,
     lseh = lse.reshape(b * h, sq, 1)
     deltah = delta.reshape(b * h, sq, 1)
     bq, bk = _block_sizes(sq, sk, d)
+    if bias is not None:
+        bq, bk = min(bq, 512), min(bk, 512)  # see _fwd VMEM note
     off = sk - sq  # bottom-right causal alignment, matching the forward
     # Mirror the forward's padding to block multiples. Padded q rows carry
     # lse=+big so p == 0 there (no pollution of dk/dv); padded k rows are
@@ -415,8 +460,10 @@ def flash_block_grads(q, k, v, do, lse, delta, *, scale, causal, seg=None,
     if seg is not None:
         sq_arr, sk_arr = _pad_segments(seg, b * h, sq, sk, pq_, pk_)
         common_in += [sq_arr, sk_arr]
+    biasp = None
     if bias is not None:
-        common_in.append(_pad_bias(bias, b * h, sq, sk, pq_, pk_))
+        biasp = _pad_bias(bias, b, h, sq, sk, pq_, pk_)
+        common_in.append(biasp)
     if causal:
         def kv_index(b_, i, j):  # dead k blocks re-use the last live index (no DMA)
             last_live = jnp.maximum((i * bq + bq - 1 + off) // bk, 0)
@@ -444,8 +491,9 @@ def flash_block_grads(q, k, v, do, lse, delta, *, scale, causal, seg=None,
             pl.BlockSpec((1, bk, 1), kv_index),
         ]
     if bias is not None:
-        in_specs_q.append(pl.BlockSpec((1, bq, bk),
-                                       lambda b_, i, j: (b_, i, j)))
+        in_specs_q.append(_bias_spec(
+            biasp, h, bq, bk,
+            lambda b_, i, j: (i, kv_index(b_, i, j)[1])))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk, off=off,
@@ -472,9 +520,9 @@ def flash_block_grads(q, k, v, do, lse, delta, *, scale, causal, seg=None,
             pl.BlockSpec((1, bk, 1), lambda b_, j, i: (b_, j, 0)),
         ]
     if bias is not None:
-        in_specs_kv.append(pl.BlockSpec(
-            (1, bq, bk), lambda b_, j, i: (q_index_kv(b_, j, i)[0],
-                                           q_index_kv(b_, j, i)[1], j)))
+        in_specs_kv.append(_bias_spec(
+            biasp, h, bq, bk,
+            lambda b_, j, i: (q_index_kv(b_, j, i)[1], j)))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nq=nq, off=off,
@@ -532,12 +580,11 @@ def _flash_bias_fwd(q, k, v, bias, scale, causal):
 
 def _flash_bias_bwd(scale, causal, res, g):
     q, k, v, bias, out, lse = res
-    delta = jnp.moveaxis(
-        jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1),
-        2, 1)
-    dq, dk, dv = flash_block_grads(q, k, v, g, lse, delta, scale=scale,
-                                   causal=causal, bias=bias)
-    # attn_mask carries no meaningful gradient (reference treats it as data)
+    dq, dk, dv = flash_block_grads(q, k, v, g, lse, _delta(g, out),
+                                   scale=scale, causal=causal, bias=bias)
+    # attn_mask is non-differentiable on the flash path, matching the
+    # reference kernel (flash_attn_bwd emits no dmask); the wrapper also
+    # stop_gradients the mask so this is explicit, not silent
     return dq, dk, dv, jnp.zeros_like(bias)
 
 
@@ -548,14 +595,17 @@ def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
                     attn_mask=None):
     """Differentiable flash attention; layout [batch, seq, heads, head_dim].
     ``attn_mask``: optional additive mask (bool masks converted to 0/-1e30),
-    broadcastable [b, h|1, sq, sk] or [sq, sk] — the reference kernel's
-    attn_mask attr, applied INSIDE the tiled kernel."""
+    broadcastable [sq, sk], [b, sq, sk] or [b, h|1, sq, sk] — the reference
+    kernel's attn_mask attr, applied INSIDE the tiled kernel. Like the
+    reference kernel the mask is NON-differentiable here (stop_gradient
+    applied); learned additive biases (ALiBi/T5) must use the XLA path."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     if attn_mask is not None:
         m = jnp.asarray(attn_mask)
         if m.dtype == jnp.bool_:
             m = jnp.where(m, jnp.float32(0), jnp.float32(-1e30))
+        m = jax.lax.stop_gradient(m)
         return _flash_bias(q, k, v, m, scale, causal)
     return _flash(q, k, v, scale, causal)
 

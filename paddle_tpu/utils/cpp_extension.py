@@ -54,12 +54,17 @@ def load(name, sources, extra_cxx_cflags=None, build_directory=None,
     if (not os.path.exists(so_path)
             or any(os.path.getmtime(s) > os.path.getmtime(so_path)
                    for s in srcs if os.path.exists(s))):
+        # compile to a per-pid temp and atomically rename: N processes may
+        # race on the first build (multiprocess DataLoader workers) and must
+        # never dlopen a partially written .so
+        tmp_so = f"{so_path}.{os.getpid()}.tmp"
         cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
                f"-I{sysconfig.get_paths()['include']}",
-               *(extra_cxx_cflags or []), *srcs, "-o", so_path]
+               *(extra_cxx_cflags or []), *srcs, "-o", tmp_so]
         if verbose:
             print(" ".join(cmd))
         subprocess.run(cmd, check=True)
+        os.replace(tmp_so, so_path)
     return ctypes.CDLL(so_path)
 
 

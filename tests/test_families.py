@@ -223,3 +223,83 @@ def test_group_sharded_offload_places_state_on_host():
                  for v in state[slot].values()
                  if hasattr(v, "sharding")}
         assert "pinned_host" in kinds, kinds
+
+
+# ---------------- geometric / onnx / launch auto-tuner ----------------
+
+def test_geometric_send_u_recv():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    src = np.array([0, 1, 2, 3, 0])
+    dst = np.array([1, 1, 0, 0, 3])
+    out = pt.geometric.send_u_recv(x, src, dst, "sum")
+    want = np.zeros((4, 3), np.float32)
+    for s, d in zip(src, dst):
+        want[d] += x[s]
+    np.testing.assert_allclose(np.asarray(out), want)
+    out_mean = pt.geometric.send_u_recv(x, src, dst, "mean")
+    cnt = np.bincount(dst, minlength=4)[:, None]
+    np.testing.assert_allclose(np.asarray(out_mean),
+                               want / np.maximum(cnt, 1), rtol=1e-6)
+    out_max = pt.geometric.send_u_recv(x, src, dst, "max")
+    assert np.asarray(out_max)[2].sum() == 0  # empty segment zeroed
+
+
+def test_geometric_edge_ops_and_segments():
+    x = np.arange(8, dtype=np.float32).reshape(4, 2)
+    e = np.ones((3, 2), np.float32)
+    src = np.array([0, 1, 2])
+    dst = np.array([1, 2, 3])
+    out = pt.geometric.send_ue_recv(x, e, src, dst, "add", "sum")
+    assert out.shape == (4, 2)
+    uv = pt.geometric.send_uv(x, x, src, dst, "mul")
+    np.testing.assert_allclose(np.asarray(uv), np.asarray(x)[src] * np.asarray(x)[dst])
+    seg = pt.geometric.segment_mean(x, np.array([0, 0, 1, 1]))
+    np.testing.assert_allclose(np.asarray(seg),
+                               [x[:2].mean(0), x[2:].mean(0)], rtol=1e-6)
+
+
+def test_onnx_export_is_stablehlo(tmp_path):
+    from paddle_tpu import nn
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(4, 2))
+    net.eval()
+    pt.onnx.export(net, str(tmp_path / "m"),
+                   input_spec=[pt.jit.InputSpec([2, 4])])
+    loaded = pt.jit.load(str(tmp_path / "m"))
+    x = RNG.standard_normal((2, 4)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(loaded(x)), np.asarray(net(x)),
+                               rtol=1e-5, atol=1e-6)
+    with pytest.raises(NotImplementedError):
+        pt.onnx.export(net, str(tmp_path / "m.onnx"),
+                       input_spec=[pt.jit.InputSpec([2, 4])])
+
+
+def test_launch_auto_tuner_exports_env(tmp_path):
+    import json
+    import subprocess
+    import sys
+    import os as _os
+    spec = {"n_params": 25_000_000, "num_layers": 4, "hidden": 512,
+            "seq_len": 512, "vocab": 32000, "global_batch": 64,
+            "n_devices": 8}
+    cfg = tmp_path / "tune.json"
+    cfg.write_text(json.dumps(spec))
+    script = tmp_path / "w.py"
+    script.write_text(
+        "import os, json\n"
+        "print(json.dumps({k: v for k, v in os.environ.items()\n"
+        "                  if k.startswith('PADDLE_AUTO_')}))\n")
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    code = (f"import sys; sys.path.insert(0, {repo!r});\n"
+            f"from paddle_tpu.distributed.launch.main import launch\n"
+            f"sys.exit(launch(['--nproc_per_node', '1', '--auto_tuner_json',"
+            f" {str(cfg)!r}, {str(script)!r}]))")
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    env = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert "PADDLE_AUTO_DP_DEGREE" in env
+    degs = [int(env[f"PADDLE_AUTO_{a}_DEGREE"])
+            for a in ("DP", "FSDP", "MP", "PP", "SEP")]
+    assert np.prod(degs) == 8
+    assert "[auto_tuner] selected" in proc.stderr
