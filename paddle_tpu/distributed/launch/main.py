@@ -77,7 +77,7 @@ def launch(argv=None):
             spec = _json.load(f)
         hw = HardwareSpec(n_devices=int(spec.pop("n_devices", n)),
                           **{k: spec.pop(k) for k in
-                             ("hbm_bytes", "flops", "ici_bw")
+                             ("hbm_bytes", "flops", "ici_bw", "dcn_bw")
                              if k in spec})
         best = AutoTuner(ModelSpec(**spec), hw).tune()[0]
         print(f"[auto_tuner] selected {best.degrees} "

@@ -30,8 +30,13 @@ def _segment_reduce(data, segment_ids, pool, num_segments):
     fn = _REDUCERS[pool]
     out = fn(data, segment_ids, num_segments)
     if pool in ("max", "min"):
-        # empty segments come back +/-inf; the reference zeros them
-        return jnp.where(jnp.isfinite(out), out, 0)
+        # empty segments come back as the dtype's +/-extreme (inf for
+        # floats, INT_MIN/MAX for ints); the reference zeros them —
+        # detect emptiness by count, which is dtype-agnostic
+        cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), jnp.int32),
+                                  segment_ids, num_segments)
+        nonempty = (cnt > 0)[(...,) + (None,) * (data.ndim - 1)]
+        return jnp.where(nonempty, out, jnp.zeros_like(out))
     return out
 
 
@@ -69,25 +74,39 @@ def send_uv(x, y, src_index, dst_index, message_op: str = "add", name=None):
             "div": jnp.divide}[message_op.lower()](x[src], y[dst])
 
 
-def segment_sum(data, segment_ids, name=None):
-    n = int(jnp.max(jnp.asarray(segment_ids))) + 1
+def _num_segments(segment_ids, num_segments):
+    """num_segments is data-derived in eager mode (the reference's
+    behavior); under jit it must be passed explicitly (static shapes)."""
+    if num_segments is not None:
+        return int(num_segments)
+    try:
+        return int(jnp.max(jnp.asarray(segment_ids))) + 1
+    except jax.errors.ConcretizationTypeError as e:
+        raise ValueError(
+            "segment_* under jit needs an explicit num_segments= (segment "
+            "count is a shape and cannot be data-derived while tracing)"
+        ) from e
+
+
+def segment_sum(data, segment_ids, num_segments=None, name=None):
+    n = _num_segments(segment_ids, num_segments)
     return jax.ops.segment_sum(jnp.asarray(data),
                                jnp.asarray(segment_ids), n)
 
 
-def segment_mean(data, segment_ids, name=None):
-    n = int(jnp.max(jnp.asarray(segment_ids))) + 1
+def segment_mean(data, segment_ids, num_segments=None, name=None):
+    n = _num_segments(segment_ids, num_segments)
     return _segment_reduce(jnp.asarray(data), jnp.asarray(segment_ids),
                            "mean", n)
 
 
-def segment_max(data, segment_ids, name=None):
-    n = int(jnp.max(jnp.asarray(segment_ids))) + 1
+def segment_max(data, segment_ids, num_segments=None, name=None):
+    n = _num_segments(segment_ids, num_segments)
     return _segment_reduce(jnp.asarray(data), jnp.asarray(segment_ids),
                            "max", n)
 
 
-def segment_min(data, segment_ids, name=None):
-    n = int(jnp.max(jnp.asarray(segment_ids))) + 1
+def segment_min(data, segment_ids, num_segments=None, name=None):
+    n = _num_segments(segment_ids, num_segments)
     return _segment_reduce(jnp.asarray(data), jnp.asarray(segment_ids),
                            "min", n)

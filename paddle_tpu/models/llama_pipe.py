@@ -192,6 +192,24 @@ class LlamaForCausalLMPipe(Layer):
             shift_logits.reshape(-1, shift_logits.shape[-1]),
             shift_labels.reshape(-1), ignore_index=ignore_index)
 
+    def to_unstacked_state_dict(self) -> dict:
+        """Inverse of ``from_unstacked``: a state dict loadable by a plain
+        ``LlamaForCausalLM`` (deploy/export after pipelined training)."""
+        out = {}
+        for k, v in self.param_dict().items():
+            if k.startswith("stage__"):
+                path = k[len("stage__"):].replace("__", ".")
+                arr = np.asarray(v)
+                for i in range(self.config.num_hidden_layers):
+                    out[f"model.layers.{i}.{path}"] = arr[i]
+            elif k == "embed_tokens.weight":
+                out["model.embed_tokens.weight"] = v
+            elif k == "norm.weight":
+                out["model.norm.weight"] = v
+            else:
+                out[k] = v
+        return out
+
     @classmethod
     def from_unstacked(cls, model, num_micro: int = 1, vpp: int = 1):
         """Build a pipe model from a LlamaForCausalLM, copying weights

@@ -138,6 +138,26 @@ def _flash_sharded(q, k, v, is_causal):
     return fn(q, k, v)
 
 
+def _normalize_kernel_mask(mask, b, sq, sk):
+    """Broadcast a paddle-style mask to a shape the flash kernel accepts
+    ([b, h|1, sq, sk]); returns None when it cannot (caller uses XLA)."""
+    m = jnp.asarray(mask)
+    if m.ndim == 2:
+        m = m[None, None]
+    elif m.ndim == 3:
+        m = m[:, None]
+    if m.ndim != 4:
+        return None
+    try:
+        tgt = (m.shape[0] if m.shape[0] in (1, b) else None,
+               m.shape[1], sq, sk)
+        if tgt[0] is None:
+            return None
+        return jnp.broadcast_to(m, tgt)
+    except (ValueError, TypeError):
+        return None
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
                                  is_causal=False, training=True, name=None):
     """Inputs [batch, seq, num_heads, head_dim] (paddle convention)."""
@@ -154,12 +174,15 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
                 return out
         else:
             # masked flash: single-device route only (the in-kernel bias has
-            # no shard_map rule yet); mesh/manual contexts use XLA
+            # no shard_map rule yet); mesh/manual contexts and masks the
+            # kernel cannot take (non-broadcastable ranks) use XLA
             from ..._mesh_gate import no_mesh_active
-            if no_mesh_active() and not _in_manual_trace():
+            m = _normalize_kernel_mask(attn_mask, q.shape[0], q.shape[1],
+                                       k.shape[1])
+            if m is not None and no_mesh_active() and not _in_manual_trace():
                 from ...ops.pallas.flash_attention import \
                     flash_attention as _fa
-                return _fa(q, k, v, causal=is_causal, attn_mask=attn_mask)
+                return _fa(q, k, v, causal=is_causal, attn_mask=m)
     return _xla_attention(q, k, v, attn_mask, dropout_p, is_causal, training=training)
 
 

@@ -1,0 +1,59 @@
+"""String tensor ops (parity: phi StringTensor + strings kernels,
+paddle/phi/kernels/strings/ — lower/upper on string tensors, plus the
+tensor-ified byte codec the TPU path actually needs).
+
+TPU-native story: devices compute on numbers, so the framework's string
+support is (a) host-side vectorized string ops over numpy object/str arrays
+(the StringTensor kernel surface), and (b) a bytes<->uint8-tensor codec so
+text rides the input pipeline into device memory (the reference moves
+strings into DenseTensors the same way for data feeding)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["lower", "upper", "to_tensor", "to_strings", "length", "equal"]
+
+
+def _as_str_array(x):
+    return np.asarray(x, dtype=np.str_)
+
+
+def lower(x, use_utf8_encoding: bool = False, name=None):
+    """Elementwise lowercase (parity: strings lower kernel)."""
+    return np.char.lower(_as_str_array(x))
+
+
+def upper(x, use_utf8_encoding: bool = False, name=None):
+    return np.char.upper(_as_str_array(x))
+
+
+def length(x, name=None):
+    return np.char.str_len(_as_str_array(x)).astype(np.int64)
+
+
+def equal(x, y, name=None):
+    return np.char.equal(_as_str_array(x), _as_str_array(y))
+
+
+def to_tensor(strings, max_len: int | None = None, pad: int = 0):
+    """Encode a list/array of strings as a [n, max_len] uint8 tensor of
+    UTF-8 bytes + a length vector (device-feedable)."""
+    arrs = [np.frombuffer(s.encode("utf-8"), np.uint8)
+            for s in np.asarray(strings, dtype=object).ravel()]
+    lens = np.array([len(a) for a in arrs], np.int64)
+    width = max_len or (int(lens.max()) if len(arrs) else 0)
+    out = np.full((len(arrs), width), pad, np.uint8)
+    for i, a in enumerate(arrs):
+        out[i, : min(len(a), width)] = a[:width]
+    return out, np.minimum(lens, width)
+
+
+def to_strings(tensor, lengths=None):
+    """Inverse of to_tensor."""
+    tensor = np.asarray(tensor, np.uint8)
+    out = []
+    for i, row in enumerate(tensor):
+        n = int(lengths[i]) if lengths is not None else len(row)
+        out.append(bytes(row[:n]).decode("utf-8", errors="replace"))
+    return out

@@ -303,3 +303,18 @@ def test_launch_auto_tuner_exports_env(tmp_path):
             for a in ("DP", "FSDP", "MP", "PP", "SEP")]
     assert np.prod(degs) == 8
     assert "[auto_tuner] selected" in proc.stderr
+
+
+def test_strings_family():
+    s = ["Hello", "WORLD", "MiXeD"]
+    np.testing.assert_array_equal(pt.strings.lower(s),
+                                  ["hello", "world", "mixed"])
+    np.testing.assert_array_equal(pt.strings.upper(s),
+                                  ["HELLO", "WORLD", "MIXED"])
+    np.testing.assert_array_equal(pt.strings.length(s), [5, 5, 5])
+    t, lens = pt.strings.to_tensor(["ab", "xyz"])
+    assert t.shape == (2, 3) and t.dtype == np.uint8
+    assert pt.strings.to_strings(t, lens) == ["ab", "xyz"]
+    # unicode roundtrip
+    t2, l2 = pt.strings.to_tensor(["héllo", "日本"])
+    assert pt.strings.to_strings(t2, l2) == ["héllo", "日本"]

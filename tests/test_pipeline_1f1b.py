@@ -198,3 +198,19 @@ def test_pipeline_train_step_converges():
         ids = np.random.default_rng(3).integers(0, 64, (8, 16))
         losses = [float(step(ids, ids)) for _ in range(8)]
     assert losses[-1] < losses[0] - 0.5, losses
+
+
+def test_pipe_to_unstacked_roundtrip():
+    """Weights trained in the pipe layout must load into the plain model
+    and produce identical logits (deploy path after PP training)."""
+    cfg, ref, ids, rl, rg = _llama_pair(None)
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("dp", "pp"))
+    with mesh_lib.use_mesh(mesh):
+        pipe = LlamaForCausalLMPipe.from_unstacked(ref, num_micro=2)
+        back = pipe.to_unstacked_state_dict()
+    fresh = LlamaForCausalLM(cfg)
+    fresh.set_state_dict(back)
+    fresh.eval()
+    ref.eval()
+    np.testing.assert_allclose(np.asarray(fresh(ids)), np.asarray(ref(ids)),
+                               rtol=1e-5, atol=1e-6)

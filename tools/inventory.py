@@ -1,0 +1,51 @@
+"""Print the framework's component/op inventory (parity audit aid:
+enumerates the registered op surface and the public module families so
+coverage against SURVEY.md §2 is checkable mechanically).
+
+Usage: python tools/inventory.py [--ops]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as pt
+    from paddle_tpu.core.registry import all_ops
+
+    ops = all_ops()
+    covered = {n: i for n, i in ops.items()
+               if i.ref is not None or i.extra.get("check")}
+    print(f"registered ops: {len(ops)}  under contract: {len(covered)}")
+    print("by category:", dict(Counter(i.category for i in ops.values())))
+
+    families = [
+        "nn", "optimizer", "autograd", "amp", "io", "jit", "hapi", "metric",
+        "vision", "audio", "text", "sparse", "quantization", "distribution",
+        "fft", "signal", "geometric", "strings", "device", "profiler",
+        "inference", "incubate", "distributed", "utils", "onnx", "models",
+    ]
+    print("\nAPI families:")
+    for fam in families:
+        mod = getattr(pt, fam, None)
+        n = len([a for a in dir(mod) if not a.startswith("_")]) if mod else 0
+        print(f"  paddle_tpu.{fam:<14} {'OK' if mod else 'MISSING':<8} "
+              f"({n} public names)")
+
+    if "--ops" in sys.argv:
+        print("\nops:")
+        for name in sorted(ops):
+            i = ops[name]
+            mark = "C" if name in covered else "-"
+            print(f"  [{mark}] {i.category:<12} {name}")
+
+
+if __name__ == "__main__":
+    main()
