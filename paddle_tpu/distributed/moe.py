@@ -30,7 +30,42 @@ from ..nn import initializer as I
 from ..nn.module import Layer, Parameter
 
 __all__ = ["MoELayer", "TopKGate", "SwitchGate", "GShardGate", "ExpertFFN",
-           "moe_dispatch_combine"]
+           "moe_dispatch_combine", "global_scatter", "global_gather"]
+
+
+def global_scatter(x, local_count, global_count, axis: str = "mp"):
+    """Explicit expert-parallel token dispatch (parity:
+    distributed/utils/moe_utils.py:20 ``global_scatter`` over the
+    global_scatter_op all-to-all).
+
+    Call INSIDE a shard_map manual over ``axis`` (the EP group). Each rank
+    holds ``x`` = its local tokens grouped by destination expert in
+    capacity-padded expert-major layout [E, C, d] (E = total experts =
+    P * experts_per_rank). The all-to-all reshapes so every rank receives
+    the slots bound for ITS experts from every peer:
+    [E, C, d] -> [P, E/P, C, d] -all_to_all-> [P, E/P, C, d]
+    = per-source-rank slots for my local experts.
+    Returns [E/P_local_experts, P*C, d] — each local expert's inbox.
+    """
+    from jax import lax
+    E, C, d = x.shape
+    P = lax.psum(1, axis)
+    xr = x.reshape(P, E // P, C, d)
+    recv = lax.all_to_all(xr, axis, split_axis=0, concat_axis=0, tiled=False)
+    # recv: [P(source), E/P(my experts), C, d] -> inbox per local expert
+    return jnp.moveaxis(recv, 0, 1).reshape(E // P, P * C, d)
+
+
+def global_gather(y, local_count, global_count, axis: str = "mp"):
+    """Inverse of global_scatter (parity: moe_utils.py:153): expert outputs
+    [E/P, P*C, d] return to their source ranks as [E, C, d]."""
+    from jax import lax
+    Elocal, PC, d = y.shape
+    P = lax.psum(1, axis)
+    C = PC // P
+    yr = jnp.moveaxis(y.reshape(Elocal, P, C, d), 1, 0)  # [P, E/P, C, d]
+    back = lax.all_to_all(yr, axis, split_axis=0, concat_axis=0, tiled=False)
+    return back.reshape(P * Elocal, C, d)
 
 
 def _top2_gating(logits, capacity, *, second_policy="random", key=None,

@@ -40,6 +40,13 @@ def _xla_attention(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
         scores = jnp.where(causal, scores, -jnp.inf)
     if attn_mask is not None:
         m = jnp.asarray(attn_mask)
+        # paddle-style rank normalization, SAME convention as the flash
+        # kernel (_pad_bias): [sq,sk] -> [1,1,sq,sk]; [b,sq,sk] ->
+        # [b,1,sq,sk] (per-batch, NOT per-head)
+        if m.ndim == 2:
+            m = m[None, None]
+        elif m.ndim == 3:
+            m = m[:, None]
         if m.dtype == jnp.bool_:
             scores = jnp.where(m, scores, -jnp.inf)
         else:
@@ -138,9 +145,10 @@ def _flash_sharded(q, k, v, is_causal):
     return fn(q, k, v)
 
 
-def _normalize_kernel_mask(mask, b, sq, sk):
+def _normalize_kernel_mask(mask, b, h, sq, sk):
     """Broadcast a paddle-style mask to a shape the flash kernel accepts
-    ([b, h|1, sq, sk]); returns None when it cannot (caller uses XLA)."""
+    ([b|1, h|1, sq, sk]); returns None when it cannot (caller uses XLA).
+    The rank convention matches _xla_attention: rank-3 masks are per-BATCH."""
     m = jnp.asarray(mask)
     if m.ndim == 2:
         m = m[None, None]
@@ -148,12 +156,10 @@ def _normalize_kernel_mask(mask, b, sq, sk):
         m = m[:, None]
     if m.ndim != 4:
         return None
+    if m.shape[0] not in (1, b) or m.shape[1] not in (1, h):
+        return None
     try:
-        tgt = (m.shape[0] if m.shape[0] in (1, b) else None,
-               m.shape[1], sq, sk)
-        if tgt[0] is None:
-            return None
-        return jnp.broadcast_to(m, tgt)
+        return jnp.broadcast_to(m, (m.shape[0], m.shape[1], sq, sk))
     except (ValueError, TypeError):
         return None
 
@@ -175,14 +181,16 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
         else:
             # masked flash: single-device route only (the in-kernel bias has
             # no shard_map rule yet); mesh/manual contexts and masks the
-            # kernel cannot take (non-broadcastable ranks) use XLA
+            # kernel cannot take (non-broadcastable shapes) use XLA. Cheap
+            # context checks run BEFORE the (materializing) normalization.
             from ..._mesh_gate import no_mesh_active
-            m = _normalize_kernel_mask(attn_mask, q.shape[0], q.shape[1],
-                                       k.shape[1])
-            if m is not None and no_mesh_active() and not _in_manual_trace():
-                from ...ops.pallas.flash_attention import \
-                    flash_attention as _fa
-                return _fa(q, k, v, causal=is_causal, attn_mask=m)
+            if no_mesh_active() and not _in_manual_trace():
+                m = _normalize_kernel_mask(attn_mask, q.shape[0], q.shape[2],
+                                           q.shape[1], k.shape[1])
+                if m is not None:
+                    from ...ops.pallas.flash_attention import \
+                        flash_attention as _fa
+                    return _fa(q, k, v, causal=is_causal, attn_mask=m)
     return _xla_attention(q, k, v, attn_mask, dropout_p, is_causal, training=training)
 
 

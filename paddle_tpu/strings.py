@@ -49,11 +49,16 @@ def to_tensor(strings, max_len: int | None = None, pad: int = 0):
     return out, np.minimum(lens, width)
 
 
-def to_strings(tensor, lengths=None):
-    """Inverse of to_tensor."""
+def to_strings(tensor, lengths=None, pad: int = 0):
+    """Inverse of to_tensor. Without ``lengths``, trailing ``pad`` bytes are
+    stripped (so dropping the length vector still roundtrips; strings whose
+    real content ends in the pad byte need explicit lengths)."""
     tensor = np.asarray(tensor, np.uint8)
     out = []
     for i, row in enumerate(tensor):
-        n = int(lengths[i]) if lengths is not None else len(row)
-        out.append(bytes(row[:n]).decode("utf-8", errors="replace"))
+        if lengths is not None:
+            data = bytes(row[: int(lengths[i])])
+        else:
+            data = bytes(row).rstrip(bytes([pad]))
+        out.append(data.decode("utf-8", errors="replace"))
     return out
