@@ -10,7 +10,7 @@ from .clip import (  # noqa: F401
     ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue, clip_grad_norm_,
     clip_grad_value_,
 )
-from .module import Layer, Module, Parameter, functional_call  # noqa: F401
+from .module import Layer, Module, Parameter, functional_call, to_static_state  # noqa: F401
 from .layer.activation import *  # noqa: F401,F403
 from .layer.common import *  # noqa: F401,F403
 from .layer.container import *  # noqa: F401,F403
@@ -18,4 +18,8 @@ from .layer.conv import *  # noqa: F401,F403
 from .layer.loss import *  # noqa: F401,F403
 from .layer.norm import *  # noqa: F401,F403
 from .layer.pooling import *  # noqa: F401,F403
+from .layer.rnn import (  # noqa: F401
+    RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN,
+    SimpleRNN, LSTM, GRU,
+)
 from .layer.transformer import *  # noqa: F401,F403

@@ -10,3 +10,4 @@ from .conv import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
+from ..layer.rnn import birnn, rnn  # noqa: F401  (functional recurrence entry points)

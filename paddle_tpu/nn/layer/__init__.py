@@ -1,1 +1,1 @@
-from . import activation, common, container, conv, loss, norm, pooling, transformer  # noqa: F401
+from . import activation, common, container, conv, loss, norm, pooling, rnn, transformer  # noqa: F401

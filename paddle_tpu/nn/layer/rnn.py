@@ -1,0 +1,509 @@
+"""Recurrent networks: SimpleRNN/LSTM/GRU cells, RNN/BiRNN wrappers, stacked
+multi-layer bidirectional RNNBase.
+
+Parity target: python/paddle/nn/layer/rnn.py — SimpleRNNCell (:697),
+LSTMCell (:874), GRUCell (:1070), RNN (:1263), BiRNN (:1336),
+RNNBase (:1420), SimpleRNN (:1718), LSTM (:1840), GRU (:1966), functional
+``rnn`` (:44) / ``birnn`` (:356), state utilities split/concat_states
+(:456/:509).
+
+TPU-native design: the reference unrolls a Python while-loop per timestep in
+dygraph and emits a cuDNN fused kernel when it can. Here the single recurrence
+primitive is :func:`jax.lax.scan` over the cell's pure step function — one
+traced step compiled once, O(1) compile cost in sequence length, differentiable
+(scan has a native VJP), remat-compatible, and the per-step matmuls
+``x @ W_ih^T`` / ``h @ W_hh^T`` land on the MXU. The input-to-hidden projection
+for the whole sequence is hoisted OUT of the scan as one large batched matmul
+``[T*B, in] @ [in, G*H]`` (MXU-friendly), so the scan body only carries the
+small ``[B,H] @ [H,G*H]`` recurrent matmul — the part that is genuinely serial.
+Variable-length sequences use a mask that freezes states and zeroes outputs
+past each row's length, exactly reproducing the reference's ``_maybe_copy``
+semantics (rnn.py:143) without dynamic shapes.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .. import functional as F
+from ..initializer import Uniform
+from ..module import Layer, Parameter
+from .container import LayerList
+
+__all__ = [
+    "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell",
+    "RNN", "BiRNN", "SimpleRNN", "LSTM", "GRU",
+    "rnn", "birnn", "split_states", "concat_states",
+]
+
+
+# ---------------------------------------------------------------------------
+# state utilities (parity: rnn.py:456/:509)
+# ---------------------------------------------------------------------------
+
+def split_states(states, bidirectional=False, state_components=1):
+    """Split stacked states [L*D, B, H] (per component) into per-layer chunks.
+
+    Returns a list over layers; each element is the state structure the
+    corresponding RNN/BiRNN layer expects (parity: rnn.py:456).
+    """
+    def unstack(x):
+        if isinstance(x, (list, tuple)):
+            return list(x)
+        return [x[i] for i in range(x.shape[0])]
+
+    if state_components == 1:
+        flat = unstack(states)
+        if not bidirectional:
+            return flat
+        return list(zip(flat[::2], flat[1::2]))
+    # states: tuple of `state_components` tensors, each [L*D, B, H]
+    per_entry = list(zip(*(unstack(c) for c in states)))  # L*D entries of (h, c)
+    if not bidirectional:
+        return per_entry
+    return list(zip(per_entry[::2], per_entry[1::2]))
+
+
+def concat_states(states, bidirectional=False, state_components=1):
+    """Inverse of :func:`split_states` (parity: rnn.py:509)."""
+    if state_components == 1:
+        flat = []
+        for s in states:
+            if bidirectional:
+                flat.extend(s)
+            else:
+                flat.append(s)
+        return jnp.stack(flat)
+    # per-layer entries are tuples of components (possibly pairs of tuples when
+    # bidirectional: ((h_fw, c_fw), (h_bw, c_bw)))
+    comps = [[] for _ in range(state_components)]
+    for s in states:
+        directions = s if bidirectional else (s,)
+        for d in directions:
+            for j, c in enumerate(d):
+                comps[j].append(c)
+    return tuple(jnp.stack(c) for c in comps)
+
+
+# ---------------------------------------------------------------------------
+# masking helpers for variable-length sequences
+# ---------------------------------------------------------------------------
+
+def _reverse_sequence(x, lengths):
+    """Reverse the first `lengths[b]` steps of each row of time-major x.
+
+    x: [T, B, ...]; lengths: [B]. Padding positions stay in place, matching
+    the reference's reverse-with-sequence-length semantics so a backward RNN
+    reads each sequence from its last *valid* step.
+    """
+    T = x.shape[0]
+    t = jnp.arange(T)[:, None]                       # [T, 1]
+    lengths = jnp.asarray(lengths)[None, :]          # [1, B]
+    idx = jnp.where(t < lengths, lengths - 1 - t, t)  # [T, B]
+    return jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)).astype(jnp.int32), axis=0
+    )
+
+
+# ---------------------------------------------------------------------------
+# functional recurrence (parity: rnn.py:44 `rnn`, :356 `birnn`)
+# ---------------------------------------------------------------------------
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """Run `cell` over `inputs` with lax.scan (parity: rnn.py:44).
+
+    Returns (outputs, final_states); outputs past a row's valid length are
+    zero and its states freeze at the last valid step.
+    """
+    if not time_major:
+        inputs = jnp.swapaxes(inputs, 0, 1)          # -> [T, B, I]
+    T, B = inputs.shape[0], inputs.shape[1]
+    if initial_states is None:
+        initial_states = cell.get_initial_states(B, dtype=inputs.dtype)
+
+    if is_reverse:
+        inputs = (_reverse_sequence(inputs, sequence_length)
+                  if sequence_length is not None else jnp.flip(inputs, axis=0))
+
+    if sequence_length is not None:
+        step_mask = (jnp.arange(T)[:, None]
+                     < jnp.asarray(sequence_length)[None, :]).astype(inputs.dtype)
+    else:
+        step_mask = None
+
+    # Hoist the input projection out of the scan when the cell supports it:
+    # one [T*B, in] @ [in, G*H] MXU matmul instead of T small ones. Only taken
+    # when forward() is the stock mixin implementation — a subclass that
+    # overrides forward() must go through its own step.
+    precomputed = None
+    if (not kwargs and isinstance(cell, _GatedCellMixin)
+            and type(cell).forward is _GatedCellMixin.forward):
+        precomputed = cell._precompute_inputs(inputs)
+
+    def step(state, xs):
+        if step_mask is None:
+            x_t = xs
+            m_t = None
+        else:
+            x_t, m_t = xs
+        if precomputed is not None:
+            out, new_state = cell._step_precomputed(x_t, state)
+        else:
+            out, new_state = cell.forward(x_t, state, **kwargs)
+        if m_t is not None:
+            m = m_t[:, None]
+            new_state = jax.tree_util.tree_map(
+                lambda ns, s: ns * m + s * (1.0 - m), new_state, state)
+            out = out * m
+        return new_state, out
+
+    seq = precomputed if precomputed is not None else inputs
+    xs = seq if step_mask is None else (seq, step_mask)
+    final_states, outputs = jax.lax.scan(step, initial_states, xs)
+
+    if is_reverse:
+        outputs = (_reverse_sequence(outputs, sequence_length)
+                   if sequence_length is not None else jnp.flip(outputs, axis=0))
+    if not time_major:
+        outputs = jnp.swapaxes(outputs, 0, 1)
+    return outputs, final_states
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None, sequence_length=None,
+          time_major=False, **kwargs):
+    """Bidirectional recurrence; concat outputs on the last axis (rnn.py:356)."""
+    if initial_states is None:
+        states_fw, states_bw = None, None
+    else:
+        states_fw, states_bw = initial_states
+    out_fw, st_fw = rnn(cell_fw, inputs, states_fw, sequence_length,
+                        time_major, False, **kwargs)
+    out_bw, st_bw = rnn(cell_bw, inputs, states_bw, sequence_length,
+                        time_major, True, **kwargs)
+    outputs = jnp.concatenate([out_fw, out_bw], axis=-1)
+    return outputs, (st_fw, st_bw)
+
+
+# ---------------------------------------------------------------------------
+# cells
+# ---------------------------------------------------------------------------
+
+class RNNCellBase(Layer):
+    """Base for recurrence cells (parity: rnn.py:551)."""
+
+    def get_initial_states(self, batch_size, dtype="float32", init_value=0.0):
+        def make(shape):
+            return jnp.full((batch_size,) + tuple(shape), init_value,
+                            dtype=jnp.dtype(dtype))
+        shapes = self.state_shape
+        if isinstance(shapes, tuple) and shapes and isinstance(shapes[0], tuple):
+            return tuple(make(s) for s in shapes)
+        return make(shapes)
+
+    @property
+    def state_shape(self):
+        raise NotImplementedError(
+            "Please add implementation for `state_shape` in the used cell.")
+
+
+def _uniform_rnn_init(hidden_size):
+    std = 1.0 / math.sqrt(hidden_size)
+    return Uniform(-std, std)
+
+
+class _GatedCellMixin:
+    """Shared weight layout: weight_ih [G*H, in], weight_hh [G*H, H]."""
+
+    def _init_params(self, input_size, hidden_size, num_gates,
+                     weight_ih_attr=None, weight_hh_attr=None,
+                     bias_ih_attr=None, bias_hh_attr=None):
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        init = _uniform_rnn_init(hidden_size)
+        w_ih = (weight_ih_attr if callable(weight_ih_attr) else init)
+        w_hh = (weight_hh_attr if callable(weight_hh_attr) else init)
+        self.weight_ih = Parameter(w_ih((num_gates * hidden_size, input_size),
+                                        self._dtype))
+        self.weight_hh = Parameter(w_hh((num_gates * hidden_size, hidden_size),
+                                        self._dtype))
+        if bias_ih_attr is False:
+            self.bias_ih = None
+        else:
+            b_ih = bias_ih_attr if callable(bias_ih_attr) else init
+            self.bias_ih = Parameter(b_ih((num_gates * hidden_size,), self._dtype))
+        if bias_hh_attr is False:
+            self.bias_hh = None
+        else:
+            b_hh = bias_hh_attr if callable(bias_hh_attr) else init
+            self.bias_hh = Parameter(b_hh((num_gates * hidden_size,), self._dtype))
+
+    def _precompute_inputs(self, inputs):
+        """[T, B, in] -> [T, B, G*H]: the whole-sequence input projection."""
+        x = inputs @ jnp.swapaxes(self.weight_ih, -1, -2)
+        if self.bias_ih is not None:
+            x = x + self.bias_ih
+        return x
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs.shape[0], inputs.dtype)
+        return self._step_precomputed(self._precompute_inputs(inputs), states)
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+class SimpleRNNCell(_GatedCellMixin, RNNCellBase):
+    """Elman cell: h = act(W_ih x + b_ih + W_hh h + b_hh) (rnn.py:697)."""
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if activation not in ("tanh", "relu"):
+            raise ValueError(
+                f"activation for SimpleRNNCell should be tanh or relu, but got {activation}")
+        self.activation = activation
+        self._act = jnp.tanh if activation == "tanh" else jax.nn.relu
+        self._init_params(input_size, hidden_size, 1, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def _step_precomputed(self, x_proj, pre_h):
+        pre = x_proj + pre_h @ jnp.swapaxes(self.weight_hh, -1, -2)
+        if self.bias_hh is not None:
+            pre = pre + self.bias_hh
+        h = self._act(pre)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(_GatedCellMixin, RNNCellBase):
+    """LSTM cell, gate order i,f,g,o (rnn.py:874, forward at :1035)."""
+
+    def __init__(self, input_size, hidden_size,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None,
+                 proj_size=None):
+        super().__init__()
+        if proj_size is not None:
+            raise NotImplementedError(
+                "projected LSTM (proj_size) is not implemented")
+        self._init_params(input_size, hidden_size, 4, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def _step_precomputed(self, x_proj, states):
+        pre_h, pre_c = states
+        gates = x_proj + pre_h @ jnp.swapaxes(self.weight_hh, -1, -2)
+        if self.bias_hh is not None:
+            gates = gates + self.bias_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        o = jax.nn.sigmoid(o)
+        c = f * pre_c + i * jnp.tanh(g)
+        h = o * jnp.tanh(c)
+        return h, (h, c)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(_GatedCellMixin, RNNCellBase):
+    """GRU cell, gate order r,z,c; h = z*h_prev + (1-z)*c (rnn.py:1070).
+
+    Note the paddle convention: the update gate keeps the OLD state (torch
+    keeps the candidate); the reset gate applies AFTER the hidden matmul.
+    """
+
+    def __init__(self, input_size, hidden_size,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self._init_params(input_size, hidden_size, 3, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def _step_precomputed(self, x_proj, pre_h):
+        h_gates = pre_h @ jnp.swapaxes(self.weight_hh, -1, -2)
+        if self.bias_hh is not None:
+            h_gates = h_gates + self.bias_hh
+        x_r, x_z, x_c = jnp.split(x_proj, 3, axis=-1)
+        h_r, h_z, h_c = jnp.split(h_gates, 3, axis=-1)
+        r = jax.nn.sigmoid(x_r + h_r)
+        z = jax.nn.sigmoid(x_z + h_z)
+        c = jnp.tanh(x_c + r * h_c)
+        h = z * pre_h + (1.0 - z) * c
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+# ---------------------------------------------------------------------------
+# wrappers
+# ---------------------------------------------------------------------------
+
+class RNN(Layer):
+    """Wrap a cell into a sequence-level recurrence (parity: rnn.py:1263)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        return rnn(self.cell, inputs, initial_states, sequence_length,
+                   self.time_major, self.is_reverse, **kwargs)
+
+
+class BiRNN(Layer):
+    """Forward + backward cells, outputs concatenated (parity: rnn.py:1336)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        if cell_fw.input_size != cell_bw.input_size:
+            raise ValueError(
+                f"input size of forward cell({cell_fw.input_size}) does not "
+                f"equal that of backward cell({cell_bw.input_size})")
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        if isinstance(initial_states, (list, tuple)):
+            if len(initial_states) != 2:
+                raise ValueError(
+                    "length of initial_states should be 2 when it is a list/tuple")
+        return birnn(self.cell_fw, self.cell_bw, inputs, initial_states,
+                     sequence_length, self.time_major, **kwargs)
+
+
+class RNNBase(LayerList):
+    """Stacked (optionally bidirectional) recurrence (parity: rnn.py:1420)."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, activation="tanh"):
+        super().__init__()
+        bidirectional = direction in ("bidirectional", "bidirect")
+        if not bidirectional and direction != "forward":
+            raise ValueError(
+                "direction should be forward or bidirect (or bidirectional), "
+                f"received direction = {direction}")
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.dropout = dropout
+        self.num_directions = 2 if bidirectional else 1
+        self.time_major = time_major
+        self.num_layers = num_layers
+        self.state_components = 2 if mode == "LSTM" else 1
+
+        kwargs = {
+            "weight_ih_attr": weight_ih_attr, "weight_hh_attr": weight_hh_attr,
+            "bias_ih_attr": bias_ih_attr, "bias_hh_attr": bias_hh_attr,
+        }
+        if mode == "LSTM":
+            cell_cls = LSTMCell
+        elif mode == "GRU":
+            cell_cls = GRUCell
+        else:
+            cell_cls = SimpleRNNCell
+            kwargs["activation"] = activation
+
+        for i in range(num_layers):
+            layer_in = input_size if i == 0 else hidden_size * self.num_directions
+            if bidirectional:
+                self.append(BiRNN(cell_cls(layer_in, hidden_size, **kwargs),
+                                  cell_cls(layer_in, hidden_size, **kwargs),
+                                  time_major))
+            else:
+                self.append(RNN(cell_cls(layer_in, hidden_size, **kwargs),
+                                False, time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        batch_index = 1 if self.time_major else 0
+        B = inputs.shape[batch_index]
+        if initial_states is None:
+            shape = (self.num_layers * self.num_directions, B, self.hidden_size)
+            initial_states = tuple(jnp.zeros(shape, inputs.dtype)
+                                   for _ in range(self.state_components))
+            if self.state_components == 1:
+                initial_states = initial_states[0]
+        states = split_states(initial_states, self.num_directions == 2,
+                              self.state_components)
+        final_states = []
+        outputs = inputs
+        for i, rnn_layer in enumerate(self):
+            if i > 0:
+                outputs = F.dropout(outputs, p=self.dropout,
+                                    training=self.training,
+                                    mode="upscale_in_train")
+            outputs, final_state = rnn_layer(outputs, states[i], sequence_length)
+            final_states.append(final_state)
+        final_states = concat_states(final_states, self.num_directions == 2,
+                                     self.state_components)
+        return outputs, final_states
+
+    def extra_repr(self):
+        s = f"{self.input_size}, {self.hidden_size}"
+        if self.num_layers != 1:
+            s += f", num_layers={self.num_layers}"
+        if self.time_major:
+            s += f", time_major={self.time_major}"
+        if self.dropout:
+            s += f", dropout={self.dropout}"
+        return s
+
+
+class SimpleRNN(RNNBase):
+    """Multi-layer Elman RNN (parity: rnn.py:1718)."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        if activation not in ("tanh", "relu"):
+            raise ValueError(f"Unknown activation '{activation}'")
+        super().__init__("RNN_" + activation.upper(), input_size, hidden_size,
+                         num_layers, direction, time_major, dropout,
+                         weight_ih_attr, weight_hh_attr, bias_ih_attr,
+                         bias_hh_attr, activation=activation)
+
+
+class LSTM(RNNBase):
+    """Multi-layer LSTM (parity: rnn.py:1840)."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None,
+                 proj_size=None):
+        if proj_size is not None:
+            raise NotImplementedError(
+                "projected LSTM (proj_size) is not implemented")
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+
+class GRU(RNNBase):
+    """Multi-layer GRU (parity: rnn.py:1966)."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)

@@ -1,0 +1,307 @@
+"""RNN family tests: cell formulas vs numpy, stacked/bidirectional layers vs a
+hand-rolled step loop, sequence-length masking, grads, and an e2e LSTM+CTC
+step (pairing the new encoder with the already-shipped CTCLoss).
+
+Mirrors the reference test strategy for test/rnn/test_rnn_nets.py (numpy cell
+oracles + layer-vs-naive parity)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn.layer.rnn import concat_states, split_states
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_params(cell):
+    return (np.asarray(cell.weight_ih), np.asarray(cell.weight_hh),
+            np.asarray(cell.bias_ih), np.asarray(cell.bias_hh))
+
+
+def np_simple_rnn_step(cell, x, h, act=np.tanh):
+    wi, wh, bi, bh = _np_params(cell)
+    return act(x @ wi.T + bi + h @ wh.T + bh)
+
+
+def np_lstm_step(cell, x, h, c):
+    wi, wh, bi, bh = _np_params(cell)
+    gates = x @ wi.T + bi + h @ wh.T + bh
+    i, f, g, o = np.split(gates, 4, axis=-1)
+    c_new = _sigmoid(f) * c + _sigmoid(i) * np.tanh(g)
+    h_new = _sigmoid(o) * np.tanh(c_new)
+    return h_new, c_new
+
+
+def np_gru_step(cell, x, h):
+    wi, wh, bi, bh = _np_params(cell)
+    xg = x @ wi.T + bi
+    hg = h @ wh.T + bh
+    x_r, x_z, x_c = np.split(xg, 3, axis=-1)
+    h_r, h_z, h_c = np.split(hg, 3, axis=-1)
+    r = _sigmoid(x_r + h_r)
+    z = _sigmoid(x_z + h_z)
+    c = np.tanh(x_c + r * h_c)
+    return z * h + (1 - z) * c
+
+
+# ---------------------------------------------------------------------------
+# cell formula oracles
+# ---------------------------------------------------------------------------
+
+def test_simple_rnn_cell_formula():
+    paddle.seed(0)
+    cell = nn.SimpleRNNCell(16, 32)
+    x = np.random.RandomState(1).randn(4, 16).astype("float32")
+    h = np.random.RandomState(2).randn(4, 32).astype("float32")
+    y, h_new = cell(jnp.asarray(x), jnp.asarray(h))
+    ref = np_simple_rnn_step(cell, x, h)
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-5, atol=1e-5)
+    assert y is h_new  # output IS the new state
+
+
+def test_simple_rnn_cell_relu_and_validation():
+    cell = nn.SimpleRNNCell(8, 8, activation="relu")
+    x = np.random.RandomState(0).randn(2, 8).astype("float32")
+    y, _ = cell(jnp.asarray(x))  # default zero state
+    ref = np_simple_rnn_step(cell, x, np.zeros((2, 8), "float32"),
+                             act=lambda v: np.maximum(v, 0))
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-5, atol=1e-5)
+    with pytest.raises(ValueError):
+        nn.SimpleRNNCell(8, 8, activation="sigmoid")
+
+
+def test_lstm_cell_formula():
+    paddle.seed(0)
+    cell = nn.LSTMCell(16, 32)
+    rs = np.random.RandomState(3)
+    x, h, c = (rs.randn(4, 16).astype("float32"),
+               rs.randn(4, 32).astype("float32"),
+               rs.randn(4, 32).astype("float32"))
+    y, (h_new, c_new) = cell(jnp.asarray(x), (jnp.asarray(h), jnp.asarray(c)))
+    rh, rc = np_lstm_step(cell, x, h, c)
+    np.testing.assert_allclose(np.asarray(h_new), rh, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(c_new), rc, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y), rh, rtol=1e-5, atol=1e-5)
+
+
+def test_gru_cell_formula():
+    paddle.seed(0)
+    cell = nn.GRUCell(16, 32)
+    rs = np.random.RandomState(4)
+    x, h = rs.randn(4, 16).astype("float32"), rs.randn(4, 32).astype("float32")
+    y, h_new = cell(jnp.asarray(x), jnp.asarray(h))
+    ref = np_gru_step(cell, x, h)
+    np.testing.assert_allclose(np.asarray(h_new), ref, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# sequence-level recurrence vs hand-rolled loop
+# ---------------------------------------------------------------------------
+
+def _naive_rnn(cell, step, x, h0, reverse=False, lengths=None):
+    """Hand-rolled per-timestep numpy loop with mask-freeze semantics."""
+    B, T = x.shape[0], x.shape[1]
+    h = h0
+    outs = np.zeros((B, T, cell.hidden_size), "float32")
+    ts = range(T - 1, -1, -1) if reverse else range(T)
+    for b in range(B):
+        L = T if lengths is None else int(lengths[b])
+        hb = tuple(s[b:b + 1] for s in h) if isinstance(h, tuple) else h[b:b + 1]
+        steps = (range(L - 1, -1, -1) if reverse else range(L))
+        for t in steps:
+            res = step(cell, x[b:b + 1, t], *(hb if isinstance(hb, tuple) else (hb,)))
+            hb = res if isinstance(res, tuple) else res
+            outs[b, t] = (hb[0] if isinstance(hb, tuple) else hb)[0]
+        if isinstance(h, tuple):
+            for comp, val in zip(h, hb):
+                comp[b] = val[0]
+        else:
+            h[b] = hb[0]
+    return outs, h
+
+
+def test_rnn_layer_matches_naive_loop():
+    paddle.seed(1)
+    cell = nn.SimpleRNNCell(8, 12)
+    layer = nn.RNN(cell)
+    x = np.random.RandomState(5).randn(3, 7, 8).astype("float32")
+    h0 = np.random.RandomState(6).randn(3, 12).astype("float32")
+    out, hT = layer(jnp.asarray(x), jnp.asarray(h0))
+    ref_out, ref_h = _naive_rnn(cell, np_simple_rnn_step, x, h0.copy())
+    np.testing.assert_allclose(np.asarray(out), ref_out, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(hT), ref_h, rtol=1e-4, atol=1e-4)
+
+
+def test_rnn_layer_sequence_length_masks_and_freezes():
+    paddle.seed(1)
+    cell = nn.GRUCell(8, 12)
+    layer = nn.RNN(cell)
+    x = np.random.RandomState(7).randn(3, 7, 8).astype("float32")
+    lengths = np.array([7, 4, 1], dtype=np.int32)
+    out, hT = layer(jnp.asarray(x), None, sequence_length=jnp.asarray(lengths))
+    ref_out, ref_h = _naive_rnn(cell, lambda c, xi, hi: np_gru_step(c, xi, hi),
+                                x, np.zeros((3, 12), "float32"), lengths=lengths)
+    np.testing.assert_allclose(np.asarray(out), ref_out, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(hT), ref_h, rtol=1e-4, atol=1e-4)
+    # padded positions are exactly zero
+    assert np.all(np.asarray(out)[1, 4:] == 0)
+    assert np.all(np.asarray(out)[2, 1:] == 0)
+
+
+def test_reverse_rnn_reads_from_last_valid_step():
+    paddle.seed(2)
+    cell = nn.SimpleRNNCell(8, 12)
+    layer = nn.RNN(cell, is_reverse=True)
+    x = np.random.RandomState(8).randn(2, 5, 8).astype("float32")
+    lengths = np.array([5, 3], dtype=np.int32)
+    out, hT = layer(jnp.asarray(x), None, sequence_length=jnp.asarray(lengths))
+    ref_out, ref_h = _naive_rnn(cell, np_simple_rnn_step, x,
+                                np.zeros((2, 12), "float32"), reverse=True,
+                                lengths=lengths)
+    np.testing.assert_allclose(np.asarray(out), ref_out, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(hT), ref_h, rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# stacked / bidirectional nets
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("direction", ["forward", "bidirectional"])
+def test_lstm_shapes_and_final_state_stack(direction):
+    paddle.seed(3)
+    D = 2 if direction == "bidirectional" else 1
+    net = nn.LSTM(16, 32, num_layers=2, direction=direction)
+    x = jnp.asarray(np.random.RandomState(9).randn(4, 23, 16).astype("float32"))
+    out, (h, c) = net(x)
+    assert out.shape == (4, 23, 32 * D)
+    assert h.shape == (2 * D, 4, 32)
+    assert c.shape == (2 * D, 4, 32)
+
+
+def test_gru_time_major_matches_batch_major():
+    paddle.seed(4)
+    net_bm = nn.GRU(8, 16, num_layers=1)
+    net_tm = nn.GRU(8, 16, num_layers=1, time_major=True)
+    net_tm.set_state_dict(net_bm.state_dict())
+    x = np.random.RandomState(10).randn(3, 6, 8).astype("float32")
+    out_bm, h_bm = net_bm(jnp.asarray(x))
+    out_tm, h_tm = net_tm(jnp.asarray(x.transpose(1, 0, 2)))
+    np.testing.assert_allclose(np.asarray(out_bm),
+                               np.asarray(out_tm).transpose(1, 0, 2),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h_bm), np.asarray(h_tm),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_stacked_lstm_matches_two_manual_layers():
+    paddle.seed(5)
+    net = nn.LSTM(8, 16, num_layers=2)
+    net.eval()  # dropout=0 anyway; be explicit
+    layers = list(net)
+    x = jnp.asarray(np.random.RandomState(11).randn(2, 5, 8).astype("float32"))
+    out1, st1 = layers[0](x, None, None)
+    out2, st2 = layers[1](out1, None, None)
+    out, (h, c) = net(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(h[0]), np.asarray(st1[0]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(h[1]), np.asarray(st2[0]), rtol=1e-6)
+
+
+def test_birnn_concat_of_forward_and_reverse():
+    paddle.seed(6)
+    cfw, cbw = nn.LSTMCell(8, 12), nn.LSTMCell(8, 12)
+    bi = nn.BiRNN(cfw, cbw)
+    x = jnp.asarray(np.random.RandomState(12).randn(2, 5, 8).astype("float32"))
+    out, (st_fw, st_bw) = bi(x)
+    ofw, sfw = nn.RNN(cfw)(x)
+    obw, sbw = nn.RNN(cbw, is_reverse=True)(x)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(jnp.concatenate([ofw, obw], -1)),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(st_fw[0]), np.asarray(sfw[0]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(st_bw[1]), np.asarray(sbw[1]), rtol=1e-6)
+
+
+def test_split_concat_states_roundtrip():
+    rs = np.random.RandomState(13)
+    h = jnp.asarray(rs.randn(4, 3, 8).astype("float32"))
+    c = jnp.asarray(rs.randn(4, 3, 8).astype("float32"))
+    per_layer = split_states((h, c), bidirectional=True, state_components=2)
+    assert len(per_layer) == 2          # 2 layers x 2 directions
+    assert len(per_layer[0]) == 2       # (fw, bw)
+    assert len(per_layer[0][0]) == 2    # (h, c)
+    h2, c2 = concat_states(per_layer, bidirectional=True, state_components=2)
+    np.testing.assert_array_equal(np.asarray(h), np.asarray(h2))
+    np.testing.assert_array_equal(np.asarray(c), np.asarray(c2))
+    # single-component path
+    per_layer = split_states(h, bidirectional=False, state_components=1)
+    h3 = concat_states(per_layer, bidirectional=False, state_components=1)
+    np.testing.assert_array_equal(np.asarray(h), np.asarray(h3))
+
+
+# ---------------------------------------------------------------------------
+# autodiff + jit + e2e
+# ---------------------------------------------------------------------------
+
+def test_lstm_grads_flow_and_jit():
+    paddle.seed(7)
+    net = nn.LSTM(8, 16, num_layers=2, direction="bidirectional")
+    x = jnp.asarray(np.random.RandomState(14).randn(2, 6, 8).astype("float32"))
+    params = {k: jnp.asarray(v) for k, v in paddle.nn.to_static_state(net).items()}
+
+    @jax.jit
+    def loss_fn(params, x):
+        out, _ = paddle.nn.functional_call(net, params, x)[0]
+        return jnp.mean(out ** 2)
+
+    grads = jax.grad(loss_fn)(params, x)
+    leaves = jax.tree_util.tree_leaves(grads)
+    assert leaves and all(bool(jnp.any(g != 0)) for g in leaves)
+
+
+def test_lstm_ctc_e2e_loss_decreases():
+    """Speech-style e2e: BiLSTM encoder + CTC loss, a few SGD steps."""
+    paddle.seed(8)
+
+    class Enc(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lstm = nn.LSTM(6, 24, direction="bidirectional")
+            self.proj = nn.Linear(48, 5)
+
+        def forward(self, x):
+            out, _ = self.lstm(x)
+            return self.proj(out)
+
+    net = Enc()
+    rs = np.random.RandomState(15)
+    x = jnp.asarray(rs.randn(2, 12, 6).astype("float32"))
+    labels = jnp.asarray(rs.randint(1, 5, (2, 4)).astype("int32"))
+    in_len = jnp.full((2,), 12, "int32")
+    lab_len = jnp.full((2,), 4, "int32")
+    params = {k: jnp.asarray(v) for k, v in paddle.nn.to_static_state(net).items()}
+
+    def loss_fn(params):
+        logits, _ = paddle.nn.functional_call(net, params, x)
+        logp = jax.nn.log_softmax(logits.transpose(1, 0, 2), -1)  # [T,B,C]
+        return jnp.mean(paddle.nn.functional.ctc_loss(
+            logp, labels, in_len, lab_len, blank=0))
+
+    vg = jax.jit(jax.value_and_grad(loss_fn))
+    l0, g = vg(params)
+    for _ in range(8):
+        l, g = vg(params)
+        params = jax.tree_util.tree_map(lambda p, gr: p - 0.05 * gr, params, g)
+    l_end, _ = vg(params)
+    assert float(l_end) < float(l0)
+
+
+def test_functional_rnn_entry_points_exported():
+    from paddle_tpu.nn import functional as F
+    assert callable(F.rnn) and callable(F.birnn)
