@@ -162,7 +162,12 @@ class TopKGate(Layer):
     def forward(self, x):
         T = x.shape[0]
         logits = x.astype(jnp.float32) @ self.weight
-        cap = self.capacity(T)
+        return self._route(logits, self.capacity(T))
+
+    def _route(self, logits, cap):
+        """Post-logits routing policy — the single definition used by both
+        the dense einsum path (via forward) and the all-to-all path, so the
+        two dispatch modes can never diverge on gating rules."""
         if self.top_k == 1:
             return _top1_gating(logits, cap,
                                 balance_loss_weight=self.balance_loss_weight,
@@ -204,12 +209,20 @@ class ExpertFFN(Layer):
 
     def forward(self, x):
         # x: [E, C, d_model]
-        h = jnp.einsum("ecd,edh->ech", x, self.w_in)
-        if self.gated:
-            h = self.activation(jnp.einsum("ecd,edh->ech", x, self.w_gate)) * h
+        w_gate = self.w_gate if self.gated else None
+        return self.apply(x, self.w_in, w_gate, self.w_out, self.activation)
+
+    @staticmethod
+    def apply(x, w_in, w_gate, w_out, activation):
+        """Pure form of forward — used by the all-to-all dispatch path, which
+        must compute with per-rank weight SLICES handed in by shard_map rather
+        than the captured global parameters."""
+        h = jnp.einsum("ecd,edh->ech", x, w_in)
+        if w_gate is not None:
+            h = activation(jnp.einsum("ecd,edh->ech", x, w_gate)) * h
         else:
-            h = self.activation(h)
-        return jnp.einsum("ech,ehd->ecd", h, self.w_out)
+            h = activation(h)
+        return jnp.einsum("ech,ehd->ecd", h, w_out)
 
 
 def moe_dispatch_combine(x, dispatch, combine, expert_fn):
@@ -229,22 +242,103 @@ class MoELayer(Layer):
     """
 
     def __init__(self, d_model, experts=None, gate="gshard", num_experts=8,
-                 d_hidden=None, recompute_interval=0, ep_axis="mp", name=None):
+                 d_hidden=None, recompute_interval=0, ep_axis="mp",
+                 dispatch="einsum", name=None):
         super().__init__()
         d_hidden = d_hidden or 4 * d_model
         if isinstance(gate, str):
             gate = {"gshard": GShardGate, "switch": SwitchGate,
                     "naive": SwitchGate}[gate](d_model, num_experts)
         self.gate = gate
+        self.ep_axis = ep_axis
+        if dispatch not in ("einsum", "alltoall"):
+            raise ValueError(f"dispatch must be 'einsum' or 'alltoall', got {dispatch!r}")
+        self.dispatch = dispatch
         self.experts = experts if experts is not None else ExpertFFN(
             num_experts, d_model, d_hidden, ep_axis=ep_axis)
+        if dispatch == "alltoall" and not isinstance(self.experts, ExpertFFN):
+            raise ValueError("dispatch='alltoall' requires ExpertFFN experts")
         self.register_buffer("aux_loss", jnp.zeros((), jnp.float32),
                              persistable=False)
 
     def forward(self, x):
         shape = x.shape
         t = x.reshape(-1, shape[-1])
-        dispatch, combine, aux = self.gate(t)
+        if self.dispatch == "alltoall":
+            out, aux = self._forward_alltoall(t)
+        else:
+            dispatch, combine, aux = self.gate(t)
+            out = moe_dispatch_combine(t, dispatch, combine, self.experts)
         self.aux_loss = aux
-        out = moe_dispatch_combine(t, dispatch, combine, self.experts)
         return out.reshape(shape)
+
+    def _forward_alltoall(self, t):
+        """Explicit EP dispatch (parity: moe_layer.py:263 dispatch path over
+        moe_utils.py:20/:153 global_scatter/global_gather).
+
+        shard_map over the EP axis: tokens sharded across the EP group, gate
+        weight replicated, expert weights sharded on the expert dim. Each rank
+        routes its local tokens into capacity-padded per-expert slots, the
+        all-to-all delivers every expert its inbox, local expert FFNs run on
+        per-rank weight slices, and the inverse all-to-all returns outputs for
+        the local combine. Partial-manual shard_map requires an enclosing jit
+        (TrainStep provides one; standalone callers must wrap in jax.jit).
+
+        Falls back to the dense einsum path when no multi-device mesh with the
+        EP axis is active (single-chip) so the same model code runs anywhere.
+        """
+        from functools import partial
+
+        from jax.sharding import PartitionSpec as P
+        try:
+            from jax import shard_map
+        except ImportError:  # older jax
+            from jax.experimental.shard_map import shard_map
+
+        from ..core import mesh as mesh_lib
+
+        mesh = mesh_lib.current_mesh()
+        axis = self.ep_axis
+        if mesh is None or mesh.shape.get(axis, 1) == 1:
+            dispatch, combine, aux = self.gate(t)
+            return moe_dispatch_combine(t, dispatch, combine, self.experts), aux
+
+        ep = mesh.shape[axis]
+        T = t.shape[0]
+        E = self.gate.num_experts
+        if T % ep:
+            raise ValueError(f"token count {T} not divisible by ep degree {ep}")
+        if E % ep:
+            raise ValueError(f"num_experts {E} not divisible by ep degree {ep}")
+        cap = self.gate.capacity(T // ep)
+        gate_layer = self.gate
+        experts = self.experts
+        w_gate = experts.w_gate if experts.gated else None
+
+        def fn(t_local, gw, w_in, w_out, *rest):
+            w_g = rest[0] if rest else None
+            logits = t_local.astype(jnp.float32) @ gw
+            disp, comb, aux = gate_layer._route(logits, cap)
+            expert_in = jnp.einsum("td,tec->ecd",
+                                   t_local.astype(jnp.float32), disp)
+            inbox = global_scatter(expert_in.astype(t_local.dtype),
+                                   None, None, axis)
+            out = ExpertFFN.apply(inbox, w_in, w_g, w_out, experts.activation)
+            back = global_gather(out, None, None, axis)
+            y = jnp.einsum("ecd,tec->td", back.astype(jnp.float32),
+                           comb).astype(t_local.dtype)
+            return y, jax.lax.pmean(aux, axis)
+
+        args = [t, gate_layer.weight, experts.w_in, experts.w_out]
+        in_specs = [P(axis), P(), P(axis), P(axis)]
+        if w_gate is not None:
+            args.append(w_gate)
+            in_specs.append(P(axis))
+        # Partial-manual over ONLY the EP axis: other mesh axes (dp/fsdp)
+        # stay auto so dp-sharded activations are not gathered/replicated —
+        # each dp group runs only its own tokens' MoE.
+        shmap = partial(shard_map, mesh=mesh, in_specs=tuple(in_specs),
+                        out_specs=(P(axis), P()), check_vma=False,
+                        axis_names={axis})
+        y, aux = shmap(fn)(*args)
+        return y, aux

@@ -49,6 +49,7 @@ class Qwen2MoeConfig:
     mp_axis: str | None = "mp"
     fsdp_axis: str | None = "fsdp"
     ep_axis: str | None = "mp"             # expert-weight sharding axis
+    ep_dispatch: str = "einsum"            # 'einsum' (GSPMD) | 'alltoall' (explicit EP)
     sep_axis: str | None = None
 
     def _attn_cfg(self) -> LlamaConfig:
@@ -74,7 +75,9 @@ class Qwen2MoeSparseMLP(Layer):
         experts = ExpertFFN(config.num_experts, config.hidden_size,
                             config.moe_intermediate_size,
                             ep_axis=config.ep_axis)
-        self.moe = MoELayer(config.hidden_size, experts=experts, gate=gate)
+        self.moe = MoELayer(config.hidden_size, experts=experts, gate=gate,
+                            ep_axis=config.ep_axis,
+                            dispatch=config.ep_dispatch)
         shared_cfg = config._attn_cfg()
         shared_cfg.intermediate_size = config.shared_expert_intermediate_size
         self.shared_expert = LlamaMLP(shared_cfg)

@@ -332,3 +332,71 @@ def test_global_scatter_gather_roundtrip(sep_mesh):
                             check_vma=False))(x)
     want = x * scales[:, None, None]
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+def test_moe_alltoall_dispatch_matches_einsum(hybrid_mesh):
+    """dispatch='alltoall' (explicit global_scatter/global_gather under
+    shard_map over mp) must agree with the dense GSPMD einsum path when
+    capacity is ample (eval mode => deterministic gating, no drops)."""
+    from paddle_tpu.distributed.moe import MoELayer, TopKGate
+    pt.seed(6)
+    # eval_capacity_factor large enough that neither the global (einsum) nor
+    # the per-rank (alltoall) capacity drops any token — otherwise the two
+    # paths legitimately differ on which overflow tokens they drop.
+    moe_e = MoELayer(d_model=16, num_experts=8, d_hidden=32,
+                     gate=TopKGate(16, 8, top_k=2, eval_capacity_factor=16.0),
+                     ep_axis="mp", dispatch="einsum")
+    moe_a = MoELayer(d_model=16, num_experts=8, d_hidden=32,
+                     gate=TopKGate(16, 8, top_k=2, eval_capacity_factor=16.0),
+                     ep_axis="mp", dispatch="alltoall")
+    moe_a.set_state_dict(moe_e.state_dict())
+    moe_e.eval(); moe_a.eval()
+    x = jnp.asarray(RNG.standard_normal((4, 8, 16)), jnp.float32)
+
+    y_e = moe_e(x)
+    # partial-manual shard_map needs an enclosing jit; read aux as a jit
+    # OUTPUT (a bare buffer read after raw jit would see a leaked tracer —
+    # TrainStep/functional_call handle this swap in real training code)
+    y_a, aux_a = jax.jit(lambda v: (moe_a(v), moe_a.aux_loss))(x)
+    np.testing.assert_allclose(np.asarray(y_e), np.asarray(y_a),
+                               rtol=2e-4, atol=2e-4)
+    assert np.isfinite(float(aux_a)) and float(aux_a) > 0
+
+
+def test_moe_alltoall_trains_and_falls_back(sep_mesh):
+    """Training step through the alltoall path converges; on a mesh without
+    the ep axis >1 the layer falls back to the einsum path (sep_mesh has
+    mp=1)."""
+    from paddle_tpu.distributed.moe import MoELayer
+    pt.seed(7)
+    moe = MoELayer(d_model=16, num_experts=4, d_hidden=32, gate="switch",
+                   ep_axis="mp", dispatch="alltoall")  # mp=1 -> fallback
+    x = jnp.asarray(RNG.standard_normal((2, 8, 16)), jnp.float32)
+    t = jnp.asarray(RNG.standard_normal((2, 8, 16)), jnp.float32)
+    opt = pt.optimizer.Adam(learning_rate=1e-2, parameters=moe)
+    step = pt.jit.TrainStep(moe, opt, lambda o, tt: F.mse_loss(o, tt))
+    losses = [float(step(x, t)) for _ in range(8)]
+    assert losses[-1] < losses[0]
+
+
+def test_qwen2_moe_alltoall_trains(hybrid_mesh):
+    """Flagship routed through explicit EP dispatch on an expert-sharded
+    mesh: one train step, finite loss, grads flow to expert weights."""
+    from paddle_tpu.models.qwen2_moe import Qwen2MoeConfig, Qwen2MoeForCausalLM
+    pt.seed(8)
+    cfg = Qwen2MoeConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                         moe_intermediate_size=16,
+                         shared_expert_intermediate_size=64,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=2, num_experts=8,
+                         num_experts_per_tok=2, max_position_embeddings=64,
+                         mp_axis=None, fsdp_axis=None,
+                         ep_axis="mp", ep_dispatch="alltoall")
+    model = Qwen2MoeForCausalLM(cfg)
+    opt = pt.optimizer.AdamW(learning_rate=1e-3, parameters=model)
+    step = pt.jit.TrainStep(model, opt,
+                            lambda logits, labels: model.loss(logits, labels))
+    ids = np.asarray(RNG.integers(0, cfg.vocab_size, (4, 16)))
+    l0 = float(step(ids, ids))
+    l1 = float(step(ids, ids))
+    assert np.isfinite(l0) and np.isfinite(l1)
