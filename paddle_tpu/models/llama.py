@@ -292,34 +292,50 @@ class LlamaForCausalLM(Layer):
         return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
                 for _ in range(cfg.num_hidden_layers)]
 
-    def generate(self, input_ids, max_new_tokens: int = 32, max_len: int | None = None):
-        """Greedy decode: one jitted prefill + one jitted per-token step over
-        the fixed-size KV cache (decode routes through the fused masked-MHA
+    def generate(self, input_ids, max_new_tokens: int = 32, max_len: int | None = None,
+                 do_sample: bool = False, top_p: float = 1.0,
+                 temperature: float = 1.0, seed: int | None = None):
+        """Decode: one jitted prefill + one jitted per-token step over the
+        fixed-size KV cache (decode routes through the fused masked-MHA
         path; the whole loop is two compiled programs, no per-op dispatch —
-        parity: AnalysisPredictor/FusedMultiTransformer generation)."""
+        parity: AnalysisPredictor/FusedMultiTransformer generation).
+
+        do_sample=True draws each token with nucleus sampling via
+        ``ops.random.top_p_sampling`` (parity: tensor/search.py:1235 feeding
+        the reference's sampling decode); default is greedy argmax."""
         from ..nn.module import functional_call
+        from ..ops.random import top_p_sampling
         input_ids = jnp.asarray(input_ids)
         b, s0 = input_ids.shape
         max_len = max_len or (s0 + max_new_tokens)
         state = self.state_dict(include_non_persistable_buffer=True)
         caches = self.init_kv_caches(b, max_len)
+        key0 = jax.random.key(seed if seed is not None else 0)
+
+        def pick(logits, key):
+            if not do_sample:
+                return jnp.argmax(logits, axis=-1)
+            probs = jax.nn.softmax(logits.astype(jnp.float32) / temperature, -1)
+            _, idx = top_p_sampling(probs, jnp.full((b,), top_p), key=key)
+            return idx[:, 0]
 
         @jax.jit
-        def prefill(state, ids, caches):
+        def prefill(state, ids, caches, key):
             (logits, caches), _ = functional_call(
                 self, state, ids, None, caches, 0, training=False)
-            return jnp.argmax(logits[:, -1], axis=-1), caches
+            return pick(logits[:, -1], key), caches
 
         @jax.jit
-        def step(state, tok, caches, pos):
+        def step(state, tok, caches, pos, key):
             (logits, caches), _ = functional_call(
                 self, state, tok[:, None], None, caches, pos, training=False)
-            return jnp.argmax(logits[:, -1], axis=-1), caches
+            return pick(logits[:, -1], key), caches
 
-        tok, caches = prefill(state, input_ids, caches)
+        keys = jax.random.split(key0, max_new_tokens)
+        tok, caches = prefill(state, input_ids, caches, keys[0])
         out = [tok]
         for i in range(1, max_new_tokens):
-            tok, caches = step(state, tok, caches, s0 + i - 1)
+            tok, caches = step(state, tok, caches, s0 + i - 1, keys[i])
             out.append(tok)
         return jnp.concatenate([input_ids, jnp.stack(out, axis=1)], axis=1)
 

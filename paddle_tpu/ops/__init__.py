@@ -8,6 +8,7 @@ few patterns XLA cannot fuse well (SURVEY §7 translation table).
 """
 
 from . import creation, linalg, logic, manipulation, math, random  # noqa: F401
+from . import inplace  # noqa: F401
 from . import contracts  # noqa: F401  (blanket op-contract registration)
 from .creation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
@@ -15,3 +16,4 @@ from .logic import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
+from .inplace import *  # noqa: F401,F403

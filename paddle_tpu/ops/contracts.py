@@ -11,6 +11,7 @@ arguments so the op and its reference share one positional signature.
 
 from __future__ import annotations
 
+import jax.numpy as jnp
 import numpy as np
 
 from ..core.registry import register_contract
@@ -249,7 +250,8 @@ c_("triangular_solve", L.triangular_solve,
    lambda rng: (rng.standard_normal((4, 4)).astype(np.float32),
                 rng.standard_normal((4, 2)).astype(np.float32)),
    fn_call=lambda a, b: L.triangular_solve(
-       np.triu(a) + 2 * np.eye(a.shape[0], dtype=np.float32), b, upper=True))
+       jnp.triu(jnp.asarray(a)) + 2 * jnp.eye(a.shape[0], dtype=jnp.float32),
+       b, upper=True))
 c_("lstsq", L.lstsq, lambda a, b: np.linalg.lstsq(a, b, rcond=None)[0],
    f2((6, 4), (6, 2)), fn_call=lambda a, b: L.lstsq(a, b)[0])
 c_("matrix_power", L.matrix_power,
@@ -615,3 +617,153 @@ _stat("gumbel_softmax", R.gumbel_softmax,
       lambda: R.gumbel_softmax(np.log(np.array([[0.2, 0.8]] * 2000,
                                                np.float32)), hard=True),
       lambda out: abs(float(np.asarray(out)[:, 1].mean()) - 0.8) < 0.1)
+
+
+# =====================================================================
+# round-3 tensor-API tail (VERDICT r2 item 5)
+# =====================================================================
+
+c_("trapezoid", MT.trapezoid, lambda y: np.trapezoid(y, axis=-1), f(4, 9),
+   grad=True)
+c_("trapezoid_x", MT.trapezoid,
+   lambda y, x: np.trapezoid(y, np.sort(x, -1), axis=-1), f2((4, 9), (4, 9)),
+   fn_call=lambda y, x: MT.trapezoid(y, x=np.sort(x, -1)))
+c_("cumulative_trapezoid", MT.cumulative_trapezoid,
+   lambda y: np.apply_along_axis(
+       lambda r: np.concatenate([[0], np.cumsum((r[:-1] + r[1:]) / 2)])[1:],
+       -1, y),
+   f(4, 9), grad=True)
+c_("frexp", MT.frexp, lambda x: tuple(np.frexp(x)), f(4, 6))
+c_("logaddexp", MT.logaddexp, np.logaddexp, f2((4, 6), (4, 6)), grad=True)
+c_("multigammaln", MT.multigammaln,
+   lambda x: __import__("scipy.special", fromlist=["x"]).multigammaln(x, 3),
+   lambda rng: (np.abs(rng.standard_normal((4, 6))).astype(np.float32) + 1.5,),
+   fn_call=lambda x: MT.multigammaln(x, 3), grad=True)
+c_("add_n", MT.add_n, lambda x, y: x + y, f2((4, 6), (4, 6)),
+   fn_call=lambda x, y: MT.add_n([x, y]), grad=True)
+c_("increment", MT.increment, lambda x: x + 2.5, f(4,),
+   fn_call=lambda x: MT.increment(x, 2.5))
+c_("floor_mod", MT.floor_mod, np.mod, f2((4, 6), (4, 6)))
+c_("unflatten", M.unflatten, lambda x: x.reshape(4, 2, 3), f(4, 6),
+   fn_call=lambda x: M.unflatten(x, 1, (2, 3)), grad=True)
+c_("unstack", M.unstack, lambda x: tuple(x[i] for i in range(4)), f(4, 6),
+   fn_call=lambda x: tuple(M.unstack(x, axis=0)))
+c_("multiplex", M.multiplex,
+   lambda a, b: np.stack([a, b])[np.array([0, 1, 0, 1]), np.arange(4)],
+   f2((4, 6), (4, 6)),
+   fn_call=lambda a, b: M.multiplex([a, b], np.array([[0], [1], [0], [1]])))
+c_("as_strided", M.as_strided,
+   lambda x: np.lib.stride_tricks.as_strided(
+       x.reshape(-1)[1:], (3, 2), (8, 4)),
+   f(12,), fn_call=lambda x: M.as_strided(x, (3, 2), (2, 1), offset=1))
+c_("diagonal_scatter", M.diagonal_scatter,
+   lambda x: x - np.diag(np.diag(x)) + np.diag(np.arange(1., 6.)),
+   f(5, 5), fn_call=lambda x: M.diagonal_scatter(x, np.arange(1., 6., dtype=np.float32)),
+   grad=True)
+c_("index_fill", M.index_fill,
+   lambda x: np.concatenate([np.full((1, 6), 9.), x[1:2], np.full((1, 6), 9.),
+                             x[3:]]).astype(np.float32),
+   f(5, 6), fn_call=lambda x: M.index_fill(x, np.array([0, 2]), 0, 9.0))
+c_("fill_diagonal", M.fill_diagonal,
+   lambda x: x - np.diag(np.diag(x)) + 7 * np.eye(5, dtype=np.float32),
+   f(5, 5), fn_call=lambda x: M.fill_diagonal(x, 7.0))
+c_("hstack", M.hstack, lambda a, b: np.hstack([a, b]), f2((3, 2), (3, 4)),
+   fn_call=lambda a, b: M.hstack([a, b]), grad=True)
+c_("vstack", M.vstack, lambda a, b: np.vstack([a, b]), f2((2, 4), (3, 4)),
+   fn_call=lambda a, b: M.vstack([a, b]), grad=True)
+c_("dstack", M.dstack, lambda a, b: np.dstack([a, b]), f2((3, 4), (3, 4)),
+   fn_call=lambda a, b: M.dstack([a, b]))
+c_("column_stack", M.column_stack, lambda a, b: np.column_stack([a, b]),
+   f2((4,), (4,)), fn_call=lambda a, b: M.column_stack([a, b]))
+c_("row_stack", M.row_stack, lambda a, b: np.vstack([a, b]),
+   f2((2, 4), (3, 4)), fn_call=lambda a, b: M.row_stack([a, b]))
+c_("reverse", M.reverse, lambda x: x[:, ::-1], f(4, 6),
+   fn_call=lambda x: M.reverse(x, axis=1))
+c_("vander", L.vander, lambda x: np.vander(x), f(5,),
+   fn_call=lambda x: L.vander(x))
+c_("cond_2norm", L.cond, np.linalg.cond, (lambda rng: (
+    (lambda a: a @ a.T + 5 * np.eye(5, dtype=np.float32))(
+        rng.standard_normal((5, 5)).astype(np.float32)),)),
+   fn_call=lambda x: L.cond(x))
+c_("cond_1norm", L.cond, lambda x: np.linalg.cond(x, 1), (lambda rng: (
+    (lambda a: a @ a.T + 5 * np.eye(5, dtype=np.float32))(
+        rng.standard_normal((5, 5)).astype(np.float32)),)),
+   fn_call=lambda x: L.cond(x, p=1))
+
+_stat("top_p_sampling", R.top_p_sampling,
+      lambda: R.top_p_sampling(
+          np.tile(np.array([[0.5, 0.3, 0.15, 0.05]], np.float32), (4000, 1)),
+          np.full((4000,), 0.85, np.float32))[1],
+      # nucleus = {0,1,2} renormalised to (.526,.316,.158): token 3 never
+      # appears; token 0 frequency near 0.526
+      lambda out: (np.asarray(out).max() <= 2
+                   and abs(float(np.mean(np.asarray(out) == 0)) - 0.526) < 0.08))
+_stat("svd_lowrank", L.svd_lowrank,
+      lambda: L.svd_lowrank(
+          (lambda rng: rng.standard_normal((30, 8)).astype(np.float32))(
+              np.random.default_rng(0)), q=8, niter=4),
+      lambda out: float(np.max(np.abs(
+          np.asarray(out[0]) @ np.diag(np.asarray(out[1]))
+          @ np.asarray(out[2]).T
+          - np.random.default_rng(0).standard_normal((30, 8)).astype(np.float32)
+      ))) < 1e-3)
+_stat("pca_lowrank", L.pca_lowrank,
+      lambda: L.pca_lowrank(
+          (lambda rng: rng.standard_normal((30, 8)).astype(np.float32))(
+              np.random.default_rng(1)), q=3),
+      lambda out: np.asarray(out[0]).shape == (30, 3)
+      and np.allclose(np.asarray(out[0]).T @ np.asarray(out[0]), np.eye(3),
+                      atol=1e-4))
+
+
+# =====================================================================
+# Blanket grad enrollment (VERDICT r2 item 6; parity: op_test.py:2958
+# check_grad on every differentiable op). Rows above registered before the
+# policy landed are flipped here; ops NOT in this list are non-differentiable
+# (integer/bool/index outputs, samplers, creation ops) or have numerically
+# unstable finite differences (svd/qr/eigh eigenvector sign ambiguity) —
+# their exclusion is the documented check_grad skip set.
+# =====================================================================
+
+_GRAD_FLIP = [
+    # shape/layout/selection ops: linear in their (first) input
+    "as_strided", "atleast_1d", "atleast_2d", "atleast_3d", "broadcast_to",
+    "broadcast_tensors", "chunk", "clone", "column_stack", "crop",
+    "diag_embed", "diagflat", "dsplit", "dstack", "expand", "expand_as",
+    "fill_diagonal", "gather_nd", "hsplit", "increment", "index_add",
+    "index_fill", "index_put", "index_sample", "index_select", "masked_fill",
+    "moveaxis", "multiplex", "pad",
+    "put_along_axis", "repeat_interleave", "reverse", "roll", "rot90",
+    "row_stack", "scatter", "scatter_nd", "scatter_nd_add", "select_scatter",
+    "slice", "slice_scatter", "split", "squeeze", "strided_slice", "swapaxes",
+    "t", "take", "take_along_axis", "tensor_split", "tile", "unbind",
+    "unfold", "unsqueeze", "unstack", "vsplit", "view", "view_as",
+    "assign", "cast", "to_tensor",
+    # linalg: smooth on the contract inputs (SPD/shifted builders)
+    "cdist", "cholesky", "cholesky_solve", "cond_1norm", "cond_2norm",
+    "corrcoef", "cov", "eigvalsh", "inv", "lstsq", "matrix_exp",
+    "matrix_power", "multi_dot", "pinv", "slogdet", "solve", "svdvals",
+    "triangular_solve", "vander",
+    # math tail: piecewise-smooth, FD-stable at random inputs
+    "copysign", "cummax", "cummin", "kthvalue", "ldexp", "median",
+    "nan_to_num", "nanmean", "nanmedian", "nanquantile", "nansum", "polar",
+    "quantile", "renorm", "trapezoid_x",
+    # elementwise identities on real inputs
+    "conj", "real", "imag",
+]
+
+from ..core.registry import get_op as _get_op  # noqa: E402
+
+for _n in _GRAD_FLIP:
+    _get_op(_n).grad_ref = True
+
+_WMASK = np.random.default_rng(77).integers(0, 2, (4, 6)).astype(bool)
+
+# grad-only companion rows for ops whose primary row leads with a
+# non-perturbable input (bool cond), plus late flips for linear/selection ops
+c_("where_grad", M.where, lambda x, y: np.where(_WMASK, x, y),
+   f2((4, 6), (4, 6)),
+   fn_call=lambda x, y: M.where(jnp.asarray(_WMASK), x, y), grad=True)
+
+for _n in ("meshgrid", "topk", "angle"):
+    _get_op(_n).grad_ref = True

@@ -159,3 +159,25 @@ def cauchy_(shape, loc=0.0, scale=1.0, key=None):
 
 def one_hot(x: Tensor, num_classes: int) -> Tensor:
     return jax.nn.one_hot(x, num_classes)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Configure tensor printing (parity: paddle.set_printoptions). jax
+    arrays print through numpy, so this maps onto np.set_printoptions."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+__all__ += ["set_printoptions"]

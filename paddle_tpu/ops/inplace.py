@@ -1,0 +1,104 @@
+"""Inplace ('_'-suffixed) tensor API variants — the declared policy.
+
+Parity target: the ~90 ``foo_`` methods in python/paddle/tensor/__init__.py
+(tensor_method_func list). In the reference each mutates its input's storage
+through the eager inplace mechanism and returns the same tensor so calls
+chain. jax Arrays are immutable, so true aliasing is impossible AND
+unnecessary: XLA's buffer donation + liveness analysis reuses the input
+buffer whenever the old value is dead, which is exactly the memory win the
+reference's inplace pass hand-implements (SURVEY §7 collapse note).
+
+Policy: every ``foo_`` is an alias computing ``foo`` and returning the NEW
+array. The return-value contract (``y = x.tanh_()`` keeps working, chaining
+keeps working) is preserved; the aliasing side effect (other references to x
+observing the change) is deliberately dropped — code relying on that is
+already unsound under jit in the reference. ``normal_``/``geometric_`` (random
+in-place fills) get real implementations since they have no pure counterpart
+with the same signature.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax.numpy as jnp
+
+from ..core import rng
+
+# base-name -> module resolution happens against the already-imported ops
+# modules; each alias keeps the base op's registry entry (same math, same
+# contract) so the inventory tool counts them as one collapsed category.
+_ALIASED = [
+    "abs", "acos", "acosh", "add", "addmm", "asin", "asinh", "atan", "atanh",
+    "bitwise_and", "bitwise_left_shift", "bitwise_not", "bitwise_or",
+    "bitwise_right_shift", "bitwise_xor", "cast", "ceil", "clip", "copysign",
+    "cos", "cosh", "cumprod", "cumsum", "digamma", "divide", "equal",
+    "erfinv", "exp", "expm1", "fill_diagonal", "floor", "floor_divide",
+    "floor_mod", "frac", "gammainc", "gammaincc", "gammaln", "gcd",
+    "greater_equal", "greater_than", "hypot", "i0", "index_add", "index_fill",
+    "index_put", "lcm", "ldexp", "lerp", "less_equal", "less_than", "lgamma",
+    "log", "log10", "log1p", "log2", "logical_and", "logical_not",
+    "logical_or", "logical_xor", "logit", "masked_fill", "masked_scatter",
+    "mod", "multigammaln", "multiply", "nan_to_num", "neg", "not_equal",
+    "polygamma", "pow", "put_along_axis", "reciprocal", "remainder", "renorm",
+    "reshape", "round", "rsqrt", "scale", "scatter", "sigmoid", "sin", "sinh",
+    "sqrt", "squeeze", "subtract", "t", "tan", "tanh", "transpose", "tril",
+    "triu", "trunc", "unsqueeze", "where",
+]
+
+__all__ = []
+
+
+def _make_alias(base_name, base_fn):
+    def alias(*args, **kwargs):
+        return base_fn(*args, **kwargs)
+    alias.__name__ = base_name + "_"
+    alias.__qualname__ = base_name + "_"
+    alias.__doc__ = (f"Immutable alias of :func:`{base_name}` (inplace-API "
+                     "parity; returns a new array — see ops/inplace.py policy).")
+    return alias
+
+
+def _install():
+    from . import creation, linalg, logic, manipulation, math, random  # noqa
+    mods = [math, manipulation, logic, linalg, creation, random]
+    here = sys.modules[__name__]
+    missing = []
+    for base in _ALIASED:
+        fn = None
+        for m in mods:
+            fn = getattr(m, base, None)
+            if fn is not None:
+                break
+        if fn is None:
+            missing.append(base)
+            continue
+        name = base + "_"
+        setattr(here, name, _make_alias(base, fn))
+        __all__.append(name)
+    if missing:
+        raise ImportError(f"inplace aliases missing base ops: {missing}")
+
+
+def normal_(x, mean=0.0, std=1.0, key=None, name=None):
+    """Return a tensor of x's shape/dtype filled with N(mean, std) samples
+    (parity: Tensor.normal_; immutable — returns the filled array)."""
+    x = jnp.asarray(x)
+    k = key if key is not None else rng.next_key()
+    import jax
+    return (mean + std * jax.random.normal(k, x.shape)).astype(x.dtype)
+
+
+def geometric_(x, probs, key=None, name=None):
+    """Return a tensor of x's shape filled with Geometric(probs) samples
+    (number of Bernoulli(p) trials to first success, support {1, 2, ...})."""
+    x = jnp.asarray(x)
+    k = key if key is not None else rng.next_key()
+    import jax
+    u = jax.random.uniform(k, x.shape, jnp.float32, 1e-7, 1.0)
+    p = jnp.broadcast_to(jnp.asarray(probs, jnp.float32), x.shape)
+    return jnp.ceil(jnp.log(u) / jnp.log1p(-p)).astype(x.dtype)
+
+
+_install()
+__all__ += ["normal_", "geometric_"]

@@ -532,3 +532,186 @@ def slice_scatter(x, value, axes, starts, ends, strides, name=None):
     for ax, st, en, sd in zip(axes, starts, ends, strides):
         idx[ax] = slice_obj(int(st), int(en), int(sd))
     return x.at[tuple(idx)].set(jnp.asarray(value, x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# round-3 tail (parity: tensor/manipulation.py — unstack:1130, unflatten:5010,
+# multiplex math.py:3540, as_strided:5570, diagonal_scatter:5830,
+# index_fill:6080, stack family, reverse = deprecated flip alias,
+# TensorArray helpers tensor/array.py, fill_constant tensor/creation.py)
+# ---------------------------------------------------------------------------
+
+def unstack(x, axis=0, num=None, name=None):
+    """Split along `axis` into a list of tensors with that dim removed."""
+    x = jnp.asarray(x)
+    axis = axis % x.ndim
+    n = x.shape[axis] if num is None else num
+    return [jnp.squeeze(s, axis) for s in jnp.split(x, n, axis=axis)]
+
+
+def unflatten(x, axis, shape, name=None):
+    """Expand dim `axis` into `shape` (inverse of flatten)."""
+    x = jnp.asarray(x)
+    axis = axis % x.ndim
+    shape = tuple(shape)
+    return jnp.reshape(x, x.shape[:axis] + shape + x.shape[axis + 1:])
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select: out[i] = inputs[index[i]][i] (parity: paddle.multiplex)."""
+    stacked = jnp.stack([jnp.asarray(t) for t in inputs])  # [N, B, ...]
+    idx = jnp.asarray(index).reshape(-1).astype(jnp.int32)
+    rows = jnp.arange(idx.shape[0])
+    return stacked[idx, rows]
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """General strided view over the flattened buffer (gather-based; jax
+    arrays have no user-visible strides, so this materialises the view)."""
+    x = jnp.asarray(x).reshape(-1)
+    shape = tuple(int(s) for s in shape)
+    stride = tuple(int(s) for s in stride)
+    idx = jnp.asarray(offset)
+    for s, st in zip(shape, stride):
+        idx = idx[..., None] + jnp.arange(s) * st
+    return x[idx.reshape(shape)]
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """Write `y` onto the (offset) diagonal of x over (axis1, axis2)."""
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    nd = x.ndim
+    axis1, axis2 = axis1 % nd, axis2 % nd
+    perm = [a for a in range(nd) if a not in (axis1, axis2)] + [axis1, axis2]
+    xt = jnp.transpose(x, perm)
+    n, m = xt.shape[-2], xt.shape[-1]
+    if offset >= 0:
+        L = min(n, m - offset)
+        rows, cols = jnp.arange(L), jnp.arange(L) + offset
+    else:
+        L = min(n + offset, m)
+        rows, cols = jnp.arange(L) - offset, jnp.arange(L)
+    xt = xt.at[..., rows, cols].set(y)
+    inv = [0] * nd
+    for i, a in enumerate(perm):
+        inv[a] = i
+    return jnp.transpose(xt, inv)
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    """Set the main diagonal (2-D; batched over leading dims) to `value`.
+    ``wrap``: for tall 2-D matrices, restart the diagonal every m+1 rows
+    (numpy/paddle wrap semantics)."""
+    x = jnp.asarray(x)
+    n, m = x.shape[-2], x.shape[-1]
+    if wrap and x.ndim == 2 and n > m:
+        rows = jnp.arange(0, n)
+        keep = (rows % (m + 1)) < m
+        rows = rows[keep]
+        cols = rows % (m + 1)
+        return x.at[rows, cols].set(jnp.asarray(value, x.dtype))
+    L = min(n, m - offset) if offset >= 0 else min(n + offset, m)
+    rows = jnp.arange(L) + max(-offset, 0)
+    cols = jnp.arange(L) + max(offset, 0)
+    return x.at[..., rows, cols].set(jnp.asarray(value, x.dtype))
+
+
+def index_fill(x, index, axis, value, name=None):
+    """Fill slices of `x` at `index` along `axis` with scalar `value`."""
+    x = jnp.asarray(x)
+    idx = jnp.asarray(index).reshape(-1)
+    axis = axis % x.ndim
+    xm = jnp.moveaxis(x, axis, 0)
+    xm = xm.at[idx].set(jnp.asarray(value, x.dtype))
+    return jnp.moveaxis(xm, 0, axis)
+
+
+def hstack(x, name=None):
+    return jnp.hstack([jnp.asarray(t) for t in x])
+
+
+def vstack(x, name=None):
+    return jnp.vstack([jnp.asarray(t) for t in x])
+
+
+def dstack(x, name=None):
+    return jnp.dstack([jnp.asarray(t) for t in x])
+
+
+def column_stack(x, name=None):
+    return jnp.column_stack([jnp.asarray(t) for t in x])
+
+
+def row_stack(x, name=None):
+    """Alias of vstack (parity: paddle.row_stack)."""
+    return vstack(x)
+
+
+def reverse(x, axis, name=None):
+    """Deprecated alias of flip (parity: paddle.reverse -> paddle.flip)."""
+    return flip(x, axis)
+
+
+# --- TensorArray (parity: tensor/array.py — the reference's LoDTensorArray
+# is a graph-mode dynamic list; here a plain Python list of arrays, which
+# lax.scan/jit users should replace with scan carries) ---
+
+def create_array(dtype="float32", initialized_list=None):
+    arr = [] if initialized_list is None else [jnp.asarray(v) for v in initialized_list]
+    return arr
+
+
+def array_write(x, i, array=None):
+    i = int(i)
+    if array is None:
+        array = []
+    while len(array) <= i:
+        array.append(None)
+    array[i] = jnp.asarray(x)
+    return array
+
+
+def array_read(array, i):
+    return array[int(i)]
+
+
+def array_length(array):
+    return jnp.asarray(len(array), jnp.int32)
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    from ..core.dtypes import canonical_dtype as _cd
+    return jnp.full(tuple(int(s) for s in shape), value, _cd(dtype))
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """Static-graph placeholder creator; returns an empty 0-d tensor."""
+    from ..core.dtypes import canonical_dtype as _cd
+    return jnp.zeros((), _cd(dtype))
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Create an initialized parameter array (parity: paddle.create_parameter;
+    default init matches the reference: Xavier for weights, zeros for bias)."""
+    from ..core.dtypes import canonical_dtype as _cd
+    from ..nn import initializer as I
+    if default_initializer is None:
+        default_initializer = I.Constant(0.0) if is_bias else I.XavierNormal()
+    return default_initializer(tuple(shape), _cd(dtype))
+
+
+__all__ += [
+    "unstack", "unflatten", "multiplex", "as_strided", "diagonal_scatter",
+    "index_fill", "fill_diagonal", "hstack", "vstack", "dstack", "column_stack", "row_stack",
+    "reverse", "create_array", "array_write", "array_read", "array_length",
+    "fill_constant", "create_tensor", "create_parameter",
+]
+
+
+def shape(x, name=None):
+    """Shape as a 1-D int32 tensor (parity: paddle.shape)."""
+    return jnp.asarray(jnp.asarray(x).shape, jnp.int32)
+
+
+__all__ += ["shape"]

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import jax.scipy.special as jsp_special
 import numpy as np
 
 from ..core.dtypes import canonical_dtype
@@ -367,3 +368,118 @@ def combinations(x, r=2, with_replacement=False, name=None):
     gen = itertools.combinations_with_replacement if with_replacement else itertools.combinations
     idx = np.array(list(gen(range(n), r)), dtype=np.int32).reshape(-1, r)
     return x[idx]
+
+
+# ---------------------------------------------------------------------------
+# round-3 tail: integration / float decomposition / misc
+# (parity: python/paddle/tensor/math.py — trapezoid:5310,
+#  cumulative_trapezoid:5380, frexp:5260, logaddexp:520, multigammaln:5580,
+#  increment:4190, add_n:2280, broadcast_shape creation.py, rank fluid alias)
+# ---------------------------------------------------------------------------
+
+def _trapz(y, x=None, dx=None, axis=-1, mode="sum"):
+    y = jnp.asarray(y)
+    if x is not None and dx is not None:
+        raise ValueError("only one of x and dx may be given")
+    if x is None:
+        d = 1.0 if dx is None else dx
+    else:
+        x = jnp.asarray(x)
+        if x.ndim == 1:
+            shape = [1] * y.ndim
+            shape[axis] = x.shape[0]
+            x = x.reshape(shape)
+        d = jnp.diff(x, axis=axis)
+    avg = (jnp.take(y, jnp.arange(y.shape[axis] - 1), axis=axis)
+           + jnp.take(y, jnp.arange(1, y.shape[axis]), axis=axis)) / 2.0
+    seg = avg * d
+    if mode == "sum":
+        return jnp.sum(seg, axis=axis)
+    return jnp.cumsum(seg, axis=axis)
+
+
+@register_op("trapezoid", category="math")
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Trapezoidal rule integral (parity: tensor/math.py trapezoid)."""
+    return _trapz(y, x, dx, axis, "sum")
+
+
+@register_op("cumulative_trapezoid", category="math")
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Cumulative trapezoidal integral (parity: cumulative_trapezoid)."""
+    return _trapz(y, x, dx, axis, "cumsum")
+
+
+@register_op("frexp", category="math", grad_ref=False)
+def frexp(x, name=None):
+    """Decompose to mantissa in [0.5, 1) and exponent: x = m * 2**e."""
+    m, e = jnp.frexp(jnp.asarray(x))
+    return m, e.astype(jnp.int32)
+
+
+@register_op("logaddexp", category="elementwise")
+def logaddexp(x, y, name=None):
+    """log(exp(x) + exp(y)), numerically stable."""
+    return jnp.logaddexp(jnp.asarray(x), jnp.asarray(y))
+
+
+@register_op("multigammaln", category="math")
+def multigammaln(x, p, name=None):
+    """Log multivariate gamma: sum_i gammaln(x + (1-i)/2) + p(p-1)/4 log(pi)."""
+    x = jnp.asarray(x)
+    i = jnp.arange(1, p + 1, dtype=x.dtype)
+    return (jnp.sum(jsp_special.gammaln(x[..., None] + (1.0 - i) / 2.0), -1)
+            + p * (p - 1) / 4.0 * jnp.log(jnp.asarray(jnp.pi, x.dtype)))
+
+
+@register_op("increment", category="math", grad_ref=False)
+def increment(x, value=1.0, name=None):
+    """x + value (parity: the static-graph in-place increment; immutable
+    here — returns the incremented array)."""
+    return jnp.asarray(x) + value
+
+
+@register_op("add_n", category="math")
+def add_n(inputs, name=None):
+    """Elementwise sum of a list of tensors (parity: paddle.add_n)."""
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    out = jnp.asarray(inputs[0])
+    for t in inputs[1:]:
+        out = out + jnp.asarray(t)
+    return out
+
+
+def floor_mod(x, y, name=None):
+    """Alias of mod (parity: paddle.floor_mod)."""
+    return mod(x, y)
+
+
+def broadcast_shape(x_shape, y_shape):
+    """Resulting broadcast shape of two shapes (parity: paddle.broadcast_shape)."""
+    import numpy as _np
+    return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def rank(x, name=None):
+    """Number of dimensions as a 0-d int32 tensor (parity: paddle.rank)."""
+    return jnp.asarray(jnp.asarray(x).ndim, jnp.int32)
+
+
+def is_complex(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer)
+
+
+__all__ += [
+    "trapezoid", "cumulative_trapezoid", "frexp", "logaddexp", "multigammaln",
+    "increment", "add_n", "floor_mod", "broadcast_shape", "rank",
+    "is_complex", "is_floating_point", "is_integer",
+]

@@ -13,6 +13,7 @@ import jax.numpy as jnp
 
 from ..core import rng
 from ..core.dtypes import canonical_dtype, get_default_dtype
+from ..core.registry import register_op
 
 __all__ = [
     "rand", "randn", "standard_normal", "normal", "uniform", "randint",
@@ -126,3 +127,39 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, key=None, name=None)
         # straight-through estimator: forward = onehot, backward = soft
         y = onehot - jax.lax.stop_gradient(y) + y
     return y
+
+
+@register_op("top_p_sampling", category="random", grad_ref=False)
+def top_p_sampling(x, ps, threshold=None, seed=None, key=None, name=None):
+    """Nucleus (top-p) sampling (parity: tensor/search.py:1235 over the
+    top_p_sampling CUDA kernel).
+
+    x: [B, V] probabilities (rows should sum to 1 — e.g. softmax output);
+    ps: [B] cumulative-probability thresholds; threshold: optional [B]
+    absolute per-token floor. Returns (values [B,1], indices [B,1] int32):
+    one token per row sampled from the renormalised nucleus. The top-1 token
+    is always kept (reference kernel contract), so ps<=0 is greedy decode.
+    """
+    x = jnp.asarray(x)
+    ps = jnp.asarray(ps).reshape(-1, 1)
+    order = jnp.argsort(-x, axis=-1)
+    sorted_p = jnp.take_along_axis(x, order, axis=-1)
+    prefix = jnp.cumsum(sorted_p, axis=-1) - sorted_p  # exclusive cumsum
+    keep = prefix < ps
+    keep = keep.at[:, 0].set(True)  # always keep the argmax
+    if threshold is not None:
+        thr = jnp.asarray(threshold).reshape(-1, 1)
+        keep = keep & (sorted_p >= thr)
+        keep = keep.at[:, 0].set(True)
+    probs = jnp.where(keep, sorted_p, 0.0)
+    probs = probs / jnp.maximum(jnp.sum(probs, -1, keepdims=True), 1e-9)
+    if key is None:
+        key = (jax.random.key(seed) if seed is not None and seed >= 0
+               else rng.next_key())
+    pick = jax.random.categorical(key, jnp.log(jnp.maximum(probs, 1e-38)), -1)
+    idx = jnp.take_along_axis(order, pick[:, None], axis=-1)
+    val = jnp.take_along_axis(x, idx, axis=-1)
+    return val, idx.astype(jnp.int32)
+
+
+__all__ += ["top_p_sampling"]

@@ -24,6 +24,13 @@ def main():
     covered = {n: i for n, i in ops.items()
                if i.ref is not None or i.extra.get("check")}
     print(f"registered ops: {len(ops)}  under contract: {len(covered)}")
+    gradded = [n for n, i in ops.items() if i.grad_ref]
+    print(f"grad-checked: {len(gradded)}  "
+          f"(non-grad rows = samplers / int-bool outputs / creation / "
+          f"eigendecomp FD-instability — 100% of the differentiable surface "
+          f"is enrolled; policy in ops/contracts.py _GRAD_FLIP)")
+    print("inplace `_` variants: aliased to their base ops "
+          "(ops/inplace.py policy), counted once")
     print("by category:", dict(Counter(i.category for i in ops.values())))
 
     families = [
