@@ -2,3 +2,5 @@
 (parity: python/paddle/incubate/, SURVEY §A.5 fused LLM layer zoo)."""
 
 from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import asp  # noqa: F401

@@ -1,0 +1,66 @@
+"""ASP — automatic structured (n:m) sparsity utilities
+(parity: python/paddle/incubate/asp/ — create_mask utils.py, prune_model,
+calculate_density supported_layer_list).
+
+The reference targets NVIDIA 2:4 sparse tensor cores; TPUs have no sparse
+MXU mode, so the VALUE here is the pruning workflow (train → prune → mask is
+preserved by masked grads), not a kernel speedup. Masks are computed with the
+same greedy largest-magnitude n-of-m rule, and ``decorate``-style enforcement
+is a multiply — XLA fuses it into the consumer matmul. Documented
+deprioritization: no sparse-format storage or sparse kernel dispatch.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["create_mask", "calculate_density", "check_mask", "prune_model",
+           "apply_masks"]
+
+
+def create_mask(w, n=2, m=4):
+    """Keep the n largest-|w| entries of every m consecutive elements of the
+    last axis (parity: asp create_mask with MaskAlgo.MASK_1D best-effort)."""
+    w = jnp.asarray(w)
+    if w.shape[-1] % m:
+        raise ValueError(f"last dim {w.shape[-1]} not divisible by m={m}")
+    groups = w.reshape(w.shape[:-1] + (w.shape[-1] // m, m))
+    order = jnp.argsort(-jnp.abs(groups), axis=-1)
+    ranks = jnp.argsort(order, axis=-1)  # rank of each element within group
+    mask = (ranks < n).astype(w.dtype)
+    return mask.reshape(w.shape)
+
+
+def calculate_density(x):
+    x = jnp.asarray(x)
+    return float(jnp.mean((x != 0).astype(jnp.float32)))
+
+
+def check_mask(w, n=2, m=4):
+    """True iff every m-group of w has at most n nonzeros."""
+    w = jnp.asarray(w)
+    groups = w.reshape(w.shape[:-1] + (w.shape[-1] // m, m))
+    nnz = jnp.sum((groups != 0).astype(jnp.int32), axis=-1)
+    return bool(jnp.all(nnz <= n))
+
+
+def prune_model(layer, n=2, m=4, min_ndim=2):
+    """Apply n:m masks to every >=2-D parameter whose last dim divides m.
+
+    Returns {param_path: mask}; reapply after each optimizer step with
+    :func:`apply_masks` (the reference's OptimizerWithSparsityGuarantee)."""
+    masks = {}
+    params = layer.param_dict(trainable_only=True)
+    pruned = {}
+    for k, w in params.items():
+        if w.ndim >= min_ndim and w.shape[-1] % m == 0:
+            mask = create_mask(w, n, m)
+            masks[k] = mask
+            pruned[k] = w * mask
+    layer.set_state_dict(pruned)
+    return masks
+
+
+def apply_masks(params, masks):
+    """params with masks re-applied (post-update sparsity enforcement)."""
+    return {k: (p * masks[k] if k in masks else p) for k, p in params.items()}

@@ -1,6 +1,7 @@
 """paddle_tpu.optimizer (parity: python/paddle/optimizer/)."""
 
 from . import lr  # noqa: F401
+from .lbfgs import LBFGS  # noqa: F401
 from .optimizer import (  # noqa: F401
     ASGD, SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Lars, Momentum,
     NAdam, Optimizer, RAdam, RMSProp, Rprop,
