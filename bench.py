@@ -1,34 +1,70 @@
-"""Benchmark: Llama decoder pretraining throughput on one TPU chip.
+"""Benchmark suite: one JSON line per BASELINE.md measurement config, on one
+TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Configs (BASELINE.md "measurement configs", bring-up order 2/3/4/5):
+  - llama_420m  : Llama decoder pretraining, seq 2048, bf16, flash attention
+                  (the round-2 headline metric; keep MFU >= 0.507)
+  - resnet50    : ImageNet-shape conv training, images/sec
+  - bert_base   : MLM+NSP pretraining step, seq 512, DP-shape attention
+  - qwen2_moe   : sparse MoE decoder step (einsum dispatch on one chip)
 
-Config: a ~420M-param Llama (hidden 2048, 8 layers) at seq 2048, bf16 params
-and compute, fused train step (forward+backward+AdamW in one XLA program with
-buffer donation), flash-attention Pallas kernel on the causal path, fused
-Pallas RMS-norm. Batch 4 with NO activation recompute — measured fastest on
-this chip (sweep 2026-07: b4/no-remat 25.7k tok/s vs b8/remat 22.1k, b6/
-no-remat 24.1k; b8/no-remat exceeds compile memory). MFU against the v5e
-nominal bf16 peak (197 TFLOP/s); vs_baseline is MFU / 0.40 (the BASELINE.md
-north-star target).
+Each line: {"metric", "value", "unit", "vs_baseline", "extra"}. The primary
+(first) line is llama_420m — vs_baseline remains MFU/0.40 against the
+BASELINE.json north-star target. Other configs report their own MFU-based
+vs_baseline against the same 0.40 target (BASELINE.md publishes no absolute
+reference numbers — "to measure").
+
+Chip peak FLOP/s is detected from device_kind (VERDICT r2: was hardcoded
+v5e); unknown kinds fall back to v5e with a note in extra.
+
+Pass config names as argv to run a subset: `python bench.py llama_420m`.
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
 
+# nominal bf16 dense peak FLOP/s by TPU generation (public spec sheets)
+_PEAKS = {
+    "v4": 275e12,
+    "v5e": 197e12, "v5litepod": 197e12, "v5lite": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12, "trillium": 918e12,
+}
 
-def main():
-    import jax
+
+def _detect_peak(dev) -> tuple[float, str]:
+    kind = getattr(dev, "device_kind", "").lower().replace(" ", "")
+    for key, peak in _PEAKS.items():
+        if key in kind:
+            return peak, key
+    return 197e12, f"unknown({kind})->v5e-fallback"
+
+
+def _time_step(step_fn, *args, iters=10):
+    loss = step_fn(*args)
+    _ = float(np.asarray(loss).ravel()[0])  # compile + warmup
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step_fn(*args)
+    lossv = float(np.asarray(loss).ravel()[0])
+    dt = (time.perf_counter() - t0) / iters
+    assert np.isfinite(lossv), lossv
+    return dt, lossv
+
+
+def bench_llama(peak, peak_kind):
     import jax.numpy as jnp
 
     import paddle_tpu as pt
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 
     pt.seed(0)
-    batch, seq = 4, 2048
+    batch, seq = 4, 2048  # sweep 2026-07: fastest no-remat point on v5e
     cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
                       num_hidden_layers=8, num_attention_heads=16,
                       num_key_value_heads=8, max_position_embeddings=seq,
@@ -39,38 +75,169 @@ def main():
     opt = pt.optimizer.AdamW(learning_rate=1e-4, parameters=model)
     step = pt.jit.TrainStep(model, opt,
                             lambda logits, labels: model.loss(logits, labels))
-    rng = np.random.default_rng(0)
-    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
-
-    # warmup / compile
-    loss = step(ids, ids)
-    _ = float(loss)
-
-    iters = 10
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = step(ids, ids)
-    lossv = float(loss)  # forces completion of the chain
-    dt = (time.perf_counter() - t0) / iters
-
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, cfg.vocab_size,
+                                                        (batch, seq)), jnp.int32)
+    dt, lossv = _time_step(step, ids, ids)
     tokens_per_sec = batch * seq / dt
-    # 6ND for fwd+bwd (attention FLOPs add ~12*L*h*s^2*d ≈ included via 6ND
-    # underestimate; report the standard 6ND MFU)
-    flops_per_token = 6.0 * n_params
-    attn_flops = 12.0 * cfg.num_hidden_layers * seq * cfg.hidden_size
-    model_flops = (flops_per_token + attn_flops) * tokens_per_sec
-    peak = 197e12  # v5e nominal bf16
-    mfu = model_flops / peak
-    assert np.isfinite(lossv)
-    print(json.dumps({
+    flops_per_token = 6.0 * n_params + 12.0 * cfg.num_hidden_layers * seq * cfg.hidden_size
+    mfu = flops_per_token * tokens_per_sec / peak
+    return {
         "metric": "llama_420m_seq2048_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.40, 4),
         "extra": {"mfu": round(mfu, 4), "step_ms": round(dt * 1000, 2),
                   "params": n_params, "loss": round(lossv, 4),
-                  "batch": batch, "seq": seq},
-    }))
+                  "batch": batch, "seq": seq, "peak": peak_kind},
+    }
+
+
+def bench_resnet50(peak, peak_kind):
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.models import resnet50
+
+    pt.seed(0)
+    batch = 64
+    model = resnet50(num_classes=1000)
+    opt = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                parameters=model)
+    step = pt.jit.TrainStep(model, opt,
+                            lambda out, y: F.cross_entropy(out, y))
+    rng = np.random.default_rng(0)
+    # model params are f32; XLA's default TPU precision runs the convs on
+    # the MXU (bf16 passes) — input stays f32 to match BN/param dtypes
+    x = jnp.asarray(rng.standard_normal((batch, 3, 224, 224)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 1000, (batch,)), jnp.int32)
+    dt, lossv = _time_step(step, x, y)
+    images_per_sec = batch / dt
+    # ResNet-50 fwd ≈ 4.09 GFLOP @224; train ≈ 3x fwd (bwd ~2x)
+    mfu = 3 * 4.09e9 * images_per_sec / peak
+    return {
+        "metric": "resnet50_224_images_per_sec_per_chip",
+        "value": round(images_per_sec, 1),
+        "unit": "images/s",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {"mfu": round(mfu, 4), "step_ms": round(dt * 1000, 2),
+                  "loss": round(lossv, 4), "batch": batch, "peak": peak_kind},
+    }
+
+
+def bench_bert(peak, peak_kind):
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.bert import BertConfig, BertForPreTraining
+
+    pt.seed(0)
+    batch, seq = 32, 512
+    cfg = BertConfig(dtype="bfloat16", hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0)
+    model = BertForPreTraining(cfg)
+    n_params = model.num_params() if hasattr(model, "num_params") else int(sum(
+        np.prod(v.shape) for v in model.state_dict().values()))
+    opt = pt.optimizer.AdamW(learning_rate=1e-4, parameters=model)
+
+    def loss_fn(outputs, labels):
+        mlm_logits, nsp_logits = outputs
+        mlm_labels, nsp_labels = labels
+        return model.loss(mlm_logits, nsp_logits, mlm_labels, nsp_labels)
+
+    step = pt.jit.TrainStep(model, opt, loss_fn)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    mlm_labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                             jnp.int32)
+    nsp_labels = jnp.asarray(rng.integers(0, 2, (batch,)), jnp.int32)
+    dt, lossv = _time_step(step, ids, (mlm_labels, nsp_labels))
+    tokens_per_sec = batch * seq / dt
+    mfu = 6.0 * n_params * tokens_per_sec / peak
+    return {
+        "metric": "bert_base_seq512_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {"mfu": round(mfu, 4), "step_ms": round(dt * 1000, 2),
+                  "params": n_params, "loss": round(lossv, 4),
+                  "batch": batch, "seq": seq, "peak": peak_kind},
+    }
+
+
+def bench_qwen2_moe(peak, peak_kind):
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.qwen2_moe import Qwen2MoeConfig, Qwen2MoeForCausalLM
+
+    pt.seed(0)
+    batch, seq = 4, 1024
+    cfg = Qwen2MoeConfig(vocab_size=32000, hidden_size=1024,
+                         intermediate_size=2816, moe_intermediate_size=704,
+                         shared_expert_intermediate_size=2816,
+                         num_hidden_layers=8, num_attention_heads=16,
+                         num_key_value_heads=8, num_experts=16,
+                         num_experts_per_tok=2, max_position_embeddings=seq,
+                         dtype="bfloat16", mp_axis=None, fsdp_axis=None,
+                         ep_axis=None)
+    model = Qwen2MoeForCausalLM(cfg)
+    n_params = int(sum(np.prod(v.shape)
+                       for v in model.state_dict().values()))
+    # active params per token: dense stack + shared expert + top-k routed
+    cfg2 = cfg
+    routed_per_layer = 3 * cfg2.hidden_size * cfg2.moe_intermediate_size
+    n_active = n_params - cfg2.num_hidden_layers * (
+        cfg2.num_experts - cfg2.num_experts_per_tok) * routed_per_layer
+    opt = pt.optimizer.AdamW(learning_rate=1e-4, parameters=model)
+    step = pt.jit.TrainStep(model, opt,
+                            lambda logits, labels: model.loss(logits, labels))
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    dt, lossv = _time_step(step, ids, ids)
+    tokens_per_sec = batch * seq / dt
+    mfu = 6.0 * n_active * tokens_per_sec / peak
+    return {
+        "metric": "qwen2_moe_16e_seq1024_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {"mfu_active": round(mfu, 4), "step_ms": round(dt * 1000, 2),
+                  "params_total": n_params, "params_active": int(n_active),
+                  "loss": round(lossv, 4), "batch": batch, "seq": seq,
+                  "experts": cfg.num_experts, "peak": peak_kind},
+    }
+
+
+_CONFIGS = {
+    "llama_420m": bench_llama,
+    "resnet50": bench_resnet50,
+    "bert_base": bench_bert,
+    "qwen2_moe": bench_qwen2_moe,
+}
+
+
+def main():
+    import jax
+
+    dev = jax.devices()[0]
+    peak, peak_kind = _detect_peak(dev)
+    unknown = [a for a in sys.argv[1:] if a not in _CONFIGS]
+    if unknown:
+        raise SystemExit(f"unknown bench config(s) {unknown}; "
+                         f"choose from {list(_CONFIGS)}")
+    names = sys.argv[1:] or list(_CONFIGS)
+    failed = []
+    for name in names:
+        try:
+            print(json.dumps(_CONFIGS[name](peak, peak_kind)), flush=True)
+        except Exception as e:  # one config failing must not kill the others
+            failed.append(name)
+            print(json.dumps({"metric": name, "value": None, "unit": "error",
+                              "vs_baseline": 0.0,
+                              "extra": {"error": repr(e)[:300]}}), flush=True)
+    if failed:  # ...but the run must still report failure to the driver
+        raise SystemExit(f"bench config(s) failed: {failed}")
 
 
 if __name__ == "__main__":
