@@ -168,21 +168,29 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
                                  is_causal=False, training=True, name=None):
     """Inputs [batch, seq, num_heads, head_dim] (paddle convention)."""
     q, k, v = jnp.asarray(query), jnp.asarray(key), jnp.asarray(value)
+    eff_dropout = dropout_p if training else 0.0
     use_flash = (
         q.shape[1] >= _FLASH_MIN_SEQ
-        and dropout_p == 0.0
         and jax.default_backend() == "tpu"
     )
     if use_flash:
-        if attn_mask is None:
+        if attn_mask is None and eff_dropout > 0.0:
+            # in-kernel seeded dropout: single-device route (the dropout
+            # kernel carries no shard_map rule yet)
+            from ..._mesh_gate import no_mesh_active
+            if no_mesh_active() and not _in_manual_trace():
+                from ...ops.pallas.flash_attention import flash_attention as _fa
+                return _fa(q, k, v, causal=is_causal, dropout_p=eff_dropout)
+        elif attn_mask is None and eff_dropout == 0.0:
             out = _flash_sharded(q, k, v, is_causal)
             if out is not None:
                 return out
-        else:
+        elif eff_dropout == 0.0:
             # masked flash: single-device route only (the in-kernel bias has
-            # no shard_map rule yet); mesh/manual contexts and masks the
-            # kernel cannot take (non-broadcastable shapes) use XLA. Cheap
-            # context checks run BEFORE the (materializing) normalization.
+            # no shard_map rule yet; mask+dropout combined stay on XLA);
+            # mesh/manual contexts and masks the kernel cannot take
+            # (non-broadcastable shapes) use XLA. Cheap context checks run
+            # BEFORE the (materializing) normalization.
             from ..._mesh_gate import no_mesh_active
             if no_mesh_active() and not _in_manual_trace():
                 m = _normalize_kernel_mask(attn_mask, q.shape[0], q.shape[2],
@@ -198,7 +206,21 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax
                     fixed_seed_offset=None, rng_name="", training=True, name=None):
     """Parity: paddle.nn.functional.flash_attention.flash_attention.
     Returns (out, softmax) — softmax is None unless return_softmax (the
-    reference only materializes it for debugging)."""
+    reference only materializes it for debugging). ``fixed_seed_offset``
+    pins the in-kernel dropout PRNG for deterministic replays (reference
+    kernel contract flash_attn_kernel.cu:250); honored on the TPU kernel
+    path, ignored by the XLA fallback (which draws from the framework
+    stream)."""
+    q = jnp.asarray(query)
+    if (dropout > 0.0 and training and fixed_seed_offset is not None
+            and not return_softmax
+            and jax.default_backend() == "tpu" and q.shape[1] >= _FLASH_MIN_SEQ):
+        from ..._mesh_gate import no_mesh_active
+        if no_mesh_active() and not _in_manual_trace():
+            from ...ops.pallas.flash_attention import flash_attention as _fa
+            out = _fa(q, jnp.asarray(key), jnp.asarray(value), causal=causal,
+                      dropout_p=dropout, fixed_seed_offset=fixed_seed_offset)
+            return out, None
     out = scaled_dot_product_attention(query, key, value, None, dropout, causal,
                                        training=training)
     if return_softmax:

@@ -45,6 +45,26 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _drop_mask(seed_ref, bh, i, j, bq, bk, dropout_p):
+    """Deterministic per-block keep mask from (seed, offset, bh, qi, kj).
+
+    Parity: flash_attn_kernel.cu:250 fixed_seed_offset — the same five-tuple
+    reseeds the per-core PRNG in the forward AND both backward kernels, so
+    the mask regenerates bit-identically without storing it (the reference
+    stores philox seed/offset in the softmax_return state; here the seed
+    rides in SMEM). TPU-only: pltpu.prng_* has no interpret-mode lowering.
+    """
+    # the core PRNG accepts at most 2 seed words on this libtpu — fold the
+    # five-tuple into two via odd-constant mixing (wrapping int32 mults);
+    # identical folding in fwd/bwd keeps masks bit-identical
+    h1 = seed_ref[0] ^ (bh * jnp.int32(-1640531527))   # 0x9E3779B9
+    h2 = seed_ref[1] ^ (i * jnp.int32(-2048144777)) ^ (j * jnp.int32(-1028477379))
+    pltpu.prng_seed(h1, h2)
+    bits = pltpu.bitcast(pltpu.prng_random_bits((bq, bk)), jnp.uint32)
+    thresh = jnp.uint32(min(int(dropout_p * 4294967296.0), 4294967295))
+    return bits >= thresh
+
+
 def _block_sizes(seq_q, seq_k, head_dim):
     """Tuned on v5e (sweep 2026-07): bq=bk=1024 is ~9% faster end-to-end
     than the round-1 512/256 at seq 2048 (fewer grid steps, larger MXU
@@ -72,16 +92,19 @@ def _block_sizes(seq_q, seq_k, head_dim):
 # ---------------- forward ----------------
 
 def _fwd_kernel(*refs, scale, causal, bq, bk, nk, off, k_valid, has_seg=False,
-                has_bias=False):
+                has_bias=False, dropout_p=0.0):
     refs = list(refs)
     q_ref, k_ref, v_ref = refs[:3]
     refs = refs[3:]
-    qs_ref = ks_ref = bias_ref = None
+    qs_ref = ks_ref = bias_ref = seed_ref = None
     if has_seg:
         qs_ref, ks_ref = refs[:2]
         refs = refs[2:]
     if has_bias:
         bias_ref = refs[0]
+        refs = refs[1:]
+    if dropout_p > 0.0:
+        seed_ref = refs[0]
         refs = refs[1:]
     o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     j = pl.program_id(2)
@@ -127,7 +150,13 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, nk, off, k_valid, has_seg=False,
         # with bottom-right alignment when off < 0) give p == 0, not exp(0)
         p = jnp.exp(s - jnp.maximum(m_cur, jnp.float32(-1e25))[:, None])
         alpha = jnp.exp(m_prev - m_cur)
+        # softmax normalizer uses the UNDROPPED mass (dropout applies to the
+        # normalized P); PV accumulation uses the dropped, rescaled p
         l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
+        if dropout_p > 0.0:
+            keep = _drop_mask(seed_ref, pl.program_id(0), i, j, bq, bk,
+                              dropout_p)
+            p = jnp.where(keep, p * (1.0 / (1.0 - dropout_p)), 0.0)
         acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         m_ref[:, 0] = m_cur
@@ -140,7 +169,8 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, nk, off, k_valid, has_seg=False,
         lse_ref[0, :, 0] = m_ref[:, 0] + jnp.log(l_safe)
 
 
-def _fwd(q, k, v, scale, causal, seg=None, bias=None):
+def _fwd(q, k, v, scale, causal, seg=None, bias=None, dropout_p=0.0,
+         seed_arr=None):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     qh = jnp.moveaxis(q, 2, 1).reshape(b * h, sq, d)
@@ -171,7 +201,8 @@ def _fwd(q, k, v, scale, causal, seg=None, bias=None):
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                bq=bq, bk=bk, nk=nk, off=off, k_valid=k_valid,
                                has_seg=seg is not None,
-                               has_bias=bias is not None)
+                               has_bias=bias is not None,
+                               dropout_p=dropout_p)
 
     if causal:
         # Clamp dead (fully masked) k blocks to the last live block index:
@@ -203,6 +234,9 @@ def _fwd(q, k, v, scale, causal, seg=None, bias=None):
             biasp, h, bq, bk,
             lambda b_, i, j: (i, kv_index(b_, i, j)[1])))
         inputs.append(biasp)
+    if dropout_p > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        inputs.append(seed_arr)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, nq, nk),
@@ -291,16 +325,19 @@ def _scratch(shape):
 # ---------------- backward ----------------
 
 def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, off, has_seg=False,
-                   has_bias=False):
+                   has_bias=False, dropout_p=0.0):
     refs = list(refs)
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
     refs = refs[6:]
-    qs_ref = ks_ref = bias_ref = None
+    qs_ref = ks_ref = bias_ref = seed_ref = None
     if has_seg:
         qs_ref, ks_ref = refs[:2]
         refs = refs[2:]
     if has_bias:
         bias_ref = refs[0]
+        refs = refs[1:]
+    if dropout_p > 0.0:
+        seed_ref = refs[0]
         refs = refs[1:]
     dq_ref, dq_acc = refs
     j = pl.program_id(2)
@@ -337,6 +374,10 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, off, has_seg=False,
         p = jnp.exp(s - jnp.maximum(lse, jnp.float32(-1e25))[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:  # dS = P ∘ (mask∘dP/(1-p) − D): same FA2 chain
+            keep = _drop_mask(seed_ref, pl.program_id(0), i, j, bq, bk,
+                              dropout_p)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_p)), 0.0)
         ds = p * (dp - delta[:, None]) * scale
         dq_acc[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
@@ -347,16 +388,19 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, off, has_seg=False,
 
 
 def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, off, has_seg=False,
-                    has_bias=False):
+                    has_bias=False, dropout_p=0.0):
     refs = list(refs)
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
     refs = refs[6:]
-    qs_ref = ks_ref = bias_ref = None
+    qs_ref = ks_ref = bias_ref = seed_ref = None
     if has_seg:
         qs_ref, ks_ref = refs[:2]
         refs = refs[2:]
     if has_bias:
         bias_ref = refs[0]
+        refs = refs[1:]
+    if dropout_p > 0.0:
+        seed_ref = refs[0]
         refs = refs[1:]
     dk_ref, dv_ref, dk_acc, dv_acc = refs
     i = pl.program_id(2)  # q block (innermost)
@@ -392,10 +436,21 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, off, has_seg=False,
             s = s + bias_ref[0, 0].astype(jnp.float32)
         # clamped so fully-masked rows (lse == -1e30 sentinel) give p == 0
         p = jnp.exp(s - jnp.maximum(lse, jnp.float32(-1e25))[:, None])
-        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        if dropout_p > 0.0:
+            # dV = (mask∘P/(1-p))^T dO; dS = P ∘ (mask∘dP/(1-p) − D).
+            # Seed tuple (bh, qi, kj) matches the forward bit-for-bit.
+            keep = _drop_mask(seed_ref, pl.program_id(0), i, j, bq, bk,
+                              dropout_p)
+            inv = 1.0 / (1.0 - dropout_p)
+            p_drop = jnp.where(keep, p * inv, 0.0)
+        else:
+            p_drop = p
+        dv_acc[:] += jax.lax.dot_general(p_drop, do, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            dp = jnp.where(keep, dp * inv, 0.0)
         ds = p * (dp - delta[:, None]) * scale
         dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
@@ -419,7 +474,7 @@ def _bwd(scale, causal, res, g):
 
 
 def flash_block_grads(q, k, v, do, lse, delta, *, scale, causal, seg=None,
-                      bias=None):
+                      bias=None, dropout_p=0.0, seed_arr=None):
     """Gradient building block given precomputed row stats.
 
     Inputs: q/do [b,sq,h,d]; k/v [b,sk,h,d]; lse/delta [b,h,sq] where lse is
@@ -464,6 +519,8 @@ def flash_block_grads(q, k, v, do, lse, delta, *, scale, causal, seg=None,
     if bias is not None:
         biasp = _pad_bias(bias, b, h, sq, sk, pq_, pk_)
         common_in.append(biasp)
+    if dropout_p > 0.0:
+        common_in.append(seed_arr)
     if causal:
         def kv_index(b_, i, j):  # dead k blocks re-use the last live index (no DMA)
             last_live = jnp.maximum((i * bq + bq - 1 + off) // bk, 0)
@@ -494,11 +551,14 @@ def flash_block_grads(q, k, v, do, lse, delta, *, scale, causal, seg=None,
         in_specs_q.append(_bias_spec(
             biasp, h, bq, bk,
             lambda b_, i, j: (i, kv_index(b_, i, j)[1])))
+    if dropout_p > 0.0:
+        in_specs_q.append(pl.BlockSpec(memory_space=pltpu.SMEM))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk, off=off,
                           has_seg=seg is not None,
-                          has_bias=bias is not None),
+                          has_bias=bias is not None,
+                          dropout_p=dropout_p),
         grid=(b * h, nq, nk),
         in_specs=in_specs_q,
         out_specs=pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0)),
@@ -523,11 +583,14 @@ def flash_block_grads(q, k, v, do, lse, delta, *, scale, causal, seg=None,
         in_specs_kv.append(_bias_spec(
             biasp, h, bq, bk,
             lambda b_, j, i: (q_index_kv(b_, j, i)[1], j)))
+    if dropout_p > 0.0:
+        in_specs_kv.append(pl.BlockSpec(memory_space=pltpu.SMEM))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nq=nq, off=off,
                           has_seg=seg is not None,
-                          has_bias=bias is not None),
+                          has_bias=bias is not None,
+                          dropout_p=dropout_p),
         grid=(b * h, nk, nq),
         in_specs=in_specs_kv,
         out_specs=[
@@ -591,16 +654,67 @@ def _flash_bias_bwd(scale, causal, res, g):
 _flash_bias.defvjp(_flash_bias_fwd, _flash_bias_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_drop(q, k, v, seed_arr, scale, causal, dropout_p):
+    out, _ = _fwd(q, k, v, scale, causal, dropout_p=dropout_p,
+                  seed_arr=seed_arr)
+    return out
+
+
+def _flash_drop_fwd(q, k, v, seed_arr, scale, causal, dropout_p):
+    out, lse = _fwd(q, k, v, scale, causal, dropout_p=dropout_p,
+                    seed_arr=seed_arr)
+    return out, (q, k, v, seed_arr, out, lse)
+
+
+def _flash_drop_bwd(scale, causal, dropout_p, res, g):
+    q, k, v, seed_arr, out, lse = res
+    dq, dk, dv = flash_block_grads(q, k, v, g, lse, _delta(g, out),
+                                   scale=scale, causal=causal,
+                                   dropout_p=dropout_p, seed_arr=seed_arr)
+    return dq, dk, dv, jnp.zeros_like(seed_arr)
+
+
+_flash_drop.defvjp(_flash_drop_fwd, _flash_drop_bwd)
+
+
 def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
-                    attn_mask=None):
+                    attn_mask=None, dropout_p: float = 0.0,
+                    fixed_seed_offset=None):
     """Differentiable flash attention; layout [batch, seq, heads, head_dim].
     ``attn_mask``: optional additive mask (bool masks converted to 0/-1e30),
     broadcastable [sq, sk], [b, sq, sk] or [b, h|1, sq, sk] — the reference
     kernel's attn_mask attr, applied INSIDE the tiled kernel. Like the
     reference kernel the mask is NON-differentiable here (stop_gradient
-    applied); learned additive biases (ALiBi/T5) must use the XLA path."""
+    applied); learned additive biases (ALiBi/T5) must use the XLA path.
+
+    ``dropout_p`` > 0 enables IN-KERNEL seeded attention dropout (parity:
+    flash_attn_kernel.cu:250 dropout + fixed_seed_offset): the mask is
+    generated by the TPU core PRNG keyed on (seed, offset, head, q-block,
+    k-block) and regenerated identically in the backward — nothing is
+    stored. ``fixed_seed_offset``: optional (seed, offset) int pair for
+    reproducible replays; defaults to a fresh seed from the framework RNG
+    stream. TPU-only (pltpu PRNG has no interpret lowering); CPU callers
+    must use the XLA path (nn.functional routes this automatically).
+    Dropout composes with ``causal`` but not (yet) with ``attn_mask``."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    if dropout_p > 0.0:
+        if _interpret():
+            raise NotImplementedError(
+                "in-kernel flash dropout is TPU-only; use the XLA attention "
+                "path (nn.functional.scaled_dot_product_attention) on CPU")
+        if attn_mask is not None:
+            raise NotImplementedError(
+                "dropout_p with attn_mask is not supported in-kernel; "
+                "use the XLA path")
+        if fixed_seed_offset is None:
+            from ...core import rng as _rng
+            bits = jax.random.key_data(_rng.next_key()).reshape(-1)[:2]
+            seed_arr = jnp.asarray(bits, jnp.int32)
+        else:
+            seed_arr = jnp.asarray(fixed_seed_offset, jnp.int32).reshape(2)
+        return _flash_drop(q, k, v, seed_arr, scale, causal, float(dropout_p))
     if attn_mask is not None:
         m = jnp.asarray(attn_mask)
         if m.dtype == jnp.bool_:

@@ -8,11 +8,15 @@ the env var alone is not enough — jax.config must be updated after import
 
 import os
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+_REAL_CHIP = os.environ.get("PADDLE_TPU_REAL_CHIP") == "1"
+
+if not _REAL_CHIP:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _REAL_CHIP:
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,127 @@
+"""In-kernel flash dropout tests — TPU-ONLY (pltpu.prng_* has no CPU
+interpret lowering; VERDICT r2 item 4). The whole module skips on the CPU
+mesh; the bench driver environment has a real chip, and
+tools/run_tpu_checks.py executes this file there.
+
+Checks (parity contract flash_attn_kernel.cu:250):
+  - statistical: dropout is unbiased (E[out] == no-dropout out) and actually
+    drops (outputs differ);
+  - determinism: same (seed, offset) -> bitwise-identical out AND grads;
+    different seed -> different out;
+  - gradient: FD check through the kernel with a fixed seed (the mask is
+    deterministic, so finite differences are valid).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+# This file must NOT import the CPU-forcing conftest behavior: it runs under
+# tools/run_tpu_checks.py with the real backend. Under the normal suite the
+# conftest pins CPU and everything here skips.
+import jax
+
+if jax.default_backend() != "tpu":
+    pytest.skip("in-kernel flash dropout is TPU-only", allow_module_level=True)
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def _qkv(b=1, s=512, h=4, d=64, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.standard_normal((b, s, h, d)) * 0.3,
+                             jnp.float32)
+    return mk(), mk(), mk()
+
+
+def test_dropout_unbiased_and_active():
+    q, k, v = _qkv()
+    base = flash_attention(q, k, v, causal=True)
+    dropped = flash_attention(q, k, v, causal=True, dropout_p=0.2,
+                              fixed_seed_offset=(7, 0))
+    diff = float(jnp.mean(jnp.abs(dropped - base)))
+    assert diff > 1e-4  # dropout actually happened
+    # unbiasedness: the average over independent seeds converges to the
+    # no-dropout output (each mask is unbiased after the 1/(1-p) rescale)
+    acc = jnp.zeros_like(base)
+    n_seeds = 8
+    for s_ in range(n_seeds):
+        acc = acc + flash_attention(q, k, v, causal=True, dropout_p=0.2,
+                                    fixed_seed_offset=(100 + s_, s_))
+    rel_one = diff / max(float(jnp.mean(jnp.abs(base))), 1e-9)
+    rel_avg = (float(jnp.mean(jnp.abs(acc / n_seeds - base)))
+               / max(float(jnp.mean(jnp.abs(base))), 1e-9))
+    assert rel_avg < rel_one / 2, (rel_one, rel_avg)  # ~1/sqrt(8) shrink
+    assert rel_avg < 0.25, rel_avg
+
+
+def test_dropout_deterministic_replay():
+    q, k, v = _qkv(seed=1)
+    f = lambda seed: flash_attention(q, k, v, causal=True, dropout_p=0.3,
+                                     fixed_seed_offset=seed)
+    o1 = f((123, 4))
+    o2 = f((123, 4))
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    o3 = f((124, 4))
+    assert float(jnp.max(jnp.abs(o1 - o3))) > 1e-4
+
+    g = lambda seed: jax.grad(lambda q_: jnp.sum(
+        flash_attention(q_, k, v, causal=True, dropout_p=0.3,
+                        fixed_seed_offset=seed)))(q)
+    g1, g2 = g((123, 4)), g((123, 4))
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+
+
+def test_dropout_grads_match_finite_differences():
+    # small shapes; fixed seed makes the dropped network a deterministic
+    # function, so central differences apply
+    q, k, v = _qkv(b=1, s=256, h=1, d=64, seed=2)
+    seed = (55, 1)
+
+    def loss(q_, k_, v_):
+        out = flash_attention(q_, k_, v_, causal=True, dropout_p=0.25,
+                              fixed_seed_offset=seed)
+        return jnp.sum(out * jnp.cos(jnp.arange(out.size).reshape(out.shape)
+                                     * 0.01))
+
+    gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    rng = np.random.default_rng(0)
+    eps = 1e-2
+    for name, x, gx in (("q", q, gq), ("k", k, gk), ("v", v, gv)):
+        flat = np.asarray(x).ravel()
+        for _ in range(4):
+            idx = rng.integers(0, flat.size)
+            e = np.zeros_like(flat)
+            e[idx] = eps
+            xp = jnp.asarray((flat + e).reshape(x.shape))
+            xm = jnp.asarray((flat - e).reshape(x.shape))
+            args_p = {"q": (xp, k, v), "k": (q, xp, v), "v": (q, k, xp)}[name]
+            args_m = {"q": (xm, k, v), "k": (q, xm, v), "v": (q, k, xm)}[name]
+            num = (float(loss(*args_p)) - float(loss(*args_m))) / (2 * eps)
+            ana = float(np.asarray(gx).ravel()[idx])
+            assert abs(num - ana) < 5e-2 + 0.1 * abs(num), (name, num, ana)
+
+
+def test_dropout_rejects_cpu_only_features():
+    q, k, v = _qkv(s=256)
+    with pytest.raises(NotImplementedError):
+        flash_attention(q, k, v, dropout_p=0.1,
+                        attn_mask=jnp.zeros((256, 256)))
+
+
+def test_sdpa_routes_dropout_through_kernel():
+    import paddle_tpu.nn.functional as F
+    q, k, v = _qkv(s=512)
+    out = F.scaled_dot_product_attention(q, k, v, dropout_p=0.1,
+                                         is_causal=True, training=True)
+    assert out.shape == q.shape
+    out_eval = F.scaled_dot_product_attention(q, k, v, dropout_p=0.1,
+                                              is_causal=True, training=False)
+    base = flash_attention(q, k, v, causal=True)
+    # the sharded sdpa wrapper runs the kernel in bf16 compute — compare at
+    # bf16-class tolerance (verify-skill guidance for this chip)
+    np.testing.assert_allclose(np.asarray(out_eval), np.asarray(base),
+                               rtol=2e-2, atol=5e-3)

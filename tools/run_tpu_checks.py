@@ -1,0 +1,20 @@
+"""Run the TPU-only test files on the real chip (PADDLE_TPU_REAL_CHIP=1
+disables the conftest's CPU-mesh pinning). The normal suite runs these
+files too but they skip without a TPU backend.
+
+Usage: python tools/run_tpu_checks.py
+"""
+
+import os
+import subprocess
+import sys
+
+TPU_ONLY = ["tests/test_flash_dropout_tpu.py"]
+
+if __name__ == "__main__":
+    env = dict(os.environ)
+    env["PADDLE_TPU_REAL_CHIP"] = "1"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rc = subprocess.run([sys.executable, "-m", "pytest", "-q", *TPU_ONLY],
+                        cwd=repo, env=env).returncode
+    sys.exit(rc)
