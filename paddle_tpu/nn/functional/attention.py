@@ -145,6 +145,13 @@ def _flash_sharded(q, k, v, is_causal):
     return fn(q, k, v)
 
 
+def _single_device_kernel_ok() -> bool:
+    """True when the plain (no shard_map rule) Pallas kernel is safe to
+    call directly: no active mesh and not inside a manual trace."""
+    from ..._mesh_gate import no_mesh_active
+    return no_mesh_active() and not _in_manual_trace()
+
+
 def _normalize_kernel_mask(mask, b, h, sq, sk):
     """Broadcast a paddle-style mask to a shape the flash kernel accepts
     ([b|1, h|1, sq, sk]); returns None when it cannot (caller uses XLA).
@@ -177,8 +184,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
         if attn_mask is None and eff_dropout > 0.0:
             # in-kernel seeded dropout: single-device route (the dropout
             # kernel carries no shard_map rule yet)
-            from ..._mesh_gate import no_mesh_active
-            if no_mesh_active() and not _in_manual_trace():
+            if _single_device_kernel_ok():
                 from ...ops.pallas.flash_attention import flash_attention as _fa
                 return _fa(q, k, v, causal=is_causal, dropout_p=eff_dropout)
         elif attn_mask is None and eff_dropout == 0.0:
@@ -188,11 +194,10 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
         elif eff_dropout == 0.0:
             # masked flash: single-device route only (the in-kernel bias has
             # no shard_map rule yet; mask+dropout combined stay on XLA);
-            # mesh/manual contexts and masks the kernel cannot take
-            # (non-broadcastable shapes) use XLA. Cheap context checks run
-            # BEFORE the (materializing) normalization.
-            from ..._mesh_gate import no_mesh_active
-            if no_mesh_active() and not _in_manual_trace():
+            # masks the kernel cannot take (non-broadcastable shapes) use
+            # XLA. Cheap context checks run BEFORE the (materializing)
+            # normalization.
+            if _single_device_kernel_ok():
                 m = _normalize_kernel_mask(attn_mask, q.shape[0], q.shape[2],
                                            q.shape[1], k.shape[1])
                 if m is not None:
@@ -215,8 +220,7 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax
     if (dropout > 0.0 and training and fixed_seed_offset is not None
             and not return_softmax
             and jax.default_backend() == "tpu" and q.shape[1] >= _FLASH_MIN_SEQ):
-        from ..._mesh_gate import no_mesh_active
-        if no_mesh_active() and not _in_manual_trace():
+        if _single_device_kernel_ok():
             from ...ops.pallas.flash_attention import flash_attention as _fa
             out = _fa(q, jnp.asarray(key), jnp.asarray(value), causal=causal,
                       dropout_p=dropout, fixed_seed_offset=fixed_seed_offset)

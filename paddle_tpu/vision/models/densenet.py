@@ -1,0 +1,138 @@
+"""DenseNet (parity: python/paddle/vision/models/densenet.py:203).
+
+Dense connectivity re-expressed as a running feature list concatenated
+once per dense layer — XLA fuses the BN/ReLU chains into the convs, so
+there is no materialised "concat pyramid" at runtime.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import nn
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_SPECS = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+    264: (64, 32, (6, 12, 64, 48)),
+}
+
+
+def _bn_act_conv(in_ch, out_ch, kernel, stride=1, padding=0):
+    return nn.Sequential(
+        nn.BatchNorm2D(in_ch), nn.ReLU(),
+        nn.Conv2D(in_ch, out_ch, kernel, stride=stride, padding=padding,
+                  bias_attr=False))
+
+
+class DenseLayer(nn.Layer):
+    """BN-ReLU-1x1 bottleneck then BN-ReLU-3x3 producing growth_rate maps."""
+
+    def __init__(self, in_ch, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.bottleneck = _bn_act_conv(in_ch, bn_size * growth_rate, 1)
+        self.conv = _bn_act_conv(bn_size * growth_rate, growth_rate, 3,
+                                 padding=1)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        y = self.conv(self.bottleneck(x))
+        if self.dropout is not None:
+            y = self.dropout(y)
+        return jnp.concatenate([x, y], axis=1)
+
+
+class DenseBlock(nn.Layer):
+    def __init__(self, in_ch, num_layers, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.layers = nn.LayerList([
+            DenseLayer(in_ch + i * growth_rate, growth_rate, bn_size, dropout)
+            for i in range(num_layers)])
+
+    def forward(self, x):
+        for layer in self.layers:
+            x = layer(x)
+        return x
+
+
+class TransitionLayer(nn.Layer):
+    def __init__(self, in_ch, out_ch):
+        super().__init__()
+        self.conv = _bn_act_conv(in_ch, out_ch, 1)
+        self.pool = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(x))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        if layers not in _SPECS:
+            raise ValueError(
+                f"supported layers are {sorted(_SPECS)}, got {layers}")
+        num_init, growth, block_config = _SPECS[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, num_init, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(num_init), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+
+        blocks = []
+        ch = num_init
+        for i, num_layers in enumerate(block_config):
+            blocks.append(DenseBlock(ch, num_layers, growth, bn_size, dropout))
+            ch += num_layers * growth
+            if i != len(block_config) - 1:
+                blocks.append(TransitionLayer(ch, ch // 2))
+                ch //= 2
+        blocks += [nn.BatchNorm2D(ch), nn.ReLU()]
+        self.features = nn.Sequential(*blocks)
+        self.out_channels = ch
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape(x.shape[0], -1)
+            x = self.classifier(x)
+        return x
+
+
+def _densenet(layers, pretrained, **kwargs):
+    if pretrained:
+        raise NotImplementedError("no hub weights in this environment")
+    return DenseNet(layers=layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, pretrained, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, pretrained, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, pretrained, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, pretrained, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, pretrained, **kwargs)

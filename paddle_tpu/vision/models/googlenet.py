@@ -1,0 +1,120 @@
+"""GoogLeNet / Inception v1 (parity: python/paddle/vision/models/
+googlenet.py:107). Returns [main, aux1, aux2] logits like the reference.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import nn
+from ...nn import initializer as I
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+def _xavier(fan):
+    # reference googlenet.py:40 scales linear weights by sqrt(3/fan) —
+    # without BatchNorm anywhere in this net the heads diverge otherwise
+    bound = (3.0 / fan) ** 0.5
+    return I.Uniform(-bound, bound)
+
+
+def _conv(in_ch, out_ch, kernel, stride=1):
+    return nn.Sequential(
+        nn.Conv2D(in_ch, out_ch, kernel, stride=stride,
+                  padding=(kernel - 1) // 2),
+        nn.ReLU())
+
+
+class Inception(nn.Layer):
+    """Four parallel branches concatenated on channels."""
+
+    def __init__(self, in_ch, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _conv(in_ch, c1, 1)
+        self.b3 = nn.Sequential(_conv(in_ch, c3r, 1), _conv(c3r, c3, 3))
+        self.b5 = nn.Sequential(_conv(in_ch, c5r, 1), _conv(c5r, c5, 5))
+        self.bp = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                _conv(in_ch, proj, 1))
+
+    def forward(self, x):
+        return jnp.concatenate(
+            [self.b1(x), self.b3(x), self.b5(x), self.bp(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.stem = nn.Sequential(
+            _conv(3, 64, 7, stride=2), nn.MaxPool2D(3, stride=2),
+            _conv(64, 64, 1), _conv(64, 192, 3), nn.MaxPool2D(3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+        self.ince3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self.ince3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self.ince4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.ince4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self.ince4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self.ince4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self.ince4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.ince5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self.ince5b = Inception(832, 384, 192, 384, 48, 128, 128)
+
+        if with_pool:
+            self.pool_main = nn.AdaptiveAvgPool2D(1)
+            self.pool_aux1 = nn.AvgPool2D(5, stride=3)
+            self.pool_aux2 = nn.AvgPool2D(5, stride=3)
+
+        if num_classes > 0:
+            self.drop = nn.Dropout(0.4)
+            self.fc_main = nn.Linear(1024, num_classes,
+                                     weight_attr=_xavier(1024))
+
+            self.conv_aux1 = _conv(512, 128, 1)
+            self.fc1_aux1 = nn.Linear(1152, 1024, weight_attr=_xavier(2048))
+            self.drop_aux1 = nn.Dropout(0.7)
+            self.fc2_aux1 = nn.Linear(1024, num_classes,
+                                      weight_attr=_xavier(1024))
+
+            self.conv_aux2 = _conv(528, 128, 1)
+            self.fc1_aux2 = nn.Linear(1152, 1024, weight_attr=_xavier(2048))
+            self.drop_aux2 = nn.Dropout(0.7)
+            self.fc2_aux2 = nn.Linear(1024, num_classes,
+                                      weight_attr=_xavier(1024))
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool(self.ince3b(self.ince3a(x)))
+        aux1 = self.ince4a(x)
+        x = self.ince4d(self.ince4c(self.ince4b(aux1)))
+        aux2 = x
+        x = self.pool(self.ince4e(x))
+        main = self.ince5b(self.ince5a(x))
+
+        if self.with_pool:
+            main = self.pool_main(main)
+            aux1 = self.pool_aux1(aux1)
+            aux2 = self.pool_aux2(aux2)
+
+        if self.num_classes > 0:
+            main = self.drop(main).reshape(main.shape[0], -1)
+            main = self.fc_main(main)
+
+            aux1 = self.conv_aux1(aux1).reshape(aux1.shape[0], -1)
+            aux1 = nn.functional.relu(self.fc1_aux1(aux1))
+            aux1 = self.fc2_aux1(self.drop_aux1(aux1))
+
+            aux2 = self.conv_aux2(aux2).reshape(aux2.shape[0], -1)
+            aux2 = self.fc1_aux2(aux2)
+            aux2 = self.fc2_aux2(self.drop_aux2(aux2))
+
+        return [main, aux1, aux2]
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("no hub weights in this environment")
+    return GoogLeNet(**kwargs)

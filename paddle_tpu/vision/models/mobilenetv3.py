@@ -1,0 +1,147 @@
+"""MobileNetV3 small/large (parity: python/paddle/vision/models/
+mobilenetv3.py:183). Squeeze-excitation uses hardsigmoid gating; block
+activations are ReLU ("RE") or Hardswish ("HS") per the paper tables.
+"""
+
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+           "mobilenet_v3_large"]
+
+
+def _make_divisible(v, divisor=8):
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def _act(name):
+    return nn.Hardswish() if name == "HS" else nn.ReLU()
+
+
+def _conv_bn(in_ch, out_ch, kernel, stride=1, groups=1, act="HS"):
+    layers = [nn.Conv2D(in_ch, out_ch, kernel, stride=stride,
+                        padding=(kernel - 1) // 2, groups=groups,
+                        bias_attr=False),
+              nn.BatchNorm2D(out_ch)]
+    if act is not None:
+        layers.append(_act(act))
+    return nn.Sequential(*layers)
+
+
+class SqueezeExcitation(nn.Layer):
+    def __init__(self, channels, squeeze_channels):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(channels, squeeze_channels, 1)
+        self.fc2 = nn.Conv2D(squeeze_channels, channels, 1)
+        self.relu = nn.ReLU()
+        self.gate = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.relu(self.fc1(self.pool(x)))
+        return x * self.gate(self.fc2(s))
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, in_ch, exp_ch, out_ch, kernel, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_ch == out_ch
+        layers = []
+        if exp_ch != in_ch:
+            layers.append(_conv_bn(in_ch, exp_ch, 1, act=act))
+        layers.append(_conv_bn(exp_ch, exp_ch, kernel, stride=stride,
+                               groups=exp_ch, act=act))
+        if use_se:
+            layers.append(SqueezeExcitation(exp_ch,
+                                            _make_divisible(exp_ch // 4)))
+        layers.append(_conv_bn(exp_ch, out_ch, 1, act=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+# (kernel, expanded, out, use_se, activation, stride)
+_LARGE = [
+    (3, 16, 16, False, "RE", 1), (3, 64, 24, False, "RE", 2),
+    (3, 72, 24, False, "RE", 1), (5, 72, 40, True, "RE", 2),
+    (5, 120, 40, True, "RE", 1), (5, 120, 40, True, "RE", 1),
+    (3, 240, 80, False, "HS", 2), (3, 200, 80, False, "HS", 1),
+    (3, 184, 80, False, "HS", 1), (3, 184, 80, False, "HS", 1),
+    (3, 480, 112, True, "HS", 1), (3, 672, 112, True, "HS", 1),
+    (5, 672, 160, True, "HS", 2), (5, 960, 160, True, "HS", 1),
+    (5, 960, 160, True, "HS", 1),
+]
+_SMALL = [
+    (3, 16, 16, True, "RE", 2), (3, 72, 24, False, "RE", 2),
+    (3, 88, 24, False, "RE", 1), (5, 96, 40, True, "HS", 2),
+    (5, 240, 40, True, "HS", 1), (5, 240, 40, True, "HS", 1),
+    (5, 120, 48, True, "HS", 1), (5, 144, 48, True, "HS", 1),
+    (5, 288, 96, True, "HS", 2), (5, 576, 96, True, "HS", 1),
+    (5, 576, 96, True, "HS", 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return _make_divisible(ch * scale)
+
+        in_ch = c(16)
+        layers = [_conv_bn(3, in_ch, 3, stride=2)]
+        for kernel, exp, out, se, act, stride in config:
+            layers.append(InvertedResidual(in_ch, c(exp), c(out), kernel,
+                                           stride, se, act))
+            in_ch = c(out)
+        last_conv = c(6 * config[-1][2])
+        layers.append(_conv_bn(in_ch, last_conv, 1))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_conv, last_channel), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape(x.shape[0], -1)
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, 1024, scale=scale, num_classes=num_classes,
+                         with_pool=with_pool)
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, 1280, scale=scale, num_classes=num_classes,
+                         with_pool=with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("no hub weights in this environment")
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("no hub weights in this environment")
+    return MobileNetV3Large(scale=scale, **kwargs)

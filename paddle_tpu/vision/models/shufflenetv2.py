@@ -1,0 +1,149 @@
+"""ShuffleNetV2 (parity: python/paddle/vision/models/shufflenetv2.py:195).
+
+Channel split + shuffle expressed as reshape/transpose — XLA folds these
+into the surrounding convolutions' layout assignments.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import nn
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+_STAGE_REPEATS = (4, 8, 4)
+_STAGE_CHANNELS = {
+    0.25: (24, 24, 48, 96, 512),
+    0.33: (24, 32, 64, 128, 512),
+    0.5: (24, 48, 96, 192, 1024),
+    1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024),
+    2.0: (24, 244, 488, 976, 2048),
+}
+
+
+def _act(name):
+    return nn.Swish() if name == "swish" else nn.ReLU()
+
+
+def _conv_bn(in_ch, out_ch, kernel, stride=1, groups=1, act="relu"):
+    layers = [nn.Conv2D(in_ch, out_ch, kernel, stride=stride,
+                        padding=(kernel - 1) // 2, groups=groups,
+                        bias_attr=False),
+              nn.BatchNorm2D(out_ch)]
+    if act is not None:
+        layers.append(_act(act))
+    return nn.Sequential(*layers)
+
+
+class InvertedResidual(nn.Layer):
+    """Stride-1 unit: split channels, transform one half, shuffle."""
+
+    def __init__(self, channels, act):
+        super().__init__()
+        half = channels // 2
+        self.branch = nn.Sequential(
+            _conv_bn(half, half, 1, act=act),
+            _conv_bn(half, half, 3, groups=half, act=None),
+            _conv_bn(half, half, 1, act=act))
+        self.shuffle = nn.ChannelShuffle(2)
+
+    def forward(self, x):
+        keep, work = jnp.split(x, 2, axis=1)
+        out = jnp.concatenate([keep, self.branch(work)], axis=1)
+        return self.shuffle(out)
+
+
+class InvertedResidualDS(nn.Layer):
+    """Stride-2 unit: both branches downsample, concat doubles width."""
+
+    def __init__(self, in_ch, out_ch, act):
+        super().__init__()
+        half = out_ch // 2
+        self.branch1 = nn.Sequential(
+            _conv_bn(in_ch, in_ch, 3, stride=2, groups=in_ch, act=None),
+            _conv_bn(in_ch, half, 1, act=act))
+        self.branch2 = nn.Sequential(
+            _conv_bn(in_ch, half, 1, act=act),
+            _conv_bn(half, half, 3, stride=2, groups=half, act=None),
+            _conv_bn(half, half, 1, act=act))
+        self.shuffle = nn.ChannelShuffle(2)
+
+    def forward(self, x):
+        out = jnp.concatenate([self.branch1(x), self.branch2(x)], axis=1)
+        return self.shuffle(out)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        if scale not in _STAGE_CHANNELS:
+            raise ValueError(
+                f"supported scales are {sorted(_STAGE_CHANNELS)}, got {scale}")
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        chans = _STAGE_CHANNELS[scale]
+
+        self.stem = nn.Sequential(_conv_bn(3, chans[0], 3, stride=2, act=act),
+                                  nn.MaxPool2D(3, stride=2, padding=1))
+        stages = []
+        in_ch = chans[0]
+        for stage, repeats in enumerate(_STAGE_REPEATS):
+            out_ch = chans[stage + 1]
+            stages.append(InvertedResidualDS(in_ch, out_ch, act))
+            stages += [InvertedResidual(out_ch, act)
+                       for _ in range(repeats - 1)]
+            in_ch = out_ch
+        self.stages = nn.Sequential(*stages)
+        self.last_conv = _conv_bn(in_ch, chans[-1], 1, act=act)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(chans[-1], num_classes)
+
+    def forward(self, x):
+        x = self.last_conv(self.stages(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape(x.shape[0], -1)
+            x = self.fc(x)
+        return x
+
+
+def _shufflenet(scale, pretrained, act="relu", **kwargs):
+    if pretrained:
+        raise NotImplementedError("no hub weights in this environment")
+    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(0.25, pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet(0.33, pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(0.5, pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet(1.0, pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(1.5, pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(2.0, pretrained, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet(1.0, pretrained, act="swish", **kwargs)

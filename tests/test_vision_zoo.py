@@ -1,0 +1,88 @@
+"""Vision zoo tail tests (parity: python/paddle/vision/models/
+{densenet,googlenet,inceptionv3,mobilenetv3,shufflenetv2}.py +
+test/legacy_test/test_vision_models.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.vision import models
+
+RNG = np.random.default_rng(7)
+
+
+def _n_params(model):
+    return sum(int(np.prod(p.shape)) for p in model.parameters())
+
+
+@pytest.mark.parametrize("factory,size,n_params", [
+    (models.densenet121, 64, 7_978_856),
+    (models.mobilenet_v3_small, 64, 2_542_856),
+    (models.mobilenet_v3_large, 64, 5_483_032),
+    (models.shufflenet_v2_x0_25, 64, 603_688),
+    (models.shufflenet_v2_x1_0, 64, 2_278_604),
+    (models.inception_v3, 80, 23_834_568),
+])
+def test_zoo_forward_shape_and_param_count(factory, size, n_params):
+    pt.seed(11)
+    model = factory()
+    model.eval()
+    x = RNG.standard_normal((2, 3, size, size)).astype(np.float32)
+    out = model(x)
+    assert out.shape == (2, 1000)
+    assert np.isfinite(np.asarray(out)).all()
+    assert _n_params(model) == n_params
+
+
+def test_densenet_variants_channel_arithmetic():
+    # growth-rate bookkeeping: final feature width must match the spec
+    for layers, want in [(121, 1024), (169, 1664), (201, 1920)]:
+        model = models.DenseNet(layers=layers, num_classes=0, with_pool=True)
+        assert model.out_channels == want
+
+
+def test_googlenet_returns_three_heads():
+    pt.seed(5)
+    model = models.googlenet(num_classes=10)
+    model.eval()
+    x = RNG.standard_normal((1, 3, 224, 224)).astype(np.float32)
+    main, aux1, aux2 = model(x)
+    assert main.shape == (1, 10)
+    assert aux1.shape == (1, 10)
+    assert aux2.shape == (1, 10)
+
+
+def test_shufflenet_channel_shuffle_mixes_branches():
+    # after one stride-1 unit, the passthrough half must interleave with
+    # the transformed half (shuffle property), not stay contiguous
+    from paddle_tpu.vision.models.shufflenetv2 import InvertedResidual
+    pt.seed(1)
+    unit = InvertedResidual(8, "relu")
+    unit.eval()
+    x = np.zeros((1, 8, 4, 4), np.float32)
+    x[:, :4] = 1.0  # mark the passthrough half
+    out = np.asarray(unit(x))
+    passthrough = (out == 1.0).all(axis=(0, 2, 3))
+    # shuffle with groups=2 interleaves: out channels 0,2,4,6 from keep-half
+    assert passthrough[[0, 2, 4, 6]].all()
+
+
+def test_mobilenetv3_scale_halves_width():
+    m_full = models.MobileNetV3Small(scale=1.0, num_classes=0,
+                                     with_pool=False)
+    m_half = models.MobileNetV3Small(scale=0.5, num_classes=0,
+                                     with_pool=False)
+    assert _n_params(m_half) < _n_params(m_full)
+
+
+def test_zoo_trains_one_step():
+    pt.seed(2)
+    model = models.shufflenet_v2_x0_25(num_classes=10)
+    opt = pt.optimizer.SGD(learning_rate=0.1, parameters=model)
+    loss_fn = pt.nn.CrossEntropyLoss()
+    step = pt.jit.TrainStep(model, opt, loss_fn, n_inputs=1)
+    x = RNG.standard_normal((2, 3, 64, 64)).astype(np.float32)
+    y = np.array([1, 3])
+    l0 = float(step(x, y))
+    l1 = float(step(x, y))
+    assert np.isfinite(l0) and np.isfinite(l1)
