@@ -5,6 +5,10 @@ LogMelSpectrogram, MFCC})."""
 from . import functional  # noqa: F401
 from .features import (LogMelSpectrogram, MelSpectrogram, MFCC,  # noqa: F401
                        Spectrogram)
+from . import backends  # noqa: E402,F401
+from . import datasets  # noqa: E402,F401
+from .backends import info, load, save  # noqa: E402,F401
 
 __all__ = ["functional", "Spectrogram", "MelSpectrogram",
-           "LogMelSpectrogram", "MFCC"]
+           "LogMelSpectrogram", "MFCC", "backends", "datasets", "info",
+           "load", "save"]

@@ -1,0 +1,131 @@
+"""Audio IO backends (parity: python/paddle/audio/backends/ —
+wave_backend.py info/load/save + init_backend.py backend selection).
+
+Only the stdlib ``wave`` backend ships (PCM16 WAV), same as the
+reference's default; soundfile-style backends register through
+``set_backend`` if a user supplies one.
+"""
+
+from __future__ import annotations
+
+import wave
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Union
+
+import numpy as np
+
+__all__ = ["AudioInfo", "info", "load", "save", "list_available_backends",
+           "get_current_backend", "set_backend"]
+
+
+@dataclass
+class AudioInfo:
+    """Parity: backend.py AudioInfo."""
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str
+
+
+_BACKENDS = {"wave_backend": None}  # name -> module or None (builtin)
+_current = "wave_backend"
+
+
+def list_available_backends():
+    return sorted(_BACKENDS)
+
+
+def get_current_backend() -> str:
+    return _current
+
+
+def set_backend(backend_name: str, module=None):
+    """Select the active IO backend. Third-party backends (objects with
+    info/load/save) register by passing ``module``."""
+    global _current
+    if module is not None:
+        _BACKENDS[backend_name] = module
+    if backend_name not in _BACKENDS:
+        raise NotImplementedError(
+            f"unknown audio backend {backend_name!r}; available: "
+            f"{list_available_backends()}")
+    _current = backend_name
+
+
+def _delegate(name):
+    mod = _BACKENDS[_current]
+    return getattr(mod, name) if mod is not None else None
+
+
+def info(filepath: str) -> AudioInfo:
+    ext = _delegate("info")
+    if ext is not None:
+        return ext(filepath)
+    with wave.open(str(filepath), "rb") as f:
+        return AudioInfo(sample_rate=f.getframerate(),
+                         num_samples=f.getnframes(),
+                         num_channels=f.getnchannels(),
+                         bits_per_sample=f.getsampwidth() * 8,
+                         encoding="PCM_S")
+
+
+def load(filepath: Union[str, Path], frame_offset: int = 0,
+         num_frames: int = -1, normalize: bool = True,
+         channels_first: bool = True):
+    """Returns (waveform, sample_rate); float32 in [-1, 1) when
+    ``normalize`` else raw int16-valued float32 (reference behavior)."""
+    ext = _delegate("load")
+    if ext is not None:
+        return ext(filepath, frame_offset, num_frames, normalize,
+                   channels_first)
+    try:
+        f = wave.open(str(filepath), "rb")
+    except wave.Error as e:
+        raise NotImplementedError(
+            "wave_backend only reads PCM16 WAV; install/register a "
+            "soundfile backend via set_backend for other formats") from e
+    with f:
+        channels = f.getnchannels()
+        sample_rate = f.getframerate()
+        raw = f.readframes(f.getnframes())
+    data = np.frombuffer(raw, dtype=np.int16).astype(np.float32)
+    if normalize:
+        data = data / 2.0 ** 15
+    data = data.reshape(-1, channels)
+    if num_frames != -1:
+        data = data[frame_offset:frame_offset + num_frames]
+    elif frame_offset:
+        data = data[frame_offset:]
+    # stays numpy: this is input-pipeline (host) territory — callers feed
+    # a padded/jitted step, which does the single host->device transfer
+    if channels_first:
+        data = data.T
+    return data, sample_rate
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         encoding=None, bits_per_sample=16):
+    """Writes PCM16 WAV. ``src`` is (channels, time) when channels_first."""
+    ext = _delegate("save")
+    if ext is not None:
+        return ext(filepath, src, sample_rate, channels_first, encoding,
+                   bits_per_sample)
+    if encoding not in (None, "PCM_S") or bits_per_sample != 16:
+        raise NotImplementedError("wave_backend writes PCM16 only")
+    a = np.asarray(src)
+    if a.ndim != 2:
+        raise ValueError("expected a 2D tensor")
+    if channels_first:
+        a = a.T  # -> (time, channels)
+    if a.dtype.kind == "f":
+        a = np.clip(a, -1.0, 1.0 - 1.0 / 2 ** 15)
+        a = (a * 2 ** 15).astype(np.int16)
+    else:
+        a = a.astype(np.int16)
+    with wave.open(str(filepath), "wb") as f:
+        f.setnchannels(a.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(a.tobytes())

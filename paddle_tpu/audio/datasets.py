@@ -1,0 +1,130 @@
+"""Audio datasets (parity: python/paddle/audio/datasets/ —
+AudioClassificationDataset base + ESC50/TESS).
+
+This environment has zero egress, so the download step raises with the
+official archive URL for the user to fetch; everything after (meta
+parsing, feature extraction, indexing) runs on a local copy.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+import numpy as np
+
+from ..io.dataset import Dataset
+from . import backends as _backends
+from .features import LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram
+
+__all__ = ["AudioClassificationDataset", "ESC50", "TESS"]
+
+_FEATURES = {"raw": None, "spectrogram": Spectrogram,
+             "melspectrogram": MelSpectrogram,
+             "logmelspectrogram": LogMelSpectrogram, "mfcc": MFCC}
+
+
+class AudioClassificationDataset(Dataset):
+    """Parity: datasets/dataset.py:29 — (waveform-or-feature, label)
+    pairs; ``feat_type`` selects an on-the-fly front-end."""
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=None,
+                 **feat_kwargs):
+        super().__init__()
+        if feat_type not in _FEATURES:
+            raise ValueError(
+                f"feat_type must be one of {sorted(_FEATURES)}")
+        self.files = list(files)
+        self.labels = list(labels)
+        self.feat_type = feat_type
+        self._sample_rate = sample_rate
+        self._feat_kwargs = feat_kwargs
+        self._extractors = {}  # keyed by sr: mixed-rate dirs get the
+        # right mel basis per file instead of the first file's
+
+    def _feature(self, waveform, sr):
+        if self.feat_type == "raw":
+            return waveform
+        if sr not in self._extractors:
+            kw = dict(self._feat_kwargs)
+            if self.feat_type != "spectrogram":
+                kw.setdefault("sr", sr)
+            self._extractors[sr] = _FEATURES[self.feat_type](**kw)
+        return self._extractors[sr](waveform)
+
+    def __getitem__(self, idx):
+        wavef, sr = _backends.load(self.files[idx], channels_first=False)
+        wavef = np.asarray(wavef).mean(axis=-1)  # mono
+        if self._sample_rate is not None and sr != self._sample_rate:
+            raise ValueError(
+                f"{self.files[idx]}: sample rate {sr} != expected "
+                f"{self._sample_rate}")
+        return self._feature(wavef[None, :], sr)[0], np.int64(
+            self.labels[idx])
+
+    def __len__(self):
+        return len(self.files)
+
+
+def _require_local(root, archive_url, name):
+    if root is None or not os.path.isdir(root):
+        raise RuntimeError(
+            f"{name} is not available locally (this environment has no "
+            f"network egress). Download {archive_url}, extract it, and "
+            f"pass data_dir=<extracted path>.")
+
+
+class ESC50(AudioClassificationDataset):
+    """Parity: datasets/esc50.py:26 — 50-class environmental sounds,
+    5-fold CV split by the ``fold`` meta column."""
+
+    archive = {"url": "https://github.com/karoldvl/ESC-50/archive/master.zip",
+               "md5": "70aba3bada37d2674b8f6cd5afd5f065"}
+    meta = os.path.join("ESC-50-master", "meta", "esc50.csv")
+    audio_dir = os.path.join("ESC-50-master", "audio")
+
+    def __init__(self, mode="train", split=1, feat_type="raw", data_dir=None,
+                 archive=None, **kwargs):
+        if archive is not None:
+            self.archive = archive
+        _require_local(data_dir, self.archive["url"], "ESC50")
+        files, labels = [], []
+        with open(os.path.join(data_dir, self.meta), newline="") as f:
+            for row in csv.DictReader(f):
+                in_split = int(row["fold"]) == int(split)
+                if (mode == "train") != in_split:  # train = other folds
+                    files.append(os.path.join(data_dir, self.audio_dir,
+                                              row["filename"]))
+                    labels.append(int(row["target"]))
+        super().__init__(files, labels, feat_type=feat_type, **kwargs)
+
+
+class TESS(AudioClassificationDataset):
+    """Parity: datasets/tess.py — 7-emotion speech; label is parsed from
+    the ``..._emotion.wav`` filename suffix; deterministic n_folds split."""
+
+    archive = {"url": ("https://zenodo.org/record/1188976/files/"
+                       "TESS_Toronto_emotional_speech_set.zip"),
+               "md5": "1465311b24d1de704c4c63e4ccc470c7"}
+    emotions = ("angry", "disgust", "fear", "happy", "neutral", "ps", "sad")
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 data_dir=None, archive=None, **kwargs):
+        if archive is not None:
+            self.archive = archive
+        _require_local(data_dir, self.archive["url"], "TESS")
+        all_files = sorted(
+            os.path.join(dirpath, fn)
+            for dirpath, _, fns in os.walk(data_dir)
+            for fn in fns if fn.endswith(".wav"))
+        files, labels = [], []
+        for i, path in enumerate(all_files):
+            emotion = os.path.splitext(os.path.basename(path))[0] \
+                .split("_")[-1].lower()
+            if emotion not in self.emotions:
+                continue
+            in_split = i % int(n_folds) == int(split) - 1
+            if (mode == "train") != in_split:
+                files.append(path)
+                labels.append(self.emotions.index(emotion))
+        super().__init__(files, labels, feat_type=feat_type, **kwargs)

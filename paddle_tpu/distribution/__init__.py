@@ -23,6 +23,7 @@ __all__ = [
     "Gamma", "Dirichlet", "Exponential", "Laplace", "LogNormal", "Gumbel",
     "Geometric", "Cauchy", "StudentT", "Poisson", "Binomial", "Multinomial",
     "ContinuousBernoulli", "ExponentialFamily", "Independent",
+    "MultivariateNormal",
     "TransformedDistribution", "kl_divergence", "register_kl",
     "Transform", "AffineTransform", "ExpTransform", "SigmoidTransform",
     "TanhTransform", "AbsTransform", "PowerTransform", "ChainTransform",
@@ -754,3 +755,120 @@ def _kl_gamma_gamma(p, q):
             - gammaln(p.concentration) + gammaln(q.concentration)
             + q.concentration * (jnp.log(p.rate) - jnp.log(q.rate))
             + p.concentration * (q.rate / p.rate - 1))
+
+
+class MultivariateNormal(Distribution):
+    """Parity: distribution/multivariate_normal.py:22 — parameterized by
+    exactly one of covariance_matrix / precision_matrix / scale_tril.
+    Internally everything reduces to the Cholesky factor L (Sigma = L L^T):
+    sampling is loc + L @ eps and log_prob uses a triangular solve, so no
+    explicit inverse or determinant is ever formed."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        given = [covariance_matrix is not None, precision_matrix is not None,
+                 scale_tril is not None]
+        if sum(given) != 1:
+            raise ValueError(
+                "pass exactly one of covariance_matrix, precision_matrix, "
+                "scale_tril")
+        self.loc = jnp.atleast_1d(jnp.asarray(loc, jnp.float32))
+        k = self.loc.shape[-1]
+        if scale_tril is not None:
+            self._scale_tril = jnp.asarray(scale_tril, jnp.float32)
+        elif covariance_matrix is not None:
+            cov = jnp.asarray(covariance_matrix, jnp.float32)
+            self._scale_tril = jnp.linalg.cholesky(cov)
+        else:
+            prec = jnp.asarray(precision_matrix, jnp.float32)
+            # Sigma = P^-1; chol(P) = Lp  =>  L = (Lp^-T) up to a rotation —
+            # solve Lp^T L = I for a true lower-triangular factor of Sigma
+            lp = jnp.linalg.cholesky(prec)
+            eye = jnp.broadcast_to(jnp.eye(k, dtype=jnp.float32), lp.shape)
+            linv = jax.scipy.linalg.solve_triangular(lp, eye, lower=True)
+            # Sigma = Lp^-T Lp^-1 = (linv^T)(linv); re-cholesky for lower form
+            self._scale_tril = jnp.linalg.cholesky(
+                jnp.swapaxes(linv, -1, -2) @ linv)
+        if self._scale_tril.shape[-1] != k:
+            raise ValueError("matrix event size must match loc")
+        batch = jnp.broadcast_shapes(self.loc.shape[:-1],
+                                     self._scale_tril.shape[:-2])
+        super().__init__(batch, (k,))
+
+    @property
+    def scale_tril(self):
+        return self._scale_tril
+
+    @property
+    def covariance_matrix(self):
+        return self._scale_tril @ jnp.swapaxes(self._scale_tril, -1, -2)
+
+    @property
+    def precision_matrix(self):
+        k = self.loc.shape[-1]
+        eye = jnp.broadcast_to(jnp.eye(k, dtype=jnp.float32),
+                               self._scale_tril.shape)
+        linv = jax.scipy.linalg.solve_triangular(self._scale_tril, eye,
+                                                 lower=True)
+        return jnp.swapaxes(linv, -1, -2) @ linv
+
+    @property
+    def mean(self):
+        return jnp.broadcast_to(self.loc, self.batch_shape + self.event_shape)
+
+    @property
+    def variance(self):
+        v = jnp.sum(self._scale_tril ** 2, axis=-1)
+        return jnp.broadcast_to(v, self.batch_shape + self.event_shape)
+
+    def rsample(self, shape=(), key=None):
+        s = _shape(shape, self.batch_shape) + self.event_shape
+        eps = jax.random.normal(_key(key), s)
+        return self.loc + jnp.einsum("...ij,...j->...i", self._scale_tril, eps)
+
+    def log_prob(self, value):
+        diff = jnp.asarray(value, jnp.float32) - self.loc
+        # solve L z = diff  =>  z = L^-1 diff; |z|^2 = Mahalanobis distance
+        # (solve_triangular wants matching batch ranks — broadcast first)
+        bshape = jnp.broadcast_shapes(diff.shape[:-1],
+                                      self._scale_tril.shape[:-2])
+        tril = jnp.broadcast_to(self._scale_tril,
+                                bshape + self._scale_tril.shape[-2:])
+        diff = jnp.broadcast_to(diff, bshape + diff.shape[-1:])
+        z = jax.scipy.linalg.solve_triangular(
+            tril, diff[..., None], lower=True)[..., 0]
+        k = self.loc.shape[-1]
+        half_logdet = jnp.sum(
+            jnp.log(jnp.diagonal(self._scale_tril, axis1=-2, axis2=-1)),
+            axis=-1)
+        return (-0.5 * jnp.sum(z ** 2, axis=-1) - half_logdet
+                - 0.5 * k * math.log(2 * math.pi))
+
+    def entropy(self):
+        k = self.loc.shape[-1]
+        half_logdet = jnp.sum(
+            jnp.log(jnp.diagonal(self._scale_tril, axis1=-2, axis2=-1)),
+            axis=-1)
+        return jnp.broadcast_to(
+            0.5 * k * (1 + math.log(2 * math.pi)) + half_logdet,
+            self.batch_shape)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn_mvn(p, q):
+    # 0.5 * (tr(Sq^-1 Sp) + m^T Sq^-1 m - k + logdet(Sq) - logdet(Sp))
+    k = p.loc.shape[-1]
+    lq, lp = q.scale_tril, p.scale_tril
+    diff = q.loc - p.loc
+    bshape = jnp.broadcast_shapes(diff.shape[:-1], lq.shape[:-2],
+                                  lp.shape[:-2])
+    lq = jnp.broadcast_to(lq, bshape + lq.shape[-2:])
+    lp = jnp.broadcast_to(lp, bshape + lp.shape[-2:])
+    diff = jnp.broadcast_to(diff, bshape + diff.shape[-1:])
+    m = jax.scipy.linalg.solve_triangular(
+        lq, diff[..., None], lower=True)[..., 0]
+    a = jax.scipy.linalg.solve_triangular(lq, lp, lower=True)
+    tr = jnp.sum(a ** 2, axis=(-2, -1))
+    logdet_q = jnp.sum(jnp.log(jnp.diagonal(lq, axis1=-2, axis2=-1)), axis=-1)
+    logdet_p = jnp.sum(jnp.log(jnp.diagonal(lp, axis1=-2, axis2=-1)), axis=-1)
+    return 0.5 * (tr + jnp.sum(m ** 2, axis=-1) - k) + logdet_q - logdet_p

@@ -11,7 +11,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
-           "segment_mean", "segment_max", "segment_min"]
+           "segment_mean", "segment_max", "segment_min", "reindex_graph",
+           "sample_neighbors", "weighted_sample_neighbors"]
 
 _REDUCERS = {
     "sum": jax.ops.segment_sum,
@@ -110,3 +111,116 @@ def segment_min(data, segment_ids, num_segments=None, name=None):
     n = _num_segments(segment_ids, num_segments)
     return _segment_reduce(jnp.asarray(data), jnp.asarray(segment_ids),
                            "min", n)
+
+
+# ---------------- host-side graph preprocessing ----------------
+# reindex/sampling produce data-dependent output shapes, so on TPU they
+# belong in the input pipeline (host), not under jit — same placement as
+# the reference's CPU kernels (phi/kernels/cpu/graph_reindex_kernel.cc,
+# graph_sample_neighbors_kernel.cc). Implemented over numpy; outputs are
+# numpy arrays ready to feed a padded/jitted compute step.
+
+import numpy as _np
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Parity: geometric/reindex.py reindex_graph. Renumbers ``x`` (the
+    sampled center nodes, required unique) to 0..len(x)-1 and their
+    ``neighbors`` to compact ids after them, first-appearance order.
+    Returns (reindex_src, reindex_dst, out_nodes). The hashtable buffer
+    args are accepted for API parity and ignored (GPU-only hint in the
+    reference)."""
+    x = _np.asarray(x)
+    neighbors = _np.asarray(neighbors)
+    count = _np.asarray(count)
+    if count.sum() != neighbors.shape[0]:
+        raise ValueError("count must sum to len(neighbors)")
+    mapping: dict = {}
+    for n in x.tolist():
+        if n in mapping:
+            raise ValueError("nodes in x must be unique")
+        mapping[n] = len(mapping)
+    src = _np.empty(neighbors.shape[0], dtype=x.dtype)
+    for i, n in enumerate(neighbors.tolist()):
+        j = mapping.get(n)
+        if j is None:
+            j = mapping[n] = len(mapping)
+        src[i] = j
+    dst = _np.repeat(_np.arange(len(x), dtype=x.dtype), count)
+    out_nodes = _np.fromiter(mapping.keys(), dtype=x.dtype,
+                             count=len(mapping))
+    return src, dst, out_nodes
+
+
+def _sample_one(rng, neigh, eid, weight, sample_size):
+    if sample_size < 0 or neigh.shape[0] <= sample_size:
+        return neigh, eid
+    if sample_size == 0:
+        return neigh[:0], (None if eid is None else eid[:0])
+    if weight is None:
+        idx = rng.choice(neigh.shape[0], size=sample_size, replace=False)
+    else:
+        # weighted sampling WITHOUT replacement = Efraimidis-Spirakis keys
+        # (the reference GPU kernel's algorithm, weighted_sample_funcs.h)
+        keys = rng.random(neigh.shape[0]) ** (1.0 / _np.maximum(weight, 1e-38))
+        idx = _np.argsort(keys)[-sample_size:]
+    return neigh[idx], (None if eid is None else eid[idx])
+
+
+def _sample_neighbors_impl(row, colptr, input_nodes, sample_size, eids,
+                           return_eids, weight):
+    row = _np.asarray(row).reshape(-1)
+    colptr = _np.asarray(colptr).reshape(-1)
+    nodes = _np.asarray(input_nodes).reshape(-1)
+    eids_arr = None if eids is None else _np.asarray(eids).reshape(-1)
+    w_arr = None if weight is None else _np.asarray(weight).reshape(-1)
+    rng = _np.random.default_rng(int(_np.asarray(_rng_seed())) & 0x7FFFFFFF)
+    outs, out_eids, counts = [], [], []
+    for n in nodes.tolist():
+        lo, hi = int(colptr[n]), int(colptr[n + 1])
+        neigh = row[lo:hi]
+        eid = None if eids_arr is None else eids_arr[lo:hi]
+        w = None if w_arr is None else w_arr[lo:hi]
+        picked, picked_eid = _sample_one(rng, neigh, eid, w, sample_size)
+        outs.append(picked)
+        counts.append(picked.shape[0])
+        if picked_eid is not None:
+            out_eids.append(picked_eid)
+    out = _np.concatenate(outs) if outs else _np.empty(0, row.dtype)
+    cnt = _np.asarray(counts, dtype=_np.int32)
+    if return_eids:
+        oe = (_np.concatenate(out_eids) if out_eids
+              else _np.empty(0, row.dtype))
+        return out, cnt, oe
+    return out, cnt
+
+
+def _rng_seed():
+    """Fold the framework RNG stream into a host seed so sampling follows
+    paddle_tpu.seed() like every other random op."""
+    from .core import rng as _rng
+    return jax.random.randint(_rng.next_key(), (), 0, 2**31 - 1)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Parity: geometric/sampling/neighbors.py:23 — uniform neighbor
+    sampling over a CSC graph (row, colptr). Returns (out_neighbors,
+    out_count[, out_eids]). ``perm_buffer`` (GPU fisher-yates hint) is
+    accepted and ignored."""
+    if return_eids and eids is None:
+        raise ValueError("`eids` should not be None if `return_eids` is True.")
+    return _sample_neighbors_impl(row, colptr, input_nodes, int(sample_size),
+                                  eids, return_eids, None)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Parity: geometric/sampling/neighbors.py:172 — weight-proportional
+    sampling without replacement (Efraimidis–Spirakis exponential keys)."""
+    if return_eids and eids is None:
+        raise ValueError("`eids` should not be None if `return_eids` is True.")
+    return _sample_neighbors_impl(row, colptr, input_nodes, int(sample_size),
+                                  eids, return_eids, edge_weight)

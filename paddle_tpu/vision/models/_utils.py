@@ -1,0 +1,27 @@
+"""Shared building blocks for the vision zoo (parity:
+python/paddle/vision/models/_utils.py)."""
+
+from __future__ import annotations
+
+from ... import nn
+
+_ACTS = {"relu": nn.ReLU, "RE": nn.ReLU, "relu6": nn.ReLU6,
+         "hardswish": nn.Hardswish, "HS": nn.Hardswish, "swish": nn.Swish}
+
+
+def conv_bn(in_ch, out_ch, kernel, stride=1, padding="same", groups=1,
+            act="relu"):
+    """Conv2D(bias-free) + BatchNorm2D + optional activation — the stem
+    block every zoo model composes. ``padding="same"`` resolves to
+    (k-1)//2 per spatial dim; ``act=None`` omits the nonlinearity."""
+    if padding == "same":
+        if isinstance(kernel, (tuple, list)):
+            padding = tuple((k - 1) // 2 for k in kernel)
+        else:
+            padding = (kernel - 1) // 2
+    layers = [nn.Conv2D(in_ch, out_ch, kernel, stride=stride,
+                        padding=padding, groups=groups, bias_attr=False),
+              nn.BatchNorm2D(out_ch)]
+    if act is not None:
+        layers.append(_ACTS[act]())
+    return nn.Sequential(*layers)

@@ -8,16 +8,15 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+import functools
+
 from ... import nn
+from ._utils import conv_bn
 
 __all__ = ["InceptionV3", "inception_v3"]
 
-
-def _conv_bn(in_ch, out_ch, kernel, stride=1, padding=0):
-    return nn.Sequential(
-        nn.Conv2D(in_ch, out_ch, kernel, stride=stride, padding=padding,
-                  bias_attr=False),
-        nn.BatchNorm2D(out_ch), nn.ReLU())
+# inception convs are VALID (padding 0) unless a branch says otherwise
+_conv_bn = functools.partial(conv_bn, padding=0, act="relu")
 
 
 class InceptionStem(nn.Layer):

@@ -3,21 +3,14 @@ mobilenetv1.py, mobilenetv2.py)."""
 
 from __future__ import annotations
 
+import functools
+
 from ... import nn
+from ._utils import conv_bn
 
 __all__ = ["MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2"]
 
-
-def _conv_bn(in_ch, out_ch, kernel, stride=1, padding=0, groups=1,
-             act="relu6"):
-    layers = [nn.Conv2D(in_ch, out_ch, kernel, stride=stride,
-                        padding=padding, groups=groups, bias_attr=False),
-              nn.BatchNorm2D(out_ch)]
-    if act == "relu6":
-        layers.append(nn.ReLU6())
-    elif act == "relu":
-        layers.append(nn.ReLU())
-    return nn.Sequential(*layers)
+_conv_bn = functools.partial(conv_bn, act="relu6")
 
 
 class MobileNetV1(nn.Layer):

@@ -5,7 +5,10 @@ activations are ReLU ("RE") or Hardswish ("HS") per the paper tables.
 
 from __future__ import annotations
 
+import functools
+
 from ... import nn
+from ._utils import conv_bn
 
 __all__ = ["MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
            "mobilenet_v3_large"]
@@ -18,18 +21,7 @@ def _make_divisible(v, divisor=8):
     return new_v
 
 
-def _act(name):
-    return nn.Hardswish() if name == "HS" else nn.ReLU()
-
-
-def _conv_bn(in_ch, out_ch, kernel, stride=1, groups=1, act="HS"):
-    layers = [nn.Conv2D(in_ch, out_ch, kernel, stride=stride,
-                        padding=(kernel - 1) // 2, groups=groups,
-                        bias_attr=False),
-              nn.BatchNorm2D(out_ch)]
-    if act is not None:
-        layers.append(_act(act))
-    return nn.Sequential(*layers)
+_conv_bn = functools.partial(conv_bn, act="HS")
 
 
 class SqueezeExcitation(nn.Layer):

@@ -25,18 +25,7 @@ _STAGE_CHANNELS = {
 }
 
 
-def _act(name):
-    return nn.Swish() if name == "swish" else nn.ReLU()
-
-
-def _conv_bn(in_ch, out_ch, kernel, stride=1, groups=1, act="relu"):
-    layers = [nn.Conv2D(in_ch, out_ch, kernel, stride=stride,
-                        padding=(kernel - 1) // 2, groups=groups,
-                        bias_attr=False),
-              nn.BatchNorm2D(out_ch)]
-    if act is not None:
-        layers.append(_act(act))
-    return nn.Sequential(*layers)
+from ._utils import conv_bn as _conv_bn
 
 
 class InvertedResidual(nn.Layer):

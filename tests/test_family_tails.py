@@ -1,0 +1,219 @@
+"""Family tails from VERDICT r2 item 8: MultivariateNormal, geometric
+reindex/sampling, audio backends + datasets (parity:
+distribution/multivariate_normal.py, geometric/reindex.py,
+geometric/sampling/neighbors.py, audio/backends/wave_backend.py,
+audio/datasets/)."""
+
+import csv
+import os
+
+import numpy as np
+import pytest
+import scipy.stats
+
+import paddle_tpu as pt
+from paddle_tpu import audio, geometric
+from paddle_tpu.distribution import MultivariateNormal, kl_divergence
+
+RNG = np.random.default_rng(0)
+
+
+# ---------------- MultivariateNormal ----------------
+
+def _random_spd(k, rng):
+    a = rng.standard_normal((k, k))
+    return a @ a.T + k * np.eye(k)
+
+
+def test_mvn_log_prob_entropy_match_scipy():
+    k = 4
+    cov = _random_spd(k, RNG)
+    loc = RNG.standard_normal(k)
+    rv = MultivariateNormal(loc=loc.astype(np.float32),
+                            covariance_matrix=cov.astype(np.float32))
+    ref = scipy.stats.multivariate_normal(loc, cov)
+    x = RNG.standard_normal((5, k)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(rv.log_prob(x)), ref.logpdf(x),
+                               rtol=1e-4)
+    np.testing.assert_allclose(float(rv.entropy()), ref.entropy(), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(rv.covariance_matrix), cov,
+                               rtol=1e-4)
+
+
+def test_mvn_three_parameterizations_agree():
+    k = 3
+    cov = _random_spd(k, RNG).astype(np.float32)
+    loc = np.zeros(k, np.float32)
+    a = MultivariateNormal(loc, covariance_matrix=cov)
+    b = MultivariateNormal(loc, scale_tril=np.linalg.cholesky(cov))
+    c = MultivariateNormal(loc, precision_matrix=np.linalg.inv(cov))
+    x = RNG.standard_normal((4, k)).astype(np.float32)
+    for other in (b, c):
+        np.testing.assert_allclose(np.asarray(a.log_prob(x)),
+                                   np.asarray(other.log_prob(x)), rtol=1e-3,
+                                   atol=1e-4)
+
+
+def test_mvn_sample_moments_and_kl():
+    k = 2
+    cov = np.array([[2.0, 1.0], [1.0, 2.0]], np.float32)
+    rv = MultivariateNormal(np.array([2.0, 5.0], np.float32),
+                            covariance_matrix=cov)
+    pt.seed(0)
+    s = np.asarray(rv.sample((8000,)))
+    assert s.shape == (8000, 2)
+    np.testing.assert_allclose(s.mean(0), [2.0, 5.0], atol=0.1)
+    np.testing.assert_allclose(np.cov(s.T), cov, atol=0.2)
+    # KL(p, p) == 0; KL vs shifted mean = 0.5 m^T Sigma^-1 m
+    assert abs(float(kl_divergence(rv, rv))) < 1e-5
+    rv2 = MultivariateNormal(np.array([3.0, 5.0], np.float32),
+                             covariance_matrix=cov)
+    m = np.array([1.0, 0.0])
+    want = 0.5 * m @ np.linalg.inv(cov) @ m
+    np.testing.assert_allclose(float(kl_divergence(rv, rv2)), want,
+                               rtol=1e-4)
+
+
+def test_mvn_rejects_bad_args():
+    with pytest.raises(ValueError):
+        MultivariateNormal([0.0, 0.0])
+    with pytest.raises(ValueError):
+        MultivariateNormal([0.0, 0.0], covariance_matrix=np.eye(2),
+                           scale_tril=np.eye(2))
+
+
+# ---------------- geometric graph preprocessing ----------------
+
+def test_reindex_graph_reference_example():
+    # the exact example documented at geometric/reindex.py reindex_graph
+    src, dst, nodes = geometric.reindex_graph([0, 1, 2], [8, 9, 0, 4, 7, 6, 7],
+                                              [2, 3, 2])
+    assert src.tolist() == [3, 4, 0, 5, 6, 7, 6]
+    assert dst.tolist() == [0, 0, 1, 1, 1, 2, 2]
+    assert nodes.tolist() == [0, 1, 2, 8, 9, 4, 7, 6]
+
+
+def test_reindex_graph_rejects_duplicates_and_bad_count():
+    with pytest.raises(ValueError):
+        geometric.reindex_graph([0, 0], [1, 2], [1, 1])
+    with pytest.raises(ValueError):
+        geometric.reindex_graph([0, 1], [1, 2, 3], [1, 1])
+
+
+ROW = np.array([3, 7, 0, 9, 1, 4, 2, 9, 3, 9, 1, 9, 7])
+COLPTR = np.array([0, 2, 4, 5, 6, 7, 9, 11, 11, 13, 13])
+
+
+def test_sample_neighbors_counts_and_membership():
+    pt.seed(4)
+    out, cnt = geometric.sample_neighbors(ROW, COLPTR, [0, 8, 1, 2],
+                                          sample_size=2)
+    assert cnt.tolist() == [2, 2, 2, 1]  # node 2 has a single neighbor
+    # every sampled neighbor must come from the node's CSC slice
+    off = 0
+    for node, c in zip([0, 8, 1, 2], cnt.tolist()):
+        allowed = set(ROW[COLPTR[node]:COLPTR[node + 1]].tolist())
+        assert set(out[off:off + c].tolist()) <= allowed
+        off += c
+    # sample_size=-1 returns everything
+    out_all, cnt_all = geometric.sample_neighbors(ROW, COLPTR, [0, 1],
+                                                  sample_size=-1)
+    assert cnt_all.tolist() == [2, 2]
+
+
+def test_sample_neighbors_eids_track_picks():
+    pt.seed(9)
+    eids = np.arange(len(ROW)) + 100
+    out, cnt, oe = geometric.sample_neighbors(ROW, COLPTR, [0, 6],
+                                              sample_size=1, eids=eids,
+                                              return_eids=True)
+    # each returned eid must point at the returned neighbor
+    for nb, e in zip(out.tolist(), oe.tolist()):
+        assert ROW[e - 100] == nb
+    with pytest.raises(ValueError):
+        geometric.sample_neighbors(ROW, COLPTR, [0], return_eids=True)
+
+
+def test_weighted_sample_neighbors_respects_weights():
+    # one neighbor has overwhelming weight -> it is (almost) always picked
+    pt.seed(1)
+    row = np.array([0, 1, 2, 3])
+    colptr = np.array([0, 4])
+    w = np.array([1e-6, 1e-6, 1e6, 1e-6])
+    hits = 0
+    for _ in range(20):
+        out, cnt = geometric.weighted_sample_neighbors(row, colptr, w, [0],
+                                                       sample_size=1)
+        hits += int(out[0] == 2)
+    assert hits >= 19
+    # sample_size=0 returns nothing (uniform and weighted agree)
+    out0, cnt0 = geometric.weighted_sample_neighbors(row, colptr, w, [0],
+                                                     sample_size=0)
+    assert len(out0) == 0 and cnt0.tolist() == [0]
+    out0u, cnt0u = geometric.sample_neighbors(row, colptr, [0], sample_size=0)
+    assert len(out0u) == 0 and cnt0u.tolist() == [0]
+
+
+# ---------------- audio backends + datasets ----------------
+
+def _write_wav(path, sr=16000, seconds=0.05, channels=1, freq=440.0):
+    t = np.arange(int(sr * seconds)) / sr
+    wav = 0.4 * np.sin(2 * np.pi * freq * t).astype(np.float32)
+    wav = np.tile(wav[None, :], (channels, 1))
+    audio.save(str(path), wav, sr)
+    return wav
+
+
+def test_wave_backend_save_load_info_roundtrip(tmp_path):
+    p = tmp_path / "t.wav"
+    wav = _write_wav(p, channels=2)
+    meta = audio.info(str(p))
+    assert (meta.sample_rate, meta.num_channels) == (16000, 2)
+    assert meta.bits_per_sample == 16
+    got, sr = audio.load(str(p))
+    assert sr == 16000
+    assert got.shape == wav.shape
+    np.testing.assert_allclose(np.asarray(got), wav, atol=2 / 2 ** 15)
+    # frame windowing + channels_last
+    got2, _ = audio.load(str(p), frame_offset=10, num_frames=20,
+                         channels_first=False)
+    assert got2.shape == (20, 2)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(got).T[10:30],
+                               atol=1e-7)
+
+
+def test_backend_selection():
+    assert audio.backends.get_current_backend() == "wave_backend"
+    assert "wave_backend" in audio.backends.list_available_backends()
+    with pytest.raises(NotImplementedError):
+        audio.backends.set_backend("nonexistent")
+
+
+def test_esc50_local_meta_and_features(tmp_path):
+    # fabricate a tiny local ESC-50 layout
+    root = tmp_path
+    audio_dir = root / "ESC-50-master" / "audio"
+    meta_dir = root / "ESC-50-master" / "meta"
+    os.makedirs(audio_dir)
+    os.makedirs(meta_dir)
+    rows = [("a.wav", 1, 0), ("b.wav", 1, 3), ("c.wav", 2, 7)]
+    with open(meta_dir / "esc50.csv", "w", newline="") as f:
+        wr = csv.writer(f)
+        wr.writerow(["filename", "fold", "target"])
+        for fn, fold, tgt in rows:
+            wr.writerow([fn, fold, tgt])
+            _write_wav(audio_dir / fn, seconds=0.1)
+    train = audio.datasets.ESC50(mode="train", split=1, data_dir=str(root))
+    dev = audio.datasets.ESC50(mode="dev", split=1, data_dir=str(root))
+    assert len(train) == 1 and len(dev) == 2  # fold 1 held out of train
+    x, y = train[0]
+    assert int(y) == 7 and x.ndim == 1
+    feat = audio.datasets.ESC50(mode="dev", split=1, data_dir=str(root),
+                                feat_type="mfcc", n_mfcc=13, n_fft=256)
+    fx, fy = feat[0]
+    assert fx.shape[0] == 13 and int(fy) == 0
+
+
+def test_esc50_without_data_dir_names_the_archive():
+    with pytest.raises(RuntimeError, match="ESC-50"):
+        audio.datasets.ESC50(data_dir=None)
