@@ -89,15 +89,16 @@ def load(filepath: Union[str, Path], frame_offset: int = 0,
     with f:
         channels = f.getnchannels()
         sample_rate = f.getframerate()
-        raw = f.readframes(f.getnframes())
+        # decode only the requested window — long recordings are read per
+        # slice in windowed datasets, not whole-file
+        if frame_offset:
+            f.setpos(min(frame_offset, f.getnframes()))
+        want = f.getnframes() if num_frames == -1 else num_frames
+        raw = f.readframes(want)
     data = np.frombuffer(raw, dtype=np.int16).astype(np.float32)
     if normalize:
         data = data / 2.0 ** 15
     data = data.reshape(-1, channels)
-    if num_frames != -1:
-        data = data[frame_offset:frame_offset + num_frames]
-    elif frame_offset:
-        data = data[frame_offset:]
     # stays numpy: this is input-pipeline (host) territory — callers feed
     # a padded/jitted step, which does the single host->device transfer
     if channels_first:

@@ -85,6 +85,8 @@ class ESC50(AudioClassificationDataset):
 
     def __init__(self, mode="train", split=1, feat_type="raw", data_dir=None,
                  archive=None, **kwargs):
+        if mode not in ("train", "dev"):
+            raise ValueError(f"mode must be 'train' or 'dev', got {mode!r}")
         if archive is not None:
             self.archive = archive
         _require_local(data_dir, self.archive["url"], "ESC50")
@@ -110,6 +112,8 @@ class TESS(AudioClassificationDataset):
 
     def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
                  data_dir=None, archive=None, **kwargs):
+        if mode not in ("train", "dev"):
+            raise ValueError(f"mode must be 'train' or 'dev', got {mode!r}")
         if archive is not None:
             self.archive = archive
         _require_local(data_dir, self.archive["url"], "TESS")

@@ -94,29 +94,113 @@ def _build_rank_payload(state_dict: dict, fname: str):
     return meta, payload
 
 
+def _write_rank_files(path: str, rank: int, meta, payload) -> None:
+    np.savez(os.path.join(path, f"{rank}.distcp.npz"), **payload)
+    with open(os.path.join(path, f"{rank}.meta.pkl"), "wb") as f:
+        pickle.dump(meta, f)
+
+
+def _merge_metadata(path: str, nprocs: int, seq: int | None = None) -> None:
+    """Coordinator: merge per-rank metadata pieces into the global
+    ``metadata.pkl`` (written atomically via rename so a reader never
+    sees a partial file), then clean the pieces up — removing the done
+    markers LAST, since non-coordinator async ranks treat their marker's
+    disappearance as 'merge published'."""
+    merged = Metadata()
+    for r in range(nprocs):
+        with open(os.path.join(path, f"{r}.meta.pkl"), "rb") as f:
+            piece: Metadata = pickle.load(f)
+        merged.global_shapes.update(piece.global_shapes)
+        for li, file in piece.storage_metadata.items():
+            # replicated shards may be written by several ranks; first wins
+            merged.storage_metadata.setdefault(li, file)
+        for key, shard_metas in piece.state_dict_metadata.items():
+            have = {sm.global_offset
+                    for sm in merged.state_dict_metadata.get(key, [])}
+            merged.state_dict_metadata.setdefault(key, []).extend(
+                sm for sm in shard_metas if sm.global_offset not in have)
+    tmp = os.path.join(path, "metadata.pkl.tmp")
+    with open(tmp, "wb") as f:
+        pickle.dump(merged, f)
+    os.replace(tmp, os.path.join(path, "metadata.pkl"))
+    for r in range(nprocs):
+        os.remove(os.path.join(path, f"{r}.meta.pkl"))
+    if seq is not None:
+        for r in range(nprocs):
+            done = os.path.join(path, _done_name(r, seq))
+            if os.path.exists(done):
+                os.remove(done)
+
+
+# per-path async save sequence: every rank of an SPMD program calls save
+# the same number of times, so the counter is a shared round id without
+# any cross-process coordination — markers from an earlier round (or a
+# previous timed-out attempt within this process) can never satisfy this
+# round's wait. Cross-RESTART staleness is handled by each rank clearing
+# its own old markers on entry; jobs that crash mid-save should resume
+# into a fresh step directory (the ElasticManager step_N convention).
+_SAVE_SEQ: dict[str, int] = {}
+
+
+def _done_name(rank: int, seq: int) -> str:
+    return f"{rank}.done.{seq}"
+
+
+def _wait_marker(predicate, what: str, timeout: float) -> None:
+    import time
+    deadline = time.monotonic() + timeout
+    while not predicate():
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"async checkpoint: timed out after {timeout}s waiting for "
+                f"{what}")
+        time.sleep(0.02)
+
+
 def save_state_dict(state_dict: dict, path: str, process_group=None,
-                    coordinator_rank: int = 0, async_save: bool = False):
+                    coordinator_rank: int = 0, async_save: bool = False,
+                    async_timeout: float = 600.0):
     """Write a sharded checkpoint. With ``async_save=True``, device→host
-    shard transfer happens now but file IO + metadata write run in a
+    shard transfer happens now but file IO + metadata merge run in a
     background thread; returns an AsyncSaveHandle (call .result() before
-    relying on the files). Single-process only for async (multi-process
-    coordination uses the synchronous path's barriers)."""
+    relying on the files). Multi-process async coordinates through done-
+    marker files polled by the coordinator's writer thread — no device
+    collectives off the main thread."""
+    os.makedirs(path, exist_ok=True)
+    rank = jax.process_index()
+    nprocs = jax.process_count()
+    meta, payload = _build_rank_payload(state_dict, f"{rank}.distcp.npz")
     if async_save:
+        import glob
         import threading
-        if jax.process_count() > 1:
-            raise NotImplementedError(
-                "async_save is single-process; multi-process saves "
-                "coordinate through barriers and must be synchronous")
-        os.makedirs(path, exist_ok=True)
-        fname = f"{jax.process_index()}.distcp.npz"
-        meta, payload = _build_rank_payload(state_dict, fname)
+        seq = _SAVE_SEQ[path] = _SAVE_SEQ.get(path, 0) + 1
+        # clear ALL of this rank's markers (leftovers of a previous process
+        # restarted into the same dir, or of a timed-out round) so none can
+        # masquerade as this round's; work() recreates ours after the write
+        for stale in glob.glob(os.path.join(path, _done_name(rank, "*"))):
+            os.remove(stale)
         err_cell = [None]
 
         def work():
             try:
-                np.savez(os.path.join(path, fname), **payload)
-                with open(os.path.join(path, "metadata.pkl"), "wb") as f:
-                    pickle.dump(meta, f)
+                _write_rank_files(path, rank, meta, payload)
+                mine = os.path.join(path, _done_name(rank, seq))
+                with open(mine, "w"):
+                    pass
+                if rank == coordinator_rank:
+                    _wait_marker(
+                        lambda: all(os.path.exists(
+                            os.path.join(path, _done_name(r, seq)))
+                            for r in range(nprocs)),
+                        f"all ranks' round-{seq} markers under {path!r}",
+                        async_timeout)
+                    _merge_metadata(path, nprocs, seq=seq)
+                elif nprocs > 1:
+                    # merge consumed my marker => metadata.pkl is published;
+                    # makes .result() mean 'checkpoint readable' on every rank
+                    _wait_marker(lambda: not os.path.exists(mine),
+                                 f"coordinator merge of round {seq} under "
+                                 f"{path!r}", async_timeout)
             except BaseException as e:  # noqa: BLE001
                 err_cell[0] = e
 
@@ -127,32 +211,10 @@ def save_state_dict(state_dict: dict, path: str, process_group=None,
         handle = AsyncSaveHandle(t, err_cell)
         t.start()
         return handle
-    os.makedirs(path, exist_ok=True)
-    rank = jax.process_index()
-    fname = f"{rank}.distcp.npz"
-    meta, payload = _build_rank_payload(state_dict, fname)
-    np.savez(os.path.join(path, fname), **payload)
-    with open(os.path.join(path, f"{rank}.meta.pkl"), "wb") as f:
-        pickle.dump(meta, f)
+    _write_rank_files(path, rank, meta, payload)
     _barrier(f"ckpt_save_shards:{path}")
     if rank == coordinator_rank:
-        merged = Metadata()
-        for r in range(jax.process_count()):
-            with open(os.path.join(path, f"{r}.meta.pkl"), "rb") as f:
-                piece: Metadata = pickle.load(f)
-            merged.global_shapes.update(piece.global_shapes)
-            for li, file in piece.storage_metadata.items():
-                # replicated shards may be written by several ranks; first wins
-                merged.storage_metadata.setdefault(li, file)
-            for key, shard_metas in piece.state_dict_metadata.items():
-                have = {sm.global_offset
-                        for sm in merged.state_dict_metadata.get(key, [])}
-                merged.state_dict_metadata.setdefault(key, []).extend(
-                    sm for sm in shard_metas if sm.global_offset not in have)
-        with open(os.path.join(path, "metadata.pkl"), "wb") as f:
-            pickle.dump(merged, f)
-        for r in range(jax.process_count()):
-            os.remove(os.path.join(path, f"{r}.meta.pkl"))
+        _merge_metadata(path, nprocs)
     _barrier(f"ckpt_save_meta:{path}")
 
 

@@ -136,20 +136,18 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
     count = _np.asarray(count)
     if count.sum() != neighbors.shape[0]:
         raise ValueError("count must sum to len(neighbors)")
-    mapping: dict = {}
-    for n in x.tolist():
-        if n in mapping:
-            raise ValueError("nodes in x must be unique")
-        mapping[n] = len(mapping)
-    src = _np.empty(neighbors.shape[0], dtype=x.dtype)
-    for i, n in enumerate(neighbors.tolist()):
-        j = mapping.get(n)
-        if j is None:
-            j = mapping[n] = len(mapping)
-        src[i] = j
+    # vectorized first-appearance compaction (million-edge subgraphs feed
+    # this per batch — no Python-loop renumbering)
+    combined = _np.concatenate([x, neighbors])
+    uniq, first_idx = _np.unique(combined, return_index=True)
+    if int((first_idx < len(x)).sum()) != len(x):
+        raise ValueError("nodes in x must be unique")
+    order = _np.argsort(first_idx, kind="stable")
+    out_nodes = combined[first_idx[order]]
+    new_id = _np.empty(len(uniq), dtype=x.dtype)
+    new_id[order] = _np.arange(len(uniq), dtype=x.dtype)
+    src = new_id[_np.searchsorted(uniq, neighbors)]
     dst = _np.repeat(_np.arange(len(x), dtype=x.dtype), count)
-    out_nodes = _np.fromiter(mapping.keys(), dtype=x.dtype,
-                             count=len(mapping))
     return src, dst, out_nodes
 
 

@@ -249,7 +249,13 @@ class _MPWorkers:
     def __init__(self, dataset, collate_fn, num_workers, use_shared_memory,
                  worker_init_fn):
         import multiprocessing as mp
-        ctx = mp.get_context("fork")
+        # fork is unsafe once JAX's internal threads exist (deadlocks the
+        # child); forkserver forks from a clean helper process instead,
+        # spawn is the portable fallback. Dataset/collate_fn must pickle —
+        # same contract as the reference's spawn-mode DataLoader.
+        methods = mp.get_all_start_methods()
+        ctx = mp.get_context(
+            "forkserver" if "forkserver" in methods else "spawn")
         self.task_q = ctx.Queue()
         self.result_q = ctx.Queue()
         self.use_shm = use_shared_memory
