@@ -92,7 +92,7 @@ def bench_llama(peak, peak_kind):
     }
 
 
-def bench_resnet50(peak, peak_kind):
+def bench_resnet50(peak, peak_kind, batch=128):  # 128 ~20% > 64/256 (sweep)
     import jax.numpy as jnp
 
     import paddle_tpu as pt
@@ -100,7 +100,6 @@ def bench_resnet50(peak, peak_kind):
     from paddle_tpu.vision.models import resnet50
 
     pt.seed(0)
-    batch = 64
     model = resnet50(num_classes=1000)
     opt = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
                                 parameters=model)
@@ -125,14 +124,14 @@ def bench_resnet50(peak, peak_kind):
     }
 
 
-def bench_bert(peak, peak_kind):
+def bench_bert(peak, peak_kind, batch=32):
     import jax.numpy as jnp
 
     import paddle_tpu as pt
     from paddle_tpu.models.bert import BertConfig, BertForPreTraining
 
     pt.seed(0)
-    batch, seq = 32, 512
+    seq = 512
     cfg = BertConfig(dtype="bfloat16", hidden_dropout_prob=0.0,
                      attention_probs_dropout_prob=0.0)
     model = BertForPreTraining(cfg)
@@ -165,14 +164,14 @@ def bench_bert(peak, peak_kind):
     }
 
 
-def bench_qwen2_moe(peak, peak_kind):
+def bench_qwen2_moe(peak, peak_kind, batch=4):
     import jax.numpy as jnp
 
     import paddle_tpu as pt
     from paddle_tpu.models.qwen2_moe import Qwen2MoeConfig, Qwen2MoeForCausalLM
 
     pt.seed(0)
-    batch, seq = 4, 1024
+    seq = 1024
     cfg = Qwen2MoeConfig(vocab_size=32000, hidden_size=1024,
                          intermediate_size=2816, moe_intermediate_size=704,
                          shared_expert_intermediate_size=2816,

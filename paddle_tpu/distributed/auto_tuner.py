@@ -17,7 +17,8 @@ import itertools
 import math
 from dataclasses import dataclass, field
 
-__all__ = ["ModelSpec", "HardwareSpec", "Candidate", "AutoTuner", "plan"]
+__all__ = ["ModelSpec", "HardwareSpec", "Candidate", "AutoTuner",
+           "TrialRecorder", "plan"]
 
 
 @dataclass
@@ -29,6 +30,7 @@ class ModelSpec:
     seq_len: int
     vocab: int = 32000
     global_batch: int = 8
+    num_heads: int = 8                # for building measured-trial proxies
     bytes_per_param: int = 2          # bf16
     optimizer_bytes_per_param: int = 8  # AdamW fp32 moments
 
@@ -59,6 +61,36 @@ class Candidate:
     def degrees(self):
         return dict(dp=self.dp, fsdp=self.fsdp, mp=self.mp, pp=self.pp,
                     sep=self.sep)
+
+
+class TrialRecorder:
+    """History of tuning trials (parity: auto_tuner/recorder.py — the
+    reference appends every profiled config + metric to a sortable
+    history it can export as CSV)."""
+
+    def __init__(self):
+        self.rows: list[dict] = []
+
+    def add(self, degrees: dict, **metrics) -> None:
+        self.rows.append({**degrees, **metrics})
+
+    def sorted_rows(self, metric: str = "measured_time"):
+        done = [r for r in self.rows if r.get(metric) is not None
+                and math.isfinite(r.get(metric, math.inf))]
+        rest = [r for r in self.rows if r not in done]
+        return sorted(done, key=lambda r: r[metric]) + rest
+
+    def to_csv(self, path: str) -> None:
+        import csv
+        keys: list[str] = []
+        for r in self.rows:
+            for k in r:
+                if k not in keys:
+                    keys.append(k)
+        with open(path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=keys)
+            w.writeheader()
+            w.writerows(self.rows)
 
 
 class AutoTuner:
@@ -144,21 +176,144 @@ class AutoTuner:
             c.notes.append(f"OOM: {c.mem_bytes / 1e9:.1f} GB")
         return c
 
+    # ---- measured trials (tuner.py profile-job parity) ----
+
+    def measure_candidate(self, c: Candidate, steps: int = 2,
+                          warmup: int = 1, max_trial_seq: int = 128,
+                          seed: int = 0) -> float:
+        """Run ONE candidate as a short timed trial on the ambient device
+        set: build its hybrid mesh, shard a proxy model of this
+        ModelSpec's dimensions through the fleet path, jit a real
+        TrainStep, time ``steps`` steps after ``warmup``. The analogue of
+        the reference's short profiling launches (auto_tuner/tuner.py:21),
+        minus the process round-trip — GSPMD needs no separate launcher.
+
+        Trials truncate seq to ``max_trial_seq`` (uniformly across
+        candidates, so the ranking signal survives) and cover non-
+        pipelined configs; pp>1 keeps its analytic estimate."""
+        import jax
+
+        from ..core import mesh as mesh_lib
+        from ..models.llama import LlamaConfig
+        from . import fleet
+
+        m = self.model
+        n = c.dp * c.fsdp * c.mp * c.pp * c.sep
+        if n != jax.device_count():
+            raise RuntimeError(
+                f"trial mesh wants {n} devices, runtime has "
+                f"{jax.device_count()}")
+        if c.pp > 1:
+            raise RuntimeError("measured trials cover pp=1 configs")
+        heads = m.num_heads
+        if m.hidden % heads or heads % c.mp:
+            raise RuntimeError(
+                f"num_heads={heads} incompatible with hidden={m.hidden}, "
+                f"mp={c.mp}")
+        seq = min(m.seq_len, max_trial_seq)
+        seq -= seq % max(c.sep, 1)
+        cfg = LlamaConfig(
+            vocab_size=m.vocab, hidden_size=m.hidden,
+            intermediate_size=4 * m.hidden, num_hidden_layers=m.num_layers,
+            num_attention_heads=heads, num_key_value_heads=heads,
+            max_position_embeddings=max(seq, 32))
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {
+            "dp_degree": c.dp, "mp_degree": c.mp, "sharding_degree": c.fsdp,
+            "pp_degree": 1, "sep_degree": c.sep}
+        # trials must not clobber the job's own fleet/mesh globals
+        saved_state = dict(fleet._state)
+        saved_mesh = mesh_lib._current_mesh[0]
+        try:
+            return self._run_trial(c, strategy, seq, cfg, steps, warmup, seed)
+        finally:
+            fleet._state.update(saved_state)
+            mesh_lib._current_mesh[0] = saved_mesh
+
+    def _run_trial(self, c, strategy, seq, cfg, steps, warmup, seed):
+        import time as _time
+
+        import numpy as np
+
+        import paddle_tpu as pt
+        from ..core import mesh as mesh_lib
+        from ..models.llama import LlamaForCausalLM
+        from . import fleet
+        from .auto_parallel_api import Replicate, Shard, shard_tensor
+
+        m = self.model
+        fleet.init(strategy=strategy)
+        mesh = fleet.fleet_mesh()
+        pt.seed(seed)
+        with mesh_lib.use_mesh(mesh):
+            model = fleet.distributed_model(LlamaForCausalLM(cfg))
+            opt = pt.optimizer.AdamW(learning_rate=1e-4, parameters=model)
+            step = pt.jit.TrainStep(
+                model, opt, lambda logits, labels: model.loss(logits, labels))
+            ids_np = np.random.default_rng(seed).integers(
+                0, cfg.vocab_size, (m.global_batch, seq))
+            # batch sharded over dp (the flagship-dryrun convention; fsdp
+            # shards parameters, GSPMD derives the rest)
+            placements = [Shard(0) if a == "dp" else Replicate()
+                          for a in mesh.axis_names]
+            ids = shard_tensor(ids_np, mesh=mesh, placements=placements,
+                               dtype="int32")
+            for _ in range(warmup):
+                loss = step(ids, ids)
+            float(loss)  # drain compile + warmup
+            t0 = _time.perf_counter()
+            for _ in range(steps):
+                loss = step(ids, ids)
+            float(loss)  # sync before reading the clock
+            return (_time.perf_counter() - t0) / steps
+
     # ---- tune (tuner.py parity) ----
 
-    def tune(self, top_k: int = 5, measure=None):
+    def tune(self, top_k: int = 5, measure=None, history_csv: str | None = None):
+        """Rank candidates by the analytic model; optionally re-rank the
+        top-k by measurement. ``measure="auto"`` uses the built-in timed
+        trial; any callable taking a Candidate and returning seconds also
+        works. Every trial lands in ``self.recorder`` (and
+        ``history_csv`` when given) with both analytic and measured
+        times, like the reference's recorder history."""
+        self.recorder = TrialRecorder()
         cands = [self.estimate(c) for c in self.prune(self.candidates())]
         fitting = [c for c in cands if c.fits]
         ranked = sorted(fitting or cands, key=lambda c: c.step_time)
+        if measure == "auto":
+            measure = self.measure_candidate
         if measure is not None:
             for c in ranked[:top_k]:
+                analytic = c.step_time
                 try:
                     c.step_time = measure(c)
+                    self.recorder.add(c.degrees, analytic_time=analytic,
+                                      measured_time=c.step_time, status="ok")
                 except Exception as e:  # noqa: BLE001
                     c.notes.append(f"measure failed: {e}")
-                    c.step_time = math.inf
+                    self.recorder.add(c.degrees, analytic_time=analytic,
+                                      measured_time=None,
+                                      status=f"failed: {e}")
+                    c.step_time = analytic
+            # one ordering over the top_k, on the MEASURED time scale:
+            # unmeasurable configs (pp>1 trials, incompatible shapes) stay
+            # in contention via their analytic estimate rescaled by the
+            # median measured/analytic ratio of the successful trials —
+            # raw mixing would be meaningless when trials run on a
+            # different machine (CPU mesh) than the analytic model (TPU).
+            ok = [r for r in self.recorder.rows if r["status"] == "ok"]
+            if ok:
+                ratios = sorted(r["measured_time"] / max(r["analytic_time"],
+                                                         1e-12) for r in ok)
+                cal = ratios[len(ratios) // 2]
+                for c in ranked[:top_k]:
+                    if any(n.startswith("measure failed") for n in c.notes):
+                        c.step_time *= cal
+                        c.notes.append(f"analytic x{cal:.3g} calibration")
             ranked = sorted(ranked[:top_k], key=lambda c: c.step_time) \
                 + ranked[top_k:]
+        if history_csv is not None:
+            self.recorder.to_csv(history_csv)
         return ranked
 
 

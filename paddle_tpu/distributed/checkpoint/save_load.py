@@ -140,6 +140,11 @@ def _merge_metadata(path: str, nprocs: int, seq: int | None = None) -> None:
 # its own old markers on entry; jobs that crash mid-save should resume
 # into a fresh step directory (the ElasticManager step_N convention).
 _SAVE_SEQ: dict[str, int] = {}
+# in-flight async handles per path: a second async save to the same path
+# must not start while the previous round's markers are still live (its
+# entry cleanup would eat them), so save_state_dict awaits the prior
+# handle first (cheap: the write is usually done by the next save call)
+_INFLIGHT: dict[str, "AsyncSaveHandle"] = {}
 
 
 def _done_name(rank: int, seq: int) -> str:
@@ -173,6 +178,14 @@ def save_state_dict(state_dict: dict, path: str, process_group=None,
     if async_save:
         import glob
         import threading
+        prev = _INFLIGHT.get(path)
+        if prev is not None:
+            try:
+                prev.result(timeout=async_timeout)
+            except TimeoutError:
+                raise
+            except Exception:  # noqa: BLE001 — surfaced via prev's handle
+                pass
         seq = _SAVE_SEQ[path] = _SAVE_SEQ.get(path, 0) + 1
         # clear ALL of this rank's markers (leftovers of a previous process
         # restarted into the same dir, or of a timed-out round) so none can
@@ -209,6 +222,7 @@ def save_state_dict(state_dict: dict, path: str, process_group=None,
         # of a silently truncated one
         t = threading.Thread(target=work, daemon=False)
         handle = AsyncSaveHandle(t, err_cell)
+        _INFLIGHT[path] = handle
         t.start()
         return handle
     _write_rank_files(path, rank, meta, payload)

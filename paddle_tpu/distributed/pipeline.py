@@ -89,13 +89,17 @@ def pipeline_forward(stacked: dict[str, jax.Array], x: jax.Array,
     T = num_micro + pp - 1
     perm_fwd = [(r, (r + 1) % pp) for r in range(pp)]
 
+    # extra drain ticks let the streamed-output ring (below) deliver the
+    # last microbatch to the furthest rank (pp-2 hops past the old T)
+    T2 = T + max(pp - 2, 0) + (1 if pp > 1 else 0)
+
     def per_device(local_params, xs_local):
         r = lax.axis_index(axis)
         h0 = jnp.zeros((mb,) + xs_local.shape[2:], xs_local.dtype)
         outs0 = jnp.zeros_like(xs_local)
 
         def tick(carry, t):
-            h_in, outs = carry
+            h_in, b_in, outs = carry
             m_idx = t - r  # microbatch this stage handles at tick t
             valid = (m_idx >= 0) & (m_idx < num_micro)
             # stage 0 reads from the input queue; others use the received act
@@ -106,20 +110,31 @@ def pipeline_forward(stacked: dict[str, jax.Array], x: jax.Array,
                            lambda _: h_in, None)
             y = stage_fn(local_params, src)
             y = jnp.where(valid, y, jnp.zeros_like(y))
-            # last stage banks its finished microbatch
+            # last stage banks its finished microbatch locally
             outs = lax.cond(
                 (r == pp - 1) & valid,
                 lambda o: lax.dynamic_update_index_in_dim(
                     o, y, jnp.clip(m_idx, 0, num_micro - 1), 0),
                 lambda o: o, outs)
-            # hand off to the next stage (ring; stage P-1 -> 0 is ignored)
-            h_next = lax.ppermute(y, axis, perm_fwd)
-            return (h_next, outs), None
+            # streamed replication: finished microbatches ride a second ring
+            # channel (last stage injects, everyone else forwards), so each
+            # travels every link exactly once overlapped with compute —
+            # half the ICI bytes of the old post-loop whole-buffer psum.
+            # rank r holds the microbatch the last stage emitted r+1 hops
+            # (= ticks) ago: m_b = (t - (r+1)) - (pp-1)
+            m_b = t - r - pp
+            outs = lax.cond(
+                (r != pp - 1) & (m_b >= 0) & (m_b < num_micro),
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, b_in, jnp.clip(m_b, 0, num_micro - 1), 0),
+                lambda o: o, outs)
+            b_out = jnp.where(r == pp - 1, y, b_in)
+            # hand off to the next stage (ring; stage P-1 -> 0 is ignored on
+            # the h channel, it IS the injection point of the b channel)
+            h_next, b_next = lax.ppermute((y, b_out), axis, perm_fwd)
+            return (h_next, b_next, outs), None
 
-        (_, outs), _ = lax.scan(tick, (h0, outs0), jnp.arange(T))
-        # ONE post-loop collective broadcasts the finished microbatches from
-        # the last stage to every rank (replicated output contract)
-        outs = lax.psum(jnp.where(r == pp - 1, outs, jnp.zeros_like(outs)), axis)
+        (_, _, outs), _ = lax.scan(tick, (h0, h0, outs0), jnp.arange(T2))
         return outs
 
     pspec = jax.tree.map(lambda v: P(axis, *([None] * (v.ndim - 1))), stacked)

@@ -249,10 +249,23 @@ class _MPWorkers:
     def __init__(self, dataset, collate_fn, num_workers, use_shared_memory,
                  worker_init_fn):
         import multiprocessing as mp
+        import pickle
         # fork is unsafe once JAX's internal threads exist (deadlocks the
         # child); forkserver forks from a clean helper process instead,
         # spawn is the portable fallback. Dataset/collate_fn must pickle —
-        # same contract as the reference's spawn-mode DataLoader.
+        # same contract as the reference's spawn-mode DataLoader; check up
+        # front so the error names the offender instead of a PicklingError
+        # from deep inside Process.start().
+        for name, obj in (("dataset", dataset), ("collate_fn", collate_fn),
+                          ("worker_init_fn", worker_init_fn)):
+            try:
+                pickle.dumps(obj)
+            except Exception as e:  # noqa: BLE001
+                raise TypeError(
+                    f"num_workers>0 sends {name} to worker processes via "
+                    f"forkserver/spawn, which requires it to be picklable "
+                    f"(module-level functions/classes, no lambdas or "
+                    f"closures): {e}") from e
         methods = mp.get_all_start_methods()
         ctx = mp.get_context(
             "forkserver" if "forkserver" in methods else "spawn")

@@ -12,8 +12,10 @@ TPU mapping:
   FA2's warp-level pipeline.
 - all matmuls hit the MXU in fp32 accumulation; inputs may be bf16.
 - causal masking by global row/col iota comparison; fully-masked blocks
-  skip compute via pl.when (the DMA still runs — block-sparse skipping via
-  PrefetchScalarGridSpec is a later optimization).
+  skip compute via pl.when AND their k/v DMAs: the BlockSpec index maps
+  clamp dead block indices to the last live block, and Mosaic elides the
+  copy when the index repeats (fwd kv_index, bwd kv_index/q_index_kv).
+  Dead blocks cost only a grid step (~us at 1024-wide tiles).
 
 The backward recomputes P per block from (q, k, lse) — the standard
 flash-bwd — with separate dq and dkv kernels so each accumulator has a

@@ -34,9 +34,11 @@ def test_async_save_two_rank_merge(tmp_path, monkeypatch):
     h0 = save_state_dict({"w": w}, path, async_save=True, async_timeout=30)
     time.sleep(0.2)
     assert not os.path.exists(os.path.join(path, "metadata.pkl"))
-    # both "ranks" are this one process, so undo the per-process save-seq
-    # bump rank 0 made — in a real job each process counts its own calls
+    # both "ranks" are this one process, so undo the per-process
+    # bookkeeping rank 0 made (save-seq bump + in-flight handle) — in a
+    # real job each process keeps its own
     sl._SAVE_SEQ[path] -= 1
+    sl._INFLIGHT.pop(path)
     monkeypatch.setattr(sl.jax, "process_index", lambda: 1)
     h1 = save_state_dict({"b": b}, path, async_save=True, async_timeout=30)
     h1.result(timeout=30)
