@@ -174,18 +174,20 @@ def save_state_dict(state_dict: dict, path: str, process_group=None,
     os.makedirs(path, exist_ok=True)
     rank = jax.process_index()
     nprocs = jax.process_count()
+    # an in-flight async save to the same path must finish before ANY new
+    # save (sync or async) touches its files
+    prev = _INFLIGHT.get(path)
+    if prev is not None:
+        try:
+            prev.result(timeout=async_timeout)
+        except TimeoutError:
+            raise
+        except Exception:  # noqa: BLE001 — surfaced via prev's handle
+            pass
     meta, payload = _build_rank_payload(state_dict, f"{rank}.distcp.npz")
     if async_save:
         import glob
         import threading
-        prev = _INFLIGHT.get(path)
-        if prev is not None:
-            try:
-                prev.result(timeout=async_timeout)
-            except TimeoutError:
-                raise
-            except Exception:  # noqa: BLE001 — surfaced via prev's handle
-                pass
         seq = _SAVE_SEQ[path] = _SAVE_SEQ.get(path, 0) + 1
         # clear ALL of this rank's markers (leftovers of a previous process
         # restarted into the same dir, or of a timed-out round) so none can

@@ -89,10 +89,6 @@ def pipeline_forward(stacked: dict[str, jax.Array], x: jax.Array,
     T = num_micro + pp - 1
     perm_fwd = [(r, (r + 1) % pp) for r in range(pp)]
 
-    # extra drain ticks let the streamed-output ring (below) deliver the
-    # last microbatch to the furthest rank (pp-2 hops past the old T)
-    T2 = T + max(pp - 2, 0) + (1 if pp > 1 else 0)
-
     def per_device(local_params, xs_local):
         r = lax.axis_index(axis)
         h0 = jnp.zeros((mb,) + xs_local.shape[2:], xs_local.dtype)
@@ -134,7 +130,25 @@ def pipeline_forward(stacked: dict[str, jax.Array], x: jax.Array,
             h_next, b_next = lax.ppermute((y, b_out), axis, perm_fwd)
             return (h_next, b_next, outs), None
 
-        (_, _, outs), _ = lax.scan(tick, (h0, h0, outs0), jnp.arange(T2))
+        (_, b_last, outs), _ = lax.scan(tick, (h0, h0, outs0),
+                                        jnp.arange(T))
+        # drain: every microbatch was injected during the T main ticks —
+        # the remaining hops only FORWARD the b ring (no stage compute)
+        # until the furthest rank (pp-2) has banked the last microbatch
+
+        def drain(carry, t):
+            b_in, outs = carry
+            m_b = t - r - pp
+            outs = lax.cond(
+                (r != pp - 1) & (m_b >= 0) & (m_b < num_micro),
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, b_in, jnp.clip(m_b, 0, num_micro - 1), 0),
+                lambda o: o, outs)
+            return (lax.ppermute(b_in, axis, perm_fwd), outs), None
+
+        if pp > 1:
+            (_, outs), _ = lax.scan(drain, (b_last, outs),
+                                    jnp.arange(T, T + pp - 1))
         return outs
 
     pspec = jax.tree.map(lambda v: P(axis, *([None] * (v.ndim - 1))), stacked)

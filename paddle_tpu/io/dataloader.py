@@ -12,6 +12,7 @@ the piece that actually hides H2D latency on TPU.
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 from typing import Any, Callable, Iterable, Sequence
@@ -259,7 +260,10 @@ class _MPWorkers:
         for name, obj in (("dataset", dataset), ("collate_fn", collate_fn),
                           ("worker_init_fn", worker_init_fn)):
             try:
-                pickle.dumps(obj)
+                # stream to devnull: validates without materializing a
+                # second copy of a large in-memory dataset
+                with open(os.devnull, "wb") as sink:
+                    pickle.Pickler(sink).dump(obj)
             except Exception as e:  # noqa: BLE001
                 raise TypeError(
                     f"num_workers>0 sends {name} to worker processes via "

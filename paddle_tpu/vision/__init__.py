@@ -1,4 +1,4 @@
-"""paddle_tpu.vision — models, transforms, datasets
+"""paddle_tpu.vision — models, transforms, datasets, detection ops
 (parity: python/paddle/vision/)."""
 
-from . import datasets, models, transforms  # noqa: F401
+from . import datasets, models, ops, transforms  # noqa: F401

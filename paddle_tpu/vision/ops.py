@@ -1,0 +1,791 @@
+"""Detection/vision ops (parity: python/paddle/vision/ops.py —
+yolo_loss/yolo_box, prior_box, box_coder, deform_conv2d/DeformConv2D,
+roi_pool/roi_align/psroi_pool (+ Layer wrappers), nms/matrix_nms,
+generate_proposals, distribute_fpn_proposals, read_file/decode_jpeg,
+ConvNormActivation).
+
+TPU mapping: ops with static output shapes (roi pooling family,
+deform_conv2d, yolo decode/loss, priors, box_coder) are jnp compositions
+that jit and differentiate; ops whose OUTPUT SIZE depends on the data
+(nms keep-lists, proposal generation, FPN routing) run on the host in
+numpy — the same placement as the reference's CPU kernels — and feed
+padded, static-shape device steps.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+
+__all__ = [
+    "yolo_loss", "yolo_box", "prior_box", "box_coder", "deform_conv2d",
+    "DeformConv2D", "roi_pool", "RoIPool", "roi_align", "RoIAlign",
+    "psroi_pool", "PSRoIPool", "nms", "matrix_nms", "generate_proposals",
+    "distribute_fpn_proposals", "read_file", "decode_jpeg",
+    "ConvNormActivation",
+]
+
+
+# ---------------- box utilities ----------------
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (int(v), int(v))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """Parity: vision/ops.py box_coder — encode/decode boxes against
+    anchors with optional per-box variances."""
+    pb = jnp.asarray(prior_box, jnp.float32)
+    tb = jnp.asarray(target_box, jnp.float32)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[..., 2] - pb[..., 0] + norm
+    ph = pb[..., 3] - pb[..., 1] + norm
+    px = pb[..., 0] + pw * 0.5
+    py = pb[..., 1] + ph * 0.5
+    var = jnp.ones((4,), jnp.float32) if prior_box_var is None \
+        else jnp.asarray(prior_box_var, jnp.float32)
+    if code_type == "encode_center_size":
+        # tb [N,4] vs pb [M,4] -> [N,M,4]
+        tw = tb[:, None, 2] - tb[:, None, 0] + norm
+        th = tb[:, None, 3] - tb[:, None, 1] + norm
+        tx = tb[:, None, 0] + tw * 0.5
+        ty = tb[:, None, 1] + th * 0.5
+        ox = (tx - px[None]) / pw[None]
+        oy = (ty - py[None]) / ph[None]
+        ow = jnp.log(jnp.abs(tw / pw[None]))
+        oh = jnp.log(jnp.abs(th / ph[None]))
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)
+        return out / jnp.broadcast_to(var, out.shape)
+    if code_type != "decode_center_size":
+        raise ValueError(f"unknown code_type {code_type!r}")
+    # decode: tb [N,M,4]; pb broadcast along `axis`
+    expand = (None, slice(None)) if axis == 0 else (slice(None), None)
+    pw, ph, px, py = (t[expand] for t in (pw, ph, px, py))
+    v = jnp.broadcast_to(var, tb.shape)
+    dw = jnp.exp(v[..., 2] * tb[..., 2]) * pw
+    dh = jnp.exp(v[..., 3] * tb[..., 3]) * ph
+    dx = v[..., 0] * tb[..., 0] * pw + px
+    dy = v[..., 1] * tb[..., 1] * ph + py
+    return jnp.stack([dx - dw * 0.5, dy - dh * 0.5,
+                      dx + dw * 0.5 - norm, dy + dh * 0.5 - norm], axis=-1)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """Parity: vision/ops.py prior_box — SSD anchor generation for one
+    feature map. Returns (boxes [H, W, A, 4], variances [H, W, A, 4])."""
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    ratios = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - r) < 1e-6 for r in ratios):
+            ratios.append(float(ar))
+            if flip:
+                ratios.append(1.0 / float(ar))
+    whs = []  # (w, h) per anchor, reference ordering
+    for k, ms in enumerate(min_sizes):
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                s = math.sqrt(ms * max_sizes[k])
+                whs.append((s, s))
+            for ar in ratios:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+        else:
+            for ar in ratios:
+                whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+            if max_sizes:
+                s = math.sqrt(ms * max_sizes[k])
+                whs.append((s, s))
+    wh = jnp.asarray(whs, jnp.float32)  # [A, 2]
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [H, W]
+    c = jnp.stack([cxg, cyg], -1)[:, :, None, :]  # [H, W, 1, 2]
+    half = wh[None, None] * 0.5
+    mins = (c - half) / jnp.asarray([iw, ih], jnp.float32)
+    maxs = (c + half) / jnp.asarray([iw, ih], jnp.float32)
+    boxes = jnp.concatenate([mins, maxs], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), boxes.shape)
+    return boxes, var
+
+
+# ---------------- RoI pooling family ----------------
+
+def _batch_index(boxes_num, num_boxes, batch):
+    return jnp.repeat(jnp.arange(batch, dtype=jnp.int32),
+                      jnp.asarray(boxes_num, jnp.int32),
+                      total_repeat_length=num_boxes)
+
+
+def _bilinear(feat, y, x):
+    """feat [C,H,W]; y/x arbitrary-shape sample coords -> [C, *coords]."""
+    H, W = feat.shape[1], feat.shape[2]
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy1 = y - y0
+    wx1 = x - x0
+    out = 0.0
+    for dy, wy in ((0, 1 - wy1), (1, wy1)):
+        for dx, wx in ((0, 1 - wx1), (1, wx1)):
+            yi = jnp.clip(y0 + dy, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(x0 + dx, 0, W - 1).astype(jnp.int32)
+            # out-of-image samples contribute zero (torchvision/detectron2)
+            valid = ((y0 + dy >= 0) & (y0 + dy <= H - 1)
+                     & (x0 + dx >= 0) & (x0 + dx <= W - 1))
+            out = out + feat[:, yi, xi] * (wy * wx * valid)[None]
+    return out
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """Parity: vision/ops.py:1640 — bilinear RoI Align (Mask R-CNN).
+    ``sampling_ratio<=0`` uses a fixed 2x2 grid per bin (the adaptive
+    ceil(roi/out) count is data-dependent, which cannot jit; 2 matches
+    the common detectron2 configuration)."""
+    x = jnp.asarray(x, jnp.float32)
+    boxes = jnp.asarray(boxes, jnp.float32)
+    ph, pw = _pair(output_size)
+    s = int(sampling_ratio) if sampling_ratio > 0 else 2
+    bidx = _batch_index(boxes_num, boxes.shape[0], x.shape[0])
+    shift = 0.5 if aligned else 0.0
+
+    def one(box, bi):
+        feat = x[bi]
+        x1, y1, x2, y2 = (box * spatial_scale) - shift
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:  # legacy: rois are at least 1x1
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        iy = (jnp.arange(ph)[:, None] * bin_h
+              + (jnp.arange(s, dtype=jnp.float32) + 0.5)[None] * bin_h / s
+              + y1)  # [ph, s]
+        ix = (jnp.arange(pw)[:, None] * bin_w
+              + (jnp.arange(s, dtype=jnp.float32) + 0.5)[None] * bin_w / s
+              + x1)  # [pw, s]
+        yy = jnp.broadcast_to(iy[:, None, :, None], (ph, pw, s, s))
+        xx = jnp.broadcast_to(ix[None, :, None, :], (ph, pw, s, s))
+        vals = _bilinear(feat, yy, xx)  # [C, ph, pw, s, s]
+        return vals.mean(axis=(-2, -1))
+
+    return jax.vmap(one)(boxes, bidx)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Parity: vision/ops.py:1514 — max pooling over quantized bins
+    (Fast R-CNN). Exact integer-bin semantics via masked max (jit-safe:
+    the mask, not the extent, is data-dependent)."""
+    x = jnp.asarray(x, jnp.float32)
+    boxes = jnp.asarray(boxes, jnp.float32)
+    ph, pw = _pair(output_size)
+    H, W = x.shape[2], x.shape[3]
+    bidx = _batch_index(boxes_num, boxes.shape[0], x.shape[0])
+    ygrid = jnp.arange(H)[:, None]
+    xgrid = jnp.arange(W)[None, :]
+
+    def one(box, bi):
+        feat = x[bi]
+        x1 = jnp.round(box[0] * spatial_scale)
+        y1 = jnp.round(box[1] * spatial_scale)
+        x2 = jnp.round(box[2] * spatial_scale)
+        y2 = jnp.round(box[3] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+
+        def bin_val(i, j):
+            hs = jnp.floor(y1 + i * rh / ph).astype(jnp.int32)
+            he = jnp.ceil(y1 + (i + 1) * rh / ph).astype(jnp.int32)
+            ws = jnp.floor(x1 + j * rw / pw).astype(jnp.int32)
+            we = jnp.ceil(x1 + (j + 1) * rw / pw).astype(jnp.int32)
+            m = ((ygrid >= jnp.clip(hs, 0, H)) & (ygrid < jnp.clip(he, 0, H))
+                 & (xgrid >= jnp.clip(ws, 0, W)) & (xgrid < jnp.clip(we, 0, W)))
+            masked = jnp.where(m[None], feat, -jnp.inf)
+            v = masked.max(axis=(1, 2))
+            return jnp.where(jnp.isfinite(v), v, 0.0)
+
+        rows = [jnp.stack([bin_val(i, j) for j in range(pw)], -1)
+                for i in range(ph)]
+        return jnp.stack(rows, -2)  # [C, ph, pw]
+
+    return jax.vmap(one)(boxes, bidx)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Parity: vision/ops.py:1393 — position-sensitive RoI average pool
+    (R-FCN): input channels C = out_c * ph * pw; bin (i, j) reads its own
+    channel group."""
+    x = jnp.asarray(x, jnp.float32)
+    boxes = jnp.asarray(boxes, jnp.float32)
+    ph, pw = _pair(output_size)
+    C, H, W = x.shape[1], x.shape[2], x.shape[3]
+    if C % (ph * pw):
+        raise ValueError(
+            f"psroi_pool input channels {C} must be a multiple of "
+            f"output_size^2 {ph * pw}")
+    out_c = C // (ph * pw)
+    bidx = _batch_index(boxes_num, boxes.shape[0], x.shape[0])
+    ygrid = jnp.arange(H)[:, None]
+    xgrid = jnp.arange(W)[None, :]
+
+    def one(box, bi):
+        feat = x[bi].reshape(out_c, ph, pw, H, W)
+        x1, y1, x2, y2 = box * spatial_scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+
+        def bin_val(i, j):
+            hs = jnp.floor(y1 + i * rh / ph).astype(jnp.int32)
+            he = jnp.ceil(y1 + (i + 1) * rh / ph).astype(jnp.int32)
+            ws = jnp.floor(x1 + j * rw / pw).astype(jnp.int32)
+            we = jnp.ceil(x1 + (j + 1) * rw / pw).astype(jnp.int32)
+            m = ((ygrid >= jnp.clip(hs, 0, H)) & (ygrid < jnp.clip(he, 0, H))
+                 & (xgrid >= jnp.clip(ws, 0, W)) & (xgrid < jnp.clip(we, 0, W)))
+            cnt = jnp.maximum(m.sum(), 1)
+            return (feat[:, i, j] * m[None]).sum(axis=(1, 2)) / cnt
+
+        rows = [jnp.stack([bin_val(i, j) for j in range(pw)], -1)
+                for i in range(ph)]
+        return jnp.stack(rows, -2)
+
+    return jax.vmap(one)(boxes, bidx)
+
+
+class RoIPool(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class RoIAlign(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+class PSRoIPool(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+# ---------------- deformable convolution ----------------
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Parity: vision/ops.py:753 — deformable conv v1 (mask=None) / v2
+    (modulated, mask given). Bilinear-samples each kernel tap at its
+    learned offset, then contracts with the weight — an im2col whose
+    gather indices are data, which is exactly what XLA's dynamic gather
+    handles; everything stays static-shape and differentiable."""
+    x = jnp.asarray(x, jnp.float32)
+    offset = jnp.asarray(offset, jnp.float32)
+    w = jnp.asarray(weight, jnp.float32)
+    N, Cin, H, W = x.shape
+    Cout, _, kh, kw = w.shape
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    dh, dw = _pair(dilation)
+    Hout = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wout = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    dg = deformable_groups
+    # offset [N, dg*2*kh*kw, Hout, Wout] -> [N, dg, kh*kw, 2, Hout, Wout]
+    off = offset.reshape(N, dg, kh * kw, 2, Hout, Wout)
+    base_y = (jnp.arange(Hout) * sh - ph)[:, None]  # [Hout, 1]
+    base_x = (jnp.arange(Wout) * sw - pw)[None, :]  # [1, Wout]
+    ky = (jnp.arange(kh) * dh)[:, None].repeat(kw, 1).reshape(-1)  # [kh*kw]
+    kx = (jnp.arange(kw) * dw)[None, :].repeat(kh, 0).reshape(-1)
+
+    def sample_image(img, off_img, mask_img):
+        # img [Cin,H,W]; off_img [dg, kh*kw, 2, Hout, Wout]
+        cols = []
+        per = Cin // dg
+        for g in range(dg):
+            y = base_y[None] + ky[:, None, None] + off_img[g, :, 0]
+            xs = base_x[None] + kx[:, None, None] + off_img[g, :, 1]
+            # [kh*kw, Hout, Wout] coords; sample the group's channels
+            vals = _bilinear(img[g * per:(g + 1) * per], y, xs)
+            if mask_img is not None:
+                vals = vals * mask_img[g][None]
+            cols.append(vals)  # [per, kh*kw, Hout, Wout]
+        return jnp.concatenate(cols, axis=0)  # [Cin, kh*kw, Hout, Wout]
+
+    if mask is not None:
+        m = jnp.asarray(mask, jnp.float32).reshape(N, dg, kh * kw, Hout, Wout)
+        cols = jax.vmap(sample_image)(x, off, m)
+    else:
+        cols = jax.vmap(lambda img, o: sample_image(img, o, None))(x, off)
+    # cols [N, Cin, kh*kw, Hout, Wout] x w [Cout, Cin/groups, kh, kw]
+    wg = w.reshape(groups, Cout // groups, Cin // groups, kh * kw)
+    cg = cols.reshape(N, groups, Cin // groups, kh * kw, Hout, Wout)
+    out = jnp.einsum("gock,ngckhw->ngohw", wg, cg) \
+        .reshape(N, Cout, Hout, Wout)
+    if bias is not None:
+        out = out + jnp.asarray(bias, jnp.float32)[None, :, None, None]
+    return out
+
+
+class DeformConv2D(nn.Layer):
+    """Parity: vision/ops.py:960."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn import initializer as I
+        from ..nn.module import Parameter
+        kh, kw = _pair(kernel_size)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.deformable_groups = deformable_groups
+        self.groups = groups
+        fan_in = (in_channels // groups) * kh * kw
+        w_init = weight_attr if callable(weight_attr) else \
+            I.KaimingUniform(fan_in=fan_in)
+        self.weight = Parameter(w_init(
+            (out_channels, in_channels // groups, kh, kw), self._dtype))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            b_init = bias_attr if callable(bias_attr) else I.Constant(0.0)
+            self.bias = Parameter(b_init((out_channels,), self._dtype))
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias, self.stride,
+                             self.padding, self.dilation,
+                             self.deformable_groups, self.groups, mask)
+
+
+# ---------------- YOLO ----------------
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Parity: vision/ops.py:266 — YOLOv3 detection decode. Returns
+    (boxes [N, H*W*A, 4], scores [N, H*W*A, class_num]); predictions with
+    objectness below ``conf_thresh`` get zeroed boxes+scores (static
+    shapes on TPU; the reference marks them the same way)."""
+    x = jnp.asarray(x, jnp.float32)
+    N, C, H, W = x.shape
+    na = len(anchors) // 2
+    anc = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    if iou_aware:
+        ioup = jax.nn.sigmoid(x[:, :na])  # [N, A, H, W]
+        x = x[:, na:]
+    p = x.reshape(N, na, 5 + class_num, H, W)
+    gx = jnp.arange(W, dtype=jnp.float32)[None, :]
+    gy = jnp.arange(H, dtype=jnp.float32)[:, None]
+    alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+    cx = (jax.nn.sigmoid(p[:, :, 0]) * alpha + beta + gx) / W
+    cy = (jax.nn.sigmoid(p[:, :, 1]) * alpha + beta + gy) / H
+    bw = jnp.exp(p[:, :, 2]) * anc[None, :, 0, None, None] \
+        / (downsample_ratio * W)
+    bh = jnp.exp(p[:, :, 3]) * anc[None, :, 1, None, None] \
+        / (downsample_ratio * H)
+    obj = jax.nn.sigmoid(p[:, :, 4])
+    if iou_aware:
+        obj = obj ** (1 - iou_aware_factor) * ioup ** iou_aware_factor
+    cls = jax.nn.sigmoid(p[:, :, 5:])  # [N, A, cls, H, W]
+    scores = obj[:, :, None] * cls
+    keep = (obj >= conf_thresh)[:, :, None]
+    scores = jnp.where(keep, scores, 0.0)
+    imgh = jnp.asarray(img_size, jnp.float32)[:, 0][:, None, None, None]
+    imgw = jnp.asarray(img_size, jnp.float32)[:, 1][:, None, None, None]
+    x1 = (cx - bw * 0.5) * imgw
+    y1 = (cy - bh * 0.5) * imgh
+    x2 = (cx + bw * 0.5) * imgw
+    y2 = (cy + bh * 0.5) * imgh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0.0, imgw - 1)
+        x2 = jnp.clip(x2, 0.0, imgw - 1)
+        y1 = jnp.clip(y1, 0.0, imgh - 1)
+        y2 = jnp.clip(y2, 0.0, imgh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)  # [N, A, H, W, 4]
+    boxes = jnp.where((obj >= conf_thresh)[..., None], boxes, 0.0)
+    boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(N, H * W * na, 4)
+    scores = scores.transpose(0, 3, 4, 1, 2).reshape(N, H * W * na,
+                                                     class_num)
+    return boxes, scores
+
+
+def _iou_wh(wh1, wh2):
+    """IoU of boxes sharing a center, from (w, h) only — anchor matching."""
+    inter = jnp.minimum(wh1[..., 0], wh2[..., 0]) * \
+        jnp.minimum(wh1[..., 1], wh2[..., 1])
+    union = wh1[..., 0] * wh1[..., 1] + wh2[..., 0] * wh2[..., 1] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """Parity: vision/ops.py:58 — YOLOv3 training loss for one scale:
+    coordinate (l1/bce), objectness and class BCE, with best-anchor GT
+    assignment and the ignore-threshold rule for unmatched predictions.
+    Fully static: GT boxes scatter into [A, H, W] target maps."""
+    x = jnp.asarray(x, jnp.float32)
+    gt_box = jnp.asarray(gt_box, jnp.float32)   # [N, B, 4] cx,cy,w,h (rel)
+    gt_label = jnp.asarray(gt_label, jnp.int32)  # [N, B]
+    N, C, H, W = x.shape
+    na = len(anchor_mask)
+    all_anc = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    anc = all_anc[jnp.asarray(anchor_mask)]      # this scale's anchors
+    p = x.reshape(N, na, 5 + class_num, H, W)
+    inw, inh = W * downsample_ratio, H * downsample_ratio
+    alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+
+    bce = lambda logit, t: jnp.maximum(logit, 0) - logit * t + \
+        jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    def per_image(pi, boxes, labels):
+        valid = (boxes[:, 2] > 0) & (boxes[:, 3] > 0)  # padded GTs are 0
+        # best anchor over the FULL anchor set; train only if it's ours
+        wh_img = boxes[:, 2:4] * jnp.asarray([inw, inh], jnp.float32)
+        ious = _iou_wh(wh_img[:, None], all_anc[None])  # [B, n_all]
+        best = jnp.argmax(ious, axis=1)
+        mask_arr = jnp.asarray(anchor_mask)
+        ours = (best[:, None] == mask_arr[None]).any(1) & valid
+        local_a = jnp.argmax(
+            (best[:, None] == mask_arr[None]).astype(jnp.int32), axis=1)
+        gi = jnp.clip((boxes[:, 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((boxes[:, 1] * H).astype(jnp.int32), 0, H - 1)
+        # scatter targets into [A, H, W] maps; rows that are not ours
+        # (padded GTs, other-scale anchors) aim at the out-of-bounds
+        # anchor index `na` and are DROPPED — a gather-then-set fallback
+        # would clobber a real target landing in the same cell
+        sa = jnp.where(ours, local_a, na)
+        obj_t = jnp.zeros((na, H, W)).at[sa, gj, gi].max(
+            1.0, mode="drop")
+        tx = boxes[:, 0] * W - gi
+        ty = boxes[:, 1] * H - gj
+        tw = jnp.log(jnp.maximum(
+            boxes[:, 2] * inw / jnp.maximum(anc[local_a, 0], 1e-9), 1e-9))
+        th = jnp.log(jnp.maximum(
+            boxes[:, 3] * inh / jnp.maximum(anc[local_a, 1], 1e-9), 1e-9))
+        coord = jnp.stack([tx, ty, tw, th], -1)
+        w_t = jnp.zeros((na, H, W, 4)).at[sa, gj, gi].set(
+            coord, mode="drop")
+        # box-size weighting 2 - w*h (reference loss)
+        scale_t = jnp.zeros((na, H, W)).at[sa, gj, gi].set(
+            2.0 - boxes[:, 2] * boxes[:, 3], mode="drop")
+        onehot = jax.nn.one_hot(labels, class_num)
+        if use_label_smooth:
+            delta = 1.0 / class_num
+            onehot = onehot * (1 - delta) + delta / class_num
+        cls_t = jnp.zeros((na, H, W, class_num)).at[sa, gj, gi].set(
+            onehot, mode="drop")
+        # predicted boxes for the ignore mask
+        gx = jnp.arange(W, dtype=jnp.float32)[None, :]
+        gy = jnp.arange(H, dtype=jnp.float32)[:, None]
+        px = (jax.nn.sigmoid(pi[:, 0]) * alpha + beta + gx) / W
+        py = (jax.nn.sigmoid(pi[:, 1]) * alpha + beta + gy) / H
+        pw = jnp.exp(jnp.clip(pi[:, 2], -10, 10)) * anc[:, 0, None, None] / inw
+        phh = jnp.exp(jnp.clip(pi[:, 3], -10, 10)) * anc[:, 1, None, None] / inh
+        # IoU of every prediction vs every (valid) gt, in relative coords
+        pred = jnp.stack([px - pw / 2, py - phh / 2, px + pw / 2,
+                          py + phh / 2], -1)  # [A, H, W, 4]
+        g = jnp.stack([boxes[:, 0] - boxes[:, 2] / 2,
+                       boxes[:, 1] - boxes[:, 3] / 2,
+                       boxes[:, 0] + boxes[:, 2] / 2,
+                       boxes[:, 1] + boxes[:, 3] / 2], -1)  # [B, 4]
+        ix1 = jnp.maximum(pred[..., None, 0], g[:, 0])
+        iy1 = jnp.maximum(pred[..., None, 1], g[:, 1])
+        ix2 = jnp.minimum(pred[..., None, 2], g[:, 2])
+        iy2 = jnp.minimum(pred[..., None, 3], g[:, 3])
+        inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+        pa = (pred[..., 2] - pred[..., 0]) * (pred[..., 3] - pred[..., 1])
+        ga = (g[:, 2] - g[:, 0]) * (g[:, 3] - g[:, 1])
+        iou = inter / jnp.maximum(pa[..., None] + ga - inter, 1e-10)
+        iou = jnp.where(valid, iou, 0.0)
+        ignore = (iou.max(-1) > ignore_thresh) & (obj_t == 0)
+        # losses
+        lxy = bce(pi[:, 0], w_t[..., 0]) + bce(pi[:, 1], w_t[..., 1])
+        lxy = (lxy * scale_t * obj_t).sum()
+        lwh = (jnp.abs(pi[:, 2] - w_t[..., 2])
+               + jnp.abs(pi[:, 3] - w_t[..., 3]))
+        lwh = (lwh * scale_t * obj_t).sum()
+        lobj = (bce(pi[:, 4], obj_t) * obj_t).sum() \
+            + (bce(pi[:, 4], obj_t) * (1 - obj_t)
+               * (1 - ignore.astype(jnp.float32))).sum()
+        lcls = (bce(pi[:, 5:].transpose(0, 2, 3, 1), cls_t)
+                * obj_t[..., None]).sum()
+        return lxy + lwh + lobj + lcls
+
+    return jax.vmap(per_image)(p, gt_box, gt_label)
+
+
+# ---------------- NMS family (host-side: variable outputs) ----------------
+
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = (x2 - x1) * (y2 - y1)
+    ix1 = np.maximum(x1[:, None], x1[None])
+    iy1 = np.maximum(y1[:, None], y1[None])
+    ix2 = np.minimum(x2[:, None], x2[None])
+    iy2 = np.minimum(y2[:, None], y2[None])
+    inter = np.maximum(ix2 - ix1, 0) * np.maximum(iy2 - iy1, 0)
+    return inter / np.maximum(area[:, None] + area[None] - inter, 1e-10)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Parity: vision/ops.py:1867 — greedy (optionally batched-by-
+    category) NMS. Host-side: the keep-list length is data-dependent, so
+    like the reference's CPU kernel this runs in the input/postprocess
+    pipeline, not under jit."""
+    b = np.asarray(boxes, np.float32)
+    s = None if scores is None else np.asarray(scores, np.float32)
+
+    def _greedy(idx):
+        iou = _iou_matrix(b[idx])
+        keep = []
+        alive = np.ones(len(idx), bool)
+        for i in range(len(idx)):
+            if not alive[i]:
+                continue
+            keep.append(idx[i])
+            alive &= (iou[i] <= iou_threshold) | ~alive | \
+                (np.arange(len(idx)) <= i)
+        return keep
+
+    if category_idxs is None:
+        order = np.argsort(-s) if s is not None else np.arange(len(b))
+        kept = _greedy(order)
+    else:
+        cats = np.asarray(category_idxs)
+        kept = []
+        for c in categories:
+            idx = np.nonzero(cats == c)[0]
+            if len(idx) == 0:
+                continue
+            order = idx[np.argsort(-s[idx])] if s is not None else idx
+            kept.extend(_greedy(order))
+        if s is not None:
+            kept = sorted(kept, key=lambda i: -s[i])
+    if top_k is not None:
+        kept = kept[:top_k]
+    return np.asarray(kept, np.int64)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Parity: vision/ops.py:2236 — SOLOv2 matrix NMS: scores decay by
+    overlap instead of hard suppression. Host-side (variable rois)."""
+    bboxes = np.asarray(bboxes, np.float32)  # [N, M, 4]
+    scores = np.asarray(scores, np.float32)  # [N, C, M]
+    all_out, all_idx, rois_num = [], [], []
+    N, C, M = scores.shape
+    for n in range(N):
+        dets = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            sc = scores[n, c]
+            mask = sc > score_threshold
+            idx = np.nonzero(mask)[0]
+            if len(idx) == 0:
+                continue
+            order = idx[np.argsort(-sc[idx])][:nms_top_k if nms_top_k > 0
+                                              else len(idx)]
+            bx = bboxes[n, order]
+            ss = sc[order]
+            iou = _iou_matrix(bx)
+            iu = np.triu(iou, 1)
+            # compensate[i] = box i's own max overlap with a higher-scored
+            # box — the denominator uses the SUPPRESSOR's compensation
+            compensate = iu.max(axis=0)
+            if use_gaussian:
+                decay = np.exp(-(iu ** 2 - compensate[:, None] ** 2)
+                               / gaussian_sigma).min(axis=0)
+            else:
+                decay = ((1 - iu) / np.maximum(1 - compensate[:, None],
+                                               1e-10)).min(axis=0)
+            dec = ss * decay
+            for k in range(len(order)):
+                if dec[k] >= post_threshold:
+                    dets.append((float(dec[k]), c, n * M + order[k],
+                                 bx[k]))
+        dets.sort(key=lambda d: -d[0])
+        if keep_top_k > 0:
+            dets = dets[:keep_top_k]
+        for scv, c, gidx, bx in dets:
+            all_out.append([c, scv, *bx.tolist()])
+            all_idx.append(gidx)
+        rois_num.append(len(dets))
+    out = np.asarray(all_out, np.float32).reshape(-1, 6)
+    index = np.asarray(all_idx, np.int64)[:, None]
+    ret = [out]
+    if return_index:
+        ret.append(index)
+    if return_rois_num:
+        ret.append(np.asarray(rois_num, np.int32))
+    return tuple(ret) if len(ret) > 1 else out
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """Parity: vision/ops.py:2038 — RPN proposal generation. Host-side
+    (variable proposal counts): decode deltas, clip, filter small, NMS."""
+    scores = np.asarray(scores, np.float32)        # [N, A, H, W]
+    deltas = np.asarray(bbox_deltas, np.float32)   # [N, A*4, H, W]
+    img_size = np.asarray(img_size, np.float32)    # [N, 2] (h, w)
+    anchors_np = np.asarray(anchors, np.float32).reshape(-1, 4)
+    var = np.asarray(variances, np.float32).reshape(-1, 4)
+    N = scores.shape[0]
+    offset = 1.0 if pixel_offset else 0.0
+    rois, rois_scores, rois_num = [], [], []
+    for n in range(N):
+        sc = scores[n].transpose(1, 2, 0).reshape(-1)
+        dl = deltas[n].reshape(-1, 4, *deltas.shape[2:]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-sc)[:pre_nms_top_n]
+        sc, dl, an, vr = sc[order], dl[order], anchors_np[order], var[order]
+        aw = an[:, 2] - an[:, 0] + offset
+        ah = an[:, 3] - an[:, 1] + offset
+        ax = an[:, 0] + aw * 0.5
+        ay = an[:, 1] + ah * 0.5
+        cx = vr[:, 0] * dl[:, 0] * aw + ax
+        cy = vr[:, 1] * dl[:, 1] * ah + ay
+        w = np.exp(np.minimum(vr[:, 2] * dl[:, 2], 10)) * aw
+        h = np.exp(np.minimum(vr[:, 3] * dl[:, 3], 10)) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - offset, cy + h / 2 - offset], -1)
+        ih, iw = img_size[n]
+        boxes[:, 0::2] = boxes[:, 0::2].clip(0, iw - offset)
+        boxes[:, 1::2] = boxes[:, 1::2].clip(0, ih - offset)
+        ws = boxes[:, 2] - boxes[:, 0] + offset
+        hs = boxes[:, 3] - boxes[:, 1] + offset
+        keep = (ws >= min_size) & (hs >= min_size)
+        boxes, sc = boxes[keep], sc[keep]
+        if len(boxes):
+            kept = nms(boxes, nms_thresh, sc)[:post_nms_top_n]
+            boxes, sc = boxes[kept], sc[kept]
+        rois.append(boxes)
+        rois_scores.append(sc)
+        rois_num.append(len(boxes))
+    out = (np.concatenate(rois) if rois else np.zeros((0, 4), np.float32),
+           np.concatenate(rois_scores) if rois_scores
+           else np.zeros((0,), np.float32))
+    if return_rois_num:
+        return (*out, np.asarray(rois_num, np.int32))
+    return out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Parity: vision/ops.py:1156 — route RoIs to FPN levels by scale:
+    level = floor(refer_level + log2(sqrt(area) / refer_scale)). Host-side
+    (per-level counts vary)."""
+    rois = np.asarray(fpn_rois, np.float32)
+    offset = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + offset
+    h = rois[:, 3] - rois[:, 1] + offset
+    scale = np.sqrt(np.maximum(w * h, 1e-10))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8) + refer_level)
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi_rois, restore = [], np.empty(len(rois), np.int64)
+    rois_num_per = []
+    pos = 0
+    for level in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == level)[0]
+        multi_rois.append(rois[idx])
+        restore[idx] = np.arange(pos, pos + len(idx))
+        pos += len(idx)
+        if rois_num is not None:
+            # per-image counts at this level
+            rn = np.asarray(rois_num)
+            bounds = np.cumsum(rn)
+            img_of = np.searchsorted(bounds, idx, side="right")
+            rois_num_per.append(np.bincount(
+                img_of, minlength=len(rn)).astype(np.int32))
+    restore = restore[:, None]
+    if rois_num is not None:
+        return multi_rois, restore, rois_num_per
+    return multi_rois, restore
+
+
+# ---------------- image IO ----------------
+
+def read_file(filename, name=None):
+    """Parity: vision/ops.py:1301 — raw file bytes as a uint8 tensor."""
+    with open(filename, "rb") as f:
+        return jnp.frombuffer(f.read(), dtype=jnp.uint8)
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Parity: vision/ops.py:1344 — JPEG bytes -> [C, H, W] uint8 (host,
+    via PIL; image decode belongs in the input pipeline on TPU)."""
+    import io
+
+    from PIL import Image
+    img = Image.open(io.BytesIO(np.asarray(x, np.uint8).tobytes()))
+    if mode.lower() == "gray":
+        img = img.convert("L")
+    elif mode.lower() == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return jnp.asarray(arr)
+
+
+class ConvNormActivation(nn.Sequential):
+    """Parity: vision/ops.py:1810 — Conv2D + norm + activation block."""
+
+    def __init__(self, in_channels, out_channels, kernel_size=3, stride=1,
+                 padding=None, groups=1, norm_layer=nn.BatchNorm2D,
+                 activation_layer=nn.ReLU, dilation=1, bias=None):
+        if padding is None:
+            padding = (kernel_size - 1) // 2 * dilation
+        if bias is None:
+            bias = norm_layer is None
+        layers = [nn.Conv2D(in_channels, out_channels, kernel_size, stride,
+                            padding, dilation=dilation, groups=groups,
+                            bias_attr=None if bias else False)]
+        if norm_layer is not None:
+            layers.append(norm_layer(out_channels))
+        if activation_layer is not None:
+            layers.append(activation_layer())
+        super().__init__(*layers)

@@ -1,0 +1,288 @@
+"""Detection/vision ops (parity: python/paddle/vision/ops.py +
+test/legacy_test/test_{roi_align,nms,box_coder,yolo_box}_op.py)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.vision import ops
+
+RNG = np.random.default_rng(11)
+
+
+# ---------------- roi family ----------------
+
+def test_roi_align_matches_manual_bilinear():
+    # 1x1 output over an axis-aligned box centers on known coordinates
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    boxes = np.array([[0.0, 0.0, 2.0, 2.0]], np.float32)
+    out = ops.roi_align(x, boxes, [1], output_size=1, sampling_ratio=1,
+                        aligned=False)
+    # single sample at bin center (1.0, 1.0) -> value x[1,1] = 5
+    np.testing.assert_allclose(np.asarray(out), [[[[5.0]]]], atol=1e-5)
+
+
+def test_roi_align_is_differentiable():
+    import jax
+    import jax.numpy as jnp
+    x = jnp.asarray(RNG.standard_normal((1, 2, 8, 8)), jnp.float32)
+    boxes = jnp.asarray([[1.0, 1.0, 6.0, 6.0]], jnp.float32)
+    g = jax.grad(lambda x_: ops.roi_align(
+        x_, boxes, [1], output_size=2).sum())(x)
+    assert np.isfinite(np.asarray(g)).all()
+    assert float(jnp.abs(g).sum()) > 0
+
+
+def test_roi_pool_max_semantics():
+    x = np.zeros((1, 1, 4, 4), np.float32)
+    x[0, 0, 0, 0] = 7.0
+    x[0, 0, 3, 3] = 9.0
+    boxes = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+    out = np.asarray(ops.roi_pool(x, boxes, [1], output_size=2))
+    assert out[0, 0, 0, 0] == 7.0  # top-left bin max
+    assert out[0, 0, 1, 1] == 9.0  # bottom-right bin max
+
+
+def test_psroi_pool_reads_position_channels():
+    # C = out_c(1) * 2*2; bin (i,j) must read channel i*2+j only
+    x = np.zeros((1, 4, 4, 4), np.float32)
+    for c in range(4):
+        x[0, c] = c + 1
+    boxes = np.array([[0.0, 0.0, 4.0, 4.0]], np.float32)
+    out = np.asarray(ops.psroi_pool(x, boxes, [1], output_size=2))
+    np.testing.assert_allclose(out[0, 0], [[1, 2], [3, 4]], atol=1e-5)
+
+
+def test_roi_batch_routing():
+    # two images; second box must read the second image's features
+    x = np.stack([np.zeros((1, 4, 4), np.float32),
+                  np.full((1, 4, 4), 3.0, np.float32)])
+    boxes = np.array([[0, 0, 3, 3], [0, 0, 3, 3]], np.float32)
+    out = np.asarray(ops.roi_align(x, boxes, [1, 1], output_size=1))
+    assert abs(out[0, 0, 0, 0]) < 1e-6
+    assert abs(out[1, 0, 0, 0] - 3.0) < 1e-5
+
+
+# ---------------- deformable conv ----------------
+
+def test_deform_conv2d_zero_offset_equals_conv2d():
+    import jax.numpy as jnp
+    import paddle_tpu.nn.functional as F
+    x = RNG.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    w = RNG.standard_normal((4, 3, 3, 3)).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 6, 6), np.float32)
+    out = ops.deform_conv2d(x, off, w)
+    ref = F.conv2d(jnp.asarray(x), jnp.asarray(w), stride=1, padding=0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_deform_conv2d_mask_scales_contribution():
+    x = np.ones((1, 1, 5, 5), np.float32)
+    w = np.ones((1, 1, 3, 3), np.float32)
+    off = np.zeros((1, 18, 3, 3), np.float32)
+    full = np.asarray(ops.deform_conv2d(x, off, w))
+    half = np.asarray(ops.deform_conv2d(
+        x, off, w, mask=np.full((1, 9, 3, 3), 0.5, np.float32)))
+    np.testing.assert_allclose(half, full * 0.5, rtol=1e-5)
+
+
+def test_deform_conv2d_layer_shape_and_integer_shift():
+    # offset (0, 1) shifts sampling one column right: equals plain conv of
+    # the shifted input
+    import paddle_tpu as pt
+    from paddle_tpu.vision.ops import DeformConv2D
+    pt.seed(0)
+    layer = DeformConv2D(2, 3, 3, padding=1)
+    x = RNG.standard_normal((1, 2, 6, 6)).astype(np.float32)
+    off0 = np.zeros((1, 18, 6, 6), np.float32)
+    base = np.asarray(layer(x, off0))
+    assert base.shape == (1, 3, 6, 6)
+    xs = np.roll(x, -1, axis=3)
+    off1 = np.zeros((1, 18, 6, 6), np.float32)
+    off1[:, 1::2] = 1.0  # dx = +1 for every tap
+    shifted = np.asarray(layer(x, off1))
+    # interior columns (away from the roll wrap + zero padding border)
+    np.testing.assert_allclose(shifted[..., 1:-2, 1:-2],
+                               np.asarray(layer(xs, off0))[..., 1:-2, 1:-2],
+                               rtol=2e-4, atol=2e-4)
+
+
+# ---------------- boxes ----------------
+
+def test_box_coder_encode_decode_roundtrip():
+    prior = RNG.uniform(0, 8, (5, 2)).astype(np.float32)
+    prior = np.concatenate([prior, prior + RNG.uniform(1, 4, (5, 2))
+                            .astype(np.float32)], -1)
+    target = RNG.uniform(0, 8, (3, 2)).astype(np.float32)
+    target = np.concatenate([target, target + RNG.uniform(1, 4, (3, 2))
+                             .astype(np.float32)], -1)
+    var = [0.1, 0.1, 0.2, 0.2]
+    enc = ops.box_coder(prior, var, target, "encode_center_size")
+    assert enc.shape == (3, 5, 4)
+    dec = ops.box_coder(prior, var, np.asarray(enc),
+                        "decode_center_size", axis=0)
+    # decoding its own encoding returns the target box against each prior
+    for m in range(5):
+        np.testing.assert_allclose(np.asarray(dec)[:, m], target, rtol=1e-3,
+                                   atol=1e-3)
+
+
+def test_prior_box_shapes_and_range():
+    feat = np.zeros((1, 8, 4, 4))
+    img = np.zeros((1, 3, 32, 32))
+    boxes, var = ops.prior_box(feat, img, min_sizes=[8.0], max_sizes=[16.0],
+                               aspect_ratios=[2.0], flip=True, clip=True)
+    assert boxes.shape[:2] == (4, 4) and boxes.shape[-1] == 4
+    assert var.shape == boxes.shape
+    b = np.asarray(boxes)
+    assert (b >= 0).all() and (b <= 1).all()
+    # anchors: min(1) + ar 2 + ar 1/2 + max = 4
+    assert boxes.shape[2] == 4
+
+
+def test_nms_reference_example():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                     np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    kept = ops.nms(boxes, 0.5, scores)
+    assert kept.tolist() == [0, 2]  # box 1 suppressed by box 0
+    # categorized: different categories never suppress each other
+    kept2 = ops.nms(boxes, 0.5, scores, np.array([0, 1, 0]), [0, 1])
+    assert sorted(kept2.tolist()) == [0, 1, 2]
+    kept3 = ops.nms(boxes, 0.5, scores, top_k=1)
+    assert kept3.tolist() == [0]
+
+
+def test_matrix_nms_decays_not_removes():
+    boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]]],
+                     np.float32)
+    scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)  # one fg class
+    out, idx, num = ops.matrix_nms(boxes, scores, score_threshold=0.1,
+                                   post_threshold=0.0, nms_top_k=-1,
+                                   keep_top_k=-1, background_label=-1,
+                                   return_index=True)
+    assert num.tolist() == [3]  # decayed, not dropped
+    assert out.shape == (3, 6)
+    # the overlapped box's decayed score is strictly below its raw score
+    decayed = {int(i): s for i, s in zip(idx[:, 0], out[:, 1])}
+    assert decayed[1] < 0.8 - 1e-6
+    assert abs(decayed[0] - 0.9) < 1e-6  # top box undecayed
+
+
+def test_generate_proposals_filters_and_clips():
+    N, A, H, W = 1, 2, 4, 4
+    scores = RNG.uniform(size=(N, A, H, W)).astype(np.float32)
+    deltas = RNG.standard_normal((N, A * 4, H, W)).astype(np.float32) * 0.1
+    anchors = RNG.uniform(0, 28, (H * W * A, 2)).astype(np.float32)
+    anchors = np.concatenate(
+        [anchors, anchors + RNG.uniform(2, 6, (H * W * A, 2))
+         .astype(np.float32)], -1)
+    var = np.ones_like(anchors)
+    rois, rscores, num = ops.generate_proposals(
+        scores, deltas, np.array([[32.0, 32.0]]), anchors, var,
+        post_nms_top_n=5, return_rois_num=True)
+    assert num[0] == len(rois) <= 5
+    assert (rois >= 0).all() and (rois <= 32).all()
+    assert (rscores[:-1] >= rscores[1:]).all()  # sorted by score
+
+
+def test_distribute_fpn_proposals_routes_by_scale():
+    rois = np.array([[0, 0, 16, 16],      # small -> low level
+                     [0, 0, 460, 460]], np.float32)  # >2x refer -> level 5
+    multi, restore = ops.distribute_fpn_proposals(rois, 2, 5, 4, 224)
+    sizes = [len(m) for m in multi]
+    assert sum(sizes) == 2
+    assert len(multi[0]) == 1 and len(multi[-1]) == 1
+    # restore index maps concatenated outputs back to input order
+    cat = np.concatenate([m for m in multi if len(m)])
+    np.testing.assert_allclose(cat[restore[:, 0].argsort()][restore[:, 0]],
+                               cat)
+
+
+# ---------------- yolo ----------------
+
+def test_yolo_box_decode_properties():
+    N, na, cls, H, W = 1, 2, 3, 4, 4
+    x = RNG.standard_normal((N, na * (5 + cls), H, W)).astype(np.float32)
+    boxes, scores = ops.yolo_box(x, np.array([[128, 128]]),
+                                 anchors=[10, 13, 16, 30], class_num=cls,
+                                 conf_thresh=0.0, downsample_ratio=32)
+    assert boxes.shape == (1, H * W * na, 4)
+    assert scores.shape == (1, H * W * na, cls)
+    b = np.asarray(boxes)
+    assert (b >= 0).all() and (b <= 127).all()  # clipped to image
+    s = np.asarray(scores)
+    assert (s >= 0).all() and (s <= 1).all()
+    # high threshold zeroes everything
+    b2, s2 = ops.yolo_box(x, np.array([[128, 128]]),
+                          anchors=[10, 13, 16, 30], class_num=cls,
+                          conf_thresh=1.1, downsample_ratio=32)
+    assert np.abs(np.asarray(s2)).sum() == 0
+
+
+def test_yolo_loss_trains_toward_gt():
+    import jax
+    import jax.numpy as jnp
+    N, cls, H, W = 1, 2, 4, 4
+    anchors = [10, 13, 16, 30, 33, 23]
+    mask = [0, 1, 2]
+    na = len(mask)
+    gt_box = np.array([[[0.5, 0.5, 0.3, 0.4]]], np.float32)
+    gt_label = np.array([[1]], np.int64)
+    pt_x = jnp.asarray(RNG.standard_normal(
+        (N, na * (5 + cls), H, W)) * 0.1, jnp.float32)
+    loss_fn = lambda x_: ops.yolo_loss(
+        x_, gt_box, gt_label, anchors, mask, cls, ignore_thresh=0.7,
+        downsample_ratio=32).sum()
+    l0 = float(loss_fn(pt_x))
+    assert np.isfinite(l0) and l0 > 0
+    # a few gradient steps reduce the loss
+    g = jax.grad(loss_fn)
+    x_cur = pt_x
+    for _ in range(20):
+        x_cur = x_cur - 0.1 * g(x_cur)
+    assert float(loss_fn(x_cur)) < l0
+
+
+# ---------------- misc ----------------
+
+def test_read_file_decode_jpeg_roundtrip(tmp_path):
+    from PIL import Image
+    img = (RNG.uniform(0, 255, (10, 12, 3))).astype(np.uint8)
+    p = tmp_path / "t.jpg"
+    Image.fromarray(img).save(p, quality=95)
+    data = ops.read_file(str(p))
+    assert data.dtype == np.uint8
+    out = np.asarray(ops.decode_jpeg(data, mode="rgb"))
+    assert out.shape == (3, 10, 12)
+    assert abs(out.astype(float).mean() - img.mean()) < 10  # lossy jpeg
+
+
+def test_conv_norm_activation_block():
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    pt.seed(0)
+    block = ops.ConvNormActivation(3, 8, kernel_size=3, stride=2,
+                                   activation_layer=nn.ReLU6)
+    x = RNG.standard_normal((2, 3, 16, 16)).astype(np.float32)
+    out = np.asarray(block(x))
+    assert out.shape == (2, 8, 8, 8)
+    assert (out >= 0).all() and (out <= 6).all()
+
+
+def test_yolo_loss_padded_gt_rows_do_not_clobber_targets():
+    # padded (all-zero) GT rows must not alter the loss of a real GT that
+    # happens to land in grid cell (0,0) with anchor 0 (review regression)
+    anchors = [10, 13, 16, 30, 33, 23]
+    mask = [0, 1, 2]
+    cls = 2
+    x = RNG.standard_normal((1, 3 * (5 + cls), 4, 4)).astype(np.float32)
+    gt1 = np.array([[[0.05, 0.05, 0.08, 0.1]]], np.float32)  # cell (0,0)
+    lbl1 = np.array([[1]], np.int64)
+    gt2 = np.concatenate([gt1, np.zeros((1, 3, 4), np.float32)], axis=1)
+    lbl2 = np.concatenate([lbl1, np.zeros((1, 3), np.int64)], axis=1)
+    l1 = float(np.asarray(ops.yolo_loss(x, gt1, lbl1, anchors, mask, cls,
+                                        0.7, 32)).sum())
+    l2 = float(np.asarray(ops.yolo_loss(x, gt2, lbl2, anchors, mask, cls,
+                                        0.7, 32)).sum())
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
