@@ -133,8 +133,31 @@ def _batch_index(boxes_num, num_boxes, batch):
                       total_repeat_length=num_boxes)
 
 
+def _bilinear_clamp(feat, y, x):
+    """RoI-align sampling semantics (reference roi_align kernel):
+    coordinates in (-1, 0) / (H-1, H) CLAMP to the border pixel at full
+    weight; only samples beyond that band are zero."""
+    H, W = feat.shape[1], feat.shape[2]
+    empty = (y < -1.0) | (y > H) | (x < -1.0) | (x > W)
+    y = jnp.clip(y, 0.0, H - 1)
+    x = jnp.clip(x, 0.0, W - 1)
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy1 = y - y0
+    wx1 = x - x0
+    out = 0.0
+    for dy, wy in ((0, 1 - wy1), (1, wy1)):
+        for dx, wx in ((0, 1 - wx1), (1, wx1)):
+            yi = jnp.clip(y0 + dy, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(x0 + dx, 0, W - 1).astype(jnp.int32)
+            out = out + feat[:, yi, xi] * (wy * wx)[None]
+    return out * (~empty)[None]
+
+
 def _bilinear(feat, y, x):
-    """feat [C,H,W]; y/x arbitrary-shape sample coords -> [C, *coords]."""
+    """feat [C,H,W]; y/x arbitrary-shape sample coords -> [C, *coords].
+    Zero beyond the image (deformable-conv semantics: taps landing in the
+    implicit zero padding contribute nothing)."""
     H, W = feat.shape[1], feat.shape[2]
     y0 = jnp.floor(y)
     x0 = jnp.floor(x)
@@ -183,7 +206,7 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
               + x1)  # [pw, s]
         yy = jnp.broadcast_to(iy[:, None, :, None], (ph, pw, s, s))
         xx = jnp.broadcast_to(ix[None, :, None, :], (ph, pw, s, s))
-        vals = _bilinear(feat, yy, xx)  # [C, ph, pw, s, s]
+        vals = _bilinear_clamp(feat, yy, xx)  # [C, ph, pw, s, s]
         return vals.mean(axis=(-2, -1))
 
     return jax.vmap(one)(boxes, bidx)
@@ -472,7 +495,7 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     bce = lambda logit, t: jnp.maximum(logit, 0) - logit * t + \
         jnp.log1p(jnp.exp(-jnp.abs(logit)))
 
-    def per_image(pi, boxes, labels):
+    def per_image(pi, boxes, labels, gscores):
         valid = (boxes[:, 2] > 0) & (boxes[:, 3] > 0)  # padded GTs are 0
         # best anchor over the FULL anchor set; train only if it's ours
         wh_img = boxes[:, 2:4] * jnp.asarray([inw, inh], jnp.float32)
@@ -491,6 +514,10 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         sa = jnp.where(ours, local_a, na)
         obj_t = jnp.zeros((na, H, W)).at[sa, gj, gi].max(
             1.0, mode="drop")
+        # mixup weighting (reference: gt_score scales the positive
+        # objectness + class terms); defaults to 1
+        sc_t = jnp.zeros((na, H, W)).at[sa, gj, gi].max(
+            gscores, mode="drop")
         tx = boxes[:, 0] * W - gi
         ty = boxes[:, 1] * H - gj
         tw = jnp.log(jnp.maximum(
@@ -539,26 +566,44 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         lwh = (jnp.abs(pi[:, 2] - w_t[..., 2])
                + jnp.abs(pi[:, 3] - w_t[..., 3]))
         lwh = (lwh * scale_t * obj_t).sum()
-        lobj = (bce(pi[:, 4], obj_t) * obj_t).sum() \
+        lobj = (bce(pi[:, 4], obj_t) * obj_t * sc_t).sum() \
             + (bce(pi[:, 4], obj_t) * (1 - obj_t)
                * (1 - ignore.astype(jnp.float32))).sum()
         lcls = (bce(pi[:, 5:].transpose(0, 2, 3, 1), cls_t)
-                * obj_t[..., None]).sum()
+                * (obj_t * sc_t)[..., None]).sum()
         return lxy + lwh + lobj + lcls
 
-    return jax.vmap(per_image)(p, gt_box, gt_label)
+    gscore_arr = jnp.ones(gt_label.shape, jnp.float32) if gt_score is None \
+        else jnp.asarray(gt_score, jnp.float32)
+    return jax.vmap(per_image)(p, gt_box, gt_label, gscore_arr)
 
 
 # ---------------- NMS family (host-side: variable outputs) ----------------
 
-def _iou_matrix(boxes):
+def _iou_rows(box, boxes, offset=0.0):
+    """IoU of one box vs many — O(n) rows keep greedy NMS at O(kept*n)
+    memory instead of materializing n x n. ``offset=1`` for
+    pixel-coordinate (non-normalized) boxes."""
+    area1 = (box[2] - box[0] + offset) * (box[3] - box[1] + offset)
+    areas = (boxes[:, 2] - boxes[:, 0] + offset) * \
+        (boxes[:, 3] - boxes[:, 1] + offset)
+    iw = np.minimum(box[2], boxes[:, 2]) - np.maximum(box[0], boxes[:, 0]) \
+        + offset
+    ih = np.minimum(box[3], boxes[:, 3]) - np.maximum(box[1], boxes[:, 1]) \
+        + offset
+    inter = np.maximum(iw, 0) * np.maximum(ih, 0)
+    return inter / np.maximum(area1 + areas - inter, 1e-10)
+
+
+def _iou_matrix(boxes, offset=0.0):
     x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
-    area = (x2 - x1) * (y2 - y1)
+    area = (x2 - x1 + offset) * (y2 - y1 + offset)
     ix1 = np.maximum(x1[:, None], x1[None])
     iy1 = np.maximum(y1[:, None], y1[None])
     ix2 = np.minimum(x2[:, None], x2[None])
     iy2 = np.minimum(y2[:, None], y2[None])
-    inter = np.maximum(ix2 - ix1, 0) * np.maximum(iy2 - iy1, 0)
+    inter = np.maximum(ix2 - ix1 + offset, 0) * \
+        np.maximum(iy2 - iy1 + offset, 0)
     return inter / np.maximum(area[:, None] + area[None] - inter, 1e-10)
 
 
@@ -572,15 +617,18 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     s = None if scores is None else np.asarray(scores, np.float32)
 
     def _greedy(idx):
-        iou = _iou_matrix(b[idx])
+        sel = b[idx]
         keep = []
         alive = np.ones(len(idx), bool)
         for i in range(len(idx)):
             if not alive[i]:
                 continue
             keep.append(idx[i])
-            alive &= (iou[i] <= iou_threshold) | ~alive | \
-                (np.arange(len(idx)) <= i)
+            # one IoU row per KEPT box: O(kept * n) work, O(n) memory
+            # (a full n x n matrix is ~1 GB at RPN's 6000-box default)
+            later = alive & (np.arange(len(idx)) > i)
+            if later.any():
+                alive[later] &= _iou_rows(sel[i], sel[later]) <= iou_threshold
         return keep
 
     if category_idxs is None:
@@ -626,7 +674,7 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold,
                                               else len(idx)]
             bx = bboxes[n, order]
             ss = sc[order]
-            iou = _iou_matrix(bx)
+            iou = _iou_matrix(bx, offset=0.0 if normalized else 1.0)
             iu = np.triu(iou, 1)
             # compensate[i] = box i's own max overlap with a higher-scored
             # box — the denominator uses the SUPPRESSOR's compensation

@@ -4,6 +4,8 @@ the device graph static-shaped."""
 
 from __future__ import annotations
 
+import math
+
 import numpy as np
 
 __all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop", "RandomCrop",
@@ -166,3 +168,412 @@ class Pad:
             self.padding if len(self.padding) == 4 else
             [self.padding[0], self.padding[1], self.padding[0], self.padding[1]])
         return np.pad(img, ((0, 0), (t, b), (l, r)), constant_values=self.fill)
+
+
+# ---------------- color transforms (parity: transforms.py ColorJitter
+# family + functional adjust_*) ----------------
+
+__all__ += ["BaseTransform", "ColorJitter", "BrightnessTransform",
+            "ContrastTransform", "SaturationTransform", "HueTransform",
+            "Grayscale", "RandomRotation", "RandomAffine",
+            "RandomPerspective", "RandomResizedCrop", "RandomErasing",
+            "adjust_brightness", "adjust_contrast", "adjust_saturation",
+            "adjust_hue", "to_grayscale", "crop", "center_crop", "pad",
+            "rotate", "affine", "perspective", "erase"]
+
+_GRAY_W = np.asarray([0.299, 0.587, 0.114], np.float32)
+
+
+class BaseTransform:
+    """Parity: transforms.py BaseTransform — _apply_image hook."""
+
+    def __call__(self, img):
+        return self._apply_image(img)
+
+    def _apply_image(self, img):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+def adjust_brightness(img, brightness_factor):
+    return np.asarray(img, np.float32) * brightness_factor
+
+
+def to_grayscale(img, num_output_channels=1):
+    img = np.asarray(img, np.float32)
+    gray = np.tensordot(_GRAY_W, img, axes=([0], [0]))[None]
+    if num_output_channels == 3:
+        gray = np.repeat(gray, 3, axis=0)
+    return gray
+
+
+def adjust_contrast(img, contrast_factor):
+    img = np.asarray(img, np.float32)
+    mean = to_grayscale(img)[0].mean()
+    return img * contrast_factor + mean * (1 - contrast_factor)
+
+
+def adjust_saturation(img, saturation_factor):
+    img = np.asarray(img, np.float32)
+    gray = to_grayscale(img, 3)
+    return img * saturation_factor + gray * (1 - saturation_factor)
+
+
+def adjust_hue(img, hue_factor):
+    """hue_factor in [-0.5, 0.5] — shift along the HSV hue circle."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    img = np.asarray(img, np.float32)
+    scale = 255.0 if img.max() > 1.5 else 1.0
+    rgb = (img / scale).clip(0, 1)
+    r, g, b = rgb
+    mx = rgb.max(0)
+    mn = rgb.min(0)
+    d = mx - mn
+    safe = np.where(d == 0, 1.0, d)
+    h = np.where(mx == r, ((g - b) / safe) % 6,
+                 np.where(mx == g, (b - r) / safe + 2, (r - g) / safe + 4))
+    h = np.where(d == 0, 0.0, h) / 6.0
+    s = np.where(mx == 0, 0.0, d / np.where(mx == 0, 1.0, mx))
+    h = (h + hue_factor) % 1.0
+    # HSV -> RGB
+    i = np.floor(h * 6).astype(int)
+    f = h * 6 - i
+    p = mx * (1 - s)
+    q = mx * (1 - f * s)
+    t = mx * (1 - (1 - f) * s)
+    i = i % 6
+    sextants = np.stack([  # [6, 3, H, W]: RGB per hue sextant
+        np.stack([mx, t, p]), np.stack([q, mx, p]), np.stack([p, mx, t]),
+        np.stack([p, q, mx]), np.stack([t, p, mx]), np.stack([mx, p, q])])
+    out = np.take_along_axis(sextants, i[None, None], axis=0)[0]
+    return out * scale
+
+
+def _jitter_range(value, name, center=1.0, bound=None):
+    """Paddle accepts scalar v (range [center-v, center+v] clamped >= 0)
+    or an explicit (min, max) pair; returns the (lo, hi) range or None
+    when the transform is a no-op."""
+    if isinstance(value, (tuple, list)):
+        lo, hi = float(value[0]), float(value[1])
+    else:
+        value = float(value)
+        if value < 0:
+            raise ValueError(f"{name} value should be non-negative")
+        if value == 0:
+            return None
+        lo, hi = center - value, center + value
+        if center == 1.0:
+            lo = max(lo, 0.0)
+    if bound is not None and not (bound[0] <= lo <= hi <= bound[1]):
+        raise ValueError(f"{name} range {lo, hi} outside {bound}")
+    return (lo, hi) if (lo, hi) != (center, center) else None
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.range = _jitter_range(value, "brightness")
+
+    def _apply_image(self, img):
+        if self.range is None:
+            return img
+        return adjust_brightness(img, np.random.uniform(*self.range))
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.range = _jitter_range(value, "contrast")
+
+    def _apply_image(self, img):
+        if self.range is None:
+            return img
+        return adjust_contrast(img, np.random.uniform(*self.range))
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.range = _jitter_range(value, "saturation")
+
+    def _apply_image(self, img):
+        if self.range is None:
+            return img
+        return adjust_saturation(img, np.random.uniform(*self.range))
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.range = _jitter_range(value, "hue", center=0.0,
+                                   bound=(-0.5, 0.5))
+
+    def _apply_image(self, img):
+        if self.range is None:
+            return img
+        return adjust_hue(img, np.random.uniform(*self.range))
+
+
+class ColorJitter(BaseTransform):
+    """Parity: transforms.py ColorJitter — random order of the four."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.ts = [BrightnessTransform(brightness),
+                   ContrastTransform(contrast),
+                   SaturationTransform(saturation), HueTransform(hue)]
+
+    def _apply_image(self, img):
+        for i in np.random.permutation(len(self.ts)):
+            img = self.ts[i](img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+# ---------------- geometric transforms ----------------
+
+def crop(img, top, left, height, width):
+    return np.asarray(img)[:, top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    size = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    c, h, w = np.asarray(img).shape
+    return crop(img, max(0, (h - size[0]) // 2), max(0, (w - size[1]) // 2),
+                size[0], size[1])
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    return Pad(padding, fill, padding_mode)(np.asarray(img))
+
+
+def _warp(img, inv3, fill=0.0):
+    """Inverse-warp CHW with a 3x3 matrix mapping OUTPUT -> INPUT coords
+    (x, y, 1); bilinear; out-of-image samples take ``fill``."""
+    img = np.asarray(img, np.float32)
+    c, h, w = img.shape
+    ys, xs = np.meshgrid(np.arange(h, dtype=np.float32),
+                         np.arange(w, dtype=np.float32), indexing="ij")
+    ones = np.ones_like(xs)
+    src = inv3 @ np.stack([xs.ravel(), ys.ravel(), ones.ravel()])
+    sx = src[0] / src[2]
+    sy = src[1] / src[2]
+    x0 = np.floor(sx)
+    y0 = np.floor(sy)
+    out = np.zeros((c, h * w), np.float32)
+    wsum = np.zeros((h * w,), np.float32)
+    for dy in (0, 1):
+        for dx in (0, 1):
+            xi = x0 + dx
+            yi = y0 + dy
+            wgt = (1 - np.abs(sx - xi)) * (1 - np.abs(sy - yi))
+            valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+            xi_c = np.clip(xi, 0, w - 1).astype(int)
+            yi_c = np.clip(yi, 0, h - 1).astype(int)
+            out += img[:, yi_c, xi_c] * (wgt * valid)
+            wsum += wgt * valid
+    out = out + fill * (1 - wsum)  # fill mass for out-of-image taps
+    return out.reshape(c, h, w)
+
+
+def _affine_inv(center, angle, translate, scale, shear):
+    cx, cy = center
+    rot = math.radians(angle)
+    shx, shy = (math.radians(s) for s in shear)
+    # forward = T(translate) @ C @ R(angle) Scale Shear @ C^-1 ; invert
+    a = math.cos(rot - shy) / math.cos(shy)
+    b = -math.cos(rot - shy) * math.tan(shx) / math.cos(shy) - math.sin(rot)
+    c = math.sin(rot - shy) / math.cos(shy)
+    d = -math.sin(rot - shy) * math.tan(shx) / math.cos(shy) + math.cos(rot)
+    fwd = np.array([[a * scale, b * scale, 0.0],
+                    [c * scale, d * scale, 0.0],
+                    [0.0, 0.0, 1.0]], np.float32)
+    pre = np.array([[1, 0, cx + translate[0]], [0, 1, cy + translate[1]],
+                    [0, 0, 1]], np.float32)
+    post = np.array([[1, 0, -cx], [0, 1, -cy], [0, 0, 1]], np.float32)
+    return np.linalg.inv(pre @ fwd @ post)
+
+
+def affine(img, angle, translate=(0, 0), scale=1.0, shear=(0, 0),
+           interpolation="bilinear", fill=0, center=None):
+    img = np.asarray(img, np.float32)
+    _, h, w = img.shape
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    if np.isscalar(shear):
+        shear = (float(shear), 0.0)
+    return _warp(img, _affine_inv(center, angle, translate, scale, shear),
+                 fill)
+
+
+def rotate(img, angle, interpolation="bilinear", expand=False, center=None,
+           fill=0):
+    # PIL/paddle convention: positive angle = counter-clockwise; affine()
+    # keeps the torchvision clockwise-positive matrix convention
+    angle = -angle
+    if expand:
+        img = np.asarray(img, np.float32)
+        _, h, w = img.shape
+        rot = math.radians(angle)
+        nw = int(abs(w * math.cos(rot)) + abs(h * math.sin(rot)) + 0.5)
+        nh = int(abs(h * math.cos(rot)) + abs(w * math.sin(rot)) + 0.5)
+        # pad with FILL, not zero — the expansion band is outside the
+        # original image and must read as fill after the warp
+        padded = np.full((img.shape[0], nh, nw), np.float32(fill))
+        t, l = (nh - h) // 2, (nw - w) // 2
+        padded[:, t:t + h, l:l + w] = img
+        img = padded
+    return affine(img, angle, fill=fill, center=center)
+
+
+def perspective(img, startpoints, endpoints, interpolation="bilinear",
+                fill=0):
+    """Warp so that startpoints map onto endpoints (4 corner pairs)."""
+    a = []
+    bvec = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([sx, sy, 1, 0, 0, 0, -ex * sx, -ex * sy])
+        a.append([0, 0, 0, sx, sy, 1, -ey * sx, -ey * sy])
+        bvec += [ex, ey]
+    coeff = np.linalg.solve(np.asarray(a, np.float64),
+                            np.asarray(bvec, np.float64))
+    fwd = np.append(coeff, 1.0).reshape(3, 3).astype(np.float32)
+    return _warp(np.asarray(img, np.float32), np.linalg.inv(fwd), fill)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    img = np.asarray(img) if inplace else np.array(img, copy=True)
+    v = np.asarray(v, img.dtype)
+    if v.ndim == 1:  # per-channel fill
+        v = v[:, None, None]
+    img[:, i:i + h, j:j + w] = v
+    return img
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="bilinear", expand=False,
+                 center=None, fill=0, keys=None):
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else tuple(degrees)
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, expand=self.expand, center=self.center,
+                      fill=self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="bilinear", fill=0, center=None, keys=None):
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else tuple(degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        _, h, w = np.asarray(img).shape
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = np.random.uniform(*self.scale) if self.scale else 1.0
+        sh = (0.0, 0.0)
+        if self.shear is not None:
+            if np.isscalar(self.shear):  # scalar s -> x-shear in [-s, s]
+                sh = (np.random.uniform(-self.shear, self.shear), 0.0)
+            elif len(self.shear) == 2:   # [lo, hi] -> x-shear range
+                sh = (np.random.uniform(*self.shear), 0.0)
+            else:                        # [xlo, xhi, ylo, yhi]
+                sh = (np.random.uniform(*self.shear[:2]),
+                      np.random.uniform(*self.shear[2:]))
+        return affine(img, angle, (tx, ty), sc, sh, fill=self.fill,
+                      center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="bilinear", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if np.random.random() >= self.prob:
+            return img
+        _, h, w = np.asarray(img).shape
+        d = self.distortion_scale
+        dx = int(d * w / 2)
+        dy = int(d * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(np.random.randint(0, dx + 1), np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1),
+                np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1)),
+               (np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1))]
+        return perspective(img, start, end, fill=self.fill)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def _apply_image(self, img):
+        img = np.asarray(img, np.float32)
+        _, h, w = img.shape
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            logr = np.random.uniform(math.log(self.ratio[0]),
+                                     math.log(self.ratio[1]))
+            ar = math.exp(logr)
+            cw = int(round(math.sqrt(target * ar)))
+            ch = int(round(math.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                return resize(crop(img, i, j, ch, cw), self.size)
+        return resize(center_crop(img, (min(h, w), min(h, w))), self.size)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        if np.random.random() >= self.prob:
+            return img
+        img = np.asarray(img, np.float32)
+        c, h, w = img.shape
+        for _ in range(10):
+            target = h * w * np.random.uniform(*self.scale)
+            ar = math.exp(np.random.uniform(math.log(self.ratio[0]),
+                                            math.log(self.ratio[1])))
+            eh = int(round(math.sqrt(target / ar)))
+            ew = int(round(math.sqrt(target * ar)))
+            if eh < h and ew < w and eh > 0 and ew > 0:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                v = np.random.standard_normal((c, eh, ew)).astype(np.float32) \
+                    if self.value == "random" else self.value
+                return erase(img, i, j, eh, ew, v)
+        return img

@@ -1,0 +1,133 @@
+"""Color + geometric transforms (parity: python/paddle/vision/transforms/
+{transforms,functional}.py — ColorJitter family, rotate/affine/
+perspective, RandomResizedCrop, RandomErasing)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.vision.transforms as T
+
+RNG = np.random.default_rng(2)
+
+
+def _img(c=3, h=8, w=8):
+    return RNG.uniform(0, 1, (c, h, w)).astype(np.float32)
+
+
+def test_adjust_brightness_contrast_identity_and_scale():
+    img = _img()
+    np.testing.assert_allclose(T.adjust_brightness(img, 1.0), img)
+    np.testing.assert_allclose(T.adjust_brightness(img, 2.0), img * 2)
+    np.testing.assert_allclose(T.adjust_contrast(img, 1.0), img, rtol=1e-6)
+    # contrast 0 collapses to the gray mean
+    flat = T.adjust_contrast(img, 0.0)
+    assert np.ptp(flat) < 1e-6
+
+
+def test_adjust_saturation_and_grayscale():
+    img = _img()
+    np.testing.assert_allclose(T.adjust_saturation(img, 1.0), img,
+                               rtol=1e-6)
+    gray = T.adjust_saturation(img, 0.0)
+    # fully desaturated: all channels equal
+    np.testing.assert_allclose(gray[0], gray[1], rtol=1e-5)
+    g1 = T.to_grayscale(img)
+    assert g1.shape == (1, 8, 8)
+    g3 = T.to_grayscale(img, 3)
+    assert g3.shape == (3, 8, 8)
+    np.testing.assert_allclose(g3[0], g1[0])
+
+
+def test_adjust_hue_identity_and_full_cycle():
+    img = _img()
+    np.testing.assert_allclose(T.adjust_hue(img, 0.0), img, atol=1e-5)
+    # +0.5 then +0.5 wraps the hue circle back to the original
+    back = T.adjust_hue(T.adjust_hue(img, 0.5), 0.5)
+    np.testing.assert_allclose(back, img, atol=1e-4)
+    with pytest.raises(ValueError):
+        T.adjust_hue(img, 0.7)
+
+
+def test_rotate_90_matches_numpy():
+    img = _img(1, 6, 6)
+    out = T.rotate(img, 90.0)
+    # 90-degree CCW rotation about the center equals np.rot90 on (H, W)
+    ref = np.rot90(img[0]).copy()
+    np.testing.assert_allclose(out[0], ref, atol=1e-4)
+
+
+def test_rotate_zero_and_affine_identity():
+    img = _img()
+    np.testing.assert_allclose(T.rotate(img, 0.0), img, atol=1e-5)
+    np.testing.assert_allclose(T.affine(img, 0.0), img, atol=1e-5)
+
+
+def test_affine_translate_shifts():
+    img = _img(1, 8, 8)
+    out = T.affine(img, 0.0, translate=(2, 0))
+    np.testing.assert_allclose(out[0, :, 2:], img[0, :, :-2], atol=1e-4)
+
+
+def test_perspective_identity_corners():
+    img = _img()
+    pts = [(0, 0), (7, 0), (7, 7), (0, 7)]
+    np.testing.assert_allclose(T.perspective(img, pts, pts), img, atol=1e-4)
+
+
+def test_erase_and_random_erasing():
+    img = _img()
+    out = T.erase(img, 2, 3, 2, 2, 0.0)
+    assert np.abs(out[:, 2:4, 3:5]).sum() == 0
+    assert np.abs(out[:, :2]).sum() > 0
+    np.random.seed(0)
+    er = T.RandomErasing(prob=1.0)(img)
+    assert er.shape == img.shape
+    assert not np.allclose(er, img)
+
+
+def test_random_resized_crop_shape():
+    np.random.seed(0)
+    out = T.RandomResizedCrop(4)(_img(3, 16, 16))
+    assert out.shape == (3, 4, 4)
+
+
+def test_color_jitter_and_random_transforms_shapes():
+    np.random.seed(1)
+    img = _img()
+    for t in (T.ColorJitter(0.4, 0.4, 0.4, 0.1), T.Grayscale(3),
+              T.RandomRotation(30), T.RandomPerspective(prob=1.0),
+              T.RandomAffine(10, translate=(0.1, 0.1), scale=(0.9, 1.1))):
+        out = t(img)
+        assert out.shape == img.shape, type(t).__name__
+
+
+def test_crop_center_crop_pad_functions():
+    img = _img(3, 8, 10)
+    assert T.crop(img, 1, 2, 4, 5).shape == (3, 4, 5)
+    assert T.center_crop(img, 6).shape == (3, 6, 6)
+    assert T.pad(img, 2).shape == (3, 12, 14)
+
+
+def test_review_regressions_transforms():
+    img = _img()
+    # per-channel erase value
+    out = T.erase(img, 1, 1, 3, 4, np.array([0.1, 0.2, 0.3], np.float32))
+    np.testing.assert_allclose(out[:, 1:4, 1:5],
+                               np.broadcast_to(
+                                   np.array([0.1, 0.2, 0.3],
+                                            np.float32)[:, None, None],
+                                   (3, 3, 4)))
+    # tuple ranges accepted by the jitter family
+    np.random.seed(0)
+    T.ColorJitter(brightness=(0.5, 1.5), contrast=(0.8, 1.2),
+                  saturation=(0.9, 1.1), hue=(-0.1, 0.1))(img)
+    # sequence shear is applied (result differs from shear=None)
+    np.random.seed(3)
+    a = T.RandomAffine(0, shear=[10, 10])(img)
+    assert not np.allclose(a, img)
+    # expand-rotate fills the expansion band with `fill`
+    big = T.rotate(np.full((1, 6, 6), 100.0, np.float32), 45.0,
+                   expand=True, fill=50.0)
+    corners = [big[0, 0, 0], big[0, 0, -1], big[0, -1, 0], big[0, -1, -1]]
+    for c in corners:
+        assert abs(c - 50.0) < 1.0, corners

@@ -286,3 +286,46 @@ def test_yolo_loss_padded_gt_rows_do_not_clobber_targets():
     l2 = float(np.asarray(ops.yolo_loss(x, gt2, lbl2, anchors, mask, cls,
                                         0.7, 32)).sum())
     np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+def test_roi_align_boundary_clamp_semantics():
+    # reference kernel: samples in (-1, 0) clamp to pixel 0 at FULL
+    # weight; box [0,0,1,1] aligned on a 4x4 ramp gives exactly 0.625
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    boxes = np.array([[0.0, 0.0, 1.0, 1.0]], np.float32)
+    out = ops.roi_align(x, boxes, [1], output_size=1, aligned=True)
+    np.testing.assert_allclose(float(np.asarray(out)[0, 0, 0, 0]), 0.625,
+                               atol=1e-5)
+
+
+def test_yolo_loss_gt_score_weights_positive_terms():
+    anchors = [10, 13, 16, 30, 33, 23]
+    mask = [0, 1, 2]
+    cls = 2
+    x = RNG.standard_normal((1, 3 * (5 + cls), 4, 4)).astype(np.float32)
+    gt = np.array([[[0.5, 0.5, 0.3, 0.4]]], np.float32)
+    lbl = np.array([[1]], np.int64)
+    l_full = float(np.asarray(ops.yolo_loss(
+        x, gt, lbl, anchors, mask, cls, 0.7, 32,
+        gt_score=np.array([[1.0]], np.float32))).sum())
+    l_none = float(np.asarray(ops.yolo_loss(
+        x, gt, lbl, anchors, mask, cls, 0.7, 32)).sum())
+    l_half = float(np.asarray(ops.yolo_loss(
+        x, gt, lbl, anchors, mask, cls, 0.7, 32,
+        gt_score=np.array([[0.5]], np.float32))).sum())
+    np.testing.assert_allclose(l_full, l_none, rtol=1e-6)
+    assert l_half < l_full  # down-weighted positives shrink the loss
+
+
+def test_matrix_nms_normalized_flag_changes_iou():
+    # pixel-space boxes: +1 offset raises IoU, decaying the overlap more
+    boxes = np.array([[[0, 0, 4, 4], [2, 0, 6, 4], [20, 20, 24, 24]]],
+                     np.float32)
+    scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)
+    kw = dict(score_threshold=0.1, post_threshold=0.0, nms_top_k=-1,
+              keep_top_k=-1, background_label=-1, return_index=True)
+    out_n, idx_n, _ = ops.matrix_nms(boxes, scores, normalized=True, **kw)
+    out_p, idx_p, _ = ops.matrix_nms(boxes, scores, normalized=False, **kw)
+    dn = {int(i): s for i, s in zip(idx_n[:, 0], out_n[:, 1])}
+    dp = {int(i): s for i, s in zip(idx_p[:, 0], out_p[:, 1])}
+    assert dp[1] < dn[1]  # pixel-mode IoU is larger -> stronger decay
