@@ -1,0 +1,76 @@
+"""Model hub (parity: python/paddle/hapi/hub.py — list/help/load over a
+``hubconf.py`` entry-point protocol).
+
+Zero-egress environment: the ``github``/``gitee`` sources raise with the
+archive URL for the user to fetch; ``source="local"`` (a directory
+containing hubconf.py) is fully functional — the protocol, entry-point
+discovery, dependency check, and kwargs forwarding match the reference.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_builtin_list = list
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location(
+        f"paddle_tpu_hubconf_{abs(hash(repo_dir))}", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    deps = getattr(mod, "dependencies", [])
+    missing = [d for d in deps
+               if importlib.util.find_spec(d) is None]
+    if missing:
+        raise RuntimeError(
+            f"hub repo {repo_dir!r} requires missing packages: {missing}")
+    return mod
+
+
+def _resolve(repo, source):
+    if source == "local":
+        return _load_hubconf(repo)
+    if source in ("github", "gitee"):
+        raise RuntimeError(
+            f"this environment has no network egress; clone "
+            f"https://{source}.com/{repo} locally and call with "
+            f"source='local'")
+    raise ValueError(f"unknown source {source!r}: use local/github/gitee")
+
+
+def list(repo_dir, source="github", force_reload=False):
+    """Entry points exported by the repo's hubconf.py."""
+    mod = _resolve(repo_dir, source)
+    return _builtin_list(
+        name for name in dir(mod)
+        if callable(getattr(mod, name)) and not name.startswith("_")
+        and name != "dependencies")
+
+
+def help(repo_dir, model, source="github", force_reload=False):
+    """Docstring of one entry point."""
+    mod = _resolve(repo_dir, source)
+    entry = getattr(mod, model, None)
+    if entry is None or not callable(entry):
+        raise RuntimeError(f"no callable entry point {model!r}; "
+                           f"available: {list(repo_dir, source)}")
+    return entry.__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    """Instantiate an entry point with kwargs."""
+    mod = _resolve(repo_dir, source)
+    entry = getattr(mod, model, None)
+    if entry is None or not callable(entry):
+        raise RuntimeError(f"no callable entry point {model!r}; "
+                           f"available: {list(repo_dir, source)}")
+    return entry(**kwargs)

@@ -1,7 +1,5 @@
-"""paddle_tpu.text (parity: python/paddle/text/ — the ops surface is
-viterbi_decode/ViterbiDecoder; the dataset zoo of the reference is
-deprecated upstream and represented here by the vision/io dataset
-machinery)."""
+"""paddle_tpu.text (parity: python/paddle/text/ — viterbi_decode/
+ViterbiDecoder plus the dataset zoo in ``text.datasets``)."""
 
 from __future__ import annotations
 
@@ -9,8 +7,12 @@ import jax
 import jax.numpy as jnp
 
 from ..nn.module import Layer
+from . import datasets  # noqa: F401
+from .datasets import (Conll05st, Imdb, Imikolov, Movielens,  # noqa: F401
+                       UCIHousing, WMT14, WMT16)
 
-__all__ = ["viterbi_decode", "ViterbiDecoder"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets", "UCIHousing",
+           "Imdb", "Imikolov", "Movielens", "Conll05st", "WMT14", "WMT16"]
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,

@@ -1,5 +1,8 @@
 """paddle_tpu.utils (parity: python/paddle/utils/ — the custom-op toolchain
 lives in utils.cpp_extension in the reference; here in utils.custom_op)."""
 
-from . import custom_op  # noqa: F401
 from . import cpp_extension  # noqa: F401
+from . import custom_op  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import unique_name  # noqa: F401
+from .deprecated import deprecated, try_import  # noqa: F401

@@ -1,0 +1,47 @@
+"""Deprecation + optional-import helpers (parity: python/paddle/utils/
+{deprecated,lazy_import}.py)."""
+
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+
+__all__ = ["deprecated", "try_import"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "",
+               level: int = 1):
+    """Mark an API deprecated: warns (level 1) or raises (level 2)."""
+
+    def deco(fn):
+        msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f"; use {update_to} instead"
+        if reason:
+            msg += f" ({reason})"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if level >= 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        wrapper.__doc__ = (f"[DEPRECATED] {msg}\n\n" + (fn.__doc__ or ""))
+        return wrapper
+
+    return deco
+
+
+def try_import(module_name: str, err_msg: str | None = None):
+    """Import an optional dependency with an actionable error."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"optional dependency {module_name!r} is not "
+            f"installed (and this environment cannot pip install — gate "
+            f"the feature)") from e

@@ -1,0 +1,34 @@
+"""DLPack interop (parity: python/paddle/utils/dlpack.py —
+to_dlpack/from_dlpack). JAX arrays speak DLPack natively, so this is a
+zero-copy bridge to torch/numpy/cupy on the same device."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Export a tensor as a DLPack capsule (zero-copy where possible)."""
+    x = jnp.asarray(x)
+    return x.__dlpack__()
+
+
+def from_dlpack(dlpack):
+    """Import any object implementing the DLPack protocol (``__dlpack__``
+    + ``__dlpack_device__``: torch/cupy/numpy/jax arrays) as a framework
+    tensor, zero-copy on the same device.
+
+    Deviation from the reference: bare PyCapsules are rejected — a
+    capsule carries no device information, so importing one would have
+    to GUESS where the memory lives (XLA refuses them for the same
+    reason). Pass the producing array object instead; every current
+    framework exposes the protocol."""
+    if hasattr(dlpack, "__dlpack__") and hasattr(dlpack, "__dlpack_device__"):
+        return jnp.from_dlpack(dlpack)
+    raise TypeError(
+        "from_dlpack needs an object with __dlpack__/__dlpack_device__ "
+        "(e.g. the torch/cupy/numpy array itself, not a raw capsule — "
+        "a capsule cannot say which device its memory is on)")

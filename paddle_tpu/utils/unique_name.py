@@ -1,0 +1,48 @@
+"""Unique name generation (parity: python/paddle/utils/unique_name.py —
+generate/guard/switch over a process-wide counter namespace)."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+__all__ = ["generate", "guard", "switch"]
+
+_lock = threading.Lock()
+
+
+class _Generator:
+    def __init__(self):
+        self.ids: dict[str, int] = {}
+
+    def __call__(self, key: str) -> str:
+        with _lock:
+            n = self.ids.get(key, 0)
+            self.ids[key] = n + 1
+        return f"{key}_{n}"
+
+
+_generator = _Generator()
+
+
+def generate(key: str) -> str:
+    """Next unique name for ``key``: key_0, key_1, ..."""
+    return _generator(key)
+
+
+def switch(new_generator=None):
+    """Replace the active namespace; returns the previous one."""
+    global _generator
+    old = _generator
+    _generator = new_generator if new_generator is not None else _Generator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """Scope a fresh (or given) namespace; restores the old one on exit."""
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

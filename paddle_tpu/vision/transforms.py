@@ -376,7 +376,9 @@ def _warp(img, inv3, fill=0.0):
             yi_c = np.clip(yi, 0, h - 1).astype(int)
             out += img[:, yi_c, xi_c] * (wgt * valid)
             wsum += wgt * valid
-    out = out + fill * (1 - wsum)  # fill mass for out-of-image taps
+    # fill mass for out-of-image taps; scalar or per-channel fill
+    fill = np.asarray(fill, np.float32).reshape(-1, 1)
+    out = out + fill * (1 - wsum)[None]
     return out.reshape(c, h, w)
 
 
@@ -421,9 +423,11 @@ def rotate(img, angle, interpolation="bilinear", expand=False, center=None,
         rot = math.radians(angle)
         nw = int(abs(w * math.cos(rot)) + abs(h * math.sin(rot)) + 0.5)
         nh = int(abs(h * math.cos(rot)) + abs(w * math.sin(rot)) + 0.5)
-        # pad with FILL, not zero — the expansion band is outside the
-        # original image and must read as fill after the warp
-        padded = np.full((img.shape[0], nh, nw), np.float32(fill))
+        # pad with FILL (scalar or per-channel), not zero — the expansion
+        # band is outside the original image and reads as fill post-warp
+        padded = np.broadcast_to(
+            np.asarray(fill, np.float32).reshape(-1, 1, 1),
+            (img.shape[0], nh, nw)).copy()
         t, l = (nh - h) // 2, (nw - w) // 2
         padded[:, t:t + h, l:l + w] = img
         img = padded
@@ -573,7 +577,10 @@ class RandomErasing(BaseTransform):
             if eh < h and ew < w and eh > 0 and ew > 0:
                 i = np.random.randint(0, h - eh + 1)
                 j = np.random.randint(0, w - ew + 1)
-                v = np.random.standard_normal((c, eh, ew)).astype(np.float32) \
-                    if self.value == "random" else self.value
+                if isinstance(self.value, str) and self.value == "random":
+                    v = np.random.standard_normal(
+                        (c, eh, ew)).astype(np.float32)
+                else:
+                    v = self.value
                 return erase(img, i, j, eh, ew, v)
         return img

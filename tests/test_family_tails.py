@@ -217,3 +217,71 @@ def test_esc50_local_meta_and_features(tmp_path):
 def test_esc50_without_data_dir_names_the_archive():
     with pytest.raises(RuntimeError, match="ESC-50"):
         audio.datasets.ESC50(data_dir=None)
+
+
+# ---------------- hub + utils tails ----------------
+
+def test_hub_local_repo_protocol(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "dependencies = ['numpy']\n"
+        "def tiny_model(width=4):\n"
+        "    'builds a tiny model'\n"
+        "    return {'width': width}\n")
+    import paddle_tpu.hub as hub
+    assert hub.list(str(tmp_path), source="local") == ["tiny_model"]
+    assert "tiny" in hub.help(str(tmp_path), "tiny_model", source="local")
+    got = hub.load(str(tmp_path), "tiny_model", source="local", width=8)
+    assert got == {"width": 8}
+    with pytest.raises(RuntimeError, match="egress"):
+        hub.load("o/repo", "m", source="github")
+    with pytest.raises(RuntimeError, match="available"):
+        hub.load(str(tmp_path), "nope", source="local")
+
+
+def test_hub_missing_dependency_named(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "dependencies = ['not_a_real_pkg_xyz']\n"
+        "def m():\n    return 1\n")
+    import paddle_tpu.hub as hub
+    with pytest.raises(RuntimeError, match="not_a_real_pkg_xyz"):
+        hub.list(str(tmp_path), source="local")
+
+
+def test_dlpack_roundtrip_with_torch():
+    import torch
+    import jax.numpy as jnp
+    from paddle_tpu.utils import dlpack
+    t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+    arr = dlpack.from_dlpack(t)  # torch -> jax via __dlpack__
+    np.testing.assert_allclose(np.asarray(arr), t.numpy())
+    cap = dlpack.to_dlpack(jnp.asarray([1.0, 2.0]))
+    back = torch.utils.dlpack.from_dlpack(cap)
+    np.testing.assert_allclose(back.numpy(), [1.0, 2.0])
+
+
+def test_unique_name_generate_and_guard():
+    from paddle_tpu.utils import unique_name
+    with unique_name.guard():
+        a = unique_name.generate("fc")
+        b = unique_name.generate("fc")
+        c = unique_name.generate("conv")
+    assert (a, b, c) == ("fc_0", "fc_1", "conv_0")
+    with unique_name.guard():
+        assert unique_name.generate("fc") == "fc_0"  # fresh namespace
+
+
+def test_deprecated_and_try_import():
+    from paddle_tpu.utils import deprecated, try_import
+    import warnings
+
+    @deprecated(update_to="new_fn", since="2.0")
+    def old_fn():
+        return 42
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old_fn() == 42
+    assert any("new_fn" in str(x.message) for x in w)
+    assert try_import("math") is not None
+    with pytest.raises(ImportError):
+        try_import("definitely_not_installed_xyz")

@@ -1,0 +1,177 @@
+"""Text dataset zoo over fabricated official-layout archives (parity:
+python/paddle/text/datasets/ + test/legacy_test/test_datasets.py)."""
+
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text import (Conll05st, Imdb, Imikolov, Movielens,
+                             UCIHousing, WMT14, WMT16)
+
+
+def _add(tf, name, data: bytes):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+def test_uci_housing_split_and_normalization(tmp_path):
+    rng = np.random.default_rng(0)
+    rows = rng.uniform(1, 10, (20, 14))
+    p = tmp_path / "housing.data"
+    p.write_text("\n".join(" ".join(f"{v:.4f}" for v in r) for r in rows))
+    train = UCIHousing(data_file=str(p), mode="train")
+    test = UCIHousing(data_file=str(p), mode="test")
+    assert len(train) == 16 and len(test) == 4
+    x, y = train[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    # features are normalized: |x| bounded by ~1
+    assert np.abs(x).max() <= 1.0 + 1e-6
+    with pytest.raises(RuntimeError, match="egress"):
+        UCIHousing()
+
+
+def test_imdb_vocab_and_labels(tmp_path):
+    p = tmp_path / "aclImdb.tar.gz"
+    docs = {
+        "aclImdb/train/pos/0.txt": b"great movie great fun",
+        "aclImdb/train/neg/0.txt": b"bad movie, bad plot!",
+        "aclImdb/test/pos/0.txt": b"great plot",
+        "aclImdb/test/neg/0.txt": b"bad fun",
+    }
+    with tarfile.open(p, "w:gz") as tf:
+        for name, data in docs.items():
+            _add(tf, name, data)
+    ds = Imdb(data_file=str(p), mode="train", cutoff=0)
+    assert len(ds) == 2
+    # freq order: bad(3) great(3) movie(2) fun(2) plot(2) -> ties by word
+    w = ds.word_idx
+    assert w["<unk>"] == len(w) - 1
+    assert w["bad"] < w["movie"]  # higher freq first
+    doc0, label0 = ds[0]
+    assert label0[0] == 0  # pos first
+    # punctuation stripped: 'movie,' == 'movie'
+    ds_ids = {tuple(ds[i][0].tolist()) for i in range(2)}
+    assert all(len(d) == 4 for d in ds_ids)
+
+
+def test_imikolov_ngram_and_seq(tmp_path):
+    p = tmp_path / "simple-examples.tgz"
+    train = b"a b c\nb c d\n"
+    valid = b"a b\n"
+    with tarfile.open(p, "w:gz") as tf:
+        _add(tf, "./simple-examples/data/ptb.train.txt", train)
+        _add(tf, "./simple-examples/data/ptb.valid.txt", valid)
+    ng = Imikolov(data_file=str(p), data_type="NGRAM", window_size=2,
+                  mode="train", min_word_freq=0)
+    # each line '<s> a b c <e>' yields 4 bigrams
+    assert len(ng) == 8
+    assert ng[0].shape == (2,)
+    seq = Imikolov(data_file=str(p), data_type="SEQ", mode="valid",
+                   min_word_freq=0)
+    src, trg = seq[0]
+    assert src[0] == seq.word_idx["<s>"]
+    assert trg[-1] == seq.word_idx["<e>"]
+    np.testing.assert_array_equal(src[1:], trg[:-1])
+
+
+def test_movielens_features(tmp_path):
+    p = tmp_path / "ml-1m.zip"
+    with zipfile.ZipFile(p, "w") as zf:
+        zf.writestr("ml-1m/movies.dat",
+                    "1::Toy Story (1995)::Animation|Comedy\n"
+                    "2::Heat (1995)::Action\n")
+        zf.writestr("ml-1m/users.dat",
+                    "1::M::25::7::55117\n2::F::35::3::55117\n")
+        zf.writestr("ml-1m/ratings.dat",
+                    "1::1::5::964982703\n2::2::3::964982224\n"
+                    "1::2::4::964982931\n")
+    train = Movielens(data_file=str(p), mode="train", test_ratio=0.0)
+    assert len(train) == 3
+    uid, gender, age, job, mid, cats, title, rating = train[0]
+    assert uid[0] in (1, 2) and gender[0] in (0, 1)
+    assert rating.dtype == np.float64 or rating.dtype == np.float32 or \
+        float(rating[0]) in (3.0, 4.0, 5.0)
+    # categories/title map through shared dicts
+    assert set(np.asarray(cats).tolist()) <= set(
+        train.categories_dict.values())
+    test = Movielens(data_file=str(p), mode="test", test_ratio=0.0)
+    assert len(test) == 0
+
+
+def test_conll05st_bio_expansion_and_features(tmp_path):
+    words = b"The\ncat\nsat\n\n"
+    # one predicate column: (A0*) * (V*) -> B-A0 O B-V
+    props = b"-\t(A0*)\n-\t*\nsat\t(V*)\n\n"
+    p = tmp_path / "conll05st-tests.tar.gz"
+    wbuf = gzip.compress(words)
+    pbuf = gzip.compress(props)
+    with tarfile.open(p, "w:gz") as tf:
+        _add(tf, "conll05st-release/test.wsj/words/test.wsj.words.gz", wbuf)
+        _add(tf, "conll05st-release/test.wsj/props/test.wsj.props.gz", pbuf)
+    wd = tmp_path / "words.dict"
+    wd.write_text("The\ncat\nsat\nbos\neos\n")
+    vd = tmp_path / "verbs.dict"
+    vd.write_text("sat\n")
+    td = tmp_path / "targets.dict"
+    td.write_text("A0\nV\n")
+    ds = Conll05st(data_file=str(p), word_dict_file=str(wd),
+                   verb_dict_file=str(vd), target_dict_file=str(td))
+    assert len(ds) == 1
+    (w, n2, n1, c0, p1, p2, pred, mark, labels) = ds[0]
+    assert w.tolist() == [0, 1, 2]
+    assert labels.tolist() == [ds.label_dict["B-A0"], ds.label_dict["O"],
+                               ds.label_dict["B-V"]]
+    assert mark.tolist() == [1, 1, 1]  # all within +-2 of the verb
+    assert (pred == ds.predicate_dict["sat"]).all()
+    # ctx windows: verb at index 2 -> p1/p2 fall off the end = 'eos'
+    assert (p1 == ds.word_dict["eos"]).all()
+
+
+def _wmt14_archive(tmp_path):
+    p = tmp_path / "wmt14.tgz"
+    with tarfile.open(p, "w:gz") as tf:
+        _add(tf, "wmt14/src.dict", b"<s>\n<e>\n<unk>\nhello\nworld\n")
+        _add(tf, "wmt14/trg.dict", b"<s>\n<e>\n<unk>\nbonjour\nmonde\n")
+        _add(tf, "wmt14/train/train",
+             b"hello world\tbonjour monde\nhello\tbonjour\n")
+        _add(tf, "wmt14/test/test", b"world\tmonde\n")
+    return p
+
+
+def test_wmt14_ids_and_teacher_forcing(tmp_path):
+    p = _wmt14_archive(tmp_path)
+    ds = WMT14(data_file=str(p), mode="train", dict_size=5)
+    assert len(ds) == 2
+    src, trg, trg_next = ds[0]
+    sd, td = ds.get_dict()
+    assert src.tolist() == [sd["<s>"], sd["hello"], sd["world"], sd["<e>"]]
+    assert trg.tolist() == [td["<s>"], td["bonjour"], td["monde"]]
+    assert trg_next.tolist() == [td["bonjour"], td["monde"], td["<e>"]]
+    rev, _ = ds.get_dict(reverse=True)
+    assert rev[sd["hello"]] == "hello"
+    test = WMT14(data_file=str(p), mode="test", dict_size=5)
+    assert len(test) == 1
+
+
+def test_wmt16_builds_vocab_from_train(tmp_path):
+    p = tmp_path / "wmt16.tar.gz"
+    with tarfile.open(p, "w:gz") as tf:
+        _add(tf, "wmt16/train", b"good day\tguten tag\nday\ttag\n")
+        _add(tf, "wmt16/val", b"good\tguten\n")
+    ds = WMT16(data_file=str(p), mode="val", src_dict_size=10,
+               trg_dict_size=10, lang="en")
+    assert len(ds) == 1
+    src, trg, trg_next = ds[0]
+    d = ds.get_dict("en")
+    assert src.tolist() == [0, d["good"], 1]  # <s> good <e>
+    assert trg_next[-1] == 1  # <e>
+    # de-side vocab came from column 1
+    assert "guten" in ds.get_dict("de")
+    # frequency order: 'day'(2) before 'good'(1) in the en dict
+    assert d["day"] < d["good"]

@@ -131,3 +131,17 @@ def test_review_regressions_transforms():
     corners = [big[0, 0, 0], big[0, 0, -1], big[0, -1, 0], big[0, -1, -1]]
     for c in corners:
         assert abs(c - 50.0) < 1.0, corners
+
+
+def test_per_channel_fill_and_array_erase_value():
+    img = _img()
+    out = T.rotate(img, 30.0, fill=[1.0, 2.0, 3.0])
+    assert out.shape == img.shape
+    # a corner rotated out of frame reads the per-channel fill
+    np.testing.assert_allclose(out[:, 0, 0], [1.0, 2.0, 3.0], atol=0.2)
+    big = T.rotate(img, 45.0, expand=True, fill=[1.0, 2.0, 3.0])
+    np.testing.assert_allclose(big[:, 0, 0], [1.0, 2.0, 3.0], atol=0.2)
+    np.random.seed(0)
+    er = T.RandomErasing(prob=1.0, value=np.array([0.5, 0.6, 0.7],
+                                                  np.float32))(img)
+    assert er.shape == img.shape
