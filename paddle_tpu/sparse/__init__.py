@@ -185,3 +185,70 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
 
 
 from . import nn  # noqa: F401,E402  (after op definitions it depends on)
+
+
+# ---- unary tail (parity: sparse/unary.py) ----
+
+asin = _unary(jnp.arcsin)
+asinh = _unary(jnp.arcsinh)
+atan = _unary(jnp.arctan)
+atanh = _unary(jnp.arctanh)
+sinh = _unary(jnp.sinh)
+tan = _unary(jnp.tan)
+expm1 = _unary(jnp.expm1)
+log1p = _unary(jnp.log1p)
+rad2deg = _unary(jnp.rad2deg)
+deg2rad = _unary(jnp.deg2rad)
+isnan = _unary(jnp.isnan)
+
+
+def coalesce(x, name=None):
+    """Merge duplicate COO coordinates by summation (parity:
+    sparse/unary.py coalesce)."""
+    if not is_sparse_coo(x):
+        raise ValueError("coalesce expects a sparse COO tensor")
+    return x.sum_duplicates(remove_zeros=False)
+
+
+def reshape(x, shape, name=None):
+    """Parity: sparse/unary.py reshape — same storage format out."""
+    if is_sparse_coo(x):
+        return jsparse.bcoo_reshape(x, new_sizes=tuple(shape))
+    if is_sparse_csr(x):
+        return to_sparse_csr(jnp.reshape(to_dense(x), shape))
+    return jnp.reshape(jnp.asarray(x), shape)
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    """Parity: sparse/unary.py slice."""
+    import builtins
+    d = to_dense(x)
+    sl = [builtins.slice(None)] * d.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        sl[ax] = builtins.slice(int(s), int(e))
+    out = d[tuple(sl)]
+    if is_sparse_coo(x):
+        return to_sparse_coo(out)
+    if is_sparse_csr(x):
+        return to_sparse_csr(out)
+    return out
+
+
+def mv(x, vec, name=None):
+    """sparse matrix @ dense vector (parity: sparse/binary.py mv)."""
+    return x @ jnp.asarray(vec)
+
+
+def is_same_shape(x, y):
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta * input + alpha * (x @ y) (parity: sparse/multiary.py)."""
+    prod = matmul(x, y)
+    return beta * to_dense(input) + alpha * to_dense(prod)
+
+
+__all__ += ["asin", "asinh", "atan", "atanh", "sinh", "tan", "expm1",
+            "log1p", "rad2deg", "deg2rad", "isnan", "coalesce", "reshape",
+            "slice", "mv", "is_same_shape", "addmm"]

@@ -1,10 +1,27 @@
-"""Sparse nn layers (parity: python/paddle/sparse/nn/ — activation layers
-operating on sparse tensors)."""
+"""Sparse nn layers (parity: python/paddle/sparse/nn/ — activations,
+Softmax, BatchNorm over sparse values, Conv3D/SubmConv3D, MaxPool3D).
+
+TPU lowering note: XLA/MXU has no gather-based sparse conv kernel that
+beats dense compute at the occupancies these layers see in practice, so
+the conv/pool layers lower through a dense window (a measured-parity
+collapse in the SURVEY §7 sense); SubmConv3D re-masks the output to the
+input's coordinate set, which is its defining semantic. BatchNorm,
+activations, and Softmax operate directly on the stored values — no
+densify."""
 
 from __future__ import annotations
 
-from ..nn.module import Layer
-from . import relu as _relu
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..nn import initializer as I
+from ..nn.module import Layer, Parameter
+from . import is_sparse_coo, is_sparse_csr, relu as _relu
+from . import to_dense, to_sparse_coo
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
+           "SyncBatchNorm", "Conv3D", "SubmConv3D", "MaxPool3D"]
 
 
 class ReLU(Layer):
@@ -12,4 +29,246 @@ class ReLU(Layer):
         return _relu(x)
 
 
-__all__ = ["ReLU"]
+class ReLU6(Layer):
+    def forward(self, x):
+        from . import _unary
+        return _unary(lambda v: jnp.clip(v, 0.0, 6.0))(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        from . import _unary
+        return _unary(lambda v: jnp.where(v >= 0, v,
+                                          v * self.negative_slope))(x)
+
+
+class Softmax(Layer):
+    """Softmax over the last axis of a 2D sparse tensor, computed per
+    row over the STORED values only (parity: sparse/nn Softmax —
+    implicit zeros do not participate)."""
+
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        if axis != -1:
+            raise ValueError("sparse Softmax supports axis=-1")
+
+    def forward(self, x):
+        if is_sparse_csr(x):
+            rows = jnp.repeat(jnp.arange(len(x.indptr) - 1),
+                              jnp.diff(x.indptr),
+                              total_repeat_length=x.data.shape[0])
+            data = x.data
+            n = len(x.indptr) - 1
+        elif is_sparse_coo(x):
+            if x.ndim != 2:
+                raise ValueError("sparse Softmax expects a 2D tensor")
+            rows = x.indices[:, 0]
+            data = x.data
+            n = x.shape[0]
+        else:
+            return jax.nn.softmax(jnp.asarray(x), axis=-1)
+        mx = jax.ops.segment_max(data, rows, n)
+        e = jnp.exp(data - mx[rows])
+        z = jax.ops.segment_sum(e, rows, n)
+        out = e / z[rows]
+        if is_sparse_csr(x):
+            return jsparse.BCSR((out, x.indices, x.indptr), shape=x.shape)
+        return jsparse.BCOO((out, x.indices), shape=x.shape)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over sparse values with channels last (parity:
+    sparse/nn BatchNorm: input [N, ..., C] sparse, stats over nnz)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        if data_format != "NDHWC":
+            raise ValueError("sparse layers are channels-last: "
+                             "data_format must be 'NDHWC'")
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        w_init = weight_attr if callable(weight_attr) else I.Constant(1.0)
+        b_init = bias_attr if callable(bias_attr) else I.Constant(0.0)
+        self.weight = Parameter(w_init((num_features,), self._dtype))
+        self.bias = Parameter(b_init((num_features,), self._dtype))
+        self.register_buffer("_mean", jnp.zeros((num_features,)))
+        self.register_buffer("_variance", jnp.ones((num_features,)))
+
+    def forward(self, x):
+        C = self.num_features
+        if is_sparse_csr(x):
+            raise ValueError(
+                "sparse BatchNorm supports COO or dense inputs (reference "
+                "contract: SparseCooTensor)")
+        if is_sparse_coo(x) and x.data.ndim == 1:
+            # fully-sparse layout: the channel coordinate is the LAST
+            # index column; per-channel stats via segment reductions
+            ch = x.indices[:, -1]
+            vals = x.data
+            if self.training:
+                raw_cnt = jax.ops.segment_sum(jnp.ones_like(vals), ch, C)
+                cnt = jnp.maximum(raw_cnt, 1.0)
+                mean = jax.ops.segment_sum(vals, ch, C) / cnt
+                var = jax.ops.segment_sum(
+                    (vals - mean[ch]) ** 2, ch, C) / cnt
+                m = self.momentum
+                # channels absent from this batch keep their running
+                # stats (blending in 0/0 would decay variance to zero)
+                occupied = raw_cnt > 0
+                self._mean = jnp.where(
+                    occupied, m * self._mean + (1 - m) * mean, self._mean)
+                self._variance = jnp.where(
+                    occupied, m * self._variance + (1 - m) * var,
+                    self._variance)
+            else:
+                mean, var = self._mean, self._variance
+            out = (vals - mean[ch]) / jnp.sqrt(var[ch] + self.epsilon) \
+                * self.weight[ch] + self.bias[ch]
+            return jsparse.BCOO((out, x.indices), shape=x.shape)
+        vals = x.data if is_sparse_coo(x) else jnp.asarray(x)
+        # channels-last: stats over every axis but the channel one
+        flat = vals.reshape(-1, vals.shape[-1])
+        if self.training:
+            mean = flat.mean(axis=0)
+            var = flat.var(axis=0)
+            m = self.momentum
+            self._mean = m * self._mean + (1 - m) * mean
+            self._variance = m * self._variance + (1 - m) * var
+        else:
+            mean, var = self._mean, self._variance
+        out = (vals - mean) / jnp.sqrt(var + self.epsilon) * self.weight \
+            + self.bias
+        if is_sparse_coo(x):
+            return jsparse.BCOO((out, x.indices), shape=x.shape)
+        return out
+
+
+class SyncBatchNorm(BatchNorm):
+    """Parity: sparse/nn SyncBatchNorm — under GSPMD the batch stats are
+    already global (XLA all-reduces the mean/var contractions), so the
+    sync variant is the same layer."""
+
+
+def _to3(v):
+    return (v,) * 3 if isinstance(v, int) else tuple(v)
+
+
+def _dense_conv3d(xd, weight, bias, stride, padding, dilation, groups):
+    # channels-last [N, D, H, W, C]; weight [kd, kh, kw, Cin/g, Cout]
+    dn = jax.lax.conv_dimension_numbers(
+        xd.shape, weight.shape, ("NDHWC", "DHWIO", "NDHWC"))
+    pad = padding if isinstance(padding, str) else \
+        [(p, p) for p in _to3(padding)]
+    out = jax.lax.conv_general_dilated(
+        xd, weight, window_strides=_to3(stride), padding=pad,
+        rhs_dilation=_to3(dilation), dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+class Conv3D(Layer):
+    """Parity: sparse/nn Conv3D — sparse [N, D, H, W, C] input."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        if data_format != "NDHWC":
+            raise ValueError("sparse layers are channels-last: "
+                             "data_format must be 'NDHWC'")
+        k = _to3(kernel_size)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        fan_in = (in_channels // groups) * k[0] * k[1] * k[2]
+        w_init = weight_attr if callable(weight_attr) else \
+            I.KaimingUniform(fan_in=fan_in)
+        self.weight = Parameter(
+            w_init(k + (in_channels // groups, out_channels), self._dtype))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            b_init = bias_attr if callable(bias_attr) else I.Constant(0.0)
+            self.bias = Parameter(b_init((out_channels,), self._dtype))
+
+    def forward(self, x):
+        out = _dense_conv3d(to_dense(x), self.weight, self.bias,
+                            self.stride, self.padding, self.dilation,
+                            self.groups)
+        return to_sparse_coo(out)
+
+
+class SubmConv3D(Conv3D):
+    """Submanifold conv: the output's coordinate set is restricted to the
+    input's active sites (stride 1) — no sparsity dilation, the property
+    that makes deep sparse CNNs viable (parity: sparse/nn SubmConv3D over
+    the reference's rulebook kernels). Known deviation: the dense-window
+    lowering re-sparsifies by value, so an active site whose OUTPUT is
+    exactly zero in every channel is not stored (the rulebook kernel
+    would keep it as a stored zero); with float conv outputs this is
+    measure-zero in practice."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 key=None):
+        if max(_to3(stride)) != 1:
+            raise ValueError("SubmConv3D requires stride 1")
+        k = _to3(kernel_size)
+        super().__init__(in_channels, out_channels, kernel_size,
+                         stride=1, padding=tuple((kk - 1) // 2 for kk in k),
+                         dilation=dilation, groups=groups,
+                         weight_attr=weight_attr, bias_attr=bias_attr)
+
+    def forward(self, x):
+        if not is_sparse_coo(x):
+            raise ValueError("SubmConv3D expects a sparse COO input")
+        xd = to_dense(x)
+        out = _dense_conv3d(xd, self.weight, self.bias, 1, self.padding,
+                            self.dilation, self.groups)
+        # active-site mask from the STORED COORDINATES, not the values —
+        # a stored zero (e.g. post-ReLU) is still an active site and the
+        # rulebook contract preserves it
+        n_spatial = 4  # N, D, H, W of the NDHWC layout
+        sp = x.indices[:, :min(x.indices.shape[1], n_spatial)]
+        active = jnp.zeros(x.shape[:sp.shape[1]], bool)
+        active = active.at[tuple(sp[:, i] for i in range(sp.shape[1]))] \
+            .set(True)
+        active = active.reshape(active.shape + (1,) * (out.ndim
+                                                       - active.ndim))
+        return to_sparse_coo(out * active)
+
+
+class MaxPool3D(Layer):
+    """Parity: sparse/nn MaxPool3D over sparse [N, D, H, W, C]."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, return_mask=False, data_format="NDHWC",
+                 name=None):
+        super().__init__()
+        if data_format != "NDHWC":
+            raise ValueError("sparse layers are channels-last: "
+                             "data_format must be 'NDHWC'")
+        self.kernel = _to3(kernel_size)
+        self.stride = _to3(stride if stride is not None else kernel_size)
+        self.padding = _to3(padding)
+
+    def forward(self, x):
+        xd = to_dense(x)
+        out = jax.lax.reduce_window(
+            xd, -jnp.inf, jax.lax.max,
+            window_dimensions=(1, *self.kernel, 1),
+            window_strides=(1, *self.stride, 1),
+            padding=((0, 0), *[(p, p) for p in self.padding], (0, 0)))
+        out = jnp.where(jnp.isfinite(out), out, 0.0)
+        return to_sparse_coo(out)

@@ -520,23 +520,30 @@ class WMT16(Dataset):
         self.lang = lang
         self.src_dict_size = src_dict_size
         self.trg_dict_size = trg_dict_size
-        self.src_dict = self._build_dict(src_dict_size, lang)
-        self.trg_dict = self._build_dict(
-            trg_dict_size, "de" if lang == "en" else "en")
-        self._load()
-
-    def _build_dict(self, size, lang):
-        # file convention (reference wmt16.py:186): column 0 is English,
-        # column 1 is German, regardless of direction
-        freq = collections.defaultdict(int)
+        # ONE pass over wmt16/train accumulates BOTH language frequency
+        # tables (a per-language pass would gunzip the big archive twice)
+        en_freq, de_freq = (collections.defaultdict(int) for _ in range(2))
         with tarfile.open(self.data_file) as f:
             for line in f.extractfile("wmt16/train"):
                 parts = line.decode().strip().split("\t")
                 if len(parts) != 2:
                     continue
-                sen = parts[0] if lang == "en" else parts[1]
-                for w in sen.split():
-                    freq[w] += 1
+                # file convention (reference wmt16.py:186): column 0 is
+                # English, column 1 is German, regardless of direction
+                for w in parts[0].split():
+                    en_freq[w] += 1
+                for w in parts[1].split():
+                    de_freq[w] += 1
+        en_dict = self._freq_to_dict(en_freq, src_dict_size
+                                     if lang == "en" else trg_dict_size)
+        de_dict = self._freq_to_dict(de_freq, trg_dict_size
+                                     if lang == "en" else src_dict_size)
+        self.src_dict = en_dict if lang == "en" else de_dict
+        self.trg_dict = de_dict if lang == "en" else en_dict
+        self._load()
+
+    @staticmethod
+    def _freq_to_dict(freq, size):
         kept = sorted(freq.items(), key=lambda x: (-x[1], x[0]))
         if size > 0:
             kept = kept[:max(size - 3, 0)]

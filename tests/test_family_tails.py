@@ -285,3 +285,102 @@ def test_deprecated_and_try_import():
     assert try_import("math") is not None
     with pytest.raises(ImportError):
         try_import("definitely_not_installed_xyz")
+
+
+# ---------------- sparse tail ----------------
+
+def test_sparse_unary_tail_and_coalesce():
+    import paddle_tpu.sparse as S
+    import jax.numpy as jnp
+    x = S.sparse_coo_tensor([[0, 1], [1, 2]], [0.5, -0.25], (2, 3))
+    for name in ("asin", "atan", "sinh", "tan", "expm1", "log1p",
+                 "rad2deg", "deg2rad"):
+        out = getattr(S, name)(x)
+        ref = getattr(np, {"asin": "arcsin", "atan": "arctan"}.get(name, name))
+        np.testing.assert_allclose(np.asarray(out.data),
+                                   ref(np.array([0.5, -0.25])), rtol=1e-5)
+    dup = S.sparse_coo_tensor([[0, 0], [1, 1]], [1.0, 2.0], (2, 2))
+    co = S.coalesce(dup)
+    assert int(S.nnz(co)) <= 2
+    np.testing.assert_allclose(np.asarray(S.to_dense(co)),
+                               [[0, 3], [0, 0]])
+    assert S.is_same_shape(x, S.reshape(x, (3, 2))) is False
+    v = S.mv(x, np.ones(3, np.float32))
+    np.testing.assert_allclose(np.asarray(v), [0.5, -0.25])
+    out = S.addmm(np.ones((2, 2), np.float32), x,
+                  np.ones((3, 2), np.float32), beta=2.0, alpha=3.0)
+    np.testing.assert_allclose(np.asarray(out),
+                               2.0 + 3.0 * np.array([[0.5, 0.5],
+                                                     [-0.25, -0.25]]))
+
+
+def test_sparse_nn_softmax_and_batchnorm():
+    import paddle_tpu.sparse as S
+    rows = [[0, 0, 1], [0, 2, 1]]
+    x = S.sparse_coo_tensor(rows, [1.0, 2.0, 3.0], (2, 3))
+    sm = S.nn.Softmax()(x)
+    d = np.asarray(S.to_dense(sm))
+    # row sums over STORED entries are 1; implicit zeros stay zero
+    np.testing.assert_allclose(d[0].sum(), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(d[1], [0, 1.0, 0], atol=1e-6)
+    bn = S.nn.BatchNorm(4)
+    vals = RNG.standard_normal((6, 4)).astype(np.float32) * 3 + 1
+    xx = S.sparse_coo_tensor([[0, 1, 2, 3, 4, 5]], vals, (8, 4))
+    out = bn(xx)
+    od = np.asarray(out.data)
+    np.testing.assert_allclose(od.mean(0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(od.std(0), 1.0, atol=1e-2)
+
+
+def test_sparse_subm_conv_preserves_pattern():
+    import paddle_tpu.sparse as S
+    import paddle_tpu as pt
+    pt.seed(0)
+    dense = np.zeros((1, 4, 4, 4, 2), np.float32)
+    dense[0, 1, 1, 1] = [1.0, 2.0]
+    dense[0, 3, 2, 0] = [3.0, 1.0]
+    x = S.to_sparse_coo(dense)
+    conv = S.nn.SubmConv3D(2, 5, 3)
+    out = conv(x)
+    od = np.asarray(S.to_dense(out))
+    active = np.abs(od).sum(-1) > 0
+    want = np.abs(dense).sum(-1) > 0
+    np.testing.assert_array_equal(active, want)  # no sparsity dilation
+    # plain Conv3D dilates
+    conv2 = S.nn.Conv3D(2, 5, 3, padding=1)
+    out2 = np.asarray(S.to_dense(conv2(x)))
+    assert (np.abs(out2).sum(-1) > 0).sum() > want.sum()
+    # pool runs and keeps shape contract
+    pooled = S.nn.MaxPool3D(2)(x)
+    assert pooled.shape == (1, 2, 2, 2, 2)
+
+
+def test_sparse_subm_conv_masks_by_coordinates_not_values():
+    # an active site with MIXED stored values (one channel zeroed by
+    # relu) must survive and stay the ONLY active output site; masking
+    # is by coordinate set, so neighbors never activate (no dilation)
+    import paddle_tpu.sparse as S
+    import paddle_tpu as pt
+    pt.seed(1)
+    dense = np.zeros((1, 3, 3, 3, 2), np.float32)
+    dense[0, 1, 1, 1] = [-5.0, 2.0]  # relu keeps channel 1 only
+    xs = S.relu(S.to_sparse_coo(dense))
+    conv = S.nn.SubmConv3D(2, 3, 3)
+    out = conv(xs)
+    od = np.asarray(S.to_dense(out))
+    assert np.abs(od[0, 1, 1, 1]).sum() > 0
+    assert (np.abs(od).sum((0, 4)) > 0).sum() == 1  # only that site
+
+
+def test_sparse_batchnorm_guards():
+    import paddle_tpu.sparse as S
+    import pytest as _pytest
+    bn = S.nn.BatchNorm(2)
+    with _pytest.raises(ValueError):
+        S.nn.BatchNorm(2, data_format="NCDHW")
+    with _pytest.raises(ValueError):
+        bn(S.to_sparse_csr(np.eye(2, dtype=np.float32)))
+    # dense >2D input: stats stay (C,)-shaped
+    out = bn(np.ones((2, 3, 3, 3, 2), np.float32))
+    assert bn._mean.shape == (2,)
+    assert out.shape == (2, 3, 3, 3, 2)
