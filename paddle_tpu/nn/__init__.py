@@ -19,7 +19,8 @@ from .layer.loss import *  # noqa: F401,F403
 from .layer.norm import *  # noqa: F401,F403
 from .layer.pooling import *  # noqa: F401,F403
 from .layer.rnn import (  # noqa: F401
-    RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN,
+    RNNBase, RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN,
     SimpleRNN, LSTM, GRU,
 )
+from .layer.extras import *  # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403

@@ -1,0 +1,431 @@
+"""Functional tail (parity: nn/functional/{common,extension,loss,
+pooling,distance}.py — affine_grid/grid_sample, sequence_mask,
+temporal_shift, gather_tree, pairwise_distance/pdist, hsigmoid_loss,
+margin_cross_entropy, edit_distance, fractional + unpool variants)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "affine_grid", "grid_sample", "sequence_mask", "temporal_shift",
+    "gather_tree", "pairwise_distance", "pdist", "hsigmoid_loss",
+    "margin_cross_entropy", "edit_distance", "fractional_max_pool2d",
+    "fractional_max_pool3d", "max_unpool1d", "max_unpool3d",
+    "sparse_attention", "flash_attention_with_sparse_mask",
+]
+
+
+# ---------------- spatial transformer ----------------
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Sampling grid from batched 2x3 affine matrices (parity:
+    functional/vision.py affine_grid). Returns [N, H, W, 2] xy grid in
+    [-1, 1] coordinates."""
+    theta = jnp.asarray(theta, jnp.float32)
+    n, h, w = int(out_shape[0]), int(out_shape[2]), int(out_shape[3])
+
+    def axis(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    ys, xs = jnp.meshgrid(axis(h), axis(w), indexing="ij")
+    base = jnp.stack([xs, ys, jnp.ones_like(xs)], axis=-1)  # [H, W, 3]
+    grid = jnp.einsum("nij,hwj->nhwi", theta, base)  # [N, H, W, 2]
+    return grid
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample NCHW features at [-1, 1] grid locations (parity:
+    functional/vision.py grid_sample); bilinear or nearest,
+    zeros/border/reflection padding, differentiable."""
+    x = jnp.asarray(x, jnp.float32)
+    grid = jnp.asarray(grid, jnp.float32)
+    n, c, h, w = x.shape
+
+    def unnorm(coord, size):
+        if align_corners:
+            return (coord + 1) * (size - 1) / 2
+        return ((coord + 1) * size - 1) / 2
+
+    gx = unnorm(grid[..., 0], w)
+    gy = unnorm(grid[..., 1], h)
+    if padding_mode == "border":
+        gx = jnp.clip(gx, 0, w - 1)
+        gy = jnp.clip(gy, 0, h - 1)
+    elif padding_mode == "reflection":
+        def reflect(v, size):
+            if align_corners:
+                span = 2 * (size - 1)
+                v = jnp.abs(v) % span
+                return jnp.where(v > size - 1, span - v, v)
+            span = 2 * size
+            v = (jnp.abs(v + 0.5) % span)
+            v = jnp.where(v > size, span - v, v) - 0.5
+            return jnp.clip(v, 0, size - 1)
+        gx = reflect(gx, w)
+        gy = reflect(gy, h)
+
+    def sample_one(img, sx, sy):
+        if mode == "nearest":
+            xi = jnp.round(sx).astype(jnp.int32)
+            yi = jnp.round(sy).astype(jnp.int32)
+            valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+            vals = img[:, jnp.clip(yi, 0, h - 1), jnp.clip(xi, 0, w - 1)]
+            return vals * valid[None]
+        x0 = jnp.floor(sx)
+        y0 = jnp.floor(sy)
+        out = 0.0
+        for dy in (0, 1):
+            for dx in (0, 1):
+                xi, yi = x0 + dx, y0 + dy
+                wgt = (1 - jnp.abs(sx - xi)) * (1 - jnp.abs(sy - yi))
+                valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+                vals = img[:, jnp.clip(yi, 0, h - 1).astype(jnp.int32),
+                           jnp.clip(xi, 0, w - 1).astype(jnp.int32)]
+                out = out + vals * (wgt * valid)[None]
+        return out
+
+    return jax.vmap(sample_one)(x, gx, gy)
+
+
+# ---------------- sequence utilities ----------------
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """lengths -> boolean-ish mask [..., maxlen] (parity:
+    functional/extension.py sequence_mask; maxlen data-derived in eager
+    mode, must be explicit under jit)."""
+    x = jnp.asarray(x)
+    if maxlen is None:
+        maxlen = int(jnp.max(x))
+    r = jnp.arange(maxlen)
+    return (r < x[..., None]).astype(dtype)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    """TSM channel shift along the temporal axis (parity:
+    functional/extension.py temporal_shift)."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError("data_format must be NCHW or NHWC")
+    x = jnp.asarray(x)
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    v = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    # slide fold channels backward in time, fold forward, rest static
+    back = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])],
+                           axis=1)
+    fwd = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]),
+                           v[:, :-1, fold:2 * fold]], axis=1)
+    out = jnp.concatenate([back, fwd, v[:, :, 2 * fold:]], axis=2)
+    out = out.reshape(nt, c, h, w)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (parity: functional/extension.py
+    gather_tree): ids/parents [max_time, batch, beam] -> full sequences
+    read along the parent chain from the last step."""
+    ids = jnp.asarray(ids)
+    parents = jnp.asarray(parents)
+    T = ids.shape[0]
+    beams = jnp.arange(ids.shape[2])
+
+    def step(carry, t):
+        beam_sel = carry  # [batch, beam] which original beam to follow
+        out = jnp.take_along_axis(ids[t], beam_sel, axis=1)
+        beam_sel = jnp.take_along_axis(parents[t], beam_sel, axis=1)
+        return beam_sel, out
+
+    init = jnp.broadcast_to(beams, ids.shape[1:])
+    _, outs = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    return outs[::-1]
+
+
+# ---------------- distances ----------------
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """Parity: functional/distance.py pairwise_distance."""
+    d = jnp.asarray(x) - jnp.asarray(y) + epsilon
+    out = jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+    return out
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances between rows (parity: tensor pdist)."""
+    x = jnp.asarray(x)
+    n = x.shape[0]
+    iu, ju = jnp.triu_indices(n, k=1)
+    return jnp.linalg.norm(x[iu] - x[ju], ord=p, axis=-1)
+
+
+# ---------------- hierarchical sigmoid ----------------
+
+def _simple_code(labels, num_classes, j):
+    """Paddle SimpleCode: heap index c = label + num_classes;
+    node index at depth j = (c >> (j+1)) - 1; bit at depth j =
+    (c >> j) & 1 (matrix_bit_code.h)."""
+    c = labels + num_classes
+    idx = (c >> (j + 1)) - 1
+    bit = (c >> j) & 1
+    return idx, bit
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (parity: functional/loss.py:886).
+    Default tree = paddle's SimpleCode complete binary heap; custom trees
+    via path_table/path_code (padded entries < 0 are masked)."""
+    x = jnp.asarray(input, jnp.float32)
+    labels = jnp.asarray(label).reshape(-1)
+    w = jnp.asarray(weight, jnp.float32)
+    b = None if bias is None else jnp.asarray(bias, jnp.float32).reshape(-1)
+
+    def node_loss(idx, bit, valid):
+        pre = jnp.einsum("nd,nd->n", x, w[jnp.clip(idx, 0, w.shape[0] - 1)])
+        if b is not None:
+            pre = pre + b[jnp.clip(idx, 0, b.shape[0] - 1)]
+        # binary logistic: softplus(pre) - bit * pre
+        l = jnp.logaddexp(0.0, pre) - bit * pre
+        return jnp.where(valid, l, 0.0)
+
+    if path_table is not None:
+        pt_arr = jnp.asarray(path_table)
+        pc_arr = jnp.asarray(path_code)
+        total = 0.0
+        for j in range(pt_arr.shape[1]):
+            idx = pt_arr[:, j]
+            total = total + node_loss(idx, pc_arr[:, j].astype(jnp.float32),
+                                      idx >= 0)
+        return total[:, None]
+    max_depth = int(math.ceil(math.log2(max(num_classes, 2)))) + 1
+    code = labels + num_classes
+    length = jnp.floor(jnp.log2(code.astype(jnp.float32))).astype(jnp.int32)
+    total = 0.0
+    for j in range(max_depth):
+        idx, bit = _simple_code(labels, num_classes, j)
+        total = total + node_loss(idx, bit.astype(jnp.float32), j < length)
+    return total[:, None]
+
+
+# ---------------- margin softmax (ArcFace family) ----------------
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """Combined-margin softmax CE over cosine logits (parity:
+    functional/loss.py:2095): target angle θ -> cos(m1·θ + m2) - m3,
+    everything scaled by s. ``group`` is unused — under GSPMD the
+    class-parallel softmax is expressed by sharding the class dim."""
+    cos = jnp.asarray(logits, jnp.float32)
+    labels = jnp.asarray(label).reshape(-1)
+    n, c = cos.shape
+    theta = jnp.arccos(jnp.clip(cos, -1.0 + 1e-7, 1.0 - 1e-7))
+    target_cos = jnp.cos(margin1 * theta + margin2) - margin3
+    onehot = jax.nn.one_hot(labels, c, dtype=cos.dtype)
+    adjusted = jnp.where(onehot > 0, target_cos, cos) * scale
+    logp = jax.nn.log_softmax(adjusted, axis=-1)
+    loss = -jnp.take_along_axis(logp, labels[:, None], axis=1)
+    if reduction == "mean":
+        loss = jnp.mean(loss)
+    elif reduction == "sum":
+        loss = jnp.sum(loss)
+    elif reduction is not None and reduction != "none":
+        raise ValueError(f"unknown reduction {reduction!r}")
+    if return_softmax:
+        return loss, jax.nn.softmax(adjusted, axis=-1)
+    return loss
+
+
+# ---------------- edit distance (host metric) ----------------
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Batch Levenshtein distance (parity: functional/loss.py
+    edit_distance). Host-side (dynamic programming over ragged lengths,
+    a metric not a training op). Returns (distance [N, 1], seq_num)."""
+    inp = np.asarray(input)
+    lab = np.asarray(label)
+    n = inp.shape[0]
+    in_len = np.full(n, inp.shape[1]) if input_length is None \
+        else np.asarray(input_length).reshape(-1)
+    lb_len = np.full(n, lab.shape[1]) if label_length is None \
+        else np.asarray(label_length).reshape(-1)
+    ignored = set() if ignored_tokens is None else set(
+        np.asarray(ignored_tokens).ravel().tolist())
+    out = np.zeros((n, 1), np.float32)
+    for i in range(n):
+        a = [t for t in inp[i, :in_len[i]].tolist() if t not in ignored]
+        b = [t for t in lab[i, :lb_len[i]].tolist() if t not in ignored]
+        la, lb = len(a), len(b)
+        dp = np.arange(lb + 1, dtype=np.int32)
+        for r in range(1, la + 1):
+            prev = dp.copy()
+            dp[0] = r
+            for cc in range(1, lb + 1):
+                dp[cc] = min(prev[cc] + 1, dp[cc - 1] + 1,
+                             prev[cc - 1] + (a[r - 1] != b[cc - 1]))
+        dist = float(dp[lb]) if la else float(lb)
+        if normalized:
+            if lb == 0:
+                raise ValueError(
+                    "normalized edit distance needs non-empty labels")
+            dist /= lb
+        out[i, 0] = dist
+    return out, np.array([n], np.int64)
+
+
+# ---------------- fractional + unpool ----------------
+
+def _frac_starts(in_size, out_size, k, u):
+    """Fractional pooling start indices (Graham 2015): the pseudo-random
+    increment sequence from ratio alpha and offset u."""
+    alpha = in_size / out_size
+    idx = np.ceil(alpha * (np.arange(out_size) + u)).astype(int) - 1
+    idx = np.clip(idx, 0, in_size - k)
+    return idx
+
+
+def _fractional_pool(x, output_size, kernel_size, random_u, spatial_axes):
+    if random_u is None:
+        # draw from the FRAMEWORK stream so pt.seed() reproduces runs
+        from ...core import rng as _rng
+        random_u = float(jax.random.uniform(
+            _rng.next_key(), (), minval=0.1, maxval=0.9))
+    if not (0 < random_u < 1):
+        raise ValueError("random_u must be in (0, 1)")
+    out_sz = [int(s) for s in (output_size if isinstance(
+        output_size, (tuple, list)) else (output_size,) * len(spatial_axes))]
+    slabs = x
+    for ax, osz in zip(spatial_axes, out_sz):
+        in_size = slabs.shape[ax]
+        k = max(int(math.ceil(in_size / osz)), 1) if kernel_size is None \
+            else (kernel_size if isinstance(kernel_size, int)
+                  else kernel_size[spatial_axes.index(ax)])
+        starts = _frac_starts(in_size, osz, k, random_u)
+        windows = [jax.lax.slice_in_dim(slabs, int(s), int(s) + k, axis=ax)
+                   for s in starts]
+        slabs = jnp.stack([w.max(axis=ax) for w in windows], axis=ax)
+    return slabs
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Parity: functional/pooling.py:2030 (Graham fractional pooling).
+    ``return_mask`` is accepted; indices are not materialized on the
+    XLA lowering (documented deviation — unpooling uses max_unpool*)."""
+    out = _fractional_pool(jnp.asarray(x, jnp.float32), output_size,
+                           kernel_size, random_u, (2, 3))
+    return (out, None) if return_mask else out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    out = _fractional_pool(jnp.asarray(x, jnp.float32), output_size,
+                           kernel_size, random_u, (2, 3, 4))
+    return (out, None) if return_mask else out
+
+
+def _unpool(x, indices, out_spatial):
+    from .pooling import _unpool_scatter
+    return _unpool_scatter(x, indices, out_spatial)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """Parity: functional/pooling.py max_unpool1d."""
+    x = jnp.asarray(x)
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = k if stride is None else (stride if isinstance(stride, int)
+                                  else stride[0])
+    p = padding if isinstance(padding, int) else padding[0]
+    L = x.shape[2]
+    out_l = (L - 1) * s + k - 2 * p if output_size is None \
+        else (output_size if isinstance(output_size, int)
+              else output_size[-1])
+    return _unpool(x, indices, (out_l,))
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    """Parity: functional/pooling.py max_unpool3d."""
+    x = jnp.asarray(x)
+    to3 = lambda v: (v,) * 3 if isinstance(v, int) else tuple(v)
+    k, s, p = to3(kernel_size), to3(stride if stride is not None
+                                    else kernel_size), to3(padding)
+    if output_size is None:
+        spatial = tuple((x.shape[2 + i] - 1) * s[i] + k[i] - 2 * p[i]
+                        for i in range(3))
+    else:
+        spatial = tuple(output_size)[-3:]
+    return _unpool(x, indices, spatial)
+
+
+# ---------------- sparse / masked attention shims ----------------
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Attention restricted to a CSR-specified position set (parity:
+    functional/sparse_attention.py — the reference's CUDA-only op). TPU
+    lowering: the CSR pattern becomes an additive mask into the fused
+    XLA/flash softmax — correct at any sparsity, fast where patterns are
+    block-structured (the op's intended use)."""
+    q = jnp.asarray(query)
+    offs = np.asarray(sparse_csr_offset)
+    cols = np.asarray(sparse_csr_columns)
+    b, h, sq, d = q.shape
+    sk = jnp.asarray(key).shape[2]
+    mask = np.full((b, h, sq, sk), -1e30, np.float32)
+    for bi in range(b):
+        for hi in range(h):
+            off = offs[bi, hi]
+            col = cols[bi, hi]
+            for r in range(sq):
+                mask[bi, hi, r, col[off[r]:off[r + 1]]] = 0.0
+    from .attention import scaled_dot_product_attention
+    # convert to the [batch, seq, heads, dim] convention
+    to_bshd = lambda t: jnp.moveaxis(jnp.asarray(t), 1, 2)
+    out = scaled_dot_product_attention(to_bshd(q), to_bshd(key),
+                                       to_bshd(value),
+                                       attn_mask=jnp.asarray(mask))
+    return jnp.moveaxis(out, 2, 1)
+
+
+def flash_attention_with_sparse_mask(query, key, value,
+                                     attn_mask_start_row_indices,
+                                     attn_mask_start_row=0, dropout_p=0.0,
+                                     is_causal=True, training=True,
+                                     name=None):
+    """Parity: flash_attention.py flash_attention_with_sparse_mask — the
+    compressed row-start mask (row r attends cols < start_indices says
+    which rows BELOW the causal diagonal are masked out) expands to an
+    additive mask into the fused attention."""
+    q = jnp.asarray(query)
+    b, sq = q.shape[0], q.shape[1]
+    sk = jnp.asarray(key).shape[1]
+    start = jnp.asarray(attn_mask_start_row_indices)  # [b, h, sk]
+    rows = jnp.arange(sq)[:, None]
+    cols = jnp.arange(sk)[None, :]
+    causal = rows >= cols
+    # column j is masked for rows >= start[b, h, j]
+    masked = rows[None, None] >= start[:, :, None, :]
+    allow = causal[None, None] & ~masked
+    bias = jnp.where(allow, 0.0, -1e30).astype(jnp.float32)
+    from .attention import scaled_dot_product_attention
+    return scaled_dot_product_attention(query, key, value, attn_mask=bias,
+                                        dropout_p=dropout_p,
+                                        is_causal=False, training=training)

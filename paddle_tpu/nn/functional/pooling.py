@@ -111,39 +111,89 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
     out = _max(x, kernel_size, stride, padding, 1,
                "NWC" if data_format[-1] == "C" else "NCW", ceil_mode)
-    return (out, _argmax_mask(x, out, kernel_size, stride, padding, 1)) if return_mask else out
+    return (out, _argmax_mask(x, out, kernel_size, stride, padding, 1,
+                              data_format, ceil_mode)) if return_mask else out
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
     out = _max(x, kernel_size, stride, padding, 2, data_format, ceil_mode)
-    return (out, _argmax_mask(x, out, kernel_size, stride, padding, 2)) if return_mask else out
+    return (out, _argmax_mask(x, out, kernel_size, stride, padding, 2,
+                              data_format, ceil_mode)) if return_mask else out
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
     out = _max(x, kernel_size, stride, padding, 3, data_format, ceil_mode)
-    return (out, _argmax_mask(x, out, kernel_size, stride, padding, 3)) if return_mask else out
+    return (out, _argmax_mask(x, out, kernel_size, stride, padding, 3,
+                              data_format, ceil_mode)) if return_mask else out
 
 
-def _argmax_mask(x, pooled, kernel_size, stride, padding, n):
-    """Flat indices of the max within each window (paddle return_mask parity).
-    Implemented via unfold comparison; NCHW only."""
+def _argmax_mask(x, pooled, kernel_size, stride, padding, n,
+                 data_format="NCHW", ceil_mode=False):
+    """GLOBAL flat spatial index of each window's max (paddle return_mask
+    contract — max_unpool* scatters values back by these indices). Works
+    for 1/2/3-d, both layouts, explicit/string padding and ceil_mode,
+    via dilated patches; indices are assembled per-dimension so they are
+    exact at any spatial volume (a single f32 flat-index map would lose
+    integers above 2^24)."""
     x = jnp.asarray(x)
-    if n != 2:
-        raise NotImplementedError("return_mask only for 2d pooling")
-    from .common import unfold
-    k = _tup(kernel_size, 2)
-    s = _tup(stride if stride is not None else kernel_size, 2)
-    pads = _pads(padding, 2)
-    p = [pads[0][0], pads[0][1], pads[1][0], pads[1][1]] if not isinstance(pads, str) else [0, 0, 0, 0]
-    # pad with -inf (not unfold's zero-pad) so padding never wins the argmax
-    neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) else \
-        jnp.iinfo(x.dtype).min
-    xp = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])), constant_values=neg)
-    cols = unfold(xp, k, s, 0, 1)  # [N, C*kh*kw, L]
-    ncols = cols.reshape(x.shape[0], x.shape[1], k[0] * k[1], -1)
-    return jnp.argmax(ncols, axis=2).reshape(pooled.shape)
+    channel_last = data_format[-1] == "C"
+    if channel_last:
+        x = jnp.moveaxis(x, -1, 1)
+    k = _tup(kernel_size, n)
+    s = _tup(stride if stride is not None else kernel_size, n)
+    pads = _pads(padding, n)
+    spatial = x.shape[2:]
+    if isinstance(pads, str):
+        if pads == "VALID":
+            pads = [(0, 0)] * n
+        else:  # SAME
+            pads = []
+            for d in range(n):
+                out_d = -(-spatial[d] // s[d])
+                total = max((out_d - 1) * s[d] + k[d] - spatial[d], 0)
+                pads.append((total // 2, total - total // 2))
+    pads = [list(p) for p in pads]
+    if ceil_mode:
+        # extend the high side so the final partial window exists
+        for d in range(n):
+            span = spatial[d] + pads[d][0] + pads[d][1] - k[d]
+            out_d = -(-span // s[d]) + 1
+            pads[d][1] += (out_d - 1) * s[d] + k[d] - (
+                spatial[d] + pads[d][0] + pads[d][1])
+    pads = [tuple(p) for p in pads]
+    N, C = x.shape[0], x.shape[1]
+    neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    xp = jnp.pad(x, ((0, 0), (0, 0), *pads), constant_values=neg)
+
+    def patches(a):
+        # per-channel-in-batch keeps the patch feature order unambiguous
+        flat = a.reshape((-1, 1) + a.shape[2:])
+        out = jax.lax.conv_general_dilated_patches(
+            flat, filter_shape=k, window_strides=s, padding=[(0, 0)] * n)
+        return out.reshape(a.shape[0], a.shape[1], int(np.prod(k)), -1)
+
+    px = patches(xp)                     # [N, C, prod(k), L]
+    am = jnp.argmax(px, axis=2)          # [N, C, L]
+    # one small per-dim coordinate map each (exact in f32: values < dim)
+    gi = jnp.zeros_like(am)
+    for d in range(n):
+        shape = [1, 1] + [1] * n
+        shape[2 + d] = spatial[d]
+        cmap = jnp.arange(spatial[d], dtype=jnp.float32).reshape(shape)
+        cmap = jnp.broadcast_to(cmap, (1, 1) + tuple(spatial))
+        cp = jnp.pad(cmap, ((0, 0), (0, 0), *pads), constant_values=-1.0)
+        pc = patches(cp)
+        coord = jnp.take_along_axis(jnp.broadcast_to(pc, px.shape),
+                                    am[:, :, None, :], axis=2)[:, :, 0, :]
+        gi = gi * spatial[d] + coord.astype(jnp.int32)
+    mask = gi.reshape((N, C) + pooled.shape[2:] if not channel_last
+                      else (N, C) + pooled.shape[1:-1])
+    if channel_last:
+        mask = jnp.moveaxis(mask, 1, -1)
+    return mask
 
 
 def _adaptive_pool(x, output_size, n, data_format, op="avg"):
@@ -218,18 +268,29 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False
     return s ** (1.0 / p)
 
 
+def _unpool_scatter(x, indices, out_spatial):
+    """Shared unpool core: scatter values at their recorded GLOBAL flat
+    spatial indices (the _argmax_mask contract), any spatial rank."""
+    x, indices = jnp.asarray(x), jnp.asarray(indices)
+    n, c = x.shape[:2]
+    flat_sz = int(np.prod(out_spatial))
+    out = jnp.zeros((n, c, flat_sz), x.dtype)
+    flat_idx = indices.reshape(n, c, -1)
+    out = jax.vmap(jax.vmap(lambda o, i, v: o.at[i].set(v)))(
+        out, flat_idx, x.reshape(n, c, -1))
+    return out.reshape((n, c) + tuple(out_spatial))
+
+
 def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
                  data_format="NCHW", output_size=None, name=None):
-    x, indices = jnp.asarray(x), jnp.asarray(indices)
+    x = jnp.asarray(x)
     k = _tup(kernel_size, 2)
     s = _tup(stride if stride is not None else kernel_size, 2)
-    n, c, h, w = x.shape
+    h, w = x.shape[2], x.shape[3]
     if output_size is None:
-        oh = (h - 1) * s[0] + k[0] - 2 * (padding if isinstance(padding, int) else 0)
-        ow = (w - 1) * s[1] + k[1] - 2 * (padding if isinstance(padding, int) else 0)
+        p = padding if isinstance(padding, int) else 0
+        spatial = ((h - 1) * s[0] + k[0] - 2 * p,
+                   (w - 1) * s[1] + k[1] - 2 * p)
     else:
-        oh, ow = _tup(output_size, 2)[-2:]
-    out = jnp.zeros((n, c, oh * ow), x.dtype)
-    flat_idx = indices.reshape(n, c, -1)
-    out = jax.vmap(jax.vmap(lambda o, i, v: o.at[i].set(v)))(out, flat_idx, x.reshape(n, c, -1))
-    return out.reshape(n, c, oh, ow)
+        spatial = tuple(_tup(output_size, 2)[-2:])
+    return _unpool_scatter(x, indices, spatial)

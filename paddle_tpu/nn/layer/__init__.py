@@ -1,1 +1,2 @@
-from . import activation, common, container, conv, loss, norm, pooling, rnn, transformer  # noqa: F401
+from . import (activation, common, container, conv, extras, loss,  # noqa: F401
+               norm, pooling, rnn, transformer)

@@ -1,0 +1,125 @@
+"""Layer tail (parity: nn/layer/{common,distance,pooling,activation,
+loss}.py — Unflatten, PairwiseDistance, Softmax2D, MaxUnPool1D/3D,
+FractionalMaxPool2D/3D, HSigmoidLoss)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import functional as F
+from .. import initializer as I
+from ..module import Layer, Parameter
+
+__all__ = ["Unflatten", "PairwiseDistance", "Softmax2D", "MaxUnPool1D",
+           "MaxUnPool3D", "FractionalMaxPool2D", "FractionalMaxPool3D",
+           "HSigmoidLoss"]
+
+
+class Unflatten(Layer):
+    """Parity: nn/layer/common.py Unflatten."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = tuple(shape)
+
+    def forward(self, x):
+        from ...ops.manipulation import unflatten
+        return unflatten(x, self.axis, self.shape)
+
+
+class PairwiseDistance(Layer):
+    """Parity: nn/layer/distance.py."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW input (parity:
+    nn/layer/activation.py Softmax2D)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        x = jnp.asarray(x)
+        if x.ndim not in (3, 4):
+            raise ValueError("Softmax2D expects 3D or 4D input")
+        import jax
+        return jax.nn.softmax(x, axis=-3)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, osz = self.args
+        return F.max_unpool1d(x, indices, k, s, p, df, osz)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, osz = self.args
+        return F.max_unpool3d(x, indices, k, s, p, df, osz)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.kernel_size = kernel_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, self.output_size,
+                                       self.kernel_size, self.random_u,
+                                       self.return_mask)
+
+
+class FractionalMaxPool3D(FractionalMaxPool2D):
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, self.output_size,
+                                       self.kernel_size, self.random_u,
+                                       self.return_mask)
+
+
+class HSigmoidLoss(Layer):
+    """Parity: nn/layer/loss.py HSigmoidLoss — owns the non-leaf node
+    classifier weights."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if not is_custom and num_classes < 2:
+            raise ValueError("num_classes must be >= 2 for the default tree")
+        self.num_classes = num_classes
+        w_init = weight_attr if callable(weight_attr) else I.XavierNormal()
+        self.weight = Parameter(w_init((num_classes - 1, feature_size),
+                                       self._dtype))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            b_init = bias_attr if callable(bias_attr) else I.Constant(0.0)
+            self.bias = Parameter(b_init((num_classes - 1, 1), self._dtype))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table, path_code)

@@ -164,8 +164,14 @@ def _dense_conv3d(xd, weight, bias, stride, padding, dilation, groups):
     # channels-last [N, D, H, W, C]; weight [kd, kh, kw, Cin/g, Cout]
     dn = jax.lax.conv_dimension_numbers(
         xd.shape, weight.shape, ("NDHWC", "DHWIO", "NDHWC"))
-    pad = padding if isinstance(padding, str) else \
-        [(p, p) for p in _to3(padding)]
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        pad = [p if isinstance(p, tuple) else (p, p)
+               for p in (_to3(padding) if not (
+                   isinstance(padding, tuple)
+                   and padding and isinstance(padding[0], tuple))
+                   else padding)]
     out = jax.lax.conv_general_dilated(
         xd, weight, window_strides=_to3(stride), padding=pad,
         rhs_dilation=_to3(dilation), dimension_numbers=dn,
@@ -226,9 +232,17 @@ class SubmConv3D(Conv3D):
             raise ValueError("SubmConv3D requires stride 1")
         k = _to3(kernel_size)
         super().__init__(in_channels, out_channels, kernel_size,
-                         stride=1, padding=tuple((kk - 1) // 2 for kk in k),
-                         dilation=dilation, groups=groups,
-                         weight_attr=weight_attr, bias_attr=bias_attr)
+                         stride=1, padding=0, dilation=dilation,
+                         groups=groups, weight_attr=weight_attr,
+                         bias_attr=bias_attr)
+        # the output must cover the input's coordinate set exactly, so
+        # padding is size-preserving by construction (asymmetric for even
+        # kernels); a user-supplied padding value is ignored — the
+        # rulebook keeps active sites regardless of it
+        d = _to3(dilation)
+        self.padding = tuple(
+            (((kk - 1) * dd) // 2, ((kk - 1) * dd + 1) // 2)
+            for kk, dd in zip(k, d))
 
     def forward(self, x):
         if not is_sparse_coo(x):
@@ -259,16 +273,30 @@ class MaxPool3D(Layer):
         if data_format != "NDHWC":
             raise ValueError("sparse layers are channels-last: "
                              "data_format must be 'NDHWC'")
+        if return_mask:
+            raise NotImplementedError(
+                "sparse MaxPool3D does not materialize argmax indices "
+                "(no sparse unpool in the reference either)")
         self.kernel = _to3(kernel_size)
         self.stride = _to3(stride if stride is not None else kernel_size)
         self.padding = _to3(padding)
+        self.ceil_mode = ceil_mode
 
     def forward(self, x):
         xd = to_dense(x)
+        pads = [list((p, p)) for p in self.padding]
+        if self.ceil_mode:
+            # extend the high side so the last partial window pools too
+            for d in range(3):
+                size = xd.shape[1 + d] + 2 * self.padding[d]
+                span = size - self.kernel[d]
+                out_d = -(-span // self.stride[d]) + 1
+                pads[d][1] += (out_d - 1) * self.stride[d] \
+                    + self.kernel[d] - size
         out = jax.lax.reduce_window(
             xd, -jnp.inf, jax.lax.max,
             window_dimensions=(1, *self.kernel, 1),
             window_strides=(1, *self.stride, 1),
-            padding=((0, 0), *[(p, p) for p in self.padding], (0, 0)))
+            padding=((0, 0), *[tuple(p) for p in pads], (0, 0)))
         out = jnp.where(jnp.isfinite(out), out, 0.0)
         return to_sparse_coo(out)

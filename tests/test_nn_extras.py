@@ -1,0 +1,279 @@
+"""nn functional/layer tail (parity: nn/functional/{vision,extension,
+distance,loss,pooling}.py + nn/layer equivalents)."""
+
+import numpy as np
+import pytest
+import scipy.spatial.distance
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+
+RNG = np.random.default_rng(21)
+
+
+def test_affine_grid_identity_and_grid_sample_roundtrip():
+    import jax.numpy as jnp
+    x = RNG.standard_normal((2, 3, 5, 7)).astype(np.float32)
+    theta = np.tile(np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32),
+                    (2, 1, 1))
+    grid = F.affine_grid(theta, (2, 3, 5, 7), align_corners=True)
+    out = F.grid_sample(x, grid, align_corners=True)
+    np.testing.assert_allclose(np.asarray(out), x, atol=1e-5)
+    # translation by one output pixel in x
+    theta_t = theta.copy()
+    theta_t[:, 0, 2] = 2.0 / (7 - 1)
+    out_t = np.asarray(F.grid_sample(x, F.affine_grid(
+        theta_t, (2, 3, 5, 7)), padding_mode="zeros"))
+    np.testing.assert_allclose(out_t[..., :-1], x[..., 1:], atol=1e-4)
+
+
+def test_grid_sample_is_differentiable():
+    import jax
+    import jax.numpy as jnp
+    x = jnp.asarray(RNG.standard_normal((1, 2, 4, 4)), jnp.float32)
+    grid = jnp.asarray(RNG.uniform(-1, 1, (1, 3, 3, 2)), jnp.float32)
+    g = jax.grad(lambda x_: F.grid_sample(x_, grid).sum())(x)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_sequence_mask_and_temporal_shift():
+    m = F.sequence_mask(np.array([1, 3, 2]), maxlen=4)
+    np.testing.assert_array_equal(np.asarray(m),
+                                  [[1, 0, 0, 0], [1, 1, 1, 0],
+                                   [1, 1, 0, 0]])
+    x = np.arange(2 * 2 * 4 * 1 * 1, dtype=np.float32) \
+        .reshape(4, 4, 1, 1)  # N*T=4 (N=2, T=2), C=4
+    out = np.asarray(F.temporal_shift(x, seg_num=2, shift_ratio=0.25))
+    assert out.shape == x.shape
+    # channel 0 shifts backward: position t gets t+1's value; last t -> 0
+    assert out[0, 0, 0, 0] == x[1, 0, 0, 0]
+    assert out[1, 0, 0, 0] == 0.0
+    # channel 1 shifts forward: first t -> 0
+    assert out[0, 1, 0, 0] == 0.0
+    assert out[1, 1, 0, 0] == x[0, 1, 0, 0]
+    # remaining channels stay
+    np.testing.assert_array_equal(out[:, 2:], x[:, 2:])
+
+
+def test_gather_tree_backtrace():
+    # [T=3, batch=1, beam=2]
+    ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]])
+    parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]])
+    out = np.asarray(F.gather_tree(ids, parents))
+    # beam 0 at t=2 came from parent beam 1 at t=1, which came from beam 0
+    np.testing.assert_array_equal(out[:, 0, 0], [1, 4, 5])
+    np.testing.assert_array_equal(out[:, 0, 1], [1, 3, 6])
+
+
+def test_pairwise_distance_and_pdist_match_scipy():
+    x = RNG.standard_normal((4, 6)).astype(np.float32)
+    y = RNG.standard_normal((4, 6)).astype(np.float32)
+    d = np.asarray(F.pairwise_distance(x, y, p=2.0, epsilon=0.0))
+    np.testing.assert_allclose(d, np.linalg.norm(x - y, axis=-1), rtol=1e-5)
+    pd = np.asarray(F.pdist(x))
+    np.testing.assert_allclose(pd, scipy.spatial.distance.pdist(x),
+                               rtol=1e-5)
+    layer = nn.PairwiseDistance(p=1.0, epsilon=0.0)
+    np.testing.assert_allclose(np.asarray(layer(x, y)),
+                               np.abs(x - y).sum(-1), rtol=1e-5)
+
+
+def test_hsigmoid_loss_default_tree_decreases():
+    import jax
+    import jax.numpy as jnp
+    pt.seed(0)
+    n_cls, dim = 6, 8
+    layer = nn.HSigmoidLoss(dim, n_cls)
+    x = jnp.asarray(RNG.standard_normal((16, dim)), jnp.float32)
+    y = np.array([i % n_cls for i in range(16)])[:, None]
+    loss0 = float(np.asarray(layer(x, y)).mean())
+    assert np.isfinite(loss0) and loss0 > 0
+
+    w = layer.weight
+    def loss_fn(w_):
+        return F.hsigmoid_loss(x, y, n_cls, w_, layer.bias).mean()
+    g = jax.grad(loss_fn)(w)
+    w2 = w - 0.5 * g
+    assert float(loss_fn(w2)) < loss0
+
+
+def test_hsigmoid_custom_path():
+    x = RNG.standard_normal((2, 4)).astype(np.float32)
+    w = RNG.standard_normal((3, 4)).astype(np.float32)
+    table = np.array([[0, 1, -1], [0, 2, -1]])  # padded with -1
+    code = np.array([[1, 0, 0], [0, 1, 0]])
+    out = np.asarray(F.hsigmoid_loss(x, np.array([[0], [1]]), 3, w,
+                                     path_table=table, path_code=code))
+    assert out.shape == (2, 1) and np.isfinite(out).all()
+    # manual: sum over valid nodes of softplus(pre) - bit*pre
+    pre = x @ w.T
+    want0 = (np.logaddexp(0, pre[0, 0]) - pre[0, 0]
+             + np.logaddexp(0, pre[0, 1]))
+    np.testing.assert_allclose(out[0, 0], want0, rtol=1e-5)
+
+
+def test_margin_cross_entropy_reduces_to_ce_at_zero_margin():
+    import jax
+    logits = RNG.uniform(-1, 1, (4, 5)).astype(np.float32)
+    labels = np.array([0, 2, 4, 1])
+    plain = F.margin_cross_entropy(logits, labels, margin1=1.0, margin2=0.0,
+                                   margin3=0.0, scale=1.0)
+    ref = -np.log(np.exp(logits)[np.arange(4), labels]
+                  / np.exp(logits).sum(-1)).mean()
+    np.testing.assert_allclose(float(plain), ref, rtol=1e-4)
+    # a positive margin raises the loss (harder positives)
+    hard = F.margin_cross_entropy(logits, labels, margin2=0.5, scale=1.0)
+    assert float(hard) > float(plain)
+    loss, sm = F.margin_cross_entropy(logits, labels, return_softmax=True)
+    np.testing.assert_allclose(np.asarray(sm).sum(-1), 1.0, rtol=1e-5)
+
+
+def test_edit_distance():
+    a = np.array([[1, 2, 3, 0], [1, 2, 3, 4]])
+    b = np.array([[1, 3, 3, 0], [1, 2, 3, 4]])
+    d, n = F.edit_distance(a, b, normalized=False,
+                           input_length=[3, 4], label_length=[3, 4])
+    np.testing.assert_allclose(d[:, 0], [1.0, 0.0])
+    assert n[0] == 2
+    dn, _ = F.edit_distance(a, b, normalized=True,
+                            input_length=[3, 4], label_length=[3, 4])
+    np.testing.assert_allclose(dn[:, 0], [1 / 3, 0.0])
+    # ignored tokens removed before comparison
+    d2, _ = F.edit_distance(a, b, normalized=False, ignored_tokens=[3],
+                            input_length=[3, 4], label_length=[3, 4])
+    np.testing.assert_allclose(d2[:, 0], [1.0, 0.0])
+
+
+def test_fractional_max_pool_shapes_and_determinism():
+    x = RNG.standard_normal((2, 3, 9, 9)).astype(np.float32)
+    o1 = np.asarray(F.fractional_max_pool2d(x, 4, random_u=0.3))
+    o2 = np.asarray(F.fractional_max_pool2d(x, 4, random_u=0.3))
+    assert o1.shape == (2, 3, 4, 4)
+    np.testing.assert_array_equal(o1, o2)  # deterministic with fixed u
+    # every output is a max of some input window => subset of input values
+    assert np.isin(o1, x).all()
+    x3 = RNG.standard_normal((1, 2, 6, 6, 6)).astype(np.float32)
+    o3 = np.asarray(F.fractional_max_pool3d(x3, (2, 3, 2), random_u=0.7))
+    assert o3.shape == (1, 2, 2, 3, 2)
+    layer = nn.FractionalMaxPool2D(4, random_u=0.5)
+    assert np.asarray(layer(x)).shape == (2, 3, 4, 4)
+    with pytest.raises(ValueError):
+        F.fractional_max_pool2d(x, 4, random_u=1.5)
+
+
+def test_max_unpool_1d_3d_roundtrip():
+    import jax.numpy as jnp
+    x1 = jnp.asarray(RNG.standard_normal((2, 3, 8)), jnp.float32)
+    pooled, idx = F.max_pool1d(x1, 2, stride=2, return_mask=True)
+    restored = np.asarray(F.max_unpool1d(pooled, idx, 2, stride=2))
+    assert restored.shape == x1.shape
+    # every pooled max lands back at its TRUE argmax position
+    assert np.count_nonzero(restored) == pooled.size
+    nz = restored != 0
+    np.testing.assert_allclose(restored[nz], np.asarray(x1)[nz])
+    x2 = jnp.asarray(RNG.standard_normal((1, 2, 6, 6)), jnp.float32)
+    p2, i2 = F.max_pool2d(x2, 2, stride=2, return_mask=True)
+    r2 = np.asarray(F.max_unpool2d(p2, i2, 2, stride=2))
+    nz2 = r2 != 0
+    np.testing.assert_allclose(r2[nz2], np.asarray(x2)[nz2])
+    x3 = jnp.asarray(RNG.standard_normal((1, 2, 4, 4, 4)), jnp.float32)
+    p3, i3 = F.max_pool3d(x3, 2, stride=2, return_mask=True)
+    r3 = np.asarray(F.max_unpool3d(p3, i3, 2, stride=2))
+    assert r3.shape == x3.shape
+    nz3 = r3 != 0
+    np.testing.assert_allclose(r3[nz3], np.asarray(x3)[nz3])
+
+
+def test_softmax2d_and_unflatten_layers():
+    x = RNG.standard_normal((2, 3, 4, 5)).astype(np.float32)
+    out = np.asarray(nn.Softmax2D()(x))
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+    u = nn.Unflatten(1, (2, 3))
+    y = RNG.standard_normal((4, 6, 5)).astype(np.float32)
+    assert np.asarray(u(y)).shape == (4, 2, 3, 5)
+
+
+def test_sparse_attention_matches_masked_dense():
+    b, h, sq, d = 1, 2, 4, 8
+    q = RNG.standard_normal((b, h, sq, d)).astype(np.float32)
+    k = RNG.standard_normal((b, h, sq, d)).astype(np.float32)
+    v = RNG.standard_normal((b, h, sq, d)).astype(np.float32)
+    # CSR: each row attends to itself and column 0
+    offs = np.tile(np.array([0, 2, 4, 6, 8]), (b, h, 1))
+    cols = np.tile(np.array([0, 0, 0, 1, 0, 2, 0, 3]), (b, h, 1))
+    out = np.asarray(F.sparse_attention(q, k, v, offs, cols))
+    assert out.shape == (b, h, sq, d)
+    # dense reference with the same mask
+    mask = np.full((sq, sq), -np.inf)
+    for r in range(sq):
+        mask[r, [0, r]] = 0
+    import jax
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d) + mask
+    ref = np.einsum("bhqk,bhkd->bhqd",
+                    np.asarray(jax.nn.softmax(s, axis=-1)), v)
+    np.testing.assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
+
+
+def test_flash_attention_with_sparse_mask_expands_rows():
+    b, s, h, d = 1, 6, 2, 8
+    q = RNG.standard_normal((b, s, h, d)).astype(np.float32)
+    k = RNG.standard_normal((b, s, h, d)).astype(np.float32)
+    v = RNG.standard_normal((b, s, h, d)).astype(np.float32)
+    # column j masked for rows >= start[j]; start=s means never masked
+    start = np.full((b, h, s), s, np.int32)
+    out_plain = np.asarray(F.flash_attention_with_sparse_mask(
+        q, k, v, start))
+    ref = np.asarray(F.scaled_dot_product_attention(q, k, v,
+                                                    is_causal=True))
+    np.testing.assert_allclose(out_plain, ref, atol=2e-3, rtol=2e-3)
+    # masking col 0 from row 2 on changes rows >= 2 only
+    start2 = start.copy()
+    start2[..., 0] = 2
+    out_m = np.asarray(F.flash_attention_with_sparse_mask(q, k, v, start2))
+    np.testing.assert_allclose(out_m[:, :2], ref[:, :2], atol=2e-3)
+    assert np.abs(out_m[:, 2:] - ref[:, 2:]).max() > 1e-4
+
+
+def test_return_mask_ceil_mode_and_channel_last():
+    import jax.numpy as jnp
+    x = jnp.asarray(RNG.standard_normal((1, 1, 5, 5)), jnp.float32)
+    out, mask = F.max_pool2d(x, 2, stride=2, ceil_mode=True,
+                             return_mask=True)
+    assert out.shape == mask.shape == (1, 1, 3, 3)
+    r = np.asarray(F.max_unpool2d(out, mask, 2, stride=2,
+                                  output_size=(5, 5)))
+    nz = r != 0
+    np.testing.assert_allclose(r[nz], np.asarray(x)[nz])
+    # channel-last layout
+    xl = jnp.moveaxis(x, 1, -1)
+    out_l, mask_l = F.max_pool2d(xl, 2, stride=2, data_format="NHWC",
+                                 return_mask=True)
+    np.testing.assert_array_equal(
+        np.asarray(mask_l)[..., 0],
+        np.asarray(F.max_pool2d(x, 2, stride=2, return_mask=True)[1])[:, 0])
+
+
+def test_fractional_pool_follows_framework_seed():
+    x = RNG.standard_normal((1, 2, 9, 9)).astype(np.float32)
+    pt.seed(123)
+    a = np.asarray(F.fractional_max_pool2d(x, 4))
+    pt.seed(123)
+    b = np.asarray(F.fractional_max_pool2d(x, 4))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_sparse_even_kernel_and_ceil_pool():
+    import paddle_tpu.sparse as S
+    dense = np.zeros((1, 4, 4, 4, 2), np.float32)
+    dense[0, 1, 2, 3] = [1.0, -1.0]
+    x = S.to_sparse_coo(dense)
+    out = S.nn.SubmConv3D(2, 5, 2)(x)  # even kernel must work
+    od = np.asarray(S.to_dense(out))
+    assert od.shape == (1, 4, 4, 4, 5)
+    assert (np.abs(od).sum((0, 4)) > 0).sum() == 1  # pattern preserved
+    pooled = S.nn.MaxPool3D(2, ceil_mode=True)(
+        S.to_sparse_coo(np.ones((1, 5, 5, 5, 1), np.float32)))
+    assert pooled.shape == (1, 3, 3, 3, 1)
+    with pytest.raises(NotImplementedError):
+        S.nn.MaxPool3D(2, return_mask=True)
