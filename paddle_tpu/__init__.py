@@ -47,6 +47,7 @@ from . import profiler  # noqa: E402
 from . import incubate  # noqa: E402
 from . import inference  # noqa: E402
 from . import hapi  # noqa: E402
+from .hapi.flops import flops, summary  # noqa: E402
 from . import distribution  # noqa: E402
 from . import fft  # noqa: E402
 from . import signal  # noqa: E402

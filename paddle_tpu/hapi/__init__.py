@@ -21,9 +21,10 @@ from ..jit import EvalStep, TrainStep
 from ..metric import Metric
 from ..nn.module import Layer
 from . import callbacks as callbacks  # noqa: F401  (paddle.callbacks parity)
+from .flops import flops, summary  # noqa: F401
 from .callbacks import CallbackList, ProgBarLogger
 
-__all__ = ["Model", "callbacks"]
+__all__ = ["Model", "callbacks", "flops", "summary"]
 
 
 class Model:

@@ -11,7 +11,8 @@ import sys
 import time
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "LRScheduler", "HistoryLogger", "CallbackList"]
+           "LRScheduler", "HistoryLogger", "CallbackList",
+           "ReduceLROnPlateau", "VisualDL", "WandbCallback"]
 
 
 class Callback:
@@ -174,21 +175,141 @@ class LRScheduler(Callback):
         self.by_epoch = by_epoch
 
 
-class HistoryLogger(Callback):
-    """JSONL metrics history (the VisualDL-writer slot)."""
+class _JsonlWriter:
+    """Shared lazy JSONL sink for the logging callbacks: opens on first
+    write (so evaluate-only flows that skip on_train_begin still work),
+    coerces scalars to float, flushes per record."""
 
     def __init__(self, path: str):
         self.path = path
+        self._f = None
 
-    def on_train_begin(self, logs=None):
-        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        self._f = open(self.path, "a")
-
-    def on_epoch_end(self, epoch, logs=None):
-        rec = {"epoch": epoch, **{k: (float(v) if hasattr(v, "__float__")
-                                      else v) for k, v in (logs or {}).items()}}
+    def write(self, **fields):
+        if self._f is None:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            self._f = open(self.path, "a")
+        rec = {k: (float(v) if hasattr(v, "__float__") else v)
+               for k, v in fields.items()}
         self._f.write(json.dumps(rec) + "\n")
         self._f.flush()
 
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class HistoryLogger(Callback):
+    """JSONL metrics history."""
+
+    def __init__(self, path: str):
+        self._writer = _JsonlWriter(path)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._writer.write(epoch=epoch, **(logs or {}))
+
     def on_train_end(self, logs=None):
-        self._f.close()
+        self._writer.close()
+
+
+class ReduceLROnPlateau(Callback):
+    """Parity: hapi ReduceLROnPlateau — scale the optimizer lr by
+    ``factor`` after ``patience`` evals without improvement."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+
+    def on_train_begin(self, logs=None):
+        self.wait = 0
+        self.cooldown_counter = 0
+        self.best = float("-inf") if self.mode == "max" else float("inf")
+
+    def _better(self, cur):
+        if self.mode == "max":
+            return cur > self.best + self.min_delta
+        return cur < self.best - self.min_delta
+
+    def on_eval_end(self, logs=None):
+        if not hasattr(self, "wait"):  # evaluate-only flow: lazy init
+            self.on_train_begin()
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        if self.cooldown_counter > 0:
+            # inside the cooldown window: no reductions, no waiting
+            self.cooldown_counter -= 1
+            self.wait = 0
+            if self._better(cur):
+                self.best = cur
+            return
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            opt = self.model._optimizer
+            old = float(opt.get_lr()) if hasattr(opt, "get_lr") \
+                else float(opt.learning_rate)
+            new = max(old * self.factor, self.min_lr)
+            if new < old:
+                opt.set_lr(new)
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr {old:.3g} -> {new:.3g}")
+            self.cooldown_counter = self.cooldown
+            self.wait = 0
+
+
+class VisualDL(Callback):
+    """Parity slot for hapi VisualDL. The visualdl package is not in
+    this environment, so scalars land in a JSONL event file under
+    ``log_dir`` (one record per epoch/eval, the same scalars VisualDL
+    would chart); point any dashboard at it."""
+
+    def __init__(self, log_dir="./log"):
+        self._writer = _JsonlWriter(os.path.join(log_dir,
+                                                 "vdl_scalars.jsonl"))
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._writer.write(tag="train", step=epoch, **(logs or {}))
+
+    def on_eval_end(self, logs=None):
+        self._writer.write(tag="eval", step=-1, **(logs or {}))
+
+    def on_train_end(self, logs=None):
+        self._writer.close()
+
+
+class WandbCallback(Callback):
+    """Parity: hapi WandbCallback — requires the (optional) wandb
+    package; raises with guidance when absent (no egress here anyway)."""
+
+    def __init__(self, project=None, **kwargs):
+        from ..utils import try_import
+        self._wandb = try_import(
+            "wandb", "WandbCallback needs the wandb package, which is not "
+            "installed in this environment; use VisualDL/HistoryLogger "
+            "(JSONL scalars) instead")
+        self._init_kwargs = {"project": project, **kwargs}
+
+    def on_train_begin(self, logs=None):
+        self._run = self._wandb.init(**self._init_kwargs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._wandb.log({k: v for k, v in (logs or {}).items()},
+                        step=epoch)
+
+    def on_train_end(self, logs=None):
+        self._wandb.finish()

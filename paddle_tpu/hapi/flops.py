@@ -1,0 +1,127 @@
+"""Model statistics (parity: python/paddle/hapi/{dynamic_flops.py,
+model_summary.py} — paddle.flops and the standalone paddle.summary).
+
+FLOP counting uses the reference's per-layer formulas (one MAC = one
+FLOP, conv = out_elems * (Cin/g * prod(k) [+1 bias]), linear =
+in * out [+ out]); shapes come from forward hooks over a zeros forward,
+so any composite model that runs, counts."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["flops", "summary"]
+
+
+def _num_params(layer):
+    return sum(int(np.prod(p.shape))
+               for p in layer.parameters()) if hasattr(layer, "parameters") \
+        else 0
+
+
+def _layer_flops(layer, inputs, output):
+    x = inputs[0] if isinstance(inputs, tuple) else inputs
+    out = output[0] if isinstance(output, (tuple, list)) else output
+    oshape = getattr(out, "shape", None)
+    if oshape is None:
+        return 0
+    out_elems = int(np.prod(oshape))
+    name = type(layer).__name__
+    if name.startswith("Conv") and hasattr(layer, "kernel_size"):
+        cin = layer.in_channels // max(getattr(layer, "groups", 1), 1)
+        k = int(np.prod(layer.kernel_size))
+        bias = 1 if getattr(layer, "bias", None) is not None else 0
+        return out_elems * (cin * k + bias)
+    if name == "Linear":
+        batch = int(np.prod(oshape[:-1]))
+        bias = layer.out_features if getattr(layer, "bias", None) is not None \
+            else 0
+        return batch * layer.in_features * layer.out_features + batch * bias
+    if "Norm" in name:
+        return 2 * int(np.prod(getattr(x, "shape", oshape)))
+    if "Pool" in name or name in ("ReLU", "ReLU6", "GELU", "Sigmoid",
+                                  "Tanh", "Hardswish", "Hardsigmoid",
+                                  "Swish", "LeakyReLU", "Softmax", "SiLU"):
+        return int(np.prod(getattr(x, "shape", oshape)))
+    return 0
+
+
+def _trace(net, input_size=None, dtypes=None, custom_ops=None, args=None):
+    """Run one forward (zeros built from ``input_size`` or the given
+    ``args``) with leaf hooks; returns rows of
+    (name, type, out_shape, params, flops)."""
+    import jax.numpy as jnp
+    rows = []
+    handles = []
+
+    def make_hook(lname):
+        def hook(layer, inputs, output):
+            if layer._sub_layers:  # only leaves carry counts
+                return None
+            fn = None
+            if custom_ops:
+                fn = custom_ops.get(type(layer))
+            fl = fn(layer, inputs, output) if fn \
+                else _layer_flops(layer, inputs, output)
+            out = output[0] if isinstance(output, (tuple, list)) else output
+            rows.append((lname, type(layer).__name__,
+                         tuple(getattr(out, "shape", ())),
+                         _num_params(layer), int(fl)))
+            return None
+        return hook
+
+    for name, sub in net.named_sublayers():
+        handles.append(sub.register_forward_post_hook(make_hook(name)))
+    try:
+        if args is None:
+            sizes = input_size if isinstance(input_size, (list, tuple)) and \
+                input_size and isinstance(input_size[0], (list, tuple)) \
+                else [input_size]
+            dts = dtypes or ["float32"] * len(sizes)
+            args = [jnp.zeros(tuple(s), dt) for s, dt in zip(sizes, dts)]
+        net(*args)
+    finally:
+        for h in handles:
+            h.remove()
+    return rows
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Parity: paddle.flops (hapi/dynamic_flops.py). Returns total FLOPs
+    of one forward at ``input_size``; ``custom_ops`` maps layer TYPES to
+    ``fn(layer, inputs, output) -> flops``."""
+    rows = _trace(net, input_size, custom_ops=custom_ops)
+    total = sum(r[4] for r in rows)
+    if print_detail:
+        width = max(max((len(r[0]) for r in rows), default=10) + 2, 14)
+        print(f"{'Layer':<{width}}{'Type':<18}{'Output shape':<22}"
+              f"{'Params':>10}{'FLOPs':>14}")
+        for name, typ, shape, n, fl in rows:
+            print(f"{name:<{width}}{typ:<18}{str(shape):<22}"
+                  f"{n:>10,}{fl:>14,}")
+        print(f"Total FLOPs: {total:,}")
+    return total
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Parity: paddle.summary (hapi/model_summary.py) — per-layer table
+    with output shapes + parameter totals; returns the totals dict."""
+    if input is not None:
+        rows = _trace(net, args=input if isinstance(input, (list, tuple))
+                      else (input,))
+    else:
+        if input_size is None:
+            raise ValueError("summary needs input_size or input")
+        rows = _trace(net, input_size, dtypes=dtypes)
+    params = net.param_dict()
+    total = sum(int(np.prod(v.shape)) for v in params.values())
+    trainable = sum(int(np.prod(v.shape))
+                    for v in net.param_dict(trainable_only=True).values())
+    width = max(max((len(r[0]) + len(r[1]) for r in rows), default=10) + 5, 24)
+    lines = [f"{'Layer (type)':<{width}}{'Output Shape':<24}{'Param #':>12}"]
+    lines += [f"{(n + ' (' + t + ')'):<{width}}{str(s):<24}{p:>12,}"
+              for n, t, s, p, _ in rows]
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

@@ -98,3 +98,88 @@ def test_model_checkpoint_callback(tmp_path):
     import os
     assert os.path.exists(tmp_path / "ck" / "0.pdparams")
     assert os.path.exists(tmp_path / "ck" / "final.pdparams")
+
+
+def test_flops_and_standalone_summary(capsys):
+    import paddle_tpu as pt
+    net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                        nn.Flatten(), nn.Linear(8 * 8 * 8, 10))
+    total = pt.flops(net, (1, 3, 8, 8))
+    # conv: 512 out elems * (3*9 + 1 bias) = 14336; relu 512; fc 5130
+    assert total == 14336 + 512 + 5130
+    stats = pt.summary(net, (1, 3, 8, 8))
+    out = capsys.readouterr().out
+    assert "Conv2D" in out and "Total params" in out
+    assert stats["total_params"] == 224 + 5130
+    # custom op override
+    total2 = pt.flops(net, (1, 3, 8, 8),
+                      custom_ops={nn.Linear: lambda l, i, o: 7})
+    assert total2 == 14336 + 512 + 7
+
+
+def test_reduce_lr_on_plateau_callback():
+    from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+    import types
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                           verbose=0)
+    opt = pt.optimizer.SGD(learning_rate=1.0, parameters=[])
+    cb.model = types.SimpleNamespace(_optimizer=opt)
+    cb.on_train_begin()
+    for loss in (1.0, 0.9, 0.95, 0.92):  # improves twice then stalls
+        cb.on_eval_end({"loss": loss})
+    assert abs(float(opt.get_lr()) - 0.5) < 1e-9  # halved once
+    cb.on_eval_end({"loss": 0.91})
+    cb.on_eval_end({"loss": 0.91})
+    assert abs(float(opt.get_lr()) - 0.25) < 1e-9
+
+
+def test_visualdl_callback_writes_scalars(tmp_path):
+    from paddle_tpu.hapi.callbacks import VisualDL
+    import json as _json
+    cb = VisualDL(log_dir=str(tmp_path))
+    cb.on_train_begin()
+    cb.on_epoch_end(0, {"loss": 1.25})
+    cb.on_eval_end({"acc": 0.5})
+    cb.on_train_end()
+    lines = [_json.loads(ln) for ln in
+             (tmp_path / "vdl_scalars.jsonl").read_text().splitlines()]
+    assert lines[0]["tag"] == "train" and lines[0]["loss"] == 1.25
+    assert lines[1]["tag"] == "eval" and lines[1]["acc"] == 0.5
+
+
+def test_wandb_callback_names_missing_package():
+    from paddle_tpu.hapi.callbacks import WandbCallback
+    with pytest.raises(ImportError, match="wandb"):
+        WandbCallback(project="x")
+
+
+def test_reduce_lr_cooldown_and_eval_only_flows(tmp_path):
+    from paddle_tpu.hapi.callbacks import ReduceLROnPlateau, VisualDL
+    import types
+    cb = ReduceLROnPlateau(factor=0.5, patience=1, cooldown=5, verbose=0)
+    opt = pt.optimizer.SGD(learning_rate=1.0, parameters=[])
+    cb.model = types.SimpleNamespace(_optimizer=opt)
+    cb.on_train_begin()
+    for _ in range(4):
+        cb.on_eval_end({"loss": 1.0})
+    # cooldown suppresses further reductions: exactly ONE halving
+    assert abs(float(opt.get_lr()) - 0.5) < 1e-9
+    # evaluate-only (no on_train_begin) must not crash
+    cb2 = ReduceLROnPlateau(verbose=0)
+    cb2.model = types.SimpleNamespace(_optimizer=opt)
+    cb2.on_eval_end({"loss": 1.0})
+    v = VisualDL(log_dir=str(tmp_path))
+    v.on_eval_end({"acc": 0.1})
+    assert (tmp_path / "vdl_scalars.jsonl").exists()
+
+
+def test_summary_reports_frozen_params(capsys):
+    import paddle_tpu as pt
+    from paddle_tpu.nn.module import Parameter
+    net = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+    first = list(net.children())[0]
+    first.weight = Parameter(first.weight, trainable=False)  # freeze
+    stats = pt.summary(net, (1, 4))
+    capsys.readouterr()
+    assert stats["total_params"] == 4 * 8 + 8 + 8 * 2 + 2
+    assert stats["trainable_params"] == stats["total_params"] - 4 * 8
