@@ -59,6 +59,8 @@ from . import quantization  # noqa: E402
 from . import audio  # noqa: E402
 from . import text  # noqa: E402
 from . import hub  # noqa: E402
+from . import static  # noqa: E402
+from . import version  # noqa: E402
 from . import device  # noqa: E402
 from . import geometric  # noqa: E402
 from . import strings  # noqa: E402

@@ -212,9 +212,53 @@ class HistoryLogger(Callback):
         self._writer.close()
 
 
+from ..optimizer.lr import LRScheduler as _BaseSched  # noqa: E402
+
+
+class _ScaledScheduler(_BaseSched):
+    """An LRScheduler multiplying a base schedule by a running scale
+    (ReduceLROnPlateau's composable reduction): warmup/decay keep their
+    shape at a reduced amplitude. Subclasses LRScheduler so the
+    optimizer's isinstance dispatch keeps treating it as a schedule."""
+
+    def __init__(self, base, scale, min_lr):  # no super().__init__: the
+        # base schedule owns last_epoch/last_lr bookkeeping
+        self.base = base
+        self.scale = float(scale)
+        self.min_lr = float(min_lr)
+
+    def lr_at(self, step):
+        return max(float(self.base.lr_at(step)) * self.scale, self.min_lr)
+
+    def get_lr(self):
+        return max(float(self.base.get_lr()) * self.scale, self.min_lr)
+
+    def step(self, epoch=None):
+        self.base.step(epoch)
+
+    @property
+    def last_epoch(self):
+        return self.base.last_epoch
+
+    @property
+    def last_lr(self):
+        return max(float(self.base.last_lr) * self.scale, self.min_lr)
+
+    def __call__(self, step):
+        return self.lr_at(step)
+
+    def state_dict(self):
+        return {"scale": self.scale, **self.base.state_dict()}
+
+    def set_state_dict(self, state):
+        self.scale = state.pop("scale", self.scale)
+        self.base.set_state_dict(state)
+
+
 class ReduceLROnPlateau(Callback):
     """Parity: hapi ReduceLROnPlateau — scale the optimizer lr by
-    ``factor`` after ``patience`` evals without improvement."""
+    ``factor`` after ``patience`` evals without improvement; composes
+    with an existing LR schedule instead of replacing it."""
 
     def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
                  mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
@@ -261,15 +305,25 @@ class ReduceLROnPlateau(Callback):
         self.wait += 1
         if self.wait >= self.patience:
             opt = self.model._optimizer
-            old = float(opt.get_lr()) if hasattr(opt, "get_lr") \
-                else float(opt.learning_rate)
-            new = max(old * self.factor, self.min_lr)
-            if new < old:
-                opt.set_lr(new)
-                if self.verbose:
-                    print(f"ReduceLROnPlateau: lr {old:.3g} -> {new:.3g}")
+            old = float(opt.get_lr())
+            self._reduce(opt)
+            if self.verbose:
+                print(f"ReduceLROnPlateau: lr {old:.3g} -> "
+                      f"{float(opt.get_lr()):.3g}")
             self.cooldown_counter = self.cooldown
             self.wait = 0
+
+    def _reduce(self, opt):
+        from ..optimizer.lr import LRScheduler as _Sched
+        lr = opt._lr
+        if isinstance(lr, _ScaledScheduler):
+            lr.scale *= self.factor  # last_lr is a property: auto-refreshes
+        elif isinstance(lr, _Sched):
+            # COMPOSE with the schedule (warmup/decay keep running at a
+            # reduced amplitude) instead of stomping it to a constant
+            opt._lr = _ScaledScheduler(lr, self.factor, self.min_lr)
+        else:
+            opt.set_lr(max(float(lr) * self.factor, self.min_lr))
 
 
 class VisualDL(Callback):
@@ -286,7 +340,11 @@ class VisualDL(Callback):
         self._writer.write(tag="train", step=epoch, **(logs or {}))
 
     def on_eval_end(self, logs=None):
-        self._writer.write(tag="eval", step=-1, **(logs or {}))
+        # each eval gets its own monotone step (the real VisualDL writer
+        # keeps per-tag counters the same way)
+        step = getattr(self, "_eval_count", 0)
+        self._eval_count = step + 1
+        self._writer.write(tag="eval", step=step, **(logs or {}))
 
     def on_train_end(self, logs=None):
         self._writer.close()

@@ -70,7 +70,10 @@ def _trace(net, input_size=None, dtypes=None, custom_ops=None, args=None):
             return None
         return hook
 
-    for name, sub in net.named_sublayers():
+    subs = list(net.named_sublayers())
+    if not subs:  # a bare leaf layer IS the model
+        subs = [(type(net).__name__.lower(), net)]
+    for name, sub in subs:
         handles.append(sub.register_forward_post_hook(make_hook(name)))
     try:
         if args is None:

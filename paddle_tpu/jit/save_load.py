@@ -35,6 +35,14 @@ class InputSpec:
         self.name = name
 
     def to_sds(self):
+        if any(s is None or (isinstance(s, int) and s < 0)
+               for s in self.shape):
+            raise ValueError(
+                f"InputSpec shape {self.shape} has a dynamic (None/-1) "
+                f"dim: XLA export traces STATIC shapes — export one "
+                f"program per batch size you serve (the reference's "
+                f"dynamic dims come from its interpreter, which this "
+                f"design collapses)")
         return jax.ShapeDtypeStruct(self.shape, jax.numpy.dtype(self.dtype))
 
 

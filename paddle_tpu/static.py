@@ -1,0 +1,67 @@
+"""paddle.static compatibility surface (parity: python/paddle/static/).
+
+The reference's static-graph stack (Program/Executor/feed-fetch, ~200k
+LoC of C++ behind it) collapses in this framework: every jit-compiled
+function IS a static program — traced once, optimized by XLA, executed
+by PJRT (SURVEY §7's "jit-everything" equivalence). This module keeps
+the handful of static.* entry points users actually write so ported
+code runs unchanged; each maps onto the jit path.
+"""
+
+from __future__ import annotations
+
+from .jit import InputSpec  # noqa: F401  (static.InputSpec parity)
+from .jit import load as _jit_load
+from .jit import save as _jit_save
+
+__all__ = ["InputSpec", "save_inference_model", "load_inference_model",
+           "Program", "default_main_program", "name_scope"]
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    """Parity shim: static.save_inference_model — the artifact is the
+    jit.save StableHLO bundle; ``fetch_vars`` must be the traced callable
+    (a Layer or function), ``feed_vars`` its InputSpecs."""
+    return _jit_save(fetch_vars, path_prefix, input_spec=feed_vars, **kwargs)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Parity shim: static.load_inference_model -> jit.load program."""
+    return _jit_load(path_prefix, **kwargs)
+
+
+class Program:
+    """Compatibility stand-in: there is no mutable global graph — jit
+    traces are the programs. Exists so `paddle.static.Program()` in
+    ported code constructs something inert instead of crashing; any
+    attempt to build ops into it raises with guidance."""
+
+    def __init__(self):
+        self._note = ("static Program building is collapsed into jit "
+                      "tracing; decorate a function with paddle_tpu.jit."
+                      "to_static (or just call it under jit) instead")
+
+    def global_block(self):
+        raise NotImplementedError(self._note)
+
+    def __repr__(self):
+        return "<Program (collapsed: jit traces are the programs)>"
+
+
+def default_main_program():
+    return Program()
+
+
+class name_scope:
+    """Parity: static.name_scope — a no-op scope (XLA names come from
+    jaxpr provenance, not user scopes)."""
+
+    def __init__(self, prefix=None):
+        self.prefix = prefix
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

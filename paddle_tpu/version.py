@@ -1,0 +1,28 @@
+"""Version info (parity: python/paddle/version.py, generated at build
+time in the reference)."""
+
+full_version = "3.0.0-tpu"
+major = "3"
+minor = "0"
+patch = "0"
+rc = "0"
+istaged = True
+commit = "tpu-native-rebuild"
+with_gpu = "OFF"  # TPU-native: the accelerator is TPU via XLA/PJRT
+cuda_version = "False"
+cudnn_version = "False"
+xpu_version = "False"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+    print("backend: tpu (jax/xla/pallas)")
+
+
+def cuda():
+    return False
+
+
+def cudnn():
+    return False

@@ -318,3 +318,24 @@ def test_strings_family():
     # unicode roundtrip
     t2, l2 = pt.strings.to_tensor(["héllo", "日本"])
     assert pt.strings.to_strings(t2, l2) == ["héllo", "日本"]
+
+
+def test_static_compat_surface(tmp_path):
+    """paddle.static shims map onto the jit path (SURVEY jit-everything
+    collapse); InputSpec/save/load_inference_model work end-to-end."""
+    import paddle_tpu as pt
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    with pytest.raises(ValueError, match="STATIC"):
+        pt.static.InputSpec(shape=[None, 4]).to_sds()
+    spec = [pt.static.InputSpec(shape=[3, 4], dtype="float32")]
+    prefix = str(tmp_path / "inf")
+    pt.static.save_inference_model(prefix, spec, net)
+    prog = pt.static.load_inference_model(prefix)
+    x = np.random.default_rng(0).standard_normal((3, 4)).astype("float32")
+    np.testing.assert_allclose(np.asarray(prog(x)), np.asarray(net(x)),
+                               rtol=2e-5, atol=1e-5)
+    with pytest.raises(NotImplementedError):
+        pt.static.default_main_program().global_block()
+    with pt.static.name_scope("block"):
+        pass
+    assert pt.version.full_version.startswith("3.")

@@ -183,3 +183,33 @@ def test_summary_reports_frozen_params(capsys):
     capsys.readouterr()
     assert stats["total_params"] == 4 * 8 + 8 + 8 * 2 + 2
     assert stats["trainable_params"] == stats["total_params"] - 4 * 8
+
+
+def test_flops_on_bare_leaf_layer():
+    import paddle_tpu as pt
+    assert pt.flops(nn.Linear(4, 8), (1, 4)) == 4 * 8 + 8
+
+
+def test_reduce_lr_composes_with_schedule():
+    from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+    import types
+    sched = pt.optimizer.lr.CosineAnnealingDecay(learning_rate=1.0,
+                                                 T_max=100)
+    opt = pt.optimizer.SGD(learning_rate=sched, parameters=[])
+    cb = ReduceLROnPlateau(factor=0.5, patience=1, verbose=0)
+    cb.model = types.SimpleNamespace(_optimizer=opt)
+    cb.on_train_begin()
+    cb.on_eval_end({"loss": 1.0})
+    cb.on_eval_end({"loss": 1.0})  # plateau -> reduce
+    # the schedule SHAPE survives at half amplitude
+    l10 = float(opt._lr.lr_at(10))
+    l50 = float(opt._lr.lr_at(50))
+    ref10 = float(sched.lr_at(10))
+    ref50 = float(sched.lr_at(50))
+    np.testing.assert_allclose(l10, 0.5 * ref10, rtol=1e-6)
+    np.testing.assert_allclose(l50, 0.5 * ref50, rtol=1e-6)
+    assert l10 != l50  # still a schedule, not a constant
+    # second reduction compounds (patience=1: next stalled eval reduces)
+    cb.on_eval_end({"loss": 1.0})
+    np.testing.assert_allclose(float(opt._lr.lr_at(10)), 0.25 * ref10,
+                               rtol=1e-6)
