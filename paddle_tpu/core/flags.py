@@ -111,6 +111,9 @@ define_flag("flash_block_q", 1024, "Flash attention q-block rows (read at "
             "separate processes).")
 define_flag("flash_block_k", 1024, "Flash attention k-block cols (trace-time,"
             " see flash_block_q).")
+define_flag("flash_min_seq", 256, "Minimum q sequence length for routing "
+            "scaled_dot_product_attention onto the Pallas flash kernel on "
+            "TPU (below it the XLA bf16 path wins on launch overhead).")
 define_flag("comm_watchdog_timeout", 300.0,
             "Seconds before the comm watchdog flags a blocking comm/sync "
             "call as hung (parity: FLAGS_enable_async_trace timeout).")

@@ -9,6 +9,7 @@ import jax.numpy as jnp
 
 from .. import nn
 from ..nn import functional as F
+from ..core.dtypes import scoped_dtype_init
 from ..nn.module import Layer
 
 __all__ = ["BertConfig", "BertModel", "BertForPreTraining",
@@ -53,6 +54,7 @@ class BertEmbeddings(Layer):
 
 
 class BertModel(Layer):
+    @scoped_dtype_init
     def __init__(self, config: BertConfig):
         super().__init__(dtype=config.dtype)
         self.config = config
@@ -78,6 +80,7 @@ class BertModel(Layer):
 
 
 class BertForPreTraining(Layer):
+    @scoped_dtype_init
     def __init__(self, config: BertConfig):
         super().__init__(dtype=config.dtype)
         self.bert = BertModel(config)
@@ -101,6 +104,7 @@ class BertForPreTraining(Layer):
 
 
 class BertForSequenceClassification(Layer):
+    @scoped_dtype_init
     def __init__(self, config: BertConfig, num_classes: int = 2):
         super().__init__(dtype=config.dtype)
         self.bert = BertModel(config)

@@ -9,6 +9,7 @@ import jax.numpy as jnp
 
 from .. import nn
 from ..nn import functional as F
+from ..core.dtypes import scoped_dtype_init
 from ..nn.module import Layer
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt2_small", "gpt2_medium"]
@@ -62,6 +63,7 @@ class GPTBlock(Layer):
 
 
 class GPTModel(Layer):
+    @scoped_dtype_init
     def __init__(self, c: GPTConfig):
         super().__init__(dtype=c.dtype)
         self.config = c
@@ -82,6 +84,7 @@ class GPTModel(Layer):
 
 
 class GPTForCausalLM(Layer):
+    @scoped_dtype_init
     def __init__(self, c: GPTConfig):
         super().__init__(dtype=c.dtype)
         self.transformer = GPTModel(c)

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from .. import nn
 from ..nn import functional as F
 from ..nn import initializer as I
+from ..core.dtypes import scoped_dtype_init
 from ..nn.module import Layer, Parameter
 
 __all__ = ["LlamaConfig", "LlamaForCausalLM", "LlamaModel", "LlamaDecoderLayer",
@@ -224,6 +225,7 @@ class LlamaDecoderLayer(Layer):
 
 
 class LlamaModel(Layer):
+    @scoped_dtype_init
     def __init__(self, config: LlamaConfig):
         super().__init__(dtype=config.dtype)
         self.config = config
@@ -264,6 +266,7 @@ class LlamaModel(Layer):
 
 
 class LlamaForCausalLM(Layer):
+    @scoped_dtype_init
     def __init__(self, config: LlamaConfig):
         super().__init__(dtype=config.dtype)
         self.config = config

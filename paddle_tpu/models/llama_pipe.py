@@ -25,6 +25,7 @@ from jax.sharding import PartitionSpec as P
 
 from .. import nn
 from ..nn import functional as F
+from ..core.dtypes import scoped_dtype_init
 from ..nn.module import Layer, Parameter, functional_call
 from ..core import mesh as mesh_lib
 from .llama import LlamaConfig, LlamaDecoderLayer, _rope_cache
@@ -41,6 +42,7 @@ class LlamaForCausalLMPipe(Layer):
     is shared and its two gradient contributions merge in one psum).
     """
 
+    @scoped_dtype_init
     def __init__(self, config: LlamaConfig, num_micro: int = 1,
                  vpp: int = 1):
         super().__init__(dtype=config.dtype)

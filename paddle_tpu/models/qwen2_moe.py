@@ -18,6 +18,7 @@ import jax.numpy as jnp
 
 from .. import nn
 from ..nn import functional as F
+from ..core.dtypes import scoped_dtype_init
 from ..nn.module import Layer
 from ..distributed.moe import ExpertFFN, MoELayer, TopKGate
 from .llama import (LlamaAttention, LlamaConfig, LlamaMLP, _rope_cache,
@@ -116,6 +117,7 @@ class Qwen2MoeDecoderLayer(Layer):
 
 
 class Qwen2MoeForCausalLM(Layer):
+    @scoped_dtype_init
     def __init__(self, config: Qwen2MoeConfig):
         super().__init__(dtype=config.dtype)
         self.config = config

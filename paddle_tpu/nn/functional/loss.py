@@ -25,13 +25,68 @@ def _reduce(loss, reduction):
     return loss
 
 
+import functools as _ft
+
+
+@_ft.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _hard_ce(x, label, ignore_index):
+    loss, _ = _hard_ce_fwd(x, label, ignore_index)
+    return loss
+
+
+def _hard_ce_fwd(x, label, ignore_index):
+    valid = label != ignore_index
+    safe = jnp.where(valid, label, 0)
+    xf = x.astype(jnp.float32)  # fused into the reductions, not materialized
+    lse = jax.scipy.special.logsumexp(xf, axis=-1)
+    picked = jnp.take_along_axis(xf, safe[..., None], axis=-1)[..., 0]
+    loss = jnp.where(valid, lse - picked, 0.0)
+    return loss, (x, label, lse)
+
+
+def _hard_ce_bwd(ignore_index, res, g):
+    x, label, lse = res
+    valid = label != ignore_index
+    safe = jnp.where(valid, label, 0)
+    scale = (g * valid.astype(jnp.float32))[..., None]
+    cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 1)
+    p = jnp.exp(x.astype(jnp.float32) - lse[..., None])
+    dx = (p - (cols == safe[..., None]).astype(jnp.float32)) * scale
+    import numpy as _np
+    return (dx.astype(x.dtype),
+            _np.zeros(label.shape, jax.dtypes.float0))
+
+
+_hard_ce.defvjp(_hard_ce_fwd, _hard_ce_bwd)
+
+
 def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
                   soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
                   name=None):
     """Softmax cross entropy (parity: paddle.nn.functional.cross_entropy;
-    reference kernel phi/kernels/gpu/cross_entropy_kernel.cu). Computes
-    log-softmax in fp32 regardless of input dtype."""
-    x = jnp.asarray(input).astype(jnp.float32)
+    reference kernel phi/kernels/gpu/cross_entropy_kernel.cu). Accumulates
+    in fp32 regardless of input dtype.
+
+    The common hard-label case (no weight/smoothing, softmax on, last axis)
+    runs through a custom-vjp path whose forward keeps only per-row
+    logsumexp as residual and whose backward emits gradients in the INPUT
+    dtype — no [N, vocab] fp32 log-softmax is ever materialized (the
+    round-3 version cost ~4 GB of HBM traffic per BERT MLM step on it)."""
+    xin = jnp.asarray(input)
+    if (not soft_label and label_smoothing == 0.0 and weight is None
+            and use_softmax and axis in (-1, xin.ndim - 1)):
+        lab = jnp.asarray(label)
+        if lab.ndim == xin.ndim and lab.shape[-1] == 1:
+            lab = jnp.squeeze(lab, -1)
+        if lab.ndim == xin.ndim - 1 and not jnp.issubdtype(lab.dtype,
+                                                           jnp.floating):
+            loss = _hard_ce(xin, lab, int(ignore_index))
+            valid = lab != ignore_index
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(
+                    jnp.sum(valid.astype(jnp.float32)), 1.0)
+            return _reduce(loss, reduction)
+    x = xin.astype(jnp.float32)
     logp = jax.nn.log_softmax(x, axis=axis) if use_softmax else jnp.log(
         jnp.clip(x, 1e-30))
     nclass = x.shape[axis]

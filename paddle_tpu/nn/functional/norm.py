@@ -16,6 +16,13 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
     if isinstance(normalized_shape, int):
         normalized_shape = (normalized_shape,)
     axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    if (weight is not None and bias is not None and len(axes) == 1
+            and x.ndim >= 2):
+        # one-HBM-pass Pallas kernel on TPU (gates itself: lane-aligned d,
+        # no mesh) — same routing policy as rms_norm below
+        from ...ops.pallas.fused_norm import fused_layer_norm
+        return fused_layer_norm(x, jnp.asarray(weight), jnp.asarray(bias),
+                                epsilon)
     # compute in fp32 for bf16 stability (reference does the same for fp16:
     # phi/kernels/gpu/layer_norm_kernel.cu uses float accumulators)
     xf = x.astype(jnp.float32)

@@ -44,7 +44,7 @@ class Parameter:
 class Layer:
     """Base class for all neural network layers."""
 
-    def __init__(self, name_scope: str | None = None, dtype: Any = "float32"):
+    def __init__(self, name_scope: str | None = None, dtype: Any = None):
         object.__setattr__(self, "_parameters", OrderedDict())
         object.__setattr__(self, "_buffers", OrderedDict())
         object.__setattr__(self, "_non_persistable_buffer_names", set())
@@ -54,7 +54,12 @@ class Layer:
         object.__setattr__(self, "_forward_pre_hooks", OrderedDict())
         object.__setattr__(self, "_forward_post_hooks", OrderedDict())
         self.training = True
-        self._dtype = canonical_dtype(dtype)
+        # parity: reference Layers create parameters in
+        # paddle.get_default_dtype() unless told otherwise
+        # (python/paddle/nn/layer/layers.py) — sublayers built inside a
+        # core.dtypes.default_dtype_guard pick up the model's dtype
+        from ..core.dtypes import get_default_dtype
+        self._dtype = canonical_dtype(dtype) or get_default_dtype()
 
     # ---- attribute routing ----
 

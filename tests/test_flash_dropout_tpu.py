@@ -116,7 +116,7 @@ def test_sdpa_routes_dropout_through_kernel(monkeypatch):
     # s must be >= _FLASH_MIN_SEQ or sdpa silently stays on the XLA path
     import paddle_tpu.nn.functional as F
     from paddle_tpu.nn.functional import attention as attn_mod
-    assert 1024 >= attn_mod._FLASH_MIN_SEQ
+    assert 1024 >= attn_mod._flash_min_seq()
     q, k, v = _qkv(s=1024)
 
     # prove the route: the kernel entry must actually be hit for the
