@@ -164,7 +164,7 @@ def bench_bert(peak, peak_kind, batch=32):
     }
 
 
-def bench_qwen2_moe(peak, peak_kind, batch=4):
+def bench_qwen2_moe(peak, peak_kind, batch=8):  # sweep r4: 8 > 4/16 (bf16)
     import jax.numpy as jnp
 
     import paddle_tpu as pt

@@ -90,8 +90,22 @@ def make_mesh(
 def current_mesh() -> Mesh | None:
     if _current_mesh[0] is not None:
         return _current_mesh[0]
-    # fall back to ambient jax mesh context if set via jax.sharding.use_mesh
-    env = getattr(jax.sharding, "get_abstract_mesh", None)
+    # fall back to the ambient jax mesh so callers that gate on an active
+    # mesh (e.g. MoE sorted-dispatch fallback) see meshes activated without
+    # this library's use_mesh wrapper: the modern jax.sharding.set_mesh
+    # context first, then the legacy `with mesh:` thread resources (private
+    # import — the public pxla alias is deprecated; guarded so removal just
+    # disables the legacy bridge, never the set_mesh path)
+    am = jax.sharding.get_abstract_mesh()
+    if not am.empty:
+        return jax.sharding.get_mesh()
+    try:
+        from jax._src.mesh import thread_resources
+        pm = thread_resources.env.physical_mesh
+        if not pm.empty:
+            return pm
+    except Exception:
+        pass
     return None
 
 

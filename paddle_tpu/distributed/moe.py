@@ -18,6 +18,7 @@ TPU-native design (GShard-style dense dispatch):
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 
 import jax
@@ -30,7 +31,8 @@ from ..nn import initializer as I
 from ..nn.module import Layer, Parameter
 
 __all__ = ["MoELayer", "TopKGate", "SwitchGate", "GShardGate", "ExpertFFN",
-           "moe_dispatch_combine", "global_scatter", "global_gather"]
+           "moe_dispatch_combine", "moe_ragged_compute", "moe_grouped_compute",
+           "global_scatter", "global_gather"]
 
 
 def global_scatter(x, local_count, global_count, axis: str = "mp"):
@@ -68,10 +70,13 @@ def global_gather(y, local_count, global_count, axis: str = "mp"):
     return back.reshape(P * Elocal, C, d)
 
 
-def _top2_gating(logits, capacity, *, second_policy="random", key=None,
-                 balance_loss_weight=1.0):
-    """GShard top-2 gating. logits: [tokens, E]. Returns (dispatch [T,E,C],
-    combine [T,E,C], aux_loss)."""
+def _top2_parts(logits, capacity, *, second_policy="random", key=None,
+                balance_loss_weight=1.0):
+    """GShard top-2 gating core. logits: [tokens, E]. Returns the routing
+    decision pieces shared by the dense (one-hot) and sparse (sorted/ragged)
+    dispatch builders so the two paths can never diverge on gating rules:
+    (g1_idx, g2_idx, w1, w2, keep1, keep2f, p1, p2, aux) — w1/w2 are already
+    zeroed for capacity-dropped slots and renormalized over kept experts."""
     T, E = logits.shape
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     g1_idx = jnp.argmax(probs, axis=-1)
@@ -102,6 +107,17 @@ def _top2_gating(logits, capacity, *, second_policy="random", key=None,
     denom = jnp.maximum(g1 * keep1 + g2 * keep2f, 1e-9)
     w1 = jnp.where(keep1, g1, 0.0) / denom
     w2 = jnp.where(keep2f, g2, 0.0) / denom
+    return g1_idx, g2_idx, w1, w2, keep1, keep2f, p1, p2, aux
+
+
+def _top2_gating(logits, capacity, *, second_policy="random", key=None,
+                 balance_loss_weight=1.0):
+    """GShard top-2 gating. logits: [tokens, E]. Returns (dispatch [T,E,C],
+    combine [T,E,C], aux_loss)."""
+    E = logits.shape[1]
+    g1_idx, g2_idx, w1, w2, keep1, keep2f, p1, p2, aux = _top2_parts(
+        logits, capacity, second_policy=second_policy, key=key,
+        balance_loss_weight=balance_loss_weight)
     disp1 = (jax.nn.one_hot(g1_idx, E, dtype=jnp.float32)[:, :, None] *
              jax.nn.one_hot(p1, capacity, dtype=jnp.float32)[:, None, :] *
              keep1[:, None, None])
@@ -113,9 +129,10 @@ def _top2_gating(logits, capacity, *, second_policy="random", key=None,
     return dispatch, combine, aux
 
 
-def _top1_gating(logits, capacity, *, balance_loss_weight=1.0, jitter_eps=0.0,
-                 key=None, training=True):
-    """Switch-transformer top-1 gating."""
+def _top1_parts(logits, capacity, *, balance_loss_weight=1.0, jitter_eps=0.0,
+                key=None, training=True):
+    """Switch top-1 gating core (see _top2_parts): returns
+    (idx, gate, keep, p, aux)."""
     T, E = logits.shape
     if jitter_eps > 0 and training:
         k = key if key is not None else rng.next_key()
@@ -131,6 +148,16 @@ def _top1_gating(logits, capacity, *, balance_loss_weight=1.0, jitter_eps=0.0,
     pos = jnp.cumsum(mask, axis=0) * mask - mask
     p = jnp.sum(pos * mask, axis=1)
     keep = p < capacity
+    return idx, gate, keep, p, aux
+
+
+def _top1_gating(logits, capacity, *, balance_loss_weight=1.0, jitter_eps=0.0,
+                 key=None, training=True):
+    """Switch-transformer top-1 gating."""
+    E = logits.shape[1]
+    idx, gate, keep, p, aux = _top1_parts(
+        logits, capacity, balance_loss_weight=balance_loss_weight,
+        jitter_eps=jitter_eps, key=key, training=training)
     dispatch = (jax.nn.one_hot(idx, E, dtype=jnp.float32)[:, :, None] *
                 jax.nn.one_hot(p, capacity, dtype=jnp.float32)[:, None, :] *
                 keep[:, None, None])
@@ -159,10 +186,21 @@ class TopKGate(Layer):
         cap = int(f * num_tokens * self.top_k / self.num_experts)
         return max(cap, 4)
 
+    def logits(self, x):
+        """Router logits — the extension point custom gates override; every
+        dispatch mode (dense forward, sorted forward_sparse, all-to-all)
+        routes through it."""
+        return x.astype(jnp.float32) @ self.weight
+
     def forward(self, x):
-        T = x.shape[0]
-        logits = x.astype(jnp.float32) @ self.weight
-        return self._route(logits, self.capacity(T))
+        return self._route(self.logits(x), self.capacity(x.shape[0]))
+
+    def forward_sparse(self, x):
+        """Sparse-form routing for the sorted grouped-GEMM dispatch modes:
+        (idx, w, pos, keep, aux, capacity) — same logits/capacity as
+        forward."""
+        cap = self.capacity(x.shape[0])
+        return (*self._route_sparse(self.logits(x), cap), cap)
 
     def _route(self, logits, cap):
         """Post-logits routing policy — the single definition used by both
@@ -175,6 +213,27 @@ class TopKGate(Layer):
         return _top2_gating(logits, cap,
                             balance_loss_weight=self.balance_loss_weight,
                             second_policy="random" if self.training else "all")
+
+    def _route_sparse(self, logits, cap):
+        """Same routing decisions as _route, in sparse form for the sorted
+        grouped-GEMM paths: (idx, w, pos, keep, aux), each [T, k] — w is
+        zero for capacity-dropped slots and pos/keep are the SAME
+        position-in-expert/drop decisions the dense one-hot builder encodes
+        (top-1 claims before top-2; both builders consume the same
+        _top*_parts core, so the dispatch modes cannot diverge)."""
+        if self.top_k == 1:
+            idx, gate, keep, p, aux = _top1_parts(
+                logits, cap, balance_loss_weight=self.balance_loss_weight,
+                jitter_eps=self.jitter_eps, training=self.training)
+            return (idx[:, None], (gate * keep)[:, None], p[:, None],
+                    keep[:, None], aux)
+        g1_idx, g2_idx, w1, w2, keep1, keep2f, p1, p2, aux = _top2_parts(
+            logits, cap, balance_loss_weight=self.balance_loss_weight,
+            second_policy="random" if self.training else "all")
+        return (jnp.stack([g1_idx, g2_idx], axis=1),
+                jnp.stack([w1, w2], axis=1),
+                jnp.stack([p1, p2], axis=1),
+                jnp.stack([keep1, keep2f], axis=1), aux)
 
 
 class SwitchGate(TopKGate):
@@ -233,6 +292,134 @@ def moe_dispatch_combine(x, dispatch, combine, expert_fn):
                       combine).astype(x.dtype)
 
 
+def moe_ragged_compute(x, idx, w, w_in, w_gate, w_out, activation):
+    """Sorted grouped-GEMM expert compute — the TPU answer to the
+    reference's cutlass grouped GEMM (fusion/cutlass/moe_kernel.cu:647
+    ``MoeKernel``: sort tokens by expert, run one GEMM per contiguous
+    expert group, scatter back).
+
+    x: [T, D]; idx/w: [T, k] expert assignments and combine weights
+    (capacity-dropped slots carry w == 0). Token copies are sorted by
+    expert id and every expert runs over its contiguous group via
+    ``jax.lax.ragged_dot`` on the MXU — no [T, E, C] one-hot dispatch
+    tensors (the round-3 einsum path spent as much time building them as
+    computing the experts). The combine inverts the sort with a gather
+    (argsort of the permutation) instead of a scatter-add.
+    """
+    T, D = x.shape
+    K = idx.shape[1]
+    E = w_in.shape[0]
+    e_flat = idx.reshape(-1)                       # [T*K], slot t*K+k
+    order = jnp.argsort(e_flat)                    # stable: expert-major
+    tok = order // K                               # source token per slot
+    xs = jnp.take(x, tok, axis=0)                  # [T*K, D] sorted inputs
+    group_sizes = jnp.bincount(e_flat, length=E).astype(jnp.int32)
+    h = jax.lax.ragged_dot(xs, w_in, group_sizes)
+    if w_gate is not None:
+        h = activation(jax.lax.ragged_dot(xs, w_gate, group_sizes)) * h
+    else:
+        h = activation(h)
+    y = jax.lax.ragged_dot(h, w_out, group_sizes)  # [T*K, D]
+    ws = w.reshape(-1)[order].astype(jnp.float32)
+    y = y.astype(jnp.float32) * ws[:, None]
+    # inverse of a known permutation: O(n) iota scatter, not a second sort
+    inv = jnp.zeros_like(order).at[order].set(
+        jnp.arange(order.shape[0], dtype=order.dtype))
+    return jnp.take(y, inv, axis=0).reshape(T, K, D).sum(axis=1).astype(x.dtype)
+
+
+def _float0(shape):
+    import numpy as np
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _pack_rows(x, fill_tok, occupied, slot, keep, K):
+    """xe[s] = x[fill_tok[s]] for occupied slots, else 0. The backward is a
+    GATHER through the inverse mapping (slot/keep), not the scatter-add XLA
+    autodiff would emit for a gather — measured 1.3x end-to-end on v5e."""
+    xe = jnp.take(x, fill_tok, axis=0)
+    return jnp.where(occupied[:, None], xe, 0)
+
+
+def _pack_rows_fwd(x, fill_tok, occupied, slot, keep, K):
+    return _pack_rows(x, fill_tok, occupied, slot, keep, K), (slot, keep)
+
+
+def _pack_rows_bwd(K, res, g):
+    slot, keep = res
+    ec = g.shape[0]
+    d_copy = jnp.where(keep[:, None],
+                       jnp.take(g, jnp.minimum(slot, ec - 1), axis=0), 0)
+    dx = d_copy.reshape(-1, K, g.shape[-1]).sum(axis=1)
+    return (dx.astype(g.dtype), _float0((ec,)), _float0((ec,)),
+            _float0(slot.shape), _float0(keep.shape))
+
+
+_pack_rows.defvjp(_pack_rows_fwd, _pack_rows_bwd)
+
+
+@jax.custom_vjp
+def _unpack_rows(ye, slot, keep, fill_copy, occupied):
+    """Per-copy readback: out[i] = ye[slot[i]] for kept copies, else 0.
+    Backward gathers through fill_copy/occupied (see _pack_rows)."""
+    ec = ye.shape[0]
+    out = jnp.take(ye, jnp.minimum(slot, ec - 1), axis=0)
+    return jnp.where(keep[:, None], out, 0)
+
+
+def _unpack_rows_fwd(ye, slot, keep, fill_copy, occupied):
+    return _unpack_rows(ye, slot, keep, fill_copy, occupied), (fill_copy,
+                                                               occupied)
+
+
+def _unpack_rows_bwd(res, g):
+    fill_copy, occupied = res
+    tk = g.shape[0]
+    d_ye = jnp.where(occupied[:, None], jnp.take(g, fill_copy, axis=0), 0)
+    return (d_ye.astype(g.dtype), _float0((tk,)), _float0((tk,)),
+            _float0(fill_copy.shape), _float0(occupied.shape))
+
+
+_unpack_rows.defvjp(_unpack_rows_fwd, _unpack_rows_bwd)
+
+
+def moe_grouped_compute(x, idx, w, pos, keep, capacity, w_in, w_gate, w_out,
+                        activation):
+    """Capacity-packed grouped GEMM — the fastest measured TPU form of the
+    reference's cutlass grouped GEMM (fusion/cutlass/moe_kernel.cu:647):
+    token copies are placed into per-expert capacity slots by GATHER (no
+    [T, E, C] one-hot dispatch tensors), experts run as one dense batched
+    matmul over [E, C, D] on the MXU, and the combine reads each copy's slot
+    back by gather. Both pack and unpack carry custom VJPs whose backwards
+    are again gathers (v5e sweep 2026-07: 1.3x over the one-hot einsum path
+    end-to-end; jax.lax.ragged_dot fwd is equally fast but its dRHS
+    backward loses the advantage — see moe_ragged_compute).
+
+    Capacity semantics come from the router's pos/keep (the oracle's own
+    position-in-expert assignment, top-1 before top-2): a copy lands in slot
+    (e, pos) when keep, else it is dropped (zero contribution).
+    """
+    T, D = x.shape
+    K = idx.shape[1]
+    E = w_in.shape[0]
+    C = int(capacity)
+    ec = E * C
+    e_flat = idx.reshape(-1)                        # [T*K]
+    keep = keep.reshape(-1)
+    slot = jnp.where(keep, e_flat * C + pos.reshape(-1), ec)  # drop -> ec
+    fill_copy = jnp.zeros((ec + 1,), jnp.int32).at[slot].set(
+        jnp.arange(T * K, dtype=jnp.int32), mode="drop")
+    occupied = jnp.zeros((ec + 1,), bool).at[slot].set(True, mode="drop")
+    fill_copy, occupied = fill_copy[:ec], occupied[:ec]
+    xe = _pack_rows(x, fill_copy // K, occupied, slot, keep, K)
+    ye = ExpertFFN.apply(xe.reshape(E, C, D), w_in, w_gate, w_out,
+                         activation).reshape(ec, D)
+    back = _unpack_rows(ye, slot, keep, fill_copy, occupied)
+    out = back.astype(jnp.float32) * w.reshape(-1).astype(jnp.float32)[:, None]
+    return out.reshape(T, K, D).sum(axis=1).astype(x.dtype)
+
+
 class MoELayer(Layer):
     """Parity: paddle.incubate.distributed.models.moe.MoELayer(:263).
 
@@ -251,13 +438,15 @@ class MoELayer(Layer):
                     "naive": SwitchGate}[gate](d_model, num_experts)
         self.gate = gate
         self.ep_axis = ep_axis
-        if dispatch not in ("einsum", "alltoall"):
-            raise ValueError(f"dispatch must be 'einsum' or 'alltoall', got {dispatch!r}")
+        if dispatch not in ("einsum", "alltoall", "ragged", "grouped"):
+            raise ValueError(f"dispatch must be 'einsum', 'alltoall', "
+                             f"'ragged' or 'grouped', got {dispatch!r}")
         self.dispatch = dispatch
         self.experts = experts if experts is not None else ExpertFFN(
             num_experts, d_model, d_hidden, ep_axis=ep_axis)
-        if dispatch == "alltoall" and not isinstance(self.experts, ExpertFFN):
-            raise ValueError("dispatch='alltoall' requires ExpertFFN experts")
+        if dispatch in ("alltoall", "ragged", "grouped") and \
+                not isinstance(self.experts, ExpertFFN):
+            raise ValueError(f"dispatch={dispatch!r} requires ExpertFFN experts")
         self.register_buffer("aux_loss", jnp.zeros((), jnp.float32),
                              persistable=False)
 
@@ -266,11 +455,39 @@ class MoELayer(Layer):
         t = x.reshape(-1, shape[-1])
         if self.dispatch == "alltoall":
             out, aux = self._forward_alltoall(t)
+        elif self.dispatch in ("ragged", "grouped"):
+            out, aux = self._forward_sorted(t)
         else:
             dispatch, combine, aux = self.gate(t)
             out = moe_dispatch_combine(t, dispatch, combine, self.experts)
         self.aux_loss = aux
         return out.reshape(shape)
+
+    def _forward_sorted(self, t):
+        """Single-device sorted dispatch: 'grouped' = capacity-packed dense
+        batched GEMM with gather-VJP pack/unpack (moe_grouped_compute, the
+        fast path); 'ragged' = jax.lax.ragged_dot over sorted token copies
+        (no capacity padding in the compute, but capacity DROPS still apply
+        via zeroed combine weights — identical routing semantics to the
+        einsum oracle). Neither carries a GSPMD partitioning rule, so
+        under a multi-device mesh both fall back to the dense einsum path
+        (GSPMD partitions it; explicit EP uses dispatch='alltoall')."""
+        from ..core import mesh as mesh_lib
+        mesh = mesh_lib.current_mesh()
+        if mesh is not None and any(s > 1 for s in mesh.shape.values()):
+            dispatch, combine, aux = self.gate(t)
+            return moe_dispatch_combine(t, dispatch, combine, self.experts), aux
+        idx, w, pos, keep, aux, cap = self.gate.forward_sparse(t)
+        experts = self.experts
+        w_gate = experts.w_gate if experts.gated else None
+        if self.dispatch == "grouped":
+            out = moe_grouped_compute(t, idx, w, pos, keep, cap,
+                                      experts.w_in, w_gate, experts.w_out,
+                                      experts.activation)
+        else:
+            out = moe_ragged_compute(t, idx, w, experts.w_in, w_gate,
+                                     experts.w_out, experts.activation)
+        return out, aux
 
     def _forward_alltoall(self, t):
         """Explicit EP dispatch (parity: moe_layer.py:263 dispatch path over

@@ -50,7 +50,10 @@ class Qwen2MoeConfig:
     mp_axis: str | None = "mp"
     fsdp_axis: str | None = "fsdp"
     ep_axis: str | None = "mp"             # expert-weight sharding axis
-    ep_dispatch: str = "einsum"            # 'einsum' (GSPMD) | 'alltoall' (explicit EP)
+    # 'grouped' (capacity-packed grouped GEMM, single-device; falls back to
+    # einsum under a mesh) | 'ragged' (dropless ragged_dot) | 'einsum'
+    # (GSPMD dense dispatch) | 'alltoall' (explicit EP)
+    ep_dispatch: str = "grouped"
     sep_axis: str | None = None
 
     def _attn_cfg(self) -> LlamaConfig:
