@@ -71,12 +71,25 @@ def maybe_cast_inputs(*tensors):
 def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16",
              master_weight=None, save_dtype=None):
     """O2: cast model params to the low-precision dtype (master fp32 weights
-    live in the optimizer state — multi_precision=True default)."""
-    d = canonical_dtype(dtype)
+    live in the optimizer state — multi_precision=True default). Norm layers
+    (BatchNorm/LayerNorm/InstanceNorm/GroupNorm) keep fp32 params AND
+    buffers, matching the reference's keep_batchnorm_fp32=True default
+    (python/paddle/amp/__init__.py decorate) — bf16 running stats would
+    drift over long training. O1 leaves model params untouched (autocast
+    only, same as the reference)."""
+    from ..nn.layer.norm import (GroupNorm, LayerNorm, RMSNorm,
+                                 _BatchNormBase, _InstanceNormBase)
     single = isinstance(models, Layer)
     model_list = [models] if single else list(models)
+    if str(level).upper() != "O2":
+        if optimizers is None:
+            return models if single else model_list
+        return (models if single else model_list), optimizers
+    d = canonical_dtype(dtype)
+    norm_types = (_BatchNormBase, _InstanceNormBase, LayerNorm, GroupNorm,
+                  RMSNorm)
     for m in model_list:
-        m.to(dtype=d)
+        m.to(dtype=d, exclude_types=norm_types)
     if optimizers is None:
         return models if single else model_list
     return (models if single else model_list), optimizers

@@ -71,24 +71,35 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     shape[channel_axis] = x.shape[channel_axis]
 
     use_stats = (not training) if use_global_stats is None else use_global_stats
-    xf = x.astype(jnp.float32)
     if use_stats:
         mean = jnp.asarray(running_mean, jnp.float32)
         var = jnp.asarray(running_var, jnp.float32)
         new_mean, new_var = running_mean, running_var
     else:
-        mean = jnp.mean(xf, axis=axes)
-        var = jnp.var(xf, axis=axes)
-        n = xf.size / xf.shape[channel_axis]
+        # one fused pass over x: fp32-accumulated E[x] / E[x^2] (uncentered)
+        # instead of mean-then-centered-var, which needs a second read of x.
+        # Matches the fused GPU BN kernels' precision model (fp32 stats,
+        # storage-dtype normalize). Clamped: E[x^2]-E[x]^2 can go epsilon-
+        # negative in fp32 when |mean| >> std.
+        mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
+        m2 = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axes)
+        var = jnp.maximum(m2 - jnp.square(mean), 0.0)
+        n = x.size / x.shape[channel_axis]
         unbiased = var * n / max(n - 1.0, 1.0)
         new_mean = momentum * jnp.asarray(running_mean, jnp.float32) + (1 - momentum) * mean
         new_var = momentum * jnp.asarray(running_var, jnp.float32) + (1 - momentum) * unbiased
-    out = (xf - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
-    if weight is not None:
-        out = out * jnp.asarray(weight, jnp.float32).reshape(shape)
+    # normalize as out = x * a + b with per-channel fp32 coefficients; the
+    # FMA runs in fp32 (a bf16 b = -mean*a would carry a per-channel bias
+    # when |mean| >> std) and only the RESULT is cast — the broadcast-FMA
+    # fuses into one pass over x either way, no [N,C,H,W] fp32
+    # materialization (~10% of a bf16 ResNet-50 step went to the old
+    # mean-then-centered-var fp32 chain)
+    inv = jax.lax.rsqrt(var + epsilon)
+    a = inv if weight is None else jnp.asarray(weight, jnp.float32) * inv
+    b = -mean * a
     if bias is not None:
-        out = out + jnp.asarray(bias, jnp.float32).reshape(shape)
-    out = out.astype(x.dtype)
+        b = b + jnp.asarray(bias, jnp.float32)
+    out = (x * a.reshape(shape) + b.reshape(shape)).astype(x.dtype)
     if training and not use_stats:
         return out, new_mean, new_var
     return out

@@ -317,11 +317,16 @@ class Layer:
         fn(self)
         return self
 
-    def to(self, device=None, dtype: Any = None, blocking: bool = True):
-        """Cast floating-point params/buffers and/or move to a device."""
+    def to(self, device=None, dtype: Any = None, blocking: bool = True,
+           exclude_types: tuple = ()):
+        """Cast floating-point params/buffers and/or move to a device.
+        ``exclude_types``: layer classes whose own params/buffers are left
+        untouched (amp.decorate keeps norm layers fp32 through this)."""
         d = canonical_dtype(dtype)
 
         def convert(mod: Layer):
+            if exclude_types and isinstance(mod, exclude_types):
+                return
             for store in (mod._parameters, mod._buffers):
                 for k, v in store.items():
                     if v is None:
