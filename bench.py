@@ -93,7 +93,9 @@ def _time_windows(step_fn, feed, iters=10, runs=_RUNS):
 def _staged_feed(host_iter, staged):
     """feed() closure: drive the host pipeline one batch per call, return
     the next staged device batch (see _time_windows on why transfer is
-    staged)."""
+    staged). ``feed.close()`` releases the pipeline (drains an in-flight
+    DataLoader epoch so its prefetcher thread exits instead of pinning the
+    dataset in memory for the rest of the multi-config bench process)."""
     it = iter(host_iter)
     k = [0]
 
@@ -101,12 +103,38 @@ def _staged_feed(host_iter, staged):
         next(it)  # host pipeline work, in the timed loop
         k[0] += 1
         return staged[k[0] % len(staged)]
+
+    def close():
+        for obj in (host_iter, it):
+            if hasattr(obj, "close"):
+                obj.close()
+                break
+    feed.close = close
     return feed
 
 
-def _cycle(iterable_factory):
-    while True:
-        yield from iterable_factory()
+class _LoaderCycle:
+    """Endless epochs over a DataLoader. The loader's buffer-reader thread
+    has no stop signal — it runs until its epoch drains — so close()
+    consumes the in-flight epoch's tail to let the thread exit."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.it = iter(loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.it)
+        except StopIteration:
+            self.it = iter(self.loader)
+            return next(self.it)
+
+    def close(self):
+        for _ in self.it:
+            pass
 
 
 class _SynthImages:
@@ -163,8 +191,11 @@ def bench_llama(peak, peak_kind):
 
     staged = [(a := jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
                                 jnp.int32), a) for _ in range(4)]
-    dt, spread, lossv = _time_windows(step, _staged_feed(host_batches(),
-                                                         staged))
+    pipe = _staged_feed(host_batches(), staged)
+    try:
+        dt, spread, lossv = _time_windows(step, pipe)
+    finally:
+        pipe.close()
     tokens_per_sec = batch * seq / dt
     flops_per_token = 6.0 * n_params + 12.0 * cfg.num_hidden_layers * seq * cfg.hidden_size
     mfu = flops_per_token * tokens_per_sec / peak
@@ -213,8 +244,11 @@ def bench_resnet50(peak, peak_kind, batch=128):  # 128 ~20% > 64/256 (sweep)
                            jnp.bfloat16),
                jnp.asarray(rng.integers(0, 1000, (batch,)), jnp.int32))
               for _ in range(2)]
-    dt, spread, lossv = _time_windows(
-        step, _staged_feed(_cycle(lambda: loader), staged))
+    pipe = _staged_feed(_LoaderCycle(loader), staged)
+    try:
+        dt, spread, lossv = _time_windows(step, pipe)
+    finally:
+        pipe.close()
     images_per_sec = batch / dt
     # ResNet-50 @224 is 4.09 GMACs = 8.18 GFLOP forward per image (the
     # widely quoted "4.09 GFLOPs" counts multiply-accumulates; summing the
@@ -258,14 +292,16 @@ def bench_bert(peak, peak_kind, batch=32):
     from paddle_tpu.io import DataLoader, Dataset
 
     class SynthMLM(Dataset):
+        # 16 batches/epoch: epoch restarts respawn the buffer-reader
+        # thread; keep that churn out of the 10-step timed windows
         def __init__(self):
             r = np.random.default_rng(1)
             self.ids = r.integers(0, cfg.vocab_size,
-                                  (4 * batch, seq)).astype(np.int32)
-            self.nsp = r.integers(0, 2, (4 * batch,)).astype(np.int32)
+                                  (16 * batch, seq)).astype(np.int32)
+            self.nsp = r.integers(0, 2, (16 * batch,)).astype(np.int32)
 
         def __len__(self):
-            return 4 * batch
+            return 16 * batch
 
         def __getitem__(self, i):
             return self.ids[i], self.ids[(i + 1) % len(self.ids)], self.nsp[i]
@@ -282,8 +318,11 @@ def bench_bert(peak, peak_kind, batch=32):
         return (ids, (mlm, nsp))
 
     staged = [stage() for _ in range(4)]
-    dt, spread, lossv = _time_windows(
-        step, _staged_feed(_cycle(lambda: loader), staged))
+    pipe = _staged_feed(_LoaderCycle(loader), staged)
+    try:
+        dt, spread, lossv = _time_windows(step, pipe)
+    finally:
+        pipe.close()
     tokens_per_sec = batch * seq / dt
     mfu = 6.0 * n_params * tokens_per_sec / peak
     return {
@@ -329,13 +368,14 @@ def bench_qwen2_moe(peak, peak_kind, batch=8):  # sweep r4: 8 > 4/16 (bf16)
     from paddle_tpu.io import DataLoader, Dataset
 
     class SynthTokens(Dataset):
+        # 16 batches/epoch: see SynthMLM note on buffer-reader churn
         def __init__(self):
             r = np.random.default_rng(1)
             self.ids = r.integers(0, cfg.vocab_size,
-                                  (4 * batch, seq)).astype(np.int32)
+                                  (16 * batch, seq)).astype(np.int32)
 
         def __len__(self):
-            return 4 * batch
+            return 16 * batch
 
         def __getitem__(self, i):
             return self.ids[i]
@@ -344,8 +384,11 @@ def bench_qwen2_moe(peak, peak_kind, batch=8):  # sweep r4: 8 > 4/16 (bf16)
                         drop_last=True, to_device=False)
     staged = [(a := jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
                                 jnp.int32), a) for _ in range(4)]
-    dt, spread, lossv = _time_windows(
-        step, _staged_feed(_cycle(lambda: loader), staged))
+    pipe = _staged_feed(_LoaderCycle(loader), staged)
+    try:
+        dt, spread, lossv = _time_windows(step, pipe)
+    finally:
+        pipe.close()
     tokens_per_sec = batch * seq / dt
     mfu = 6.0 * n_active * tokens_per_sec / peak
     return {
