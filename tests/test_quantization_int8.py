@@ -1,0 +1,138 @@
+"""Real int8 deployment conversion (VERDICT r3 missing #5 / weak #5).
+
+Parity: quantization/qat.py:23 (convert -> deployable quantized model) and
+observers/groupwise.py:23 (groupwise weight observer). convert() must emit
+int8 weight ARTIFACTS (not eval-mode fake quant), honor quantable_types
+(Conv2D!), survive a jit.save/load roundtrip, and stay within a bounded
+accuracy delta of the fp model.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.quantization import (GroupWiseWeightObserver, PTQ, QAT,
+                                     QuantConfig, QuantedConv2D,
+                                     QuantedLinear, QuantizedConv2D,
+                                     QuantizedLinear, quantize_weight)
+
+RNG = np.random.default_rng(0)
+
+
+def _lenet():
+    pt.seed(0)
+    return nn.Sequential(
+        nn.Conv2D(1, 6, 5, padding=2), nn.ReLU(), nn.MaxPool2D(2, 2),
+        nn.Conv2D(6, 16, 5), nn.ReLU(), nn.MaxPool2D(2, 2),
+        nn.Flatten(), nn.Linear(16 * 5 * 5, 120), nn.ReLU(),
+        nn.Linear(120, 84), nn.ReLU(), nn.Linear(84, 10))
+
+
+def test_qat_wraps_conv2d():
+    m = QAT().quantize(_lenet())
+    kinds = [type(sub).__name__ for sub in m.sublayers()]
+    assert kinds.count("QuantedConv2D") == 2, kinds
+    assert kinds.count("QuantedLinear") == 3, kinds
+    # custom quantable_types restricts wrapping
+    cfg = QuantConfig()
+    cfg.add_type_config([nn.Linear])
+    m2 = QAT(cfg).quantize(_lenet())
+    kinds2 = [type(sub).__name__ for sub in m2.sublayers()]
+    assert kinds2.count("QuantedConv2D") == 0
+    assert kinds2.count("QuantedLinear") == 3
+
+
+def test_convert_emits_int8_artifacts_and_bounded_delta():
+    net = _lenet()
+    x = jnp.asarray(RNG.standard_normal((8, 1, 28, 28)), jnp.float32)
+    ref = np.asarray(net(x))
+
+    ptq = PTQ()
+    m = ptq.quantize(net)
+    for _ in range(3):
+        ptq.sample(m, x)
+    deploy = ptq.convert(m)
+
+    qlayers = [s for s in deploy.sublayers()
+               if isinstance(s, (QuantizedLinear, QuantizedConv2D))]
+    assert len(qlayers) == 5
+    for q in qlayers:
+        assert q.weight_q.dtype == jnp.int8, q.weight_q.dtype
+        assert q.weight_scale.dtype == jnp.float32
+    # per-out-channel scale shapes
+    convs = [s for s in deploy.sublayers() if isinstance(s, QuantizedConv2D)]
+    assert convs[0].weight_scale.shape == (6,)
+    lins = [s for s in deploy.sublayers() if isinstance(s, QuantizedLinear)]
+    assert lins[-1].weight_scale.shape == (10,)
+
+    got = np.asarray(deploy(x))
+    # weight-only int8 with per-channel scales: tight output delta
+    assert np.abs(got - ref).max() < 0.15 * max(1.0, np.abs(ref).max()), \
+        np.abs(got - ref).max()
+    # classification agreement on the calibration batch
+    assert (got.argmax(-1) == ref.argmax(-1)).mean() >= 0.75
+
+
+def test_groupwise_observer_and_convert():
+    obs = GroupWiseWeightObserver(group_size=4)
+    w = jnp.asarray(RNG.standard_normal((16, 8)), jnp.float32)
+    s = obs.scales(w)
+    assert s.shape == (4, 8)
+    np.testing.assert_allclose(
+        np.asarray(s)[0], np.abs(np.asarray(w)[:4]).max(0), rtol=1e-6)
+
+    q, scales = quantize_weight(w, group_size=4)
+    assert q.dtype == jnp.int8 and scales.shape == (4, 8)
+    # groupwise dequant is closer than per-tensor would be; check roundtrip
+    gs = np.repeat(np.asarray(scales), 4, axis=0)
+    deq = np.asarray(q, np.float32) * gs / 127.0
+    assert np.abs(deq - np.asarray(w)).max() <= (gs.max() / 127.0) + 1e-6
+
+    # e2e: convert with group_size on a Linear-only model
+    pt.seed(1)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    x = jnp.asarray(RNG.standard_normal((4, 16)), jnp.float32)
+    ref = np.asarray(net(x))
+    qat = QAT()
+    m = qat.quantize(net)
+    m(x)
+    deploy = qat.convert(m, group_size=8)
+    lins = [s for s in deploy.sublayers() if isinstance(s, QuantizedLinear)]
+    assert lins[0].weight_scale.shape == (2, 32)  # 16/8 groups
+    got = np.asarray(deploy(x))
+    assert np.abs(got - ref).max() < 0.1 * max(1.0, np.abs(ref).max())
+
+
+def test_converted_model_jit_save_load_roundtrip(tmp_path):
+    net = _lenet()
+    x = jnp.asarray(RNG.standard_normal((4, 1, 28, 28)), jnp.float32)
+    ptq = PTQ()
+    m = ptq.quantize(net)
+    ptq.sample(m, x)
+    deploy = ptq.convert(m)
+    want = np.asarray(deploy(x))
+
+    path = str(tmp_path / "lenet_int8")
+    pt.jit.save(deploy, path, input_spec=[
+        pt.jit.InputSpec((4, 1, 28, 28), "float32")])
+    loaded = pt.jit.load(path)
+    got = np.asarray(loaded(x))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_qat_conv_trainable_with_ste():
+    """QAT Conv2D path trains (STE gradients flow through both quanters)."""
+    import paddle_tpu.nn.functional as F
+    pt.seed(2)
+    net = nn.Sequential(nn.Conv2D(1, 4, 3, padding=1), nn.ReLU(),
+                        nn.Flatten(), nn.Linear(4 * 8 * 8, 3))
+    q = QAT().quantize(net)
+    opt = pt.optimizer.Adam(learning_rate=5e-3, parameters=q)
+    step = pt.jit.TrainStep(q, opt, lambda o, y: F.cross_entropy(o, y))
+    X = RNG.standard_normal((16, 1, 8, 8)).astype("float32")
+    Y = RNG.integers(0, 3, 16)
+    losses = [float(step(X, Y)) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
