@@ -125,7 +125,12 @@ def quantize_weight(w, bits: int = 8, group_size: int | None = None):
     q * expand(scales) / qmax.
     """
     wf = jnp.asarray(w, jnp.float32)
-    if group_size and wf.shape[0] % group_size == 0:
+    if group_size:
+        if wf.shape[0] % group_size:
+            raise ValueError(
+                f"group_size={group_size} does not divide in_features="
+                f"{wf.shape[0]}; a silent per-channel fallback would emit "
+                f"a different scale layout than the caller asked for")
         scales = GroupWiseWeightObserver(bits, group_size).scales(wf)
         s_full = jnp.repeat(scales, group_size, axis=0)
     else:
@@ -137,21 +142,25 @@ def quantize_weight(w, bits: int = 8, group_size: int | None = None):
 def _dequantize_weight(q, scales, bits: int = 8, dtype=jnp.float32):
     """Inverse of quantize_weight for [in, out] weights: group size is
     inferred from q.shape[0] // scales.shape[0] when scales are 2-D."""
-    qmax = _check_int8_bits(bits)
     if scales.ndim == 2:  # groupwise [in/gs, out]
         gs = q.shape[0] // scales.shape[0]
         s_full = jnp.repeat(scales, gs, axis=0)
     else:
         s_full = scales[None, :]
-    return (q.astype(jnp.float32) * (s_full / qmax)).astype(dtype)
+    return _dequantize(q, s_full, bits, dtype)
 
 
-class QuantizedLinear(Layer):
-    """Deploy form of QuantedLinear (the artifact ``convert()`` emits —
-    parity: qat.py:23 convert to inference model): stores the INT8 weight +
-    fp32 scales (per-out-channel or groupwise) as buffers and dequantizes
-    on use (weight-only int8). The observed activation scale rides along as
-    metadata for runtimes that quantize activations too."""
+def _dequantize(q, scales_full, bits, dtype):
+    """Shared symmetric dequant: scales_full broadcast to q's shape; the
+    clamp mirrors _quantize so zero-scale channels stay zero."""
+    qmax = _check_int8_bits(bits)
+    s = jnp.maximum(scales_full, 1e-8)
+    return (q.astype(jnp.float32) * (s / qmax)).astype(dtype)
+
+
+class _QuantizedBase(Layer):
+    """Shared deploy-artifact storage: int8 weight + fp32 scales +
+    observed activation scale as buffers, fp bias as a parameter."""
 
     def __init__(self, weight_q, scales, bias=None, act_scale=None,
                  bits: int = 8):
@@ -167,6 +176,14 @@ class QuantizedLinear(Layer):
             self.bias = Parameter(jnp.asarray(bias))
         else:
             self.bias = None
+
+
+class QuantizedLinear(_QuantizedBase):
+    """Deploy form of QuantedLinear (the artifact ``convert()`` emits —
+    parity: qat.py:23 convert to inference model): stores the INT8 weight +
+    fp32 scales (per-out-channel or groupwise) as buffers and dequantizes
+    on use (weight-only int8). The observed activation scale rides along as
+    metadata for runtimes that quantize activations too."""
 
     @classmethod
     def from_quanted(cls, quanted: "QuantedLinear", group_size=None):
@@ -186,25 +203,14 @@ class QuantizedLinear(Layer):
         return out
 
 
-class QuantizedConv2D(Layer):
+class QuantizedConv2D(_QuantizedBase):
     """Deploy form of QuantedConv2D: int8 weight [out, in/g, kh, kw] with
     per-out-channel fp32 scales, dequantized on use."""
 
     def __init__(self, weight_q, scales, bias, conv_attrs: dict,
                  act_scale=None, bits: int = 8):
-        super().__init__()
-        self.bits = bits
+        super().__init__(weight_q, scales, bias, act_scale, bits)
         self.attrs = dict(conv_attrs)
-        self.register_buffer("weight_q", weight_q)
-        self.register_buffer("weight_scale", jnp.asarray(scales, jnp.float32))
-        self.register_buffer("act_scale",
-                             jnp.asarray(act_scale if act_scale is not None
-                                         else 1.0, jnp.float32))
-        if bias is not None:
-            from ..nn.module import Parameter
-            self.bias = Parameter(jnp.asarray(bias))
-        else:
-            self.bias = None
 
     @classmethod
     def from_quanted(cls, quanted: "QuantedConv2D"):
@@ -221,10 +227,9 @@ class QuantizedConv2D(Layer):
     def forward(self, x):
         from ..nn import functional as F
         x = jnp.asarray(x)
-        qmax = _check_int8_bits(self.bits)
-        w = (self.weight_q.astype(jnp.float32)
-             * (jnp.maximum(self.weight_scale, 1e-8) / qmax)
-             [:, None, None, None]).astype(x.dtype)
+        w = _dequantize(self.weight_q,
+                        self.weight_scale[:, None, None, None], self.bits,
+                        x.dtype)
         return F.conv2d(x, w, self.bias, **self.attrs)
 
 
@@ -307,7 +312,9 @@ class QAT:
 
     def _wrapper_for(self, sub):
         """QAT wrapper class for a layer, honoring config.quantable_types
-        (VERDICT r3 weak #5: Conv2D was configured but never wrapped)."""
+        (VERDICT r3 weak #5: Conv2D was configured but never wrapped). A
+        configured type with no wrapper raises — silently skipping it would
+        ship an unquantized model the user believes is quantized."""
         from .. import nn
         if not isinstance(sub, self.config.quantable_types()):
             return None
@@ -315,7 +322,9 @@ class QAT:
             return QuantedLinear
         if isinstance(sub, nn.Conv2D):
             return QuantedConv2D
-        return None
+        raise NotImplementedError(
+            f"quantable_types includes {type(sub).__name__}, but QAT has no "
+            f"fake-quant wrapper for it (supported: Linear, Conv2D)")
 
     def _convert(self, layer: Layer):
         for name, sub in list(layer._sub_layers.items()):

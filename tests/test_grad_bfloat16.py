@@ -1,0 +1,259 @@
+"""bf16 gradient contract matrix (VERDICT r3 weak #7; parity model:
+OpTest check_grad run across its dtype matrix, test/legacy_test/
+op_test.py:2958 — bf16 grads checked against user-defined fp32 grads).
+
+Every case computes jax.grad of sum(square(op(..))) twice — once with fp32
+inputs (the reference analytic gradient) and once with the SAME values cast
+to bf16 — and compares.
+
+Tolerance model (documented): bf16 carries an 8-bit mantissa (~2 decimal
+digits). A single rounding on the input or the cotangent gives ~0.4%
+relative error; accumulation (matmul/conv/reduction backward) and
+cancellation widen it. The matrix therefore allows per-element
+rtol=8% with an absolute floor of 10% of the gradient's max magnitude
+(atol = 0.10 * max|g32| + 1e-3). Ops whose fp32 gradients are themselves
+ill-conditioned at random inputs (poles, branch points) are excluded with a
+reason rather than loosened further.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.registry import all_ops
+
+RNG = np.random.default_rng(7)
+
+
+def _grad_pair(fn, xs, argnums=None):
+    """(fp32 grads, bf16 grads) of sum(square(fn(*xs))) w.r.t. the float
+    inputs."""
+    if argnums is None:
+        argnums = tuple(i for i, x in enumerate(xs)
+                        if np.asarray(x).dtype == np.float32)
+    assert argnums, "no float inputs to differentiate"
+
+    def scalar(*args):
+        out = fn(*args)
+        tot = jnp.float32(0)
+        for leaf in jax.tree.leaves(out):
+            if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact):
+                tot = tot + jnp.sum(jnp.square(jnp.asarray(leaf)
+                                               .astype(jnp.float32)))
+        return tot
+
+    g32 = jax.grad(scalar, argnums)(*[jnp.asarray(x) for x in xs])
+    xs16 = [jnp.asarray(x, jnp.bfloat16)
+            if np.asarray(x).dtype == np.float32 else jnp.asarray(x)
+            for x in xs]
+    g16 = jax.grad(scalar, argnums)(*xs16)
+    return g32, g16
+
+
+def _assert_bf16_close(g32, g16, rtol=0.08, afrac=0.10, name=""):
+    for a, b in zip(jax.tree.leaves(g32), jax.tree.leaves(g16)):
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        atol = afrac * max(np.abs(a).max(), 0.0) + 1e-3
+        np.testing.assert_allclose(b, a, rtol=rtol, atol=atol,
+                                   err_msg=f"bf16 grad mismatch: {name}")
+
+
+def _check(fn, xs, argnums=None, name=""):
+    g32, g16 = _grad_pair(fn, xs, argnums)
+    _assert_bf16_close(g32, g16, name=name)
+
+
+# ---------------- registry-driven elementwise/contract matrix -----------
+
+# excluded with reasons: poles/branch points where the fp32 gradient itself
+# explodes at random inputs (tan near pi/2; reciprocal-family 1/x^2 near 0;
+# expm1/exp square loss overflows bf16 range; digamma/lgamma poles at
+# non-positive ints; erfinv pole at +-1)
+_EXCLUDE = {
+    "tan": "pole at pi/2",
+    "reciprocal": "1/x^2 amplifies bf16 input rounding unboundedly near 0",
+    "rsqrt": "x^-1.5 near 0",
+    "digamma": "poles at non-positive integers",
+    "lgamma": "poles at non-positive integers",
+    "polygamma": "poles",
+    "erfinv": "derivative pole at |x| -> 1",
+    "atanh": "pole at |x| -> 1 under the +0.5 input shift",
+    "acosh": "branch point at 1",
+    "bitwise_left_shift": "integer op (grad_ref marks the fp32-cast check)",
+    "bitwise_right_shift": "integer op",
+    "float_power": "x^y with random base/exponent: log(x) grad term is "
+                   "ill-conditioned near 0 even in fp32",
+}
+
+_DOMAIN_SHIFT = {
+    "sqrt": lambda x: np.abs(x) + 0.5,
+    "log": lambda x: np.abs(x) + 0.5,
+    "log2": lambda x: np.abs(x) + 0.5,
+    "log10": lambda x: np.abs(x) + 0.5,
+    "log1p": lambda x: np.abs(x) + 0.5,
+    "asin": lambda x: np.clip(x, -0.8, 0.8),
+    "acos": lambda x: np.clip(x, -0.8, 0.8),
+}
+
+
+def _registry_cases():
+    cases = []
+    for name, info in sorted(all_ops().items()):
+        if not info.grad_ref or name in _EXCLUDE:
+            continue
+        if info.category != "elementwise":
+            continue
+        cases.append((name, info))
+    return cases
+
+
+REG_CASES = _registry_cases()
+
+
+def _registry_inputs(name, info):
+    if info.make_inputs is not None:
+        import zlib
+        rng = np.random.default_rng(zlib.crc32(name.encode()))
+        xs = list(info.make_inputs(rng))
+    else:
+        import inspect
+        sig = inspect.signature(info.fn)
+        n = sum(1 for p in sig.parameters.values()
+                if p.default is inspect.Parameter.empty and p.kind in (
+                    p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)) or 1
+        shapes = (info.test_shapes or ((4, 8),))
+        if len(shapes) == 1:
+            shapes = shapes * n
+        xs = [RNG.standard_normal(s).astype(np.float32) + 0.5
+              for s in shapes]
+    fix = _DOMAIN_SHIFT.get(name)
+    if fix is not None:
+        xs = [fix(x) if np.asarray(x).dtype == np.float32 else x for x in xs]
+    return xs
+
+
+@pytest.mark.parametrize("name,info", REG_CASES,
+                         ids=[c[0] for c in REG_CASES])
+def test_grad_bfloat16_elementwise(name, info):
+    xs = _registry_inputs(name, info)
+    if not any(np.asarray(x).dtype == np.float32 for x in xs):
+        pytest.skip("integer op")
+    _check(info.fn_call or info.fn, xs, name=name)
+
+
+# ---------------- hot-family matrix (matmul/conv/norm/softmax/attention/
+# loss — the training-path ops VERDICT r3 names) ----------------
+
+def _f32(*shape):
+    return RNG.standard_normal(shape).astype(np.float32)
+
+
+HOT_CASES = {
+    "matmul": lambda: (pt.matmul, [_f32(4, 8), _f32(8, 5)]),
+    "matmul_batched": lambda: (pt.matmul, [_f32(2, 4, 8), _f32(2, 8, 5)]),
+    "linear": lambda: (F.linear, [_f32(6, 8), _f32(8, 5), _f32(5)]),
+    "conv2d": lambda: (
+        lambda x, w, b: F.conv2d(x, w, b, padding=1),
+        [_f32(2, 3, 8, 8), _f32(4, 3, 3, 3) * 0.2, _f32(4)]),
+    "conv2d_stride2": lambda: (
+        lambda x, w: F.conv2d(x, w, stride=2),
+        [_f32(2, 4, 9, 9), _f32(8, 4, 3, 3) * 0.2]),
+    "conv2d_grouped": lambda: (
+        lambda x, w: F.conv2d(x, w, groups=2, padding=1),
+        [_f32(2, 4, 8, 8), _f32(6, 2, 3, 3) * 0.2]),
+    "conv2d_transpose": lambda: (
+        lambda x, w: F.conv2d_transpose(x, w, stride=2),
+        [_f32(2, 4, 5, 5), _f32(4, 3, 3, 3) * 0.2]),
+    "conv1d": lambda: (
+        lambda x, w: F.conv1d(x, w, padding=1),
+        [_f32(2, 3, 16), _f32(5, 3, 3) * 0.2]),
+    "conv3d": lambda: (
+        lambda x, w: F.conv3d(x, w),
+        [_f32(1, 2, 5, 5, 5), _f32(3, 2, 2, 2, 2) * 0.2]),
+    "layer_norm": lambda: (
+        lambda x, w, b: F.layer_norm(x, 16, w, b),
+        [_f32(6, 16), _f32(16), _f32(16)]),
+    "rms_norm": lambda: (
+        lambda x, w: F.rms_norm(x, w), [_f32(6, 128), _f32(128)]),
+    # batch norm: check d(w)/d(b) only — d(x) of a pure normalizer under a
+    # sum-square loss is near-zero cancellation residue (the loss is almost
+    # invariant to x), meaningless to compare at bf16 resolution
+    "batch_norm_train": lambda: (
+        lambda x, w, b: F.batch_norm(x, jnp.zeros(4), jnp.ones(4), w, b,
+                                     training=True)[0],
+        [_f32(8, 4, 6, 6), _f32(4), _f32(4)], (1, 2)),
+    "group_norm": lambda: (
+        lambda x, w, b: F.group_norm(x, 2, weight=w, bias=b, epsilon=1e-5),
+        [_f32(4, 4, 5, 5), _f32(4), _f32(4)]),
+    "softmax": lambda: (lambda x: F.softmax(x, axis=-1), [_f32(6, 12)]),
+    "log_softmax": lambda: (lambda x: F.log_softmax(x, axis=-1),
+                            [_f32(6, 12)]),
+    "cross_entropy": lambda: (
+        lambda x, y: F.cross_entropy(x, y),
+        [_f32(16, 12), RNG.integers(0, 12, 16).astype(np.int32)]),
+    "cross_entropy_ignore": lambda: (
+        lambda x, y: F.cross_entropy(x, y, ignore_index=0),
+        [_f32(16, 12), RNG.integers(0, 12, 16).astype(np.int32)]),
+    "softmax_with_cross_entropy": lambda: (
+        lambda x, y: F.softmax_with_cross_entropy(x, y[:, None]),
+        [_f32(16, 12), RNG.integers(0, 12, 16).astype(np.int64)]),
+    "nll_loss": lambda: (
+        lambda x, y: F.nll_loss(F.log_softmax(x, -1), y),
+        [_f32(16, 12), RNG.integers(0, 12, 16).astype(np.int32)]),
+    "mse_loss": lambda: (F.mse_loss, [_f32(8, 4), _f32(8, 4)]),
+    "l1_loss": lambda: (F.l1_loss, [_f32(8, 4), _f32(8, 4) + 0.3]),
+    "smooth_l1_loss": lambda: (F.smooth_l1_loss, [_f32(8, 4), _f32(8, 4)]),
+    "kl_div": lambda: (
+        lambda x, y: F.kl_div(F.log_softmax(x, -1), F.softmax(y, -1)),
+        [_f32(8, 6), _f32(8, 6)]),
+    "bce_with_logits": lambda: (
+        F.binary_cross_entropy_with_logits,
+        [_f32(8, 4), (RNG.random((8, 4)) > 0.5).astype(np.float32)]),
+    "attention_sdpa": lambda: (
+        lambda q, k, v: F.scaled_dot_product_attention(q, k, v),
+        [_f32(2, 16, 4, 8) * 0.5, _f32(2, 16, 4, 8) * 0.5,
+         _f32(2, 16, 4, 8) * 0.5]),
+    "attention_causal": lambda: (
+        lambda q, k, v: F.scaled_dot_product_attention(q, k, v,
+                                                       is_causal=True),
+        [_f32(2, 16, 4, 8) * 0.5, _f32(2, 16, 4, 8) * 0.5,
+         _f32(2, 16, 4, 8) * 0.5]),
+    "embedding": lambda: (
+        lambda ids, w: F.embedding(ids, w),
+        [RNG.integers(0, 20, (4, 6)).astype(np.int32), _f32(20, 8)]),
+    "gelu": lambda: (F.gelu, [_f32(6, 16)]),
+    "gelu_tanh": lambda: (lambda x: F.gelu(x, approximate=True),
+                          [_f32(6, 16)]),
+    "silu": lambda: (F.silu, [_f32(6, 16)]),
+    "swiglu": lambda: (lambda a, b: F.silu(a) * b,
+                       [_f32(6, 16), _f32(6, 16)]),
+    "mean_reduce": lambda: (lambda x: pt.mean(x, axis=1), [_f32(5, 9)]),
+    "sum_reduce": lambda: (lambda x: pt.sum(x, axis=0), [_f32(5, 9)]),
+    "max_pool2d": lambda: (
+        lambda x: F.max_pool2d(x, 2, 2), [_f32(2, 3, 8, 8)]),
+    "avg_pool2d": lambda: (
+        lambda x: F.avg_pool2d(x, 2, 2), [_f32(2, 3, 8, 8)]),
+    "adaptive_avg_pool2d": lambda: (
+        lambda x: F.adaptive_avg_pool2d(x, (2, 2)), [_f32(2, 3, 8, 8)]),
+}
+
+
+@pytest.mark.parametrize("name", sorted(HOT_CASES),
+                         ids=sorted(HOT_CASES))
+def test_grad_bfloat16_hot(name):
+    case = HOT_CASES[name]()
+    fn, xs = case[0], case[1]
+    argnums = case[2] if len(case) > 2 else None
+    _check(fn, xs, argnums=argnums, name=name)
+
+
+def test_matrix_size():
+    """The VERDICT r3 bar: >= 50 differentiable ops under bf16 grad
+    contract."""
+    assert len(REG_CASES) + len(HOT_CASES) >= 50, (
+        len(REG_CASES), len(HOT_CASES))
