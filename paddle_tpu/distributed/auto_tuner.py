@@ -189,8 +189,9 @@ class AutoTuner:
         minus the process round-trip — GSPMD needs no separate launcher.
 
         Trials truncate seq to ``max_trial_seq`` (uniformly across
-        candidates, so the ranking signal survives) and cover non-
-        pipelined configs; pp>1 keeps its analytic estimate."""
+        candidates, so the ranking signal survives). Pipelined candidates
+        (pp>1) run a real PipelineTrainStep over the pp mesh axis — the
+        round-3 pp=1 limitation is gone."""
         import jax
 
         from ..core import mesh as mesh_lib
@@ -203,24 +204,27 @@ class AutoTuner:
             raise RuntimeError(
                 f"trial mesh wants {n} devices, runtime has "
                 f"{jax.device_count()}")
-        if c.pp > 1:
-            raise RuntimeError("measured trials cover pp=1 configs")
         heads = m.num_heads
         if m.hidden % heads or heads % c.mp:
             raise RuntimeError(
                 f"num_heads={heads} incompatible with hidden={m.hidden}, "
                 f"mp={c.mp}")
+        if m.num_layers % c.pp:
+            raise RuntimeError(
+                f"num_layers={m.num_layers} not divisible by pp={c.pp}")
         seq = min(m.seq_len, max_trial_seq)
         seq -= seq % max(c.sep, 1)
         cfg = LlamaConfig(
             vocab_size=m.vocab, hidden_size=m.hidden,
             intermediate_size=4 * m.hidden, num_hidden_layers=m.num_layers,
             num_attention_heads=heads, num_key_value_heads=heads,
-            max_position_embeddings=max(seq, 32))
+            max_position_embeddings=max(seq, 32),
+            pp_axis="pp" if c.pp > 1 else None,
+            sep_axis="sep" if c.sep > 1 else None)
         strategy = fleet.DistributedStrategy()
         strategy.hybrid_configs = {
             "dp_degree": c.dp, "mp_degree": c.mp, "sharding_degree": c.fsdp,
-            "pp_degree": 1, "sep_degree": c.sep}
+            "pp_degree": c.pp, "sep_degree": c.sep}
         # trials must not clobber the job's own fleet/mesh globals
         saved_state = dict(fleet._state)
         saved_mesh = mesh_lib._current_mesh[0]
@@ -246,10 +250,35 @@ class AutoTuner:
         mesh = fleet.fleet_mesh()
         pt.seed(seed)
         with mesh_lib.use_mesh(mesh):
-            model = fleet.distributed_model(LlamaForCausalLM(cfg))
-            opt = pt.optimizer.AdamW(learning_rate=1e-4, parameters=model)
-            step = pt.jit.TrainStep(
-                model, opt, lambda logits, labels: model.loss(logits, labels))
+            if c.pp > 1:
+                # pipelined candidate: real 1F1B PipelineTrainStep over the
+                # pp mesh axis (removes the documented r3 pp=1 limitation)
+                import math
+
+                from ..models.llama_pipe import LlamaForCausalLMPipe
+                from .fleet.meta_parallel import apply_hybrid_shardings
+                num_micro = max(math.gcd(max(c.micro_batch, 1),
+                                         m.global_batch), 1)
+                if num_micro != c.micro_batch:
+                    # the bubble fraction (pp-1)/(M+pp-1) is exactly what
+                    # distinguishes pipelined candidates — record the
+                    # substitution so the ranking stays interpretable
+                    c.notes.append(
+                        f"trial ran micro_batch={num_micro} (candidate "
+                        f"wants {c.micro_batch}, global_batch="
+                        f"{m.global_batch} not divisible)")
+                model = LlamaForCausalLMPipe(cfg, num_micro=num_micro)
+                model = apply_hybrid_shardings(model, mesh)
+                opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                                         parameters=model)
+                step = pt.jit.PipelineTrainStep(model, opt)
+            else:
+                model = fleet.distributed_model(LlamaForCausalLM(cfg))
+                opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                                         parameters=model)
+                step = pt.jit.TrainStep(
+                    model, opt,
+                    lambda logits, labels: model.loss(logits, labels))
             ids_np = np.random.default_rng(seed).integers(
                 0, cfg.vocab_size, (m.global_batch, seq))
             # batch sharded over dp (the flagship-dryrun convention; fsdp
@@ -258,9 +287,10 @@ class AutoTuner:
                           for a in mesh.axis_names]
             ids = shard_tensor(ids_np, mesh=mesh, placements=placements,
                                dtype="int32")
-            for _ in range(warmup):
+            loss = step(ids, ids)  # compile (counts as one warmup step)
+            for _ in range(max(warmup - 1, 0)):
                 loss = step(ids, ids)
-            float(loss)  # drain compile + warmup
+            float(loss)  # drain compile + warmup (bound for warmup=0 too)
             t0 = _time.perf_counter()
             for _ in range(steps):
                 loss = step(ids, ids)
@@ -296,7 +326,8 @@ class AutoTuner:
                                       status=f"failed: {e}")
                     c.step_time = analytic
             # one ordering over the top_k, on the MEASURED time scale:
-            # unmeasurable configs (pp>1 trials, incompatible shapes) stay
+            # unmeasurable configs (incompatible shapes, device-count
+            # mismatches) stay
             # in contention via their analytic estimate rescaled by the
             # median measured/analytic ratio of the successful trials —
             # raw mixing would be meaningless when trials run on a

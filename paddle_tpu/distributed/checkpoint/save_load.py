@@ -172,6 +172,11 @@ def save_state_dict(state_dict: dict, path: str, process_group=None,
     marker files polled by the coordinator's writer thread — no device
     collectives off the main thread."""
     os.makedirs(path, exist_ok=True)
+    # canonical key: two spellings of one directory ('ck' vs './ck' vs
+    # absolute) must share the in-flight guard and the round counter.
+    # abspath, NOT realpath: the string also feeds the multi-host barrier
+    # tag, and per-host symlink resolution would desynchronize it
+    path = os.path.abspath(path)
     rank = jax.process_index()
     nprocs = jax.process_count()
     # an in-flight async save to the same path must finish before ANY new
@@ -191,8 +196,11 @@ def save_state_dict(state_dict: dict, path: str, process_group=None,
         seq = _SAVE_SEQ[path] = _SAVE_SEQ.get(path, 0) + 1
         # clear ALL of this rank's markers (leftovers of a previous process
         # restarted into the same dir, or of a timed-out round) so none can
-        # masquerade as this round's; work() recreates ours after the write
-        for stale in glob.glob(os.path.join(path, _done_name(rank, "*"))):
+        # masquerade as this round's; work() recreates ours after the write.
+        # glob.escape: metacharacters in the checkpoint path (step_[1]/)
+        # must not silently match nothing and leave stale markers behind
+        for stale in glob.glob(os.path.join(glob.escape(path),
+                                            _done_name(rank, "*"))):
             os.remove(stale)
         err_cell = [None]
 

@@ -325,18 +325,27 @@ def _fractional_pool(x, output_size, kernel_size, random_u, spatial_axes):
 def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
                           return_mask=False, name=None):
     """Parity: functional/pooling.py:2030 (Graham fractional pooling).
-    ``return_mask`` is accepted; indices are not materialized on the
-    XLA lowering (documented deviation — unpooling uses max_unpool*)."""
-    out = _fractional_pool(jnp.asarray(x, jnp.float32), output_size,
-                           kernel_size, random_u, (2, 3))
-    return (out, None) if return_mask else out
+    ``return_mask=True`` raises: indices are not materialized on the XLA
+    lowering, and a (out, None) return would only surface later as an
+    opaque failure inside max_unpool* (ADVICE r3)."""
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool2d(return_mask=True) is not supported on "
+            "the XLA lowering (no index materialization); unpool flows use "
+            "max_pool2d(return_mask=True) + max_unpool2d")
+    return _fractional_pool(jnp.asarray(x, jnp.float32), output_size,
+                            kernel_size, random_u, (2, 3))
 
 
 def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
                           return_mask=False, name=None):
-    out = _fractional_pool(jnp.asarray(x, jnp.float32), output_size,
-                           kernel_size, random_u, (2, 3, 4))
-    return (out, None) if return_mask else out
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool3d(return_mask=True) is not supported on "
+            "the XLA lowering (no index materialization); unpool flows use "
+            "max_pool3d(return_mask=True) + max_unpool3d")
+    return _fractional_pool(jnp.asarray(x, jnp.float32), output_size,
+                            kernel_size, random_u, (2, 3, 4))
 
 
 def _unpool(x, indices, out_spatial):

@@ -82,6 +82,14 @@ class FractionalMaxPool2D(Layer):
     def __init__(self, output_size, kernel_size=None, random_u=None,
                  return_mask=False, name=None):
         super().__init__()
+        if return_mask:
+            # fail at the misconfiguration site, not the first forward
+            # (the functional raises the same way — no index
+            # materialization on the XLA lowering)
+            raise NotImplementedError(
+                f"{type(self).__name__}(return_mask=True) is not supported "
+                f"on the XLA lowering; use MaxPool with return_mask + "
+                f"MaxUnPool")
         self.output_size = output_size
         self.kernel_size = kernel_size
         self.random_u = random_u

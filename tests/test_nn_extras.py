@@ -160,6 +160,13 @@ def test_fractional_max_pool_shapes_and_determinism():
     assert np.asarray(layer(x)).shape == (2, 3, 4, 4)
     with pytest.raises(ValueError):
         F.fractional_max_pool2d(x, 4, random_u=1.5)
+    # return_mask raises loudly (no index materialization on XLA) instead
+    # of returning (out, None) that fails later inside max_unpool*
+    with pytest.raises(NotImplementedError):
+        F.fractional_max_pool2d(x, 4, random_u=0.3, return_mask=True)
+    with pytest.raises(NotImplementedError):
+        F.fractional_max_pool3d(x3, (2, 3, 2), random_u=0.7,
+                                return_mask=True)
 
 
 def test_max_unpool_1d_3d_roundtrip():
