@@ -253,8 +253,6 @@ class AutoTuner:
             if c.pp > 1:
                 # pipelined candidate: real 1F1B PipelineTrainStep over the
                 # pp mesh axis (removes the documented r3 pp=1 limitation)
-                import math
-
                 from ..models.llama_pipe import LlamaForCausalLMPipe
                 from .fleet.meta_parallel import apply_hybrid_shardings
                 num_micro = max(math.gcd(max(c.micro_batch, 1),
