@@ -404,20 +404,28 @@ def moe_grouped_compute(x, idx, w, pos, keep, capacity, w_in, w_gate, w_out,
     K = idx.shape[1]
     E = w_in.shape[0]
     C = int(capacity)
-    ec = E * C
-    e_flat = idx.reshape(-1)                        # [T*K]
-    keep = keep.reshape(-1)
-    slot = jnp.where(keep, e_flat * C + pos.reshape(-1), ec)  # drop -> ec
-    fill_copy = jnp.zeros((ec + 1,), jnp.int32).at[slot].set(
-        jnp.arange(T * K, dtype=jnp.int32), mode="drop")
-    occupied = jnp.zeros((ec + 1,), bool).at[slot].set(True, mode="drop")
-    fill_copy, occupied = fill_copy[:ec], occupied[:ec]
-    xe = _pack_rows(x, fill_copy // K, occupied, slot, keep, K)
+    slot, keep_f, fill_copy, occupied = _slot_structures(idx, pos, keep, E, C)
+    xe = _pack_rows(x, fill_copy // K, occupied, slot, keep_f, K)
     ye = ExpertFFN.apply(xe.reshape(E, C, D), w_in, w_gate, w_out,
-                         activation).reshape(ec, D)
-    back = _unpack_rows(ye, slot, keep, fill_copy, occupied)
+                         activation).reshape(E * C, D)
+    back = _unpack_rows(ye, slot, keep_f, fill_copy, occupied)
     out = back.astype(jnp.float32) * w.reshape(-1).astype(jnp.float32)[:, None]
     return out.reshape(T, K, D).sum(axis=1).astype(x.dtype)
+
+
+def _slot_structures(idx, pos, keep, E, C):
+    """Capacity-packed dispatch indexing shared by the single-device
+    grouped path and the all-to-all per-rank dispatch: flat copy i of
+    token i//K goes to slot e*C + pos (or the dropped sentinel E*C).
+    Returns (slot [T*K], keep [T*K], fill_copy [E*C], occupied [E*C])."""
+    ec = E * C
+    e_flat = idx.reshape(-1)
+    keep_f = keep.reshape(-1)
+    slot = jnp.where(keep_f, e_flat * C + pos.reshape(-1), ec)
+    fill_copy = jnp.zeros((ec + 1,), jnp.int32).at[slot].set(
+        jnp.arange(slot.shape[0], dtype=jnp.int32), mode="drop")
+    occupied = jnp.zeros((ec + 1,), bool).at[slot].set(True, mode="drop")
+    return slot, keep_f, fill_copy[:ec], occupied[:ec]
 
 
 class MoELayer(Layer):
@@ -535,15 +543,24 @@ class MoELayer(Layer):
         def fn(t_local, gw, w_in, w_out, *rest):
             w_g = rest[0] if rest else None
             logits = t_local.astype(jnp.float32) @ gw
-            disp, comb, aux = gate_layer._route(logits, cap)
-            expert_in = jnp.einsum("td,tec->ecd",
-                                   t_local.astype(jnp.float32), disp)
-            inbox = global_scatter(expert_in.astype(t_local.dtype),
-                                   None, None, axis)
+            # per-rank capacity packing by GATHER (same machinery as the
+            # single-device grouped path — no [T, E, C] one-hot dispatch
+            # tensors before/after the all-to-all)
+            idx, w, pos, keep, aux = gate_layer._route_sparse(logits, cap)
+            K = idx.shape[1]
+            Tl, d = t_local.shape
+            slot, keep_f, fill_copy, occupied = _slot_structures(
+                idx, pos, keep, E, cap)
+            expert_in = _pack_rows(t_local, fill_copy // K, occupied, slot,
+                                   keep_f, K).reshape(E, cap, d)
+            inbox = global_scatter(expert_in, None, None, axis)
             out = ExpertFFN.apply(inbox, w_in, w_g, w_out, experts.activation)
-            back = global_gather(out, None, None, axis)
-            y = jnp.einsum("ecd,tec->td", back.astype(jnp.float32),
-                           comb).astype(t_local.dtype)
+            back = global_gather(out, None, None, axis)  # [E, cap, d]
+            per_copy = _unpack_rows(back.reshape(E * cap, d), slot, keep_f,
+                                    fill_copy, occupied)
+            y = (per_copy.astype(jnp.float32)
+                 * w.reshape(-1).astype(jnp.float32)[:, None]) \
+                .reshape(Tl, K, d).sum(axis=1).astype(t_local.dtype)
             return y, jax.lax.pmean(aux, axis)
 
         args = [t, gate_layer.weight, experts.w_in, experts.w_out]
