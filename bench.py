@@ -1,12 +1,14 @@
 """Benchmark suite: one JSON line per BASELINE.md measurement config, on one
 TPU chip.
 
-Configs (BASELINE.md "measurement configs", bring-up order 2/3/4/5):
+Configs (BASELINE.md "measurement configs"):
   - llama_420m  : Llama decoder pretraining, seq 2048, bf16, flash attention
                   (the round-2 headline metric; keep MFU >= 0.507)
   - resnet50    : ImageNet-shape conv training, images/sec
   - bert_base   : MLM+NSP pretraining step, seq 512, DP-shape attention
-  - qwen2_moe   : sparse MoE decoder step (einsum dispatch on one chip)
+  - qwen2_moe   : sparse MoE decoder step (grouped-GEMM dispatch, one chip)
+  - lenet_mnist : BASELINE config 1, single-device correctness reference
+                  (asserts the loss falls; reports images/s)
 
 Each line: {"metric", "value", "unit", "vs_baseline", "extra"}. The primary
 (first) line is llama_420m — vs_baseline remains MFU/0.40 against the
@@ -409,11 +411,46 @@ def bench_qwen2_moe(peak, peak_kind, batch=8):  # sweep r4: 8 > 4/16 (bf16)
     }
 
 
+def bench_lenet(peak, peak_kind, batch=256):
+    """BASELINE config 1: MNIST LeNet — the single-device correctness
+    reference. Reports images/s and asserts the loss actually falls over
+    the measured windows (the other configs only check finiteness)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.models import LeNet
+
+    pt.seed(0)
+    model = LeNet(num_classes=10)
+    opt = pt.optimizer.Adam(learning_rate=1e-3, parameters=model)
+    step = pt.jit.TrainStep(model, opt, lambda o, y: F.cross_entropy(o, y))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, 1, 28, 28)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, (batch,)), jnp.int32)
+    first = float(np.asarray(step(x, y)).ravel()[0])  # compile + step 0
+    dt, spread, lossv = _time_windows(step, lambda: (x, y))
+    assert lossv < first, (first, lossv)  # memorizes the fixed batch
+    images_per_sec = batch / dt
+    return {
+        "metric": "lenet_mnist_images_per_sec_per_chip",
+        "value": round(images_per_sec, 1),
+        "unit": "images/s",
+        # correctness reference: vs_baseline = did-it-train (loss fell)
+        "vs_baseline": 1.0 if lossv < first else 0.0,
+        "extra": {"step_ms": round(dt * 1000, 3), "loss0": round(first, 4),
+                  "loss": round(lossv, 4), "batch": batch,
+                  "peak": peak_kind, "pipeline": False, "runs": _RUNS,
+                  "spread": round(spread, 4)},
+    }
+
+
 _CONFIGS = {
     "llama_420m": bench_llama,
     "resnet50": bench_resnet50,
     "bert_base": bench_bert,
     "qwen2_moe": bench_qwen2_moe,
+    "lenet_mnist": bench_lenet,
 }
 
 
