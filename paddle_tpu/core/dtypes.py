@@ -8,6 +8,8 @@ standardize on jax/numpy dtypes; bfloat16 is the preferred reduced precision
 
 from __future__ import annotations
 
+import contextlib
+import functools
 from typing import Any
 
 import jax.numpy as jnp
@@ -74,10 +76,7 @@ def set_default_dtype(dtype: Any) -> None:
     _default_dtype[0] = d
 
 
-import contextlib as _contextlib
-
-
-@_contextlib.contextmanager
+@contextlib.contextmanager
 def default_dtype_guard(dtype: Any):
     """Temporarily set the default floating dtype (parity:
     paddle.set_default_dtype scoping used by model constructors — the
@@ -98,8 +97,6 @@ def scoped_dtype_init(init):
     under ``default_dtype_guard(config.dtype)`` so every sublayer creates its
     parameters in the config's dtype (a bf16 config really builds a bf16
     model — VERDICT r3: the round-3 benches silently ran fp32 storage)."""
-    import functools
-
     @functools.wraps(init)
     def wrapped(self, config, *args, **kwargs):
         with default_dtype_guard(getattr(config, "dtype", None)

@@ -23,6 +23,7 @@ from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .. import nn
 from ..core import rng
@@ -329,7 +330,6 @@ def moe_ragged_compute(x, idx, w, w_in, w_gate, w_out, activation):
 
 
 def _float0(shape):
-    import numpy as np
     return np.zeros(shape, jax.dtypes.float0)
 
 

@@ -2,8 +2,11 @@
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = [
     "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
@@ -25,10 +28,7 @@ def _reduce(loss, reduction):
     return loss
 
 
-import functools as _ft
-
-
-@_ft.partial(jax.custom_vjp, nondiff_argnums=(2,))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def _hard_ce(x, label, ignore_index):
     loss, _ = _hard_ce_fwd(x, label, ignore_index)
     return loss
@@ -52,9 +52,8 @@ def _hard_ce_bwd(ignore_index, res, g):
     cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 1)
     p = jnp.exp(x.astype(jnp.float32) - lse[..., None])
     dx = (p - (cols == safe[..., None]).astype(jnp.float32)) * scale
-    import numpy as _np
     return (dx.astype(x.dtype),
-            _np.zeros(label.shape, jax.dtypes.float0))
+            np.zeros(label.shape, jax.dtypes.float0))
 
 
 _hard_ce.defvjp(_hard_ce_fwd, _hard_ce_bwd)

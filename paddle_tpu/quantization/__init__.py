@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..nn.module import Layer
+from ..nn.module import Layer, Parameter
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMaxObserver",
            "AbsmaxObserver", "GroupWiseWeightObserver", "quant_dequant",
@@ -172,7 +172,6 @@ class _QuantizedBase(Layer):
                              jnp.asarray(act_scale if act_scale is not None
                                          else 1.0, jnp.float32))
         if bias is not None:
-            from ..nn.module import Parameter
             self.bias = Parameter(jnp.asarray(bias))
         else:
             self.bias = None

@@ -1,4 +1,4 @@
-"""Sorted grouped-GEMM (ragged_dot) MoE dispatch vs the dense einsum oracle.
+"""Sorted MoE dispatch (grouped pack-GEMM and ragged_dot) vs the einsum oracle.
 
 Parity target: the two dispatch modes implement the same routing semantics
 (reference moe_layer.py:263 einsum path vs fusion/cutlass/moe_kernel.cu:647
