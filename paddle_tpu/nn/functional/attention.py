@@ -207,19 +207,20 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
             out = _flash_sharded(q, k, v, is_causal)
             if out is not None:
                 return out
-        elif eff_dropout == 0.0:
-            # masked flash: single-device route only (the in-kernel bias has
-            # no shard_map rule yet; mask+dropout combined stay on XLA);
-            # masks the kernel cannot take (non-broadcastable shapes) use
-            # XLA. Cheap context checks run BEFORE the (materializing)
-            # normalization.
+        else:
+            # masked flash, with or without in-kernel dropout:
+            # single-device route only (the in-kernel bias/dropout carry no
+            # shard_map rule yet); masks the kernel cannot take
+            # (non-broadcastable shapes) use XLA. Cheap context checks run
+            # BEFORE the (materializing) normalization.
             if _single_device_kernel_ok():
                 m = _normalize_kernel_mask(attn_mask, q.shape[0], q.shape[2],
                                            q.shape[1], k.shape[1])
                 if m is not None:
                     from ...ops.pallas.flash_attention import \
                         flash_attention as _fa
-                    return _fa(q, k, v, causal=is_causal, attn_mask=m)
+                    return _fa(q, k, v, causal=is_causal, attn_mask=m,
+                               dropout_p=eff_dropout)
     return _xla_attention(q, k, v, attn_mask, dropout_p, is_causal, training=training)
 
 

@@ -680,6 +680,31 @@ def _flash_drop_bwd(scale, causal, dropout_p, res, g):
 _flash_drop.defvjp(_flash_drop_fwd, _flash_drop_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash_bias_drop(q, k, v, bias, seed_arr, scale, causal, dropout_p):
+    out, _ = _fwd(q, k, v, scale, causal, bias=bias, dropout_p=dropout_p,
+                  seed_arr=seed_arr)
+    return out
+
+
+def _flash_bias_drop_fwd(q, k, v, bias, seed_arr, scale, causal, dropout_p):
+    out, lse = _fwd(q, k, v, scale, causal, bias=bias, dropout_p=dropout_p,
+                    seed_arr=seed_arr)
+    return out, (q, k, v, bias, seed_arr, out, lse)
+
+
+def _flash_bias_drop_bwd(scale, causal, dropout_p, res, g):
+    q, k, v, bias, seed_arr, out, lse = res
+    dq, dk, dv = flash_block_grads(q, k, v, g, lse, _delta(g, out),
+                                   scale=scale, causal=causal, bias=bias,
+                                   dropout_p=dropout_p, seed_arr=seed_arr)
+    # mask non-differentiable on the flash path (see _flash_bias_bwd)
+    return dq, dk, dv, jnp.zeros_like(bias), jnp.zeros_like(seed_arr)
+
+
+_flash_bias_drop.defvjp(_flash_bias_drop_fwd, _flash_bias_drop_bwd)
+
+
 def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
                     attn_mask=None, dropout_p: float = 0.0,
                     fixed_seed_offset=None):
@@ -698,30 +723,32 @@ def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
     reproducible replays; defaults to a fresh seed from the framework RNG
     stream. TPU-only (pltpu PRNG has no interpret lowering); CPU callers
     must use the XLA path (nn.functional routes this automatically).
-    Dropout composes with ``causal`` but not (yet) with ``attn_mask``."""
+    Dropout composes with ``causal`` AND with ``attn_mask`` (both ride the
+    same tiled kernel; the mask stays non-differentiable)."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    m = None
+    if attn_mask is not None:
+        m = jnp.asarray(attn_mask)
+        if m.dtype == jnp.bool_:
+            m = jnp.where(m, jnp.float32(0), jnp.float32(-1e30))
+        m = jax.lax.stop_gradient(m)
     if dropout_p > 0.0:
         if _interpret():
             raise NotImplementedError(
                 "in-kernel flash dropout is TPU-only; use the XLA attention "
                 "path (nn.functional.scaled_dot_product_attention) on CPU")
-        if attn_mask is not None:
-            raise NotImplementedError(
-                "dropout_p with attn_mask is not supported in-kernel; "
-                "use the XLA path")
         if fixed_seed_offset is None:
             from ...core import rng as _rng
             bits = jax.random.key_data(_rng.next_key()).reshape(-1)[:2]
             seed_arr = jnp.asarray(bits, jnp.int32)
         else:
             seed_arr = jnp.asarray(fixed_seed_offset, jnp.int32).reshape(2)
+        if m is not None:
+            return _flash_bias_drop(q, k, v, m, seed_arr, scale, causal,
+                                    float(dropout_p))
         return _flash_drop(q, k, v, seed_arr, scale, causal, float(dropout_p))
-    if attn_mask is not None:
-        m = jnp.asarray(attn_mask)
-        if m.dtype == jnp.bool_:
-            m = jnp.where(m, jnp.float32(0), jnp.float32(-1e30))
-        m = jax.lax.stop_gradient(m)
+    if m is not None:
         return _flash_bias(q, k, v, m, scale, causal)
     return _flash(q, k, v, scale, causal)
 
