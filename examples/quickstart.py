@@ -1,0 +1,58 @@
+"""Quickstart: define a model, train it in one compiled step, save/load.
+
+The paddle-style workflow on TPU: the whole training step (forward +
+backward + optimizer) compiles into ONE XLA program via jit.TrainStep.
+Runs on CPU too (this script forces CPU so it works anywhere):
+
+    python examples/quickstart.py
+"""
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+
+
+def main():
+    pt.seed(0)
+    model = nn.Sequential(
+        nn.Linear(28 * 28, 256), nn.ReLU(),
+        nn.Linear(256, 64), nn.ReLU(),
+        nn.Linear(64, 10),
+    )
+    opt = pt.optimizer.AdamW(learning_rate=1e-3, parameters=model)
+    step = pt.jit.TrainStep(model, opt, lambda out, y: F.cross_entropy(out, y))
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((256, 28 * 28)).astype("float32")
+    y = rng.integers(0, 10, 256).astype("int64")
+
+    for epoch in range(5):
+        loss = float(step(x, y))
+        print(f"epoch {epoch}: loss {loss:.4f}")
+
+    # checkpoint roundtrip (paddle API)
+    import tempfile
+    ckpt = os.path.join(tempfile.mkdtemp(), "quickstart.pdparams")
+    pt.save(model.state_dict(), ckpt)
+    model.set_state_dict(pt.load(ckpt))
+
+    # eval
+    model.eval()
+    pred = np.asarray(model(x)).argmax(-1)
+    print(f"train accuracy after 5 steps: {(pred == y).mean():.2f}")
+    return loss
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""The examples/ scripts must stay runnable — they are the front door for
+users switching from the reference."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("name", ["quickstart", "data_parallel",
+                                  "quantize_deploy"])
+def test_example_runs(name):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # each script sets its own device count
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", f"{name}.py")],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert proc.returncode == 0, (proc.stdout[-1500:], proc.stderr[-1500:])
