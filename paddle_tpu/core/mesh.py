@@ -87,7 +87,12 @@ def make_mesh(
     return Mesh(arr, tuple(axis_names))
 
 
-def current_mesh() -> Mesh | None:
+def current_mesh() -> "Mesh | jax.sharding.AbstractMesh | None":
+    """Active mesh: this library's use_mesh stack, else the ambient jax
+    mesh. While tracing under an ambient ``set_mesh`` scope the return is
+    an AbstractMesh (no concrete mesh exists on the trace context) —
+    callers may rely on ``.shape``/``.axis_names`` and shard_map, not on
+    ``.devices`` or ``with mesh:``."""
     if _current_mesh[0] is not None:
         return _current_mesh[0]
     # fall back to the ambient jax mesh so callers that gate on an active
@@ -98,7 +103,14 @@ def current_mesh() -> Mesh | None:
     # disables the legacy bridge, never the set_mesh path)
     am = jax.sharding.get_abstract_mesh()
     if not am.empty:
-        return jax.sharding.get_mesh()
+        # get_mesh() raises ValueError inside jit tracing (there is no
+        # concrete mesh on the trace context); callers only inspect
+        # .shape/.axis_names or feed shard_map, all of which accept the
+        # abstract mesh, so fall back to it while tracing.
+        try:
+            return jax.sharding.get_mesh()
+        except ValueError:
+            return am
     try:
         from jax._src.mesh import thread_resources
         pm = thread_resources.env.physical_mesh

@@ -255,8 +255,21 @@ class AutoTuner:
                 # pp mesh axis (removes the documented r3 pp=1 limitation)
                 from ..models.llama_pipe import LlamaForCausalLMPipe
                 from .fleet.meta_parallel import apply_hybrid_shardings
-                num_micro = max(math.gcd(max(c.micro_batch, 1),
-                                         m.global_batch), 1)
+                # largest divisor of global_batch that is <= micro_batch:
+                # gcd could collapse to 1 (micro=3, global=8) and time a
+                # maximal-bubble schedule unrepresentative of the candidate
+                want = max(c.micro_batch, 1)
+                num_micro = max(d for d in range(1, want + 1)
+                                if m.global_batch % d == 0)
+                if num_micro == 1 and want > 1:
+                    # no usable microbatching: the trial would measure the
+                    # worst-case bubble, skewing the ranking — let tune()
+                    # fall back to the calibrated analytic estimate instead
+                    raise RuntimeError(
+                        f"no divisor of global_batch={m.global_batch} in "
+                        f"[2, {want}] — pipelined trial would run a "
+                        f"maximal-bubble schedule unrepresentative of the "
+                        f"candidate")
                 if num_micro != c.micro_batch:
                     # the bubble fraction (pp-1)/(M+pp-1) is exactly what
                     # distinguishes pipelined candidates — record the

@@ -108,7 +108,14 @@ def _merge_metadata(path: str, nprocs: int, seq: int | None = None) -> None:
     disappearance as 'merge published'."""
     merged = Metadata()
     for r in range(nprocs):
-        with open(os.path.join(path, f"{r}.meta.pkl"), "rb") as f:
+        piece_path = os.path.join(path, f"{r}.meta.pkl")
+        if not os.path.exists(piece_path):
+            raise FileNotFoundError(
+                f"checkpoint merge: rank {r}'s metadata piece missing under "
+                f"{path!r}. In a multi-host job this usually means the "
+                f"checkpoint path does not resolve to one shared directory "
+                f"on every rank (e.g. a relative path with per-rank cwds).")
+        with open(piece_path, "rb") as f:
             piece: Metadata = pickle.load(f)
         merged.global_shapes.update(piece.global_shapes)
         for li, file in piece.storage_metadata.items():
@@ -170,12 +177,23 @@ def save_state_dict(state_dict: dict, path: str, process_group=None,
     background thread; returns an AsyncSaveHandle (call .result() before
     relying on the files). Multi-process async coordinates through done-
     marker files polled by the coordinator's writer thread — no device
-    collectives off the main thread."""
+    collectives off the main thread.
+
+    Multi-host contract: ``path`` must resolve to ONE shared directory on
+    every rank (pass an absolute path, or guarantee identical cwds); the
+    cross-rank barrier tag is derived from the path *string*, so two ranks
+    spelling the same directory differently will still rendezvous — and
+    then fail loudly at merge time if the files landed in different
+    places."""
     os.makedirs(path, exist_ok=True)
-    # canonical key: two spellings of one directory ('ck' vs './ck' vs
-    # absolute) must share the in-flight guard and the round counter.
-    # abspath, NOT realpath: the string also feeds the multi-host barrier
-    # tag, and per-host symlink resolution would desynchronize it
+    # barrier tag: normalized but NOT absolutized — ranks on different hosts
+    # may run with different cwds yet pass the same relative path, and the
+    # tag must be byte-identical on every rank (abspath/realpath would fold
+    # in per-host cwd / symlink state)
+    tag = os.path.normpath(path)
+    # local canonical key: two spellings of one directory ('ck' vs './ck' vs
+    # absolute) must share the in-flight guard and the round counter; this
+    # key is process-local so absolutizing is safe here
     path = os.path.abspath(path)
     rank = jax.process_index()
     nprocs = jax.process_count()
@@ -236,10 +254,10 @@ def save_state_dict(state_dict: dict, path: str, process_group=None,
         t.start()
         return handle
     _write_rank_files(path, rank, meta, payload)
-    _barrier(f"ckpt_save_shards:{path}")
+    _barrier(f"ckpt_save_shards:{tag}")
     if rank == coordinator_rank:
         _merge_metadata(path, nprocs)
-    _barrier(f"ckpt_save_meta:{path}")
+    _barrier(f"ckpt_save_meta:{tag}")
 
 
 def _overlap(dst_off, dst_shape, src_off, src_shape):

@@ -400,3 +400,42 @@ def test_qwen2_moe_alltoall_trains(hybrid_mesh):
     l0 = float(step(ids, ids))
     l1 = float(step(ids, ids))
     assert np.isfinite(l0) and np.isfinite(l1)
+
+
+def test_current_mesh_inside_jit_under_set_mesh():
+    """Regression: current_mesh() from jitted code under jax.sharding.set_mesh
+    (no use_mesh wrapper) must not crash at trace time — get_mesh() raises
+    ValueError while tracing, so the abstract mesh is the fallback. Covers
+    no_mesh_active() (gates fused norms / flash) and MoE sorted dispatch."""
+    from paddle_tpu._mesh_gate import no_mesh_active
+    mesh = mesh_lib.make_mesh({"dp": 2, "mp": 4})
+    seen = {}
+
+    @jax.jit
+    def fwd(x):
+        m = mesh_lib.current_mesh()
+        seen["shape"] = dict(m.shape)
+        seen["quiet"] = no_mesh_active()
+        return x * 2
+
+    with jax.sharding.set_mesh(mesh):
+        out = fwd(jnp.ones((4, 4)))
+    assert seen["shape"] == {"dp": 2, "mp": 4}
+    assert seen["quiet"] is False
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+
+
+def test_moe_sorted_dispatch_jitted_under_set_mesh():
+    """The grouped MoE forward (default for Qwen2MoeConfig) calls
+    current_mesh() from jitted code; under set_mesh it must trace and fall
+    back to the dense path (multi-device mesh active)."""
+    from paddle_tpu.distributed.moe import MoELayer
+    pt.seed(3)
+    layer = MoELayer(16, num_experts=4, d_hidden=32, dispatch="grouped")
+    x = jnp.asarray(RNG.standard_normal((8, 16)), jnp.float32)
+    mesh = mesh_lib.make_mesh({"dp": 2, "mp": 4})
+
+    fwd = jax.jit(lambda t: layer(t))
+    with jax.sharding.set_mesh(mesh):
+        out = fwd(x)
+    assert np.isfinite(np.asarray(out)).all()
