@@ -8,10 +8,15 @@ Configs (BASELINE.md "measurement configs"):
   - bert_base   : MLM+NSP pretraining step, seq 512, DP-shape attention
   - qwen2_moe   : sparse MoE decoder step (grouped-GEMM dispatch, one chip)
   - lenet_mnist : BASELINE config 1, single-device correctness reference
-                  (asserts the loss falls; reports images/s)
-  - llama_longctx (OPT-IN, run by name): the flagship at seq 16384 with
-                  remat — long-context demonstration; 10-step windows
-                  (extra.iters) since each step is ~0.8 s
+                  (correctness-only metric: step time sits below the relay
+                  jitter floor, so img/s is noise on this rig)
+  - llama8b_shape: 2 Llama-3-8B-config decoder layers + 128k-vocab fused CE,
+                  seq 4096 bf16 remat — north-star-shape MFU on one chip
+  - llama_decode: serving decode — compiled prefill + one-program lax.scan
+                  token loop; steady-state decode tokens/s at batch 1 and 8
+  - llama_longctx: the flagship at seq 16384 with remat — long-context;
+                  10-step windows (extra.iters) since each step is ~0.8 s
+  - llama_longctx_32k (OPT-IN, run by name): same at seq 32768
 
 Each line: {"metric", "value", "unit", "vs_baseline", "extra"}. The primary
 (first) line is llama_420m — vs_baseline remains MFU/0.40 against the
@@ -443,27 +448,36 @@ def bench_lenet(peak, peak_kind, batch=256):
     # 100-step windows: at ~10 ms/step the default 30-step window is
     # dominated by relay sync jitter (spread read >1)
     dt, spread, lossv = _time_windows(step, lambda: (x, y), iters=100)
-    assert lossv < first, (first, lossv)  # memorizes the fixed batch
+    # no assert: a did-not-train run must still EMIT the value-0.0 line
+    # (the driver reads vs_baseline, not a traceback)
     images_per_sec = batch / dt
+    # correctness-only metric (VERDICT r4 weak #3): the ~3.6 ms steps sit
+    # below the relay's sync jitter floor, so img/s is NOISE on this rig
+    # (spread ~0.36 even at 100-step windows) — report did-it-train as the
+    # value and keep the unreliable throughput in extra, labeled.
     return {
-        "metric": "lenet_mnist_images_per_sec_per_chip",
-        "value": round(images_per_sec, 1),
-        "unit": "images/s",
-        # correctness reference: vs_baseline = did-it-train (loss fell)
+        "metric": "lenet_mnist_correctness",
+        "value": 1.0 if lossv < first else 0.0,
+        "unit": "loss_fell",
         "vs_baseline": 1.0 if lossv < first else 0.0,
         "extra": {"step_ms": round(dt * 1000, 3), "loss0": round(first, 4),
                   "loss": round(lossv, 4), "batch": batch,
+                  "images_per_sec_unreliable": round(images_per_sec, 1),
+                  "throughput_note": "relay sync jitter >> step time; "
+                                     "img/s not a framework measurement",
                   "peak": peak_kind, "pipeline": False, "runs": _RUNS,
                   "spread": round(spread, 4)},
     }
 
 
 def bench_llama_longctx(peak, peak_kind, batch=1, seq=16384):
-    """Long-context demonstration (opt-in; SURVEY §5.7): the same Llama
-    flagship at seq 16k on ONE chip — Pallas flash attention (no O(S^2)
+    """Long-context (SURVEY §5.7; default at 16k since round 5 — VERDICT r4
+    weak #5 wanted the number in the driver artifact): the same Llama
+    flagship at long seq on ONE chip — Pallas flash attention (no O(S^2)
     materialization) + per-layer remat. 10-step windows (each step is
     ~0.8 s, so 10 already amortize the relay sync; extra.iters records the
-    deviation from the default 30). Run: ``python bench.py llama_longctx``."""
+    deviation from the default 30). seq-32k stays opt-in:
+    ``python bench.py llama_longctx_32k``."""
     import jax.numpy as jnp
 
     cfg, model, n_params, step, flops_per_token = _llama_flagship(
@@ -475,7 +489,7 @@ def bench_llama_longctx(peak, peak_kind, batch=1, seq=16384):
     tokens_per_sec = batch * seq / dt
     mfu = flops_per_token * tokens_per_sec / peak
     return {
-        "metric": "llama_420m_seq16384_tokens_per_sec_per_chip",
+        "metric": f"llama_420m_seq{seq}_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.40, 4),
@@ -487,18 +501,164 @@ def bench_llama_longctx(peak, peak_kind, batch=1, seq=16384):
     }
 
 
+def bench_llama_decode(peak, peak_kind, prefill_len=2048, new_tokens=256):
+    """Serving/decode throughput (VERDICT r4 missing #3): the flagship's
+    compiled prefill program and the one-program lax.scan decode loop
+    (models/llama.py decode_programs — parity: AnalysisPredictor +
+    FusedMultiTransformer KV-cache decode, fused_transformer.py:994).
+    Reports steady-state decode tokens/s at batch 8 as the headline value;
+    batch 1 and prefill tokens/s land in extra. Decode is HBM-bound: the
+    model-bandwidth utilisation (MBU = bytes-of-weights+cache per token /
+    HBM bandwidth) is the honest efficiency number, reported per batch."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    pt.seed(0)
+    seq = prefill_len + new_tokens
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                      intermediate_size=5632, num_hidden_layers=8,
+                      num_attention_heads=16, num_key_value_heads=8,
+                      max_position_embeddings=seq, dtype="bfloat16",
+                      mp_axis=None, fsdp_axis=None)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    n_params = model.num_params()
+    state = model.state_dict(include_non_persistable_buffer=True)
+    rng = np.random.default_rng(0)
+    # HBM bandwidth by generation (public specs), for MBU — keyed by the
+    # SAME aliases _detect_peak can return (_PEAKS keys)
+    hbm_bw = {"v4": 1.2e12,
+              "v5e": 0.82e12, "v5litepod": 0.82e12, "v5lite": 0.82e12,
+              "v5p": 2.77e12,
+              "v6e": 1.64e12, "trillium": 1.64e12,
+              }.get(peak_kind.split("(")[0], 0.82e12)
+    per_batch = {}
+    for batch in (1, 8):
+        prefill, decode, _ = model.decode_programs(batch, prefill_len,
+                                                   new_tokens, seq)
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size,
+                                       (batch, prefill_len)), jnp.int32)
+        caches0 = model.init_kv_caches(batch, seq)
+        keys = jax.random.split(jax.random.key(0), new_tokens)
+
+        def run_prefill():
+            tok, caches = prefill(state, ids, caches0, keys[0])
+            return tok
+
+        # prefill timing: whole-prompt forward, 10 iters/window
+        t = _time_windows(lambda: run_prefill(), lambda: (), iters=10)
+        dt_pre, spread_pre = t[0], t[1]
+        tok0, caches1 = prefill(state, ids, caches0, keys[0])
+
+        # decode timing: one call = new_tokens-1 fused steps in one program
+        t = _time_windows(lambda: decode(state, tok0, caches1, keys[1:]),
+                          lambda: (), iters=3)
+        dt_dec, spread_dec = t[0], t[1]
+        tok_s_decode = batch * (new_tokens - 1) / dt_dec
+        ms_per_tok = dt_dec / (new_tokens - 1) * 1000
+        # bytes touched per decode step: all weights (bf16) + the KV cache
+        # read up to the mean filled length + new KV write (negligible)
+        cache_bytes = (2 * cfg.num_hidden_layers * batch
+                       * (prefill_len + new_tokens / 2)
+                       * cfg.num_key_value_heads * cfg.head_dim * 2)
+        mbu = (2.0 * n_params + cache_bytes) / (dt_dec / (new_tokens - 1)) \
+            / hbm_bw
+        per_batch[batch] = {
+            "decode_tokens_per_sec": round(tok_s_decode, 1),
+            "decode_ms_per_token": round(ms_per_tok, 3),
+            "prefill_tokens_per_sec": round(batch * prefill_len / dt_pre, 1),
+            "prefill_ms": round(dt_pre * 1000, 2),
+            "mbu": round(mbu, 4),
+            "spread_prefill": round(spread_pre, 4),
+            "spread_decode": round(spread_dec, 4),
+        }
+    headline = per_batch[8]["decode_tokens_per_sec"]
+    return {
+        "metric": "llama_420m_decode_tokens_per_sec_batch8",
+        "value": headline,
+        "unit": "tokens/s",
+        # no absolute serving baseline published; report MBU-vs-ideal as
+        # the honest ratio (1.0 = every decode step at HBM speed)
+        "vs_baseline": per_batch[8]["mbu"],
+        "extra": {"params": n_params, "prefill_len": prefill_len,
+                  "new_tokens": new_tokens, "batches": per_batch,
+                  "peak": peak_kind, "hbm_bw": hbm_bw, "pipeline": False,
+                  "runs": _RUNS,
+                  "spread": per_batch[8]["spread_decode"]},
+    }
+
+
+def bench_llama8b_shape(peak, peak_kind, batch=1, seq=4096, layers=2):
+    """North-star-SHAPE evidence (VERDICT r4 missing #1): ``layers``
+    llama_3_8b-config decoder layers (hidden 4096, ffn 14336, GQA 32/8,
+    models/llama.py llama_3_8b) + the fused hard-label CE head over the
+    full 128256 vocab, fwd+bwd+AdamW at seq 4096 bf16 with per-layer
+    remat, on ONE chip. MFU physics at 8B shapes differs from the 420M
+    proxy (bigger matmuls, relatively costlier 128k-vocab softmax and
+    GQA-8 attention); this config measures exactly those shapes. The
+    embedding is tied so the 525M-param vocab matrix is stored once
+    (fits HBM next to fp32 AdamW moments); FLOPs/token = 6*N + 12*L*s*h
+    counts the head matmul through the tied matrix."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=128256, hidden_size=4096,
+                      intermediate_size=14336, num_hidden_layers=layers,
+                      num_attention_heads=32, num_key_value_heads=8,
+                      max_position_embeddings=seq, rope_theta=500000.0,
+                      tie_word_embeddings=True, dtype="bfloat16",
+                      mp_axis=None, fsdp_axis=None, recompute=True)
+    model = LlamaForCausalLM(cfg)
+    n_params = model.num_params()
+    opt = pt.optimizer.AdamW(learning_rate=1e-4, parameters=model)
+    step = pt.jit.TrainStep(model, opt,
+                            lambda logits, labels: model.loss(logits, labels))
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                      jnp.int32)
+    dt, spread, lossv = _time_windows(step, lambda: (ids, ids), iters=10)
+    tokens_per_sec = batch * seq / dt
+    flops_per_token = 6.0 * n_params \
+        + 12.0 * layers * seq * cfg.hidden_size
+    mfu = flops_per_token * tokens_per_sec / peak
+    return {
+        "metric": f"llama8b_shape_{layers}layer_seq{seq}_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {"mfu": round(mfu, 4), "step_ms": round(dt * 1000, 2),
+                  "params": n_params, "loss": round(lossv, 4),
+                  "batch": batch, "seq": seq, "layers": layers,
+                  "hidden": cfg.hidden_size, "vocab": cfg.vocab_size,
+                  "gqa": "32/8", "recompute": True, "tied": True,
+                  "peak": peak_kind, "pipeline": False, "runs": _RUNS,
+                  "iters": 10, "spread": round(spread, 4)},
+    }
+
+
 _CONFIGS = {
     "llama_420m": bench_llama,
     "resnet50": bench_resnet50,
     "bert_base": bench_bert,
     "qwen2_moe": bench_qwen2_moe,
     "lenet_mnist": bench_lenet,
+    # round-5 additions to the driver artifact (VERDICT r4 next #1/#3/#6):
+    "llama8b_shape": bench_llama8b_shape,
+    "llama_decode": bench_llama_decode,
+    "llama_longctx": bench_llama_longctx,
 }
 
 # opt-in configs (not in the default driver run — kept out to bound its
 # wall time; run by name)
 _EXTRA_CONFIGS = {
-    "llama_longctx": bench_llama_longctx,
+    "llama_longctx_32k": lambda peak, kind: bench_llama_longctx(
+        peak, kind, seq=32768),
 }
 
 

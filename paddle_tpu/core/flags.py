@@ -114,6 +114,13 @@ define_flag("flash_block_k", 1024, "Flash attention k-block cols (trace-time,"
 define_flag("flash_min_seq", 256, "Minimum q sequence length for routing "
             "scaled_dot_product_attention onto the Pallas flash kernel on "
             "TPU (below it the XLA bf16 path wins on launch overhead).")
+define_flag("flash_batch_axes", "dp",
+            "Comma-separated mesh axis names the flash SPMD rule shards the "
+            "BATCH dim over when the arrays' own sharding is unavailable "
+            "(jit tracing). Set for meshes with non-canonical axis names.")
+define_flag("flash_head_axes", "mp",
+            "Comma-separated mesh axis names the flash SPMD rule shards the "
+            "HEADS dim over (see flash_batch_axes).")
 define_flag("comm_watchdog_timeout", 300.0,
             "Seconds before the comm watchdog flags a blocking comm/sync "
             "call as hung (parity: FLAGS_enable_async_trace timeout).")

@@ -179,12 +179,13 @@ def save_state_dict(state_dict: dict, path: str, process_group=None,
     marker files polled by the coordinator's writer thread — no device
     collectives off the main thread.
 
-    Multi-host contract: ``path`` must resolve to ONE shared directory on
-    every rank (pass an absolute path, or guarantee identical cwds); the
-    cross-rank barrier tag is derived from the path *string*, so two ranks
-    spelling the same directory differently will still rendezvous — and
-    then fail loudly at merge time if the files landed in different
-    places."""
+    Multi-host contract: every rank must pass the SAME path string (after
+    normpath) naming ONE shared directory. The cross-rank barrier tag is
+    derived from that string — not from abspath, whose per-host cwd would
+    desynchronize ranks launched from different directories. Mixed
+    spellings (absolute on one rank, relative on another) fail loudly at
+    the barrier's name check; same string but different resolved
+    directories fail loudly at merge time."""
     os.makedirs(path, exist_ok=True)
     # barrier tag: normalized but NOT absolutized — ranks on different hosts
     # may run with different cwds yet pass the same relative path, and the

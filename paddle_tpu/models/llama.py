@@ -295,25 +295,32 @@ class LlamaForCausalLM(Layer):
         return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
                 for _ in range(cfg.num_hidden_layers)]
 
-    def generate(self, input_ids, max_new_tokens: int = 32, max_len: int | None = None,
-                 do_sample: bool = False, top_p: float = 1.0,
-                 temperature: float = 1.0, seed: int | None = None):
-        """Decode: one jitted prefill + one jitted per-token step over the
-        fixed-size KV cache (decode routes through the fused masked-MHA
-        path; the whole loop is two compiled programs, no per-op dispatch —
-        parity: AnalysisPredictor/FusedMultiTransformer generation).
+    def decode_programs(self, b: int, s0: int, max_new_tokens: int,
+                        max_len: int | None = None, do_sample: bool = False,
+                        top_p: float = 1.0, temperature: float = 1.0):
+        """Build (and cache per signature) the compiled serving programs:
 
-        do_sample=True draws each token with nucleus sampling via
-        ``ops.random.top_p_sampling`` (parity: tensor/search.py:1235 feeding
-        the reference's sampling decode); default is greedy argmax."""
+        - ``prefill(state, ids, caches, key) -> (tok, caches)`` — one
+          forward over the prompt, filling the KV cache;
+        - ``decode(state, tok, caches, keys) -> toks`` — the WHOLE
+          ``max_new_tokens - 1`` token loop as ONE jitted ``lax.scan`` over
+          the fixed-size cache (each iteration routes through the fused
+          masked-MHA decode path);
+        - ``step(state, tok, caches, pos, key) -> (tok, caches)`` — a
+          single decode step (the eager debugging loop).
+
+        Cached on the instance so repeated ``generate()`` calls (a serving
+        loop) reuse the executables instead of retracing — the analogue of
+        the reference predictor's program reuse
+        (analysis_predictor.cc:1423)."""
         from ..nn.module import functional_call
         from ..ops.random import top_p_sampling
-        input_ids = jnp.asarray(input_ids)
-        b, s0 = input_ids.shape
         max_len = max_len or (s0 + max_new_tokens)
-        state = self.state_dict(include_non_persistable_buffer=True)
-        caches = self.init_kv_caches(b, max_len)
-        key0 = jax.random.key(seed if seed is not None else 0)
+        sig = (b, s0, max_new_tokens, max_len, do_sample, float(top_p),
+               float(temperature))
+        cache = self.__dict__.setdefault("_decode_prog_cache", {})
+        if sig in cache:
+            return cache[sig]
 
         def pick(logits, key):
             if not do_sample:
@@ -329,13 +336,67 @@ class LlamaForCausalLM(Layer):
             return pick(logits[:, -1], key), caches
 
         @jax.jit
+        def decode(state, tok, caches, keys):
+            def body(carry, xs):
+                tok, caches = carry
+                key, pos = xs
+                (logits, caches), _ = functional_call(
+                    self, state, tok[:, None], None, caches, pos,
+                    training=False)
+                nt = pick(logits[:, -1], key)
+                return (nt, caches), nt
+            positions = s0 + jnp.arange(max_new_tokens - 1)
+            (tok, caches), toks = jax.lax.scan(
+                body, (tok, caches), (keys, positions))
+            return toks  # [max_new_tokens - 1, b]
+
+        @jax.jit
         def step(state, tok, caches, pos, key):
             (logits, caches), _ = functional_call(
                 self, state, tok[:, None], None, caches, pos, training=False)
             return pick(logits[:, -1], key), caches
 
+        cache[sig] = (prefill, decode, step)
+        return cache[sig]
+
+    def generate(self, input_ids, max_new_tokens: int = 32, max_len: int | None = None,
+                 do_sample: bool = False, top_p: float = 1.0,
+                 temperature: float = 1.0, seed: int | None = None,
+                 jit_loop: bool = True):
+        """Decode: one jitted prefill + the WHOLE token loop as one jitted
+        ``lax.scan`` over the fixed-size KV cache (decode routes through the
+        fused masked-MHA path). Two compiled programs total — the per-token
+        host dispatch floor (~3 ms/token on a tunneled chip) disappears from
+        the decode loop entirely (parity: AnalysisPredictor /
+        FusedMultiTransformer generation, analysis_predictor.cc:1423); the
+        programs are cached on the model, so a serving loop of generate()
+        calls never retraces.
+
+        ``jit_loop=False`` keeps the one-compiled-step-per-token eager loop
+        (token-by-token debugging, early-exit experimentation); both paths
+        produce identical tokens with greedy decoding.
+
+        do_sample=True draws each token with nucleus sampling via
+        ``ops.random.top_p_sampling`` (parity: tensor/search.py:1235 feeding
+        the reference's sampling decode); default is greedy argmax."""
+        input_ids = jnp.asarray(input_ids)
+        b, s0 = input_ids.shape
+        max_len = max_len or (s0 + max_new_tokens)
+        state = self.state_dict(include_non_persistable_buffer=True)
+        caches = self.init_kv_caches(b, max_len)
+        key0 = jax.random.key(seed if seed is not None else 0)
+        prefill, decode, step = self.decode_programs(
+            b, s0, max_new_tokens, max_len, do_sample, top_p, temperature)
+
         keys = jax.random.split(key0, max_new_tokens)
         tok, caches = prefill(state, input_ids, caches, keys[0])
+        if max_new_tokens == 1:
+            return jnp.concatenate([input_ids, tok[:, None]], axis=1)
+        if jit_loop:
+            toks = decode(state, tok, caches, keys[1:])
+            new = jnp.concatenate([tok[:, None], toks.T], axis=1)
+            return jnp.concatenate([input_ids, new], axis=1)
+
         out = [tok]
         for i in range(1, max_new_tokens):
             tok, caches = step(state, tok, caches, s0 + i - 1, keys[i])

@@ -92,37 +92,106 @@ def _in_manual_trace() -> bool:
 
 
 @_functools.lru_cache(maxsize=64)
-def _flash_sharded_fn(mesh, batch_axes, head_axes, is_causal):
+def _flash_sharded_fn(mesh, batch_axes, head_axes, is_causal, mask_mode,
+                      dropout_p):
     """Compiled shard_map wrapper cache — keyed so repeated attention calls
-    (every layer, every step, eager decode loops) reuse one executable."""
+    (every layer, every step, eager decode loops) reuse one executable.
+
+    ``mask_mode``: None (no mask) or a (batch_sharded, head_sharded) bool
+    pair describing which mask dims follow q's sharding (size-1 dims stay
+    replicated). With ``dropout_p`` > 0 the call takes a (2,) int32
+    (seed, offset) array, replicated; each shard folds its linear mesh
+    position into the offset so the in-kernel PRNG streams are distinct
+    across shards (the five-tuple already separates heads/blocks *within*
+    a shard, but local indices restart at 0 on every shard)."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
     from ...ops.pallas.flash_attention import flash_attention as _fa
     spec = P(batch_axes or None, None, head_axes or None, None)
+    axes = frozenset([*batch_axes, *head_axes])
+    shard_sizes = tuple(int(mesh.shape[a])
+                        for a in (*batch_axes, *head_axes))
+
+    in_specs = [spec, spec, spec]
+    if mask_mode is not None:
+        mb, mh = mask_mode
+        in_specs.append(P((batch_axes or None) if mb else None,
+                          (head_axes or None) if mh else None, None, None))
+    if dropout_p > 0.0:
+        in_specs.append(P())
+
+    def body(q, k, v, *rest):
+        rest = list(rest)
+        m = rest.pop(0) if mask_mode is not None else None
+        seed = None
+        if dropout_p > 0.0:
+            seed = rest.pop(0)
+            idx = jnp.int32(0)
+            for a, size in zip((*batch_axes, *head_axes), shard_sizes):
+                idx = idx * size + jax.lax.axis_index(a)
+            seed = seed.at[1].add(idx)
+        return _fa(q, k, v, causal=is_causal, attn_mask=m,
+                   dropout_p=dropout_p, fixed_seed_offset=seed)
+
     return jax.jit(shard_map(
-        lambda q, k, v: _fa(q, k, v, causal=is_causal), mesh=mesh,
-        in_specs=(spec, spec, spec), out_specs=spec,
-        axis_names=frozenset([*batch_axes, *head_axes]), check_vma=False))
+        body, mesh=mesh, in_specs=tuple(in_specs), out_specs=spec,
+        axis_names=axes, check_vma=False))
 
 
-def _flash_sharded(q, k, v, is_causal):
+def _flash_backend_ok() -> bool:
+    """Kernel routing gate: the Pallas kernel (and its pltpu PRNG dropout)
+    needs a real TPU backend. Separated out so routing tests can force it."""
+    return jax.default_backend() == "tpu"
+
+
+def _flag_axes(name) -> tuple:
+    from ...core import flags
+    raw = str(flags.get_flag(name))
+    return tuple(a.strip() for a in raw.split(",") if a.strip())
+
+
+_warned_mesh_sigs: set = set()
+
+
+def _flash_sharded(q, k, v, is_causal, mask=None, dropout_p=0.0,
+                   fixed_seed_offset=None):
     """SPMD rule for the Pallas flash kernel (parity:
-    phi/infermeta/spmd_rules/flash_attention.cc — shard batch and heads,
-    replicate seq/head_dim): under an active mesh the kernel runs inside a
+    phi/infermeta/spmd_rules/flash_attention.h:25 — shard batch and heads,
+    replicate seq/head_dim; the reference rule takes attn_mask as a
+    first-class input): under an active mesh the kernel runs inside a
     shard_map over the data/model axes so GSPMD programs keep the fused
-    kernel instead of falling off the partitioning path. Axes come from the
-    array's actual sharding when concrete (eager path), else the canonical
-    dp/mp names. Returns None when no rule applies (caller falls back to
-    XLA attention)."""
+    kernel instead of falling off the partitioning path. ``mask`` is a
+    raw paddle-style mask; it is normalized to [b|1, h|1, sq, sk] only
+    AFTER the cheap applicability checks pass (normalization materializes
+    an O(b*S^2) array — wasted work on every XLA-fallback call otherwise);
+    size-1 dims replicate, full dims shard with q. ``dropout_p`` > 0
+    threads a seeded (2,) int32 through the shard_map with per-shard
+    stream decorrelation. Axes come from the array's actual sharding when
+    concrete (eager path), else the flash_batch_axes/flash_head_axes flags
+    (default dp/mp). Returns None when no rule applies — including a mask
+    the kernel cannot take — and the caller falls back to XLA attention."""
     from ...core import mesh as mesh_lib
     from ...ops.pallas.flash_attention import flash_attention as _fa
+
+    def _norm_mask():
+        """(ok, normalized): ok=False -> no rule (caller uses XLA)."""
+        if mask is None:
+            return True, None
+        m = _normalize_kernel_mask(mask, q.shape[0], q.shape[2],
+                                   q.shape[1], k.shape[1])
+        return m is not None, m
+
     mesh = mesh_lib.current_mesh()
     if mesh is None or all(s == 1 for s in mesh.shape.values()):
-        return _fa(q, k, v, causal=is_causal)
+        ok, m = _norm_mask()
+        if not ok:
+            return None
+        return _fa(q, k, v, causal=is_causal, attn_mask=m,
+                   dropout_p=dropout_p, fixed_seed_offset=fixed_seed_offset)
 
     def _axes(default):
         # concrete arrays carry their placement; tracers fall back to the
-        # canonical hybrid axis names
+        # configured axis names (flash_batch_axes/flash_head_axes flags)
         sh = getattr(q, "sharding", None)
         spec = getattr(sh, "spec", None)
         if spec is not None and len(spec) >= 3:
@@ -133,20 +202,39 @@ def _flash_sharded(q, k, v, is_causal):
         return tuple(a for a in default[0]
                      if mesh_lib.axis_size(a, mesh) > 1)
 
-    batch_axes = _axes((("dp",), 0))
-    head_axes = _axes((("mp",), 2))
+    batch_axes = _axes((_flag_axes("flash_batch_axes"), 0))
+    head_axes = _axes((_flag_axes("flash_head_axes"), 2))
     if _in_manual_trace():
         # already inside a shard_map body (pipeline / sequence parallel):
         # dp/mp are auto (global-view) axes here — no nested shard_map; the
         # plain kernel is only safe when those axes are unsized, else use
         # XLA attention
         if not batch_axes and not head_axes:
-            return _fa(q, k, v, causal=is_causal)
+            ok, m = _norm_mask()
+            if not ok:
+                return None
+            return _fa(q, k, v, causal=is_causal, attn_mask=m,
+                       dropout_p=dropout_p,
+                       fixed_seed_offset=fixed_seed_offset)
         return None
     if not batch_axes and not head_axes:
-        # mesh is sized but not along the canonical batch/head axes (pure
-        # fsdp/pp/sep meshes): an empty-manual shard_map would REPLICATE
-        # q/k/v everywhere — let GSPMD partition the XLA path instead
+        # mesh is sized but not along the configured batch/head axes (pure
+        # fsdp/pp/sep meshes, or a user mesh with other names): an
+        # empty-manual shard_map would REPLICATE q/k/v everywhere — let
+        # GSPMD partition the XLA path instead, and say so once per mesh
+        sig = tuple(sorted(mesh.shape.items()))
+        if sig not in _warned_mesh_sigs:
+            _warned_mesh_sigs.add(sig)
+            import warnings
+            warnings.warn(
+                f"flash attention: active mesh {dict(mesh.shape)} has no "
+                f"sized axis named in flash_batch_axes/flash_head_axes "
+                f"(currently {_flag_axes('flash_batch_axes')}/"
+                f"{_flag_axes('flash_head_axes')}); the fused Pallas kernel "
+                f"is bypassed in favor of GSPMD-partitioned XLA attention. "
+                f"Set paddle_tpu.set_flags({{'flash_batch_axes': ...}}) to "
+                f"your mesh's data/model axis names to keep the kernel.",
+                stacklevel=3)
         return None
     bdeg = 1
     for a in batch_axes:
@@ -157,15 +245,30 @@ def _flash_sharded(q, k, v, is_causal):
     if q.shape[0] % max(bdeg, 1) or q.shape[2] % max(hdeg, 1) or \
             k.shape[2] % max(hdeg, 1):
         return None
-    fn = _flash_sharded_fn(mesh, batch_axes, head_axes, bool(is_causal))
-    return fn(q, k, v)
-
-
-def _single_device_kernel_ok() -> bool:
-    """True when the plain (no shard_map rule) Pallas kernel is safe to
-    call directly: no active mesh and not inside a manual trace."""
-    from ..._mesh_gate import no_mesh_active
-    return no_mesh_active() and not _in_manual_trace()
+    ok, m = _norm_mask()
+    if not ok:
+        return None
+    mask_mode = None
+    args = [q, k, v]
+    if m is not None:
+        # _normalize_kernel_mask guarantees dims 0/1 are 1 or b/h; a full
+        # dim shards with q, a size-1 dim replicates. Sharded dims must
+        # stay divisible (b % bdeg checked above covers mask b == q b).
+        mask_mode = (m.shape[0] != 1, m.shape[1] != 1)
+        if mask_mode[1] and m.shape[1] % max(hdeg, 1):
+            return None
+        args.append(m)
+    if dropout_p > 0.0:
+        if fixed_seed_offset is None:
+            from ...core import rng as _rng
+            bits = jax.random.key_data(_rng.next_key()).reshape(-1)[:2]
+            seed_arr = jnp.asarray(bits, jnp.int32)
+        else:
+            seed_arr = jnp.asarray(fixed_seed_offset, jnp.int32).reshape(2)
+        args.append(seed_arr)
+    fn = _flash_sharded_fn(mesh, batch_axes, head_axes, bool(is_causal),
+                           mask_mode, float(dropout_p))
+    return fn(*args)
 
 
 def _normalize_kernel_mask(mask, b, h, sq, sk):
@@ -192,35 +295,20 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     """Inputs [batch, seq, num_heads, head_dim] (paddle convention)."""
     q, k, v = jnp.asarray(query), jnp.asarray(key), jnp.asarray(value)
     eff_dropout = dropout_p if training else 0.0
-    use_flash = (
-        q.shape[1] >= _flash_min_seq()
-        and jax.default_backend() == "tpu"
-    )
+    use_flash = q.shape[1] >= _flash_min_seq() and _flash_backend_ok()
     if use_flash:
-        if attn_mask is None and eff_dropout > 0.0:
-            # in-kernel seeded dropout: single-device route (the dropout
-            # kernel carries no shard_map rule yet)
-            if _single_device_kernel_ok():
-                from ...ops.pallas.flash_attention import flash_attention as _fa
-                return _fa(q, k, v, causal=is_causal, dropout_p=eff_dropout)
-        elif attn_mask is None and eff_dropout == 0.0:
-            out = _flash_sharded(q, k, v, is_causal)
-            if out is not None:
-                return out
-        else:
-            # masked flash, with or without in-kernel dropout:
-            # single-device route only (the in-kernel bias/dropout carry no
-            # shard_map rule yet); masks the kernel cannot take
-            # (non-broadcastable shapes) use XLA. Cheap context checks run
-            # BEFORE the (materializing) normalization.
-            if _single_device_kernel_ok():
-                m = _normalize_kernel_mask(attn_mask, q.shape[0], q.shape[2],
-                                           q.shape[1], k.shape[1])
-                if m is not None:
-                    from ...ops.pallas.flash_attention import \
-                        flash_attention as _fa
-                    return _fa(q, k, v, causal=is_causal, attn_mask=m,
-                               dropout_p=eff_dropout)
+        # the in-kernel dropout PRNG is pltpu-only: interpret mode (CPU)
+        # cannot run it, so dropout routes require a real TPU backend —
+        # already guaranteed by use_flash. One rule covers every
+        # combination (mask x dropout x mesh): _flash_sharded handles the
+        # single-device case, the shard_map case, and returns None when no
+        # rule applies (indivisible shards, unsharded-axis meshes, manual
+        # traces, masks the kernel cannot take) — then XLA attention
+        # takes over.
+        out = _flash_sharded(q, k, v, is_causal, mask=attn_mask,
+                             dropout_p=eff_dropout)
+        if out is not None:
+            return out
     return _xla_attention(q, k, v, attn_mask, dropout_p, is_causal, training=training)
 
 
@@ -236,12 +324,12 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax
     q = jnp.asarray(query)
     if (dropout > 0.0 and training and fixed_seed_offset is not None
             and not return_softmax
-            and jax.default_backend() == "tpu"
+            and _flash_backend_ok()
             and q.shape[1] >= _flash_min_seq()):
-        if _single_device_kernel_ok():
-            from ...ops.pallas.flash_attention import flash_attention as _fa
-            out = _fa(q, jnp.asarray(key), jnp.asarray(value), causal=causal,
-                      dropout_p=dropout, fixed_seed_offset=fixed_seed_offset)
+        out = _flash_sharded(q, jnp.asarray(key), jnp.asarray(value),
+                             causal, dropout_p=dropout,
+                             fixed_seed_offset=fixed_seed_offset)
+        if out is not None:
             return out, None
     out = scaled_dot_product_attention(query, key, value, None, dropout, causal,
                                        training=training)

@@ -257,3 +257,26 @@ def test_llama_generate_greedy_consistent():
         nxt = jnp.argmax(logits[:, -1], axis=-1)
         cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
+
+
+def test_llama_generate_scan_matches_eager_loop():
+    """The one-program lax.scan decode (jit_loop=True, default) must produce
+    the same tokens as the per-token eager loop, greedy AND sampled (same
+    seed -> same nucleus draws)."""
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    pt.seed(9)
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=96,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=64,
+                      mp_axis=None, fsdp_axis=None)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids = jnp.asarray(RNG.integers(0, 64, (2, 5)))
+    a = model.generate(ids, max_new_tokens=7, jit_loop=True)
+    b = model.generate(ids, max_new_tokens=7, jit_loop=False)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    s1 = model.generate(ids, max_new_tokens=7, do_sample=True, top_p=0.9,
+                        seed=3, jit_loop=True)
+    s2 = model.generate(ids, max_new_tokens=7, do_sample=True, top_p=0.9,
+                        seed=3, jit_loop=False)
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
