@@ -54,6 +54,7 @@ from . import signal  # noqa: E402
 from . import utils  # noqa: E402
 from . import autograd  # noqa: E402
 from .autograd import no_grad  # noqa: E402  (paddle.no_grad parity)
+from .nn.initializer import LazyGuard  # noqa: E402  (paddle.LazyGuard parity)
 from . import sparse  # noqa: E402
 from . import quantization  # noqa: E402
 from . import audio  # noqa: E402

@@ -30,7 +30,13 @@ _state = threading.local()
 
 def _global():
     if not hasattr(_state, "key"):
-        _state.key = jax.random.key(0)
+        # the lazy init may first be reached INSIDE a jit/eval_shape trace
+        # (e.g. a thread's first draw happens under a transform);
+        # ensure_compile_time_eval keeps the stored key a concrete array —
+        # storing a tracer here would poison every later eager draw with
+        # an escaped-tracer error
+        with jax.ensure_compile_time_eval():
+            _state.key = jax.random.key(0)
         _state.stack = []
     return _state
 
@@ -38,7 +44,8 @@ def _global():
 def seed(value: int) -> None:
     """Seed the process-global eager RNG (parity: ``paddle.seed``)."""
     s = _global()
-    s.key = jax.random.key(value)
+    with jax.ensure_compile_time_eval():
+        s.key = jax.random.key(int(value))
 
 
 def default_key() -> jax.Array:
@@ -77,11 +84,20 @@ def rng_stream(base_key: jax.Array) -> Iterator[_Stream]:
 
 def next_key() -> jax.Array:
     """Draw the next RNG key: from the innermost scoped stream if one is
-    active (pure/traced mode) else by advancing the global eager key."""
+    active (pure/traced mode) else by advancing the global eager key.
+
+    The eager advance runs under ``ensure_compile_time_eval``: if a layer
+    draws from the global stream while being traced (no functional_call
+    stream scoped), the split happens eagerly and the stored key stays
+    concrete — the traced program bakes the drawn key in as a constant
+    (one pattern per compilation) instead of poisoning the global state
+    with an escaped tracer. Pass ``rngs`` to functional_call for
+    per-call randomness under jit."""
     s = _global()
     if s.stack:
         return s.stack[-1].next()
-    s.key, sub = jax.random.split(s.key)
+    with jax.ensure_compile_time_eval():
+        s.key, sub = jax.random.split(s.key)
     return sub
 
 

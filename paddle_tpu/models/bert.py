@@ -71,9 +71,12 @@ class BertModel(Layer):
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         x = self.embeddings(input_ids, token_type_ids)
         if attention_mask is not None and attention_mask.ndim == 2:
-            # [b, s] validity -> additive [b, 1, 1, s]
-            attention_mask = jnp.where(attention_mask[:, None, None, :] > 0,
-                                       0.0, -1e9)
+            # [b, s] validity -> bool [b, 1, 1, s]. Kept BOOL (not additive
+            # float): bool masks carry no gradient, so attention keeps the
+            # fused flash kernel under jit/meshes (a float tracer mask
+            # must take the differentiable XLA path — attention.py
+            # _norm_mask); where(mask, s, -inf) == s + (-1e9) for padding
+            attention_mask = attention_mask[:, None, None, :] > 0
         x = self.encoder(x, attention_mask)
         pooled = F.tanh(self.pooler_dense(x[:, 0]))
         return x, pooled

@@ -309,17 +309,23 @@ class LlamaForCausalLM(Layer):
         - ``step(state, tok, caches, pos, key) -> (tok, caches)`` — a
           single decode step (the eager debugging loop).
 
-        Cached on the instance so repeated ``generate()`` calls (a serving
-        loop) reuse the executables instead of retracing — the analogue of
-        the reference predictor's program reuse
-        (analysis_predictor.cc:1423)."""
+        Cached on the instance (LRU, 16 signatures) so repeated
+        ``generate()`` calls (a serving loop) reuse the executables instead
+        of retracing — the analogue of the reference predictor's program
+        reuse (analysis_predictor.cc:1423). The bound matters: a server
+        fed unbucketed prompt lengths would otherwise pin one compiled
+        scan program per distinct (batch, prompt_len) forever; bucket
+        prompts to a few lengths to stay inside the cache."""
+        from collections import OrderedDict
+
         from ..nn.module import functional_call
         from ..ops.random import top_p_sampling
         max_len = max_len or (s0 + max_new_tokens)
         sig = (b, s0, max_new_tokens, max_len, do_sample, float(top_p),
                float(temperature))
-        cache = self.__dict__.setdefault("_decode_prog_cache", {})
+        cache = self.__dict__.setdefault("_decode_prog_cache", OrderedDict())
         if sig in cache:
+            cache.move_to_end(sig)
             return cache[sig]
 
         def pick(logits, key):
@@ -357,6 +363,8 @@ class LlamaForCausalLM(Layer):
             return pick(logits[:, -1], key), caches
 
         cache[sig] = (prefill, decode, step)
+        while len(cache) > 16:
+            cache.popitem(last=False)
         return cache[sig]
 
     def generate(self, input_ids, max_new_tokens: int = 32, max_len: int | None = None,

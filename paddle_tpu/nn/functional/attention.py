@@ -177,6 +177,21 @@ def _flash_sharded(q, k, v, is_causal, mask=None, dropout_p=0.0,
         """(ok, normalized): ok=False -> no rule (caller uses XLA)."""
         if mask is None:
             return True, None
+        # the kernel's attn_mask is NON-differentiable (stop_gradient, like
+        # the reference FA2 contract). Routing a float mask that is being
+        # differentiated through it would silently zero its gradient, so
+        # only masks that cannot carry gradients take the kernel: bool
+        # masks (any context — selection has no mask gradient) and
+        # concrete float biases (eager constants). A float TRACER (e.g. a
+        # learned ALiBi/T5 bias inside a jitted train step) falls back to
+        # the differentiable XLA path. Padding masks should stay bool to
+        # keep the fused kernel under jit.
+        dt = getattr(mask, "dtype", None)
+        if dt is None:
+            import numpy as _np
+            dt = _np.asarray(mask).dtype
+        if dt != jnp.bool_ and isinstance(mask, jax.core.Tracer):
+            return False, None
         m = _normalize_kernel_mask(mask, q.shape[0], q.shape[2],
                                    q.shape[1], k.shape[1])
         return m is not None, m

@@ -19,8 +19,40 @@ from ..core.dtypes import canonical_dtype
 __all__ = [
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
-    "Assign", "Orthogonal", "Dirac", "calculate_gain",
+    "Assign", "Orthogonal", "Dirac", "calculate_gain", "LazyGuard",
 ]
+
+# LazyGuard state: while active, every Initializer call returns an abstract
+# jax.ShapeDtypeStruct instead of materializing the array. Thread-local
+# (matching core/rng's state): a guard held by one thread must not make a
+# concurrent thread's model construction silently abstract.
+import threading as _threading
+
+_lazy_state = _threading.local()
+
+
+def lazy_init_active() -> bool:
+    return getattr(_lazy_state, "on", False)
+
+
+class LazyGuard:
+    """Delay parameter materialization (parity: ``paddle.LazyGuard``,
+    python/paddle/fluid/lazy_init.py). Layers constructed inside the guard
+    carry ``jax.ShapeDtypeStruct`` "parameters" — no host or device memory
+    is allocated — so model code can be built at ANY scale for abstract
+    work: AOT ``.lower().compile()`` memory/sharding plans, eval_shape
+    pipelines, checkpoint-shape negotiation. Buffers created with concrete
+    jnp arrays (rope caches, norm stats) stay concrete; jax APIs accept
+    the mixed pytree. Re-entrant."""
+
+    def __enter__(self):
+        self._prev = lazy_init_active()
+        _lazy_state.on = True
+        return self
+
+    def __exit__(self, *exc):
+        _lazy_state.on = self._prev
+        return False
 
 
 def calculate_gain(nonlinearity: str, param=None) -> float:
@@ -49,6 +81,26 @@ def _fans(shape):
 class Initializer:
     def __call__(self, shape, dtype="float32") -> jax.Array:
         raise NotImplementedError
+
+    def __init_subclass__(cls, **kw):
+        """Wrap every subclass ``__call__`` with the LazyGuard short-circuit
+        (one hook instead of a check in each of the ~12 initializers)."""
+        super().__init_subclass__(**kw)
+        orig = cls.__dict__.get("__call__")
+        if orig is None:
+            return
+
+        import functools
+
+        @functools.wraps(orig)
+        def wrapper(self, shape, dtype="float32", _orig=orig):
+            if lazy_init_active():
+                return jax.ShapeDtypeStruct(
+                    tuple(int(s) for s in shape),
+                    canonical_dtype(dtype) or jnp.dtype(dtype))
+            return _orig(self, shape, dtype)
+
+        cls.__call__ = wrapper
 
 
 class Constant(Initializer):

@@ -327,3 +327,30 @@ def test_sdpa_masked_keeps_kernel_under_mesh(monkeypatch):
     assert calls and calls[0]["mask"] is not None
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_float_tracer_mask_keeps_gradient(monkeypatch):
+    """A float additive mask being differentiated (a tracer, e.g. learned
+    ALiBi) must NOT route into the kernel (whose mask is stop_gradient'd) —
+    the XLA path keeps the bias gradient alive. Bool masks carry no
+    gradient and stay on the kernel."""
+    from paddle_tpu.nn.functional import attention as attn_mod
+    monkeypatch.setattr(attn_mod, "_flash_backend_ok", lambda: True)
+    b, s, h, d = 1, 256, 2, 32
+    q = jnp.asarray(RNG.standard_normal((b, s, h, d)), jnp.float32)
+    bias = jnp.asarray(RNG.standard_normal((s, s)) * 0.1, jnp.float32)
+
+    def loss(bias):
+        out = attn_mod.scaled_dot_product_attention(q, q, q, attn_mask=bias)
+        return jnp.sum(jnp.sin(out))
+
+    g = jax.grad(loss)(bias)  # bias is a tracer inside grad
+    assert float(jnp.max(jnp.abs(g))) > 0.0  # grad flows (XLA path)
+
+    # concrete float bias still allowed on the kernel (eager, no grads)
+    calls = []
+    orig = attn_mod._flash_sharded
+    monkeypatch.setattr(attn_mod, "_flash_sharded",
+                        lambda *a, **kw: calls.append(1) or orig(*a, **kw))
+    out = attn_mod.scaled_dot_product_attention(q, q, q, attn_mask=bias)
+    assert calls and np.isfinite(np.asarray(out)).all()
