@@ -63,7 +63,13 @@ def _xla_attention(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
         elif m.ndim == 3:
             m = m[:, None]
         if m.dtype == jnp.bool_:
-            scores = jnp.where(m, scores, -jnp.inf)
+            # -1e30, not -inf: a FULLY-masked row (all-padding dummy row in
+            # a fixed-size serving batch) must stay finite — exp(-1e30-max)
+            # is exactly 0 in fp32 for rows with any valid key, identical
+            # softmax; an all-masked row degrades to uniform instead of NaN
+            # (the Pallas kernel's defined behavior for such rows is zeros;
+            # both are finite, neither propagates NaN into the loss)
+            scores = jnp.where(m, scores, jnp.float32(-1e30))
         else:
             scores = scores + m.astype(jnp.float32)
     probs = jax.nn.softmax(scores, axis=-1)

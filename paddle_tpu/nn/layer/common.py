@@ -62,7 +62,10 @@ class Embedding(Layer):
         self.padding_idx = padding_idx
         w_init = weight_attr if callable(weight_attr) else I.Normal(0.0, 1.0)
         w = w_init((num_embeddings, embedding_dim), self._dtype)
-        if padding_idx is not None:
+        # under LazyGuard the initializer returns a ShapeDtypeStruct (no
+        # values to zero; .at does not exist) — the padding row transform
+        # only applies to concrete weights
+        if padding_idx is not None and hasattr(w, "at"):
             w = w.at[padding_idx].set(0.0)
         self.weight = Parameter(w, spec=weight_spec)
 

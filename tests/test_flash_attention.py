@@ -354,3 +354,16 @@ def test_float_tracer_mask_keeps_gradient(monkeypatch):
                         lambda *a, **kw: calls.append(1) or orig(*a, **kw))
     out = attn_mod.scaled_dot_product_attention(q, q, q, attn_mask=bias)
     assert calls and np.isfinite(np.asarray(out)).all()
+
+
+def test_fully_masked_row_stays_finite():
+    """A batch row whose bool mask excludes every key (all-padding dummy
+    rows in fixed-size serving batches) must produce FINITE output on the
+    XLA path (uniform softmax), not NaN."""
+    from paddle_tpu.nn.functional.attention import _xla_attention
+    b, s, h, d = 2, 8, 2, 16
+    q = jnp.asarray(RNG.standard_normal((b, s, h, d)), jnp.float32)
+    mask = np.ones((b, s, s), bool)
+    mask[1] = False  # row 1 fully padded
+    out = _xla_attention(q, q, q, attn_mask=jnp.asarray(mask))
+    assert np.isfinite(np.asarray(out)).all()

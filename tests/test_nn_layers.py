@@ -288,3 +288,17 @@ def test_lazy_guard_abstract_init_and_aot_lower():
     opt = pt.optimizer.AdamW(learning_rate=1e-3, parameters=m)
     st = jax.eval_shape(opt.init_state, params)
     assert st["moment1"]["0.weight"].shape == (16, 64)
+
+
+def test_lazy_guard_embedding_padding_idx():
+    """Embedding with padding_idx must construct under LazyGuard (the
+    padding-row zeroing is a concrete-weight transform)."""
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    with pt.LazyGuard():
+        e = nn.Embedding(100, 16, padding_idx=0)
+    assert isinstance(e.weight, jax.ShapeDtypeStruct)
+    assert e.weight.shape == (100, 16)
+    e2 = nn.Embedding(10, 4, padding_idx=0)  # concrete: row 0 zeroed
+    assert float(jnp.abs(e2.weight[0]).sum()) == 0.0
