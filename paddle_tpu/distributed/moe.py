@@ -71,14 +71,77 @@ def global_gather(y, local_count, global_count, axis: str = "mp"):
     return back.reshape(P * Elocal, C, d)
 
 
+def _fcfs_cumsum(mask, block: int = 512):
+    """Inclusive cumsum of a 0/1 int mask over axis 0 (the FCFS
+    position-in-expert assignment), computed as a blocked tril-matmul on
+    the MXU plus a tiny per-block offset cumsum.
+
+    Why: ``jnp.cumsum`` over T=8k tokens lowers to a log-depth chain of
+    ~13 dependent kernels over [T, E] — latency-bound, ~1 ms per cumsum
+    on a v5e (PROFILE_qwen2_moe.md names routing as the MoE block's top
+    sink). One [B, B] @ [B, E] matmul per block does the same work in a
+    single MXU pass. Exact: 0/1 values, block sums <= block <= 512, fp32
+    accumulation — integer-exact far beyond these counts."""
+    T, E = mask.shape
+    if T % block or T <= block:
+        return jnp.cumsum(mask, axis=0)
+    nb = T // block
+    m = mask.astype(jnp.float32).reshape(nb, block, E)
+    tril = jnp.tril(jnp.ones((block, block), jnp.float32))
+    within = jax.lax.dot_general(
+        tril, m, (((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)  # [block, nb, E]
+    within = jnp.moveaxis(within, 0, 1)  # [nb, block, E] inclusive-in-block
+    totals = within[:, -1, :]
+    offsets = jnp.concatenate(
+        [jnp.zeros((1, E), jnp.float32), jnp.cumsum(totals, axis=0)[:-1]],
+        axis=0)
+    out = within + offsets[:, None, :]
+    return out.reshape(T, E).astype(mask.dtype)
+
+
+def _fused_routing_ok(T, E) -> bool:
+    """Route ``_top2_parts`` through the fused Pallas kernel when: the
+    flag allows it, shapes fit the kernel's block grid, and we are either
+    meshless or inside a manual shard_map region (local shapes — the
+    all-to-all EP path). Under auto-GSPMD meshes the kernel carries no
+    partitioning rule, so the XLA chain keeps the dense path partitionable."""
+    from ..core import flags
+    if not flags.get_flag("moe_fused_routing"):
+        return False
+    from ..ops.pallas.moe_routing import fused_routing_applicable
+    if not fused_routing_applicable(T, E):
+        return False
+    from .._mesh_gate import no_mesh_active
+    from ..nn.functional.attention import _in_manual_trace
+    return no_mesh_active() or _in_manual_trace()
+
+
 def _top2_parts(logits, capacity, *, second_policy="random", key=None,
                 balance_loss_weight=1.0):
     """GShard top-2 gating core. logits: [tokens, E]. Returns the routing
     decision pieces shared by the dense (one-hot) and sparse (sorted/ragged)
     dispatch builders so the two paths can never diverge on gating rules:
     (g1_idx, g2_idx, w1, w2, keep1, keep2f, p1, p2, aux) — w1/w2 are already
-    zeroed for capacity-dropped slots and renormalized over kept experts."""
+    zeroed for capacity-dropped slots and renormalized over kept experts.
+
+    Two implementations with identical decisions: the fused Pallas kernel
+    (ops/pallas/moe_routing.py — one pass + analytic VJP; the top sink
+    named by PROFILE_qwen2_moe.md) and the XLA chain below. The random
+    second-expert keep draws its uniforms OUTSIDE both paths from the same
+    key, so routing cannot diverge between them."""
     T, E = logits.shape
+    if second_policy == "random":
+        k = key if key is not None else rng.next_key()
+        u = jax.random.uniform(k, (T,))
+    else:
+        u = None
+    if _fused_routing_ok(T, E):
+        from ..ops.pallas.moe_routing import fused_top2_routing
+        return fused_top2_routing(logits, u, int(capacity),
+                                  second_policy == "random",
+                                  float(balance_loss_weight))
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     g1_idx = jnp.argmax(probs, axis=-1)
     g1 = jnp.take_along_axis(probs, g1_idx[:, None], axis=1)[:, 0]
@@ -91,16 +154,15 @@ def _top2_parts(logits, capacity, *, second_policy="random", key=None,
     aux = jnp.sum(me * ce) * E * balance_loss_weight
     # second-expert random drop (gshard: keep with prob proportional to g2)
     if second_policy == "random":
-        k = key if key is not None else rng.next_key()
-        keep2 = jax.random.uniform(k, (T,)) < (2.0 * g2 / jnp.maximum(g1 + g2, 1e-9))
+        keep2 = u < (2.0 * g2 / jnp.maximum(g1 + g2, 1e-9))
     else:
         keep2 = jnp.ones((T,), bool)
     # positions within each expert, first-come-first-served, top1 before top2
     mask1 = jax.nn.one_hot(g1_idx, E, dtype=jnp.int32)
-    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1  # 0-based
+    pos1 = _fcfs_cumsum(mask1) * mask1 - mask1  # 0-based
     count1 = jnp.sum(mask1, axis=0)  # tokens claimed by top1 per expert
     mask2 = jax.nn.one_hot(g2_idx, E, dtype=jnp.int32) * keep2[:, None].astype(jnp.int32)
-    pos2 = (jnp.cumsum(mask2, axis=0) * mask2 - mask2) + count1[None, :]
+    pos2 = (_fcfs_cumsum(mask2) * mask2 - mask2) + count1[None, :]
     keep1 = jnp.sum(pos1 * mask1, axis=1) < capacity
     keep2f = (jnp.sum(pos2 * mask2, axis=1) < capacity) & (jnp.sum(mask2, 1) > 0)
     p1 = jnp.sum(pos1 * mask1, axis=1)
@@ -146,7 +208,7 @@ def _top1_parts(logits, capacity, *, balance_loss_weight=1.0, jitter_eps=0.0,
     ce = jnp.mean(jax.nn.one_hot(idx, E, dtype=jnp.float32), axis=0)
     aux = jnp.sum(me * ce) * E * balance_loss_weight
     mask = jax.nn.one_hot(idx, E, dtype=jnp.int32)
-    pos = jnp.cumsum(mask, axis=0) * mask - mask
+    pos = _fcfs_cumsum(mask) * mask - mask
     p = jnp.sum(pos * mask, axis=1)
     keep = p < capacity
     return idx, gate, keep, p, aux
