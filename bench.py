@@ -682,6 +682,19 @@ def main():
             print(json.dumps({"metric": name, "value": None, "unit": "error",
                               "vs_baseline": 0.0,
                               "extra": {"error": repr(e)[:300]}}), flush=True)
+        finally:
+            # release the finished config's HBM before the next one: the
+            # big configs (llama8b_shape needs ~14 GB for fp32 AdamW
+            # moments) OOM if earlier configs' params/opt-states/compiled
+            # executables linger — locals die on return, but jit caches
+            # pin buffers until cleared
+            import gc
+            gc.collect()
+            try:
+                jax.clear_caches()
+            except Exception:
+                pass
+            gc.collect()
     if failed:  # ...but the run must still report failure to the driver
         raise SystemExit(f"bench config(s) failed: {failed}")
 

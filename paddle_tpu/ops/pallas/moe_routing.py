@@ -217,5 +217,8 @@ fused_top2_routing.defvjp(
 
 def fused_routing_applicable(T, E) -> bool:
     """Shape gate: sequential-grid blocks need T % BT == 0; E must fit one
-    lane tile."""
-    return T % _BT == 0 and T >= _BT and E <= 128
+    lane tile; T is capped because the eight per-token output arrays live
+    ENTIRELY in VMEM (constant index map) next to the 4 MB tril — past
+    ~64k tokens the kernel would fail Mosaic compilation instead of
+    falling back, breaking the fall-back-on-unsupported-shapes contract."""
+    return T % _BT == 0 and _BT <= T <= 65536 and E <= 128

@@ -222,9 +222,8 @@ class TestFusedRouting:
         T, E, cap = 1024, 8, 320
         logits = jnp.asarray(r.standard_normal((T, E)), jnp.float32)
         key = jax.random.key(3)
-        from paddle_tpu.core.flags import flag_guard as _fg
         from paddle_tpu.distributed.moe import _fused_routing_ok
-        with _fg(moe_fused_routing=True):
+        with flag_guard(moe_fused_routing=True):
             assert _fused_routing_ok(T, E)  # kernel engages, not vacuous
         cw1 = jnp.asarray(r.standard_normal((T,)), jnp.float32)
         cw2 = jnp.asarray(r.standard_normal((T,)), jnp.float32)
@@ -249,9 +248,8 @@ class TestFusedRouting:
         from paddle_tpu.distributed.moe import MoELayer
         r = np.random.default_rng(2)
         x = jnp.asarray(r.standard_normal((1024, 32)), jnp.float32)
-        from paddle_tpu.core.flags import flag_guard as _fg
         from paddle_tpu.distributed.moe import _fused_routing_ok
-        with _fg(moe_fused_routing=True):
+        with flag_guard(moe_fused_routing=True):
             assert _fused_routing_ok(1024, 8)
         outs = []
         for fused in (True, False):
