@@ -585,8 +585,12 @@ def bench_llama_decode(peak, peak_kind, prefill_len=2048, new_tokens=256):
         "vs_baseline": per_batch[8]["mbu"],
         "extra": {"params": n_params, "prefill_len": prefill_len,
                   "new_tokens": new_tokens, "batches": per_batch,
-                  "peak": peak_kind, "hbm_bw": hbm_bw, "pipeline": False,
-                  "runs": _RUNS,
+                  "peak": peak_kind, "hbm_bw": hbm_bw,
+                  "mbu_note": "MBU vs the SPEC bandwidth; this chip's "
+                              "measured streaming ceiling is ~600 GB/s "
+                              "(PROFILE_resnet50.md), against which the "
+                              "batch-8 decode is ~bandwidth-bound",
+                  "pipeline": False, "runs": _RUNS,
                   "spread": per_batch[8]["spread_decode"]},
     }
 
