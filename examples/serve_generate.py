@@ -1,0 +1,73 @@
+"""Serving: batched generation with compiled prefill + one-program decode.
+
+The serving workflow (parity: the reference's AnalysisPredictor +
+FusedMultiTransformer KV-cache decode): ``model.generate`` runs ONE jitted
+prefill over the prompt and the WHOLE token loop as ONE jitted ``lax.scan``
+over a fixed-size KV cache — two compiled programs total, cached on the
+model per (batch, prompt_len, new_tokens) signature, so a serving loop
+never retraces. Greedy and nucleus (top-p) sampling both ride the same
+programs.
+
+Runs on CPU as-is:
+
+    python examples/serve_generate.py
+"""
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+
+def main():
+    pt.seed(0)
+    # the test-scale Llama config so the example runs in seconds on CPU;
+    # the same code path serves llama_3_8b on a chip
+    cfg = llama_tiny(max_position_embeddings=256, mp_axis=None,
+                     fsdp_axis=None)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, cfg.vocab_size, (2, 12))  # [batch, prompt_len]
+
+    # greedy: deterministic continuation
+    out = model.generate(prompts, max_new_tokens=16)
+    print("greedy      :", np.asarray(out)[0, 12:].tolist())
+
+    # the second call with the same signature reuses the compiled
+    # prefill + scan-decode programs (no retrace) — the serving pattern
+    out2 = model.generate(prompts, max_new_tokens=16)
+    assert np.array_equal(np.asarray(out), np.asarray(out2))
+    assert len(model._decode_prog_cache) == 1  # one signature, one entry
+
+    # nucleus sampling: seeded, reproducible
+    s1 = model.generate(prompts, max_new_tokens=16, do_sample=True,
+                        top_p=0.9, temperature=0.8, seed=7)
+    s2 = model.generate(prompts, max_new_tokens=16, do_sample=True,
+                        top_p=0.9, temperature=0.8, seed=7)
+    assert np.array_equal(np.asarray(s1), np.asarray(s2))
+    print("sampled     :", np.asarray(s1)[0, 12:].tolist())
+
+    # token-by-token debugging path (identical greedy tokens)
+    dbg = model.generate(prompts, max_new_tokens=16, jit_loop=False)
+    assert np.array_equal(np.asarray(out), np.asarray(dbg))
+    print("eager-loop  : identical to scan decode")
+    # program economy: greedy reuses ONE (prefill, decode) pair across its
+    # two calls; the sampled signature adds its own pair; the eager loop
+    # adds its per-token step program
+    print(f"ok: {len(model._decode_prog_cache)} cached signatures "
+          f"served 10 sequences (5 calls x batch 2)")
+
+
+if __name__ == "__main__":
+    main()

@@ -11,7 +11,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.mark.parametrize("name", ["quickstart", "data_parallel",
-                                  "quantize_deploy"])
+                                  "quantize_deploy", "serve_generate"])
 def test_example_runs(name):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # each script sets its own device count
