@@ -32,8 +32,7 @@ def main():
     pt.seed(0)
     # the test-scale Llama config so the example runs in seconds on CPU;
     # the same code path serves llama_3_8b on a chip
-    cfg = llama_tiny(max_position_embeddings=256, mp_axis=None,
-                     fsdp_axis=None)
+    cfg = llama_tiny(mp_axis=None, fsdp_axis=None)
     model = LlamaForCausalLM(cfg)
     model.eval()
 
