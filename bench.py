@@ -678,27 +678,49 @@ def main():
                          f"choose from {list(all_configs)}")
     names = sys.argv[1:] or list(_CONFIGS)
     failed = []
-    for name in names:
+
+    def _release_hbm():
+        # release the finished config's HBM before the next one: the big
+        # configs (llama8b_shape needs ~14 GB for fp32 AdamW moments) OOM
+        # if earlier configs' params/opt-states/compiled executables
+        # linger — locals die on return, but jit caches pin buffers until
+        # cleared
+        import gc
+        gc.collect()
         try:
-            print(json.dumps(all_configs[name](peak, peak_kind)), flush=True)
-        except Exception as e:  # one config failing must not kill the others
+            jax.clear_caches()
+        except Exception:
+            pass
+        gc.collect()
+
+    for name in names:
+        # one retry per config: the tunneled chip's relay occasionally
+        # drops a connection mid-run ("response body closed") — transient;
+        # the cleanup between attempts also clears OOM-class leftovers.
+        # Only the exceptions' reprs are kept: holding the exception
+        # object would pin its traceback's frames, whose locals are the
+        # very params/opt-state jax Arrays the retry needs freed.
+        errs = []
+        for attempt in (0, 1):
+            try:
+                print(json.dumps(all_configs[name](peak, peak_kind)),
+                      flush=True)
+                errs = []
+                break
+            except Exception as e:
+                errs.append(repr(e)[:300])
+            finally:
+                # the except block's implicit `del e` ran before this, so
+                # gc here can actually collect the frame cycle + buffers
+                _release_hbm()
+        if errs:  # one config failing must not kill the others
             failed.append(name)
             print(json.dumps({"metric": name, "value": None, "unit": "error",
                               "vs_baseline": 0.0,
-                              "extra": {"error": repr(e)[:300]}}), flush=True)
-        finally:
-            # release the finished config's HBM before the next one: the
-            # big configs (llama8b_shape needs ~14 GB for fp32 AdamW
-            # moments) OOM if earlier configs' params/opt-states/compiled
-            # executables linger — locals die on return, but jit caches
-            # pin buffers until cleared
-            import gc
-            gc.collect()
-            try:
-                jax.clear_caches()
-            except Exception:
-                pass
-            gc.collect()
+                              "extra": {"error": errs[-1],
+                                        "error_first_attempt": errs[0],
+                                        "attempts": len(errs)}}),
+                  flush=True)
     if failed:  # ...but the run must still report failure to the driver
         raise SystemExit(f"bench config(s) failed: {failed}")
 
