@@ -703,8 +703,13 @@ def main():
         errs = []
         for attempt in (0, 1):
             try:
-                print(json.dumps(all_configs[name](peak, peak_kind)),
-                      flush=True)
+                result = all_configs[name](peak, peak_kind)
+                if errs:
+                    # a success on the retry must not hide that the config
+                    # was flaky: surface the first attempt's failure on the
+                    # success line (round-5 advisor finding)
+                    result.setdefault("extra", {})["retried_after"] = errs[0]
+                print(json.dumps(result), flush=True)
                 errs = []
                 break
             except Exception as e:

@@ -1,0 +1,95 @@
+"""Version-compatibility shims for the jax API surface.
+
+The codebase targets the modern spelling ``jax.shard_map(...,
+check_vma=..., axis_names=...)``; older jax releases only ship
+``jax.experimental.shard_map.shard_map`` where the kwarg is ``check_rep``
+and manual axes are implied by the mesh + specs (no ``axis_names``).
+Importing from here instead of from ``jax`` keeps every shard_map entry
+point working on both — without it the whole ``paddle_tpu.distributed``
+package fails to import on a legacy jax, taking the checkpoint/elastic
+fault path down with it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+try:
+    from jax import shard_map as _shard_map  # modern jax
+    _LEGACY = False
+except ImportError:  # pragma: no cover — depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _LEGACY = True
+
+__all__ = ["shard_map", "get_abstract_mesh", "get_concrete_mesh",
+           "set_mesh"]
+
+
+def shard_map(f=None, /, **kw):
+    if f is None:
+        return functools.partial(shard_map, **kw)
+    if _LEGACY:
+        kw.pop("axis_names", None)
+        if "check_vma" in kw:
+            kw["check_rep"] = bool(kw.pop("check_vma"))
+    return _shard_map(f, **kw)
+
+
+def get_abstract_mesh():
+    """Ambient abstract mesh, or None when there is none.
+
+    Modern jax: ``jax.sharding.get_abstract_mesh()`` (always an
+    AbstractMesh, possibly ``.empty``). 0.4.x: only the internal
+    ``jax._src.mesh.get_abstract_mesh`` exists and its unset default is an
+    empty tuple — normalize both shapes to "mesh or None"."""
+    import jax
+
+    try:
+        am = jax.sharding.get_abstract_mesh()
+    except AttributeError:
+        from jax._src.mesh import get_abstract_mesh as _gam
+        am = _gam()
+    if am is None or not hasattr(am, "empty") or am.empty:
+        return None
+    return am
+
+
+def get_concrete_mesh():
+    """Ambient concrete mesh, or None — never raises (the modern
+    ``jax.sharding.get_mesh`` raises ValueError while tracing under jit,
+    where no concrete mesh exists on the trace context)."""
+    import jax
+
+    try:
+        get = jax.sharding.get_mesh
+    except AttributeError:
+        from jax._src.mesh import get_concrete_mesh as get
+    try:
+        m = get()
+    except ValueError:
+        return None
+    return m if isinstance(m, jax.sharding.Mesh) and not m.empty else None
+
+
+def set_mesh(mesh):
+    """Ambient-mesh context: ``jax.sharding.set_mesh`` where it exists.
+    On 0.4.x only internals exist, and the internal ``set_mesh`` also flips
+    the experimental ``sharding_in_types`` config — which that release
+    can't actually trace through (tracers have no ``.sharding``) — so
+    install just the abstract + concrete ambient mesh contexts."""
+    import contextlib
+
+    import jax
+
+    try:
+        return jax.sharding.set_mesh(mesh)
+    except AttributeError:
+        pass
+
+    @contextlib.contextmanager
+    def _legacy():
+        from jax._src.mesh import set_abstract_mesh, set_concrete_mesh
+        with set_abstract_mesh(mesh.abstract_mesh), set_concrete_mesh(mesh):
+            yield mesh
+
+    return _legacy()

@@ -100,17 +100,16 @@ def current_mesh() -> "Mesh | jax.sharding.AbstractMesh | None":
     # this library's use_mesh wrapper: the modern jax.sharding.set_mesh
     # context first, then the legacy `with mesh:` thread resources (private
     # import — the public pxla alias is deprecated; guarded so removal just
-    # disables the legacy bridge, never the set_mesh path)
-    am = jax.sharding.get_abstract_mesh()
-    if not am.empty:
-        # get_mesh() raises ValueError inside jit tracing (there is no
-        # concrete mesh on the trace context); callers only inspect
-        # .shape/.axis_names or feed shard_map, all of which accept the
-        # abstract mesh, so fall back to it while tracing.
-        try:
-            return jax.sharding.get_mesh()
-        except ValueError:
-            return am
+    # disables the legacy bridge, never the set_mesh path). Both ambient
+    # getters go through core.compat, which papers over jax releases where
+    # jax.sharding.{get_abstract_mesh,get_mesh} don't exist yet.
+    from .compat import get_abstract_mesh, get_concrete_mesh
+    am = get_abstract_mesh()
+    if am is not None:
+        # while tracing under jit there is no concrete mesh on the trace
+        # context; callers only inspect .shape/.axis_names or feed
+        # shard_map, all of which accept the abstract mesh
+        return get_concrete_mesh() or am
     try:
         from jax._src.mesh import thread_resources
         pm = thread_resources.env.physical_mesh

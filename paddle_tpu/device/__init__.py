@@ -80,13 +80,18 @@ def empty_cache():
 
 
 def synchronize(device=None):
-    """Block until pending work on the device is done."""
-    try:
-        jax.effects_barrier()
-    except Exception:
-        pass
-    import jax.numpy as jnp
-    jnp.zeros(()).block_until_ready()
+    """Block until pending work on the device is done. Watchdog-escalated:
+    this is THE host call that hangs when a peer rank dies mid-collective
+    (the XLA program never completes), so it is routed through ``watch`` —
+    on timeout the comm watchdog logs/raises/aborts per its action."""
+    from ..distributed.watchdog import watch
+    with watch("device.synchronize", device=str(device)):
+        try:
+            jax.effects_barrier()
+        except Exception:
+            pass
+        import jax.numpy as jnp
+        jnp.zeros(()).block_until_ready()
 
 
 class Stream:

@@ -9,7 +9,10 @@ NCCL process groups; collectives are XLA ops compiled over ICI/DCN.
 - collective API: functional wrappers usable inside shard_map
 - fleet: strategy-driven model/optimizer wrappers (DP/TP/PP/sharding)
 - auto_parallel: shard_tensor/reshard semi-auto API over NamedSharding
-- checkpoint: sharded save/load with cross-topology reshard
+- checkpoint: sharded save/load with cross-topology reshard, atomic
+  staged commits + per-shard checksums (RESILIENCE.md)
+- fault tolerance: comm watchdog (watchdog), preemption guard
+  (fleet.preempt), deterministic fault injection (fault)
 """
 
 from ..core.mesh import HYBRID_AXES, HybridTopology, current_mesh, make_mesh, use_mesh  # noqa: F401
@@ -24,8 +27,12 @@ from .auto_parallel_api import (  # noqa: F401
     ProcessMesh, shard_tensor, shard_layer, reshard, dtensor_from_fn,
     shard_dataloader,
 )
+from . import fault  # noqa: F401
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import watchdog  # noqa: F401
+from .fleet.preempt import EXIT_PREEMPTED, PreemptionGuard  # noqa: F401
+from .watchdog import EXIT_WATCHDOG_ABORT  # noqa: F401
 from . import moe  # noqa: F401
 from . import pipeline  # noqa: F401
 from . import sequence_parallel  # noqa: F401

@@ -11,5 +11,6 @@ reference's load_state_dict.py, with jax.Arrays instead of DenseTensors.
 """
 
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata  # noqa: F401
-from .save_load import (AsyncSaveHandle, load_state_dict,  # noqa: F401
-                        save_state_dict)
+from .save_load import (AsyncSaveHandle, CheckpointCorruptionError,  # noqa: F401
+                        COMMIT_MARKER, drain_inflight_saves, is_committed,
+                        load_state_dict, save_state_dict)

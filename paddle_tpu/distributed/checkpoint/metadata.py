@@ -30,3 +30,9 @@ class Metadata:
     storage_metadata: dict = field(default_factory=dict)
     # tensor name -> global shape
     global_shapes: dict = field(default_factory=dict)
+    # "tensor|offset" payload key -> SHA-256 hexdigest of the shard's raw
+    # bytes, recorded at write time and re-verified on load so a torn or
+    # bit-flipped shard fails loudly instead of poisoning a resume.
+    # (Metadata pickled before this field existed lacks the attribute —
+    # readers use getattr(meta, "checksums", {}).)
+    checksums: dict = field(default_factory=dict)

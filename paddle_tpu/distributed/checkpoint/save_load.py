@@ -6,19 +6,64 @@ multi-process: each process writes its addressable shards plus a per-rank
 metadata piece; after a global barrier the coordinator merges the pieces
 into the global ``metadata.pkl`` (the file-based analogue of the reference's
 NCCL-coordinated gather/dedup in save_state_dict.py).
+
+Commit protocol (see RESILIENCE.md): every save stages into
+``<path>.tmp/``; per-shard SHA-256 checksums are recorded in the metadata;
+only after the post-barrier metadata merge does the coordinator write a
+``COMMIT`` marker and rename the staging dir to ``<path>``. A crash at any
+earlier point leaves a ``*.tmp`` dir that ``is_committed`` (and
+``ElasticManager.latest_checkpoint``) rejects, so a resume can never pick
+up a torn checkpoint. ``load_state_dict`` re-verifies checksums and raises
+:class:`CheckpointCorruptionError` naming the damaged shard.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 import pickle
+import shutil
 
 import jax
 import numpy as np
 
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
+from .. import fault
+from ..watchdog import watch
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "AsyncSaveHandle",
+           "CheckpointCorruptionError", "is_committed",
+           "drain_inflight_saves", "COMMIT_MARKER"]
+
+COMMIT_MARKER = "COMMIT"
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint failed integrity verification: a shard's bytes do not
+    match the checksum recorded at save time, a shard file is unreadable,
+    or the directory was never committed (torn mid-save)."""
+
+
+def _staging(path: str) -> str:
+    return path.rstrip("/\\") + ".tmp"
+
+
+def is_committed(path: str) -> bool:
+    """True iff ``path`` is a committed checkpoint: a directory carrying the
+    ``COMMIT`` marker (or, for checkpoints written before the commit
+    protocol existed, a merged ``metadata.pkl``) and not a ``*.tmp``
+    staging dir. Non-directory paths (single-file checkpoints) are outside
+    the protocol and count as committed by existing."""
+    if not os.path.isdir(path):
+        return os.path.exists(path)
+    if os.path.normpath(path).endswith(".tmp"):
+        return False
+    return (os.path.isfile(os.path.join(path, COMMIT_MARKER))
+            or os.path.isfile(os.path.join(path, "metadata.pkl")))
+
+
+def _checksum(data: np.ndarray) -> str:
+    return hashlib.sha256(np.ascontiguousarray(data).tobytes()).hexdigest()
 
 
 def _shards_of(arr: jax.Array):
@@ -36,10 +81,15 @@ def _shards_of(arr: jax.Array):
 
 
 def _barrier(tag: str) -> None:
+    fault.trip("ckpt.barrier")
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(tag)
+        # watchdog escalation: a rank that died mid-save leaves everyone
+        # else parked here forever — the watchdog turns that silent hang
+        # into a diagnosed abort the launcher can gang-restart
+        with watch("ckpt.barrier", tag=tag):
+            multihost_utils.sync_global_devices(tag)
 
 
 class AsyncSaveHandle:
@@ -95,7 +145,13 @@ def _build_rank_payload(state_dict: dict, fname: str):
 
 
 def _write_rank_files(path: str, rank: int, meta, payload) -> None:
-    np.savez(os.path.join(path, f"{rank}.distcp.npz"), **payload)
+    # checksums are taken from the exact host buffers being written, in the
+    # writer (possibly background) thread, so hashing overlaps training
+    for pk, data in payload.items():
+        meta.checksums[pk] = _checksum(data)
+    npz_path = os.path.join(path, f"{rank}.distcp.npz")
+    np.savez(npz_path, **payload)
+    fault.trip("ckpt.write_shard", rank=rank, path=npz_path)
     with open(os.path.join(path, f"{rank}.meta.pkl"), "wb") as f:
         pickle.dump(meta, f)
 
@@ -121,6 +177,10 @@ def _merge_metadata(path: str, nprocs: int, seq: int | None = None) -> None:
         for li, file in piece.storage_metadata.items():
             # replicated shards may be written by several ranks; first wins
             merged.storage_metadata.setdefault(li, file)
+        for pk, digest in getattr(piece, "checksums", {}).items():
+            # replicated copies hold identical bytes, so first-wins here
+            # stays consistent with whichever file storage_metadata kept
+            merged.checksums.setdefault(pk, digest)
         for key, shard_metas in piece.state_dict_metadata.items():
             have = {sm.global_offset
                     for sm in merged.state_dict_metadata.get(key, [])}
@@ -137,6 +197,26 @@ def _merge_metadata(path: str, nprocs: int, seq: int | None = None) -> None:
             done = os.path.join(path, _done_name(r, seq))
             if os.path.exists(done):
                 os.remove(done)
+
+
+def _commit(stage: str, final: str) -> None:
+    """Coordinator-only atomic publish: write the COMMIT marker into the
+    staging dir, then rename it into place. Everything before the rename is
+    crash-safe (a torn ``*.tmp`` is skipped by readers); overwriting an
+    existing committed checkpoint swaps via ``<final>.old`` so a committed
+    dir exists at the target for all but the instant between renames."""
+    fault.trip("ckpt.commit", path=final)
+    with open(os.path.join(stage, COMMIT_MARKER), "w") as f:
+        f.write(f"nprocs={jax.process_count()}\n")
+    if os.path.isdir(final):
+        old = final + ".old"
+        if os.path.isdir(old):
+            shutil.rmtree(old)
+        os.rename(final, old)
+        os.rename(stage, final)
+        shutil.rmtree(old, ignore_errors=True)
+    else:
+        os.rename(stage, final)
 
 
 # per-path async save sequence: every rank of an SPMD program calls save
@@ -156,6 +236,21 @@ _INFLIGHT: dict[str, "AsyncSaveHandle"] = {}
 
 def _done_name(rank: int, seq: int) -> str:
     return f"{rank}.done.{seq}"
+
+
+def drain_inflight_saves(timeout: float = 600.0) -> list:
+    """Join every in-flight async save (the preemption path: a SIGTERMed
+    trainer must not die with a checkpoint half-written). Returns
+    ``[(path, exception), ...]`` for saves that failed or timed out instead
+    of raising — the caller is usually about to take a final synchronous
+    checkpoint and should not be derailed by an already-doomed async one."""
+    errs = []
+    for p, h in list(_INFLIGHT.items()):
+        try:
+            h.result(timeout=timeout)
+        except BaseException as e:  # noqa: BLE001 — collected, not fatal
+            errs.append((p, e))
+    return errs
 
 
 def _wait_marker(predicate, what: str, timeout: float) -> None:
@@ -185,8 +280,12 @@ def save_state_dict(state_dict: dict, path: str, process_group=None,
     desynchronize ranks launched from different directories. Mixed
     spellings (absolute on one rank, relative on another) fail loudly at
     the barrier's name check; same string but different resolved
-    directories fail loudly at merge time."""
-    os.makedirs(path, exist_ok=True)
+    directories fail loudly at merge time.
+
+    Atomicity: all ranks write into the ``<path>.tmp/`` staging dir; the
+    coordinator commits (COMMIT marker + rename to ``path``) only after the
+    post-barrier metadata merge. A crash anywhere mid-save leaves only the
+    torn staging dir, never a half-written ``path``."""
     # barrier tag: normalized but NOT absolutized — ranks on different hosts
     # may run with different cwds yet pass the same relative path, and the
     # tag must be byte-identical on every rank (abspath/realpath would fold
@@ -196,6 +295,8 @@ def save_state_dict(state_dict: dict, path: str, process_group=None,
     # absolute) must share the in-flight guard and the round counter; this
     # key is process-local so absolutizing is safe here
     path = os.path.abspath(path)
+    stage = _staging(path)
+    os.makedirs(stage, exist_ok=True)
     rank = jax.process_index()
     nprocs = jax.process_count()
     # an in-flight async save to the same path must finish before ANY new
@@ -218,31 +319,39 @@ def save_state_dict(state_dict: dict, path: str, process_group=None,
         # masquerade as this round's; work() recreates ours after the write.
         # glob.escape: metacharacters in the checkpoint path (step_[1]/)
         # must not silently match nothing and leave stale markers behind
-        for stale in glob.glob(os.path.join(glob.escape(path),
+        for stale in glob.glob(os.path.join(glob.escape(stage),
                                             _done_name(rank, "*"))):
             os.remove(stale)
         err_cell = [None]
 
         def work():
             try:
-                _write_rank_files(path, rank, meta, payload)
-                mine = os.path.join(path, _done_name(rank, seq))
+                _write_rank_files(stage, rank, meta, payload)
+                mine = os.path.join(stage, _done_name(rank, seq))
                 with open(mine, "w"):
                     pass
                 if rank == coordinator_rank:
-                    _wait_marker(
-                        lambda: all(os.path.exists(
-                            os.path.join(path, _done_name(r, seq)))
-                            for r in range(nprocs)),
-                        f"all ranks' round-{seq} markers under {path!r}",
-                        async_timeout)
-                    _merge_metadata(path, nprocs, seq=seq)
+                    with watch("ckpt.async_merge_wait", path=path, seq=seq):
+                        _wait_marker(
+                            lambda: all(os.path.exists(
+                                os.path.join(stage, _done_name(r, seq)))
+                                for r in range(nprocs)),
+                            f"all ranks' round-{seq} markers under "
+                            f"{stage!r}", async_timeout)
+                    _merge_metadata(stage, nprocs, seq=seq)
+                    _commit(stage, path)
                 elif nprocs > 1:
-                    # merge consumed my marker => metadata.pkl is published;
-                    # makes .result() mean 'checkpoint readable' on every rank
-                    _wait_marker(lambda: not os.path.exists(mine),
-                                 f"coordinator merge of round {seq} under "
-                                 f"{path!r}", async_timeout)
+                    # merge consumed my marker AND the COMMIT marker exists
+                    # at the final path => the staging dir was renamed into
+                    # place; makes .result() mean 'checkpoint committed and
+                    # readable' on every rank
+                    commit_path = os.path.join(path, COMMIT_MARKER)
+                    with watch("ckpt.async_commit_wait", path=path, seq=seq):
+                        _wait_marker(
+                            lambda: (not os.path.exists(mine)
+                                     and os.path.isfile(commit_path)),
+                            f"coordinator commit of round {seq} at "
+                            f"{path!r}", async_timeout)
             except BaseException as e:  # noqa: BLE001
                 err_cell[0] = e
 
@@ -254,10 +363,11 @@ def save_state_dict(state_dict: dict, path: str, process_group=None,
         _INFLIGHT[path] = handle
         t.start()
         return handle
-    _write_rank_files(path, rank, meta, payload)
+    _write_rank_files(stage, rank, meta, payload)
     _barrier(f"ckpt_save_shards:{tag}")
     if rank == coordinator_rank:
-        _merge_metadata(path, nprocs)
+        _merge_metadata(stage, nprocs)
+        _commit(stage, path)
     _barrier(f"ckpt_save_meta:{tag}")
 
 
@@ -277,16 +387,48 @@ def _overlap(dst_off, dst_shape, src_off, src_shape):
 def load_state_dict(state_dict: dict, path: str, process_group=None,
                     coordinator_rank: int = 0) -> dict:
     """Fill ``state_dict``'s arrays (templates carrying target sharding) from
-    a checkpoint saved under any topology; returns the new dict."""
-    with open(os.path.join(path, "metadata.pkl"), "rb") as f:
+    a checkpoint saved under any topology; returns the new dict. Every shard
+    read is verified against the SHA-256 recorded at save time; a mismatch
+    (bit flip, torn write) raises :class:`CheckpointCorruptionError` naming
+    the shard."""
+    meta_path = os.path.join(path, "metadata.pkl")
+    if not os.path.isfile(meta_path):
+        raise CheckpointCorruptionError(
+            f"checkpoint at {path!r} has no metadata.pkl — it is torn or "
+            f"was never committed (a crash mid-save leaves a '*.tmp' "
+            f"staging dir; resume from the newest COMMITTED checkpoint, "
+            f"see RESILIENCE.md)")
+    with open(meta_path, "rb") as f:
         meta: Metadata = pickle.load(f)
+    checksums: dict = getattr(meta, "checksums", None) or {}
+    verified: set = set()
     # lazy-load shard files
     files: dict[str, np.lib.npyio.NpzFile] = {}
 
     def get_payload(fname, key, offset):
-        if fname not in files:
-            files[fname] = np.load(os.path.join(path, fname))
-        return files[fname][f"{key}|{','.join(map(str, offset))}"]
+        pk = f"{key}|{','.join(map(str, offset))}"
+        import zipfile
+        try:
+            if fname not in files:
+                files[fname] = np.load(os.path.join(path, fname))
+            data = files[fname][pk]
+        except (OSError, KeyError, ValueError, zipfile.BadZipFile) as e:
+            # zipfile CRC errors / truncated archives / missing entries —
+            # the shard file itself is damaged
+            raise CheckpointCorruptionError(
+                f"checkpoint shard {pk!r} in {fname!r} under {path!r} is "
+                f"unreadable ({type(e).__name__}: {e})") from e
+        want = checksums.get(pk)
+        if want is not None and pk not in verified:
+            got = _checksum(data)
+            if got != want:
+                raise CheckpointCorruptionError(
+                    f"checkpoint shard {pk!r} in {fname!r} under {path!r} "
+                    f"failed checksum verification (recorded sha256 "
+                    f"{want[:16]}…, got {got[:16]}…) — the file was "
+                    f"corrupted after it was written")
+            verified.add(pk)
+        return data
 
     out = {}
     for key, target in state_dict.items():

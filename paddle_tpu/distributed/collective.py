@@ -189,7 +189,13 @@ def barrier(group=None):
     try:
         return lax.psum(jnp.ones(()), _axis(group))
     except NameError:
-        jax.effects_barrier()
+        # eager host-blocking path: watchdog-escalated (a peer that died
+        # leaves this parked forever) and a named fault-injection site
+        from . import fault
+        from .watchdog import watch
+        fault.trip("collective.barrier")
+        with watch("collective.barrier", group=str(group)):
+            jax.effects_barrier()
         return None
 
 

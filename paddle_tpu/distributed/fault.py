@@ -1,0 +1,226 @@
+"""Deterministic fault-injection harness for chaos-testing the runtime.
+
+Production fault paths (torn checkpoints, hung collectives, preempted or
+SIGKILLed ranks) are untestable if faults fire at random. A
+:class:`FaultPlan` is a list of :class:`FaultSpec` entries keyed by
+``(rank, step, site)`` — a fault fires iff the process's rank, the current
+training step, and the named code site all match, so a chaos test replays
+the exact same failure every run. Probabilistic specs draw from a hash of
+``(seed, rank, step, site)``, never from wall-clock entropy, so even
+"random" chaos is reproducible.
+
+Named sites wired into the runtime (see RESILIENCE.md):
+
+- ``train.step``       — tripped by training loops that opt in
+  (``fault.trip("train.step")`` once per step, after ``fault.set_step(i)``)
+- ``ckpt.write_shard`` — inside the per-rank shard write (ctx: ``path`` of
+  the npz just written, so ``torn``/``corrupt`` can damage it)
+- ``ckpt.commit``      — in the coordinator immediately before the staging
+  dir is renamed into place
+- ``ckpt.barrier``     — the cross-rank checkpoint barrier
+- ``collective.barrier`` — the eager collective barrier
+
+Actions: ``hang`` (sleep ``arg`` seconds — trips the comm watchdog),
+``kill`` (SIGKILL self: the un-catchable death), ``exit`` (``os._exit(arg)``),
+``raise`` (raise :class:`FaultInjected`), ``torn`` (truncate the file in
+``ctx['path']`` to half its size — a torn write), ``corrupt`` (flip one
+byte mid-file).
+
+Activation: programmatically via :func:`activate`, or across process
+boundaries via the ``PADDLE_FAULT_PLAN`` env var holding
+``FaultPlan.to_json()`` — the launcher's workers inherit it, which is how
+a chaos test arms a fault inside a gang it spawns.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import signal
+import threading
+import time
+from dataclasses import asdict, dataclass
+
+__all__ = ["FaultSpec", "FaultPlan", "FaultInjected", "activate",
+           "deactivate", "active_plan", "trip", "set_step", "current_step"]
+
+ENV_VAR = "PADDLE_FAULT_PLAN"
+
+
+class FaultInjected(RuntimeError):
+    """Raised by the ``raise`` action — a synthetic, identifiable failure."""
+
+
+@dataclass
+class FaultSpec:
+    site: str                  # named code site this spec arms
+    action: str                # hang | kill | exit | raise | torn | corrupt
+    rank: int | None = None    # None = any rank
+    step: int | None = None    # None = any step
+    epoch: int | None = None   # restart epoch (None = any) — lets a plan
+    #                            fire only on the first life of a gang
+    prob: float = 1.0          # <1.0: deterministic hash draw, not random()
+    arg: float | None = None   # hang seconds / exit code
+    once: bool = True          # fire at most once per process
+    nth: int | None = None     # fire on the Nth matching visit (1-based) —
+    #                            targets e.g. "the 4th commit" exactly even
+    #                            when the site runs on a background thread
+    #                            whose step context is ambiguous
+    match: str | None = None   # regex the site's ctx['path'] must contain —
+    #                            pins a fault to ONE file/checkpoint (e.g.
+    #                            r"step_3$") independent of thread timing
+
+    def __post_init__(self):
+        if self.action not in ("hang", "kill", "exit", "raise", "torn",
+                               "corrupt"):
+            raise ValueError(f"unknown fault action {self.action!r}")
+
+
+def _env_int(*names: str) -> int:
+    for n in names:
+        v = os.environ.get(n)
+        if v:
+            return int(v)
+    return 0
+
+
+class FaultPlan:
+    """An armed set of :class:`FaultSpec` entries with deterministic draws."""
+
+    def __init__(self, specs=(), seed: int = 0):
+        self.specs = [s if isinstance(s, FaultSpec) else FaultSpec(**s)
+                      for s in specs]
+        self.seed = int(seed)
+        self._fired: set[int] = set()
+        self._visits: dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    # -- (de)serialization: the env-var transport for launcher-spawned gangs
+    def to_json(self) -> str:
+        return json.dumps({"seed": self.seed,
+                           "specs": [asdict(s) for s in self.specs]})
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        obj = json.loads(text)
+        return cls(obj.get("specs", ()), seed=obj.get("seed", 0))
+
+    # -- matching
+    def _draw(self, spec: FaultSpec, rank: int, step: int | None) -> bool:
+        if spec.prob >= 1.0:
+            return True
+        h = hashlib.sha256(
+            f"{self.seed}:{rank}:{step}:{spec.site}".encode()).digest()
+        return int.from_bytes(h[:8], "big") / 2**64 < spec.prob
+
+    def trip(self, site: str, *, step: int | None = None,
+             rank: int | None = None, **ctx) -> None:
+        if not self.specs:
+            return
+        if rank is None:
+            rank = _env_int("PADDLE_TRAINER_ID", "PROCESS_ID")
+        if step is None:
+            step = current_step()
+        epoch = _env_int("PADDLE_RESTART_EPOCH")
+        for i, spec in enumerate(self.specs):
+            if spec.site != site:
+                continue
+            if spec.rank is not None and spec.rank != rank:
+                continue
+            if spec.step is not None and spec.step != step:
+                continue
+            if spec.epoch is not None and spec.epoch != epoch:
+                continue
+            if spec.match is not None and not re.search(
+                    spec.match, str(ctx.get("path") or "")):
+                continue
+            with self._lock:
+                if spec.once and i in self._fired:
+                    continue
+                visit = self._visits[i] = self._visits.get(i, 0) + 1
+                if spec.nth is not None and visit != spec.nth:
+                    continue
+                if not self._draw(spec, rank, step):
+                    continue
+                self._fired.add(i)
+            self._fire(spec, site, ctx)
+
+    # -- actions
+    def _fire(self, spec: FaultSpec, site: str, ctx: dict) -> None:
+        tag = (f"[fault] {spec.action} @ {site} "
+               f"(rank={spec.rank} step={spec.step})")
+        if spec.action == "hang":
+            time.sleep(float(spec.arg if spec.arg is not None else 3600.0))
+        elif spec.action == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif spec.action == "exit":
+            os._exit(int(spec.arg if spec.arg is not None else 1))
+        elif spec.action == "raise":
+            raise FaultInjected(tag)
+        elif spec.action in ("torn", "corrupt"):
+            path = ctx.get("path")
+            if not path or not os.path.exists(path):
+                raise FaultInjected(f"{tag}: site passed no file to damage")
+            size = os.path.getsize(path)
+            if spec.action == "torn":
+                with open(path, "r+b") as f:
+                    f.truncate(max(1, size // 2))
+            else:
+                with open(path, "r+b") as f:
+                    f.seek(size // 2)
+                    b = f.read(1)
+                    f.seek(size // 2)
+                    f.write(bytes([b[0] ^ 0xFF]))
+
+
+# --- process-global plan + step cursor ------------------------------------
+
+_active: list[FaultPlan | None] = [None]
+_env_checked = [False]
+# process-global, NOT thread-local: checkpoint writer threads must see the
+# training loop's step cursor (a bg thread has no step context of its own)
+_step: list[int | None] = [None]
+
+
+def activate(plan: FaultPlan) -> FaultPlan:
+    _active[0] = plan
+    _env_checked[0] = True  # explicit plan overrides the env transport
+    return plan
+
+
+def deactivate() -> None:
+    _active[0] = None
+    _env_checked[0] = True
+
+
+def active_plan() -> FaultPlan | None:
+    if _active[0] is None and not _env_checked[0]:
+        _env_checked[0] = True
+        raw = os.environ.get(ENV_VAR)
+        if raw:
+            _active[0] = FaultPlan.from_json(raw)
+    return _active[0]
+
+
+def set_step(step: int) -> None:
+    """Advance the harness's step cursor (training loops call this once per
+    step so sites deep in library code — shard writes, barriers — can match
+    ``step``-keyed specs without threading the step through every call).
+    Background writer threads read the cursor too, which makes step-keyed
+    specs racy against async saves — key those on ``nth`` instead."""
+    _step[0] = int(step)
+
+
+def current_step() -> int | None:
+    return _step[0]
+
+
+def trip(site: str, *, step: int | None = None, rank: int | None = None,
+         **ctx) -> None:
+    """Library hook: fire any armed fault matching this site. No-op (one
+    attribute read) when no plan is active — safe on hot-ish paths."""
+    plan = active_plan()
+    if plan is not None:
+        plan.trip(site, step=step, rank=rank, **ctx)

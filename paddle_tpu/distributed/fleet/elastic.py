@@ -41,15 +41,38 @@ class ElasticManager:
     def is_restart(self) -> bool:
         return self.restart_epoch > 0
 
-    def latest_checkpoint(self) -> str | None:
-        """Newest step-numbered checkpoint under checkpoint_dir (files or
-        dirs named ``step_<n>`` / ``<n>`` / ``*-<n>``), or None."""
+    def latest_checkpoint(self, gc_torn: bool = False) -> str | None:
+        """Newest step-numbered COMMITTED checkpoint under checkpoint_dir
+        (files or dirs named ``step_<n>`` / ``<n>`` / ``*-<n>``), or None.
+
+        A resume must never come from a torn save, so entries are filtered
+        through the checkpoint commit protocol (RESILIENCE.md): ``*.tmp``
+        staging dirs and directories without a ``COMMIT`` marker /
+        ``metadata.pkl`` are skipped — this is what makes a crash mid-save
+        recoverable instead of poisoning the restart. Incidental
+        digit-bearing files (logs, loss traces) are skipped the same way.
+        With ``gc_torn=True`` leftover ``*.tmp`` staging dirs are deleted
+        while scanning (safe on the restart path: any in-flight save died
+        with the previous incarnation of this gang)."""
+        from ..checkpoint.save_load import is_committed
         d = self.checkpoint_dir
         if not d or not os.path.isdir(d):
             return None
         best, best_n = None, -1
         for name in os.listdir(d):
-            m = re.search(r"(\d+)", name)
-            if m and int(m.group(1)) > best_n:
-                best, best_n = os.path.join(d, name), int(m.group(1))
+            full = os.path.join(d, name)
+            if name.endswith(".tmp"):  # torn staging, never a candidate
+                if gc_torn and os.path.isdir(full):
+                    import shutil
+                    shutil.rmtree(full, ignore_errors=True)
+                continue
+            # the step number must be a separator-delimited FINAL component
+            # (one extension allowed), so "loss_e12.txt" / "run3_log" don't
+            # outrank real checkpoints
+            m = re.search(r"(?:^|[-_.])(\d+)(?:\.[A-Za-z0-9]+)?$", name)
+            if not m or int(m.group(1)) <= best_n:
+                continue
+            if not is_committed(full):
+                continue
+            best, best_n = full, int(m.group(1))
         return best

@@ -13,7 +13,27 @@ import subprocess
 import sys
 import time
 
-__all__ = ["launch", "main"]
+from ..fleet.preempt import EXIT_PREEMPTED
+from ..watchdog import EXIT_WATCHDOG_ABORT
+
+__all__ = ["launch", "main", "classify_exit"]
+
+
+def classify_exit(rc: int) -> str:
+    """Exit-code contract (RESILIENCE.md): map a worker's return code to a
+    failure class the restart policy and the logs can reason about."""
+    if rc == 0:
+        return "clean"
+    if rc == EXIT_WATCHDOG_ABORT:
+        return "watchdog-abort"
+    if rc == EXIT_PREEMPTED:
+        return "preempted"
+    if rc < 0:
+        try:
+            return f"killed-by-{signal.Signals(-rc).name}"
+        except ValueError:
+            return f"killed-by-signal-{-rc}"
+    return "crash"
 
 
 def _spawn_gang(args, n, restart_epoch, log_files):
@@ -59,6 +79,15 @@ def launch(argv=None):
     parser.add_argument("--max_restarts", type=int, default=0,
                         help="elastic: gang-restart the job up to this many "
                              "times when a worker dies (0 = fail fast)")
+    parser.add_argument("--restart_backoff", type=float, default=0.5,
+                        help="elastic: base seconds slept before a gang "
+                             "restart; doubles each restart (capped at "
+                             "30s) so a crash-looping job does not spin")
+    parser.add_argument("--grace_period", type=float, default=10.0,
+                        help="seconds workers get between SIGTERM (forwarded "
+                             "on launcher shutdown/preemption) and SIGKILL — "
+                             "the window for draining async saves and taking "
+                             "a final checkpoint")
     parser.add_argument("--auto_tuner_json", default=None,
                         help="parity: launch --auto_tuner_json — a JSON "
                              "model spec; the planner picks dp/fsdp/mp/pp "
@@ -93,10 +122,12 @@ def launch(argv=None):
     procs = _spawn_gang(args, n, restart_epoch, log_files)
 
     def _kill_all(*_):
+        # forward SIGTERM (the preemption shape workers' PreemptionGuard
+        # listens for), give them the grace window, then SIGKILL stragglers
         for p in procs:
             if p.poll() is None:
                 p.terminate()
-        deadline = time.time() + 10
+        deadline = time.time() + args.grace_period
         for p in procs:
             try:
                 p.wait(timeout=max(0.1, deadline - time.time()))
@@ -106,7 +137,8 @@ def launch(argv=None):
     shutting_down = [False]
 
     def _on_sigterm(*_):
-        # graceful shutdown (preemption): do NOT treat the resulting worker
+        # graceful shutdown (preemption): forward to workers so they can
+        # drain saves + final-checkpoint; do NOT treat the resulting worker
         # exits as failures needing an elastic restart
         shutting_down[0] = True
         _kill_all()
@@ -129,9 +161,15 @@ def launch(argv=None):
                 procs.clear()
                 if restart_epoch < args.max_restarts:
                     restart_epoch += 1
-                    print(f"[elastic] worker failure (rc={code}); gang "
-                          f"restart {restart_epoch}/{args.max_restarts}",
-                          file=sys.stderr)
+                    # exponential backoff: an immediately-fatal config would
+                    # otherwise burn every restart within a second
+                    delay = min(args.restart_backoff
+                                * (2 ** (restart_epoch - 1)), 30.0)
+                    print(f"[elastic] worker failure (rc={code}, "
+                          f"{classify_exit(code)}); gang restart "
+                          f"{restart_epoch}/{args.max_restarts} "
+                          f"in {delay:.1f}s", file=sys.stderr)
+                    time.sleep(delay)
                     code = 0
                     procs = _spawn_gang(args, n, restart_epoch, log_files)
             time.sleep(0.2)

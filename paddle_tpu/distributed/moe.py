@@ -122,15 +122,18 @@ def _top2_parts(logits, capacity, *, second_policy="random", key=None,
                 balance_loss_weight=1.0):
     """GShard top-2 gating core. logits: [tokens, E]. Returns the routing
     decision pieces shared by the dense (one-hot) and sparse (sorted/ragged)
-    dispatch builders so the two paths can never diverge on gating rules:
+    dispatch builders so the two paths share one set of gating rules:
     (g1_idx, g2_idx, w1, w2, keep1, keep2f, p1, p2, aux) — w1/w2 are already
     zeroed for capacity-dropped slots and renormalized over kept experts.
 
-    Two implementations with identical decisions: the fused Pallas kernel
-    (ops/pallas/moe_routing.py — one pass + analytic VJP; the top sink
-    named by PROFILE_qwen2_moe.md) and the XLA chain below. The random
+    Two implementations, identical up to float tie-breaks: the fused Pallas
+    kernel (ops/pallas/moe_routing.py — one pass + analytic VJP; the top
+    sink named by PROFILE_qwen2_moe.md) and the XLA chain below. The random
     second-expert keep draws its uniforms OUTSIDE both paths from the same
-    key, so routing cannot diverge between them."""
+    key, so the compared randomness is shared — but each path computes its
+    OWN softmax, and argmax ties or keep2 threshold comparisons that land
+    exactly on differently-rounded probabilities can resolve differently
+    between the two."""
     T, E = logits.shape
     if second_policy == "random":
         k = key if key is not None else rng.next_key()
@@ -577,11 +580,7 @@ class MoELayer(Layer):
         from functools import partial
 
         from jax.sharding import PartitionSpec as P
-        try:
-            from jax import shard_map
-        except ImportError:  # older jax
-            from jax.experimental.shard_map import shard_map
-
+        from ..core.compat import shard_map
         from ..core import mesh as mesh_lib
 
         mesh = mesh_lib.current_mesh()

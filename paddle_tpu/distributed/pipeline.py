@@ -34,8 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
-
+from ..core.compat import shard_map
 from ..core import mesh as mesh_lib
 from ..nn.module import Layer, functional_call
 

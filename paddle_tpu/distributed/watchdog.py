@@ -16,15 +16,28 @@ behavior).
 from __future__ import annotations
 
 import contextlib
+import json
 import logging
 import os
 import threading
 import time
 from dataclasses import dataclass, field
 
-__all__ = ["CommWatchdog", "default_watchdog", "watch"]
+__all__ = ["CommWatchdog", "default_watchdog", "watch",
+           "EXIT_WATCHDOG_ABORT"]
 
 logger = logging.getLogger("paddle_tpu.watchdog")
+
+# Exit-code contract (RESILIENCE.md): the launcher classifies worker deaths
+# by code — 17 means the comm watchdog aborted a hung collective, which is
+# always worth a gang restart (the deadlock is collective; only killing the
+# whole gang recovers).
+EXIT_WATCHDOG_ABORT = 17
+
+
+def _rank() -> str:
+    return (os.environ.get("PADDLE_TRAINER_ID")
+            or os.environ.get("PROCESS_ID", "0"))
 
 
 @dataclass
@@ -47,12 +60,15 @@ class CommWatchdog:
     """
 
     def __init__(self, timeout: float = 300.0, action: str = "log",
-                 poll_interval: float = 0.05):
+                 poll_interval: float = 0.05, diagnosis_dir: str | None = None,
+                 max_records: int = 1024):
         if action not in ("log", "raise", "kill"):
             raise ValueError(action)
         self.timeout = timeout
         self.action = action
         self.poll_interval = poll_interval
+        self.diagnosis_dir = diagnosis_dir
+        self.max_records = max_records
         self.records: list[_TaskRecord] = []
         self._lock = threading.Lock()
 
@@ -61,6 +77,11 @@ class CommWatchdog:
         rec = _TaskRecord(name=name, started=time.monotonic(), meta=meta)
         with self._lock:
             self.records.append(rec)
+            # watched calls run on hot-ish paths (barriers every step):
+            # bound the record list, but never drop timed-out evidence
+            if len(self.records) > self.max_records:
+                self.records = ([r for r in self.records if r.timed_out]
+                                + self.records[-(self.max_records // 2):])
         done = threading.Event()
 
         def monitor():
@@ -68,13 +89,21 @@ class CommWatchdog:
                 rec.timed_out = True
                 msg = (f"[comm watchdog] task {name!r} exceeded "
                        f"{self.timeout:.1f}s "
-                       f"(rank={os.environ.get('PROCESS_ID', '0')}, "
+                       f"(rank={_rank()}, "
                        f"meta={meta}) — possible hung collective")
                 logger.error(msg)
                 if self.action == "kill":
-                    logger.error("[comm watchdog] aborting process for "
-                                 "gang restart")
-                    os._exit(17)
+                    # the post-mortem must be on disk BEFORE os._exit —
+                    # nothing survives the abort otherwise
+                    try:
+                        dump = self.dump_diagnosis()
+                        logger.error("[comm watchdog] diagnosis written to "
+                                     "%s; aborting process for gang restart",
+                                     dump)
+                    except Exception:  # noqa: BLE001 — abort regardless
+                        logger.exception("[comm watchdog] diagnosis dump "
+                                         "failed; aborting anyway")
+                    os._exit(EXIT_WATCHDOG_ABORT)
 
         t = threading.Thread(target=monitor, daemon=True)
         t.start()
@@ -92,6 +121,40 @@ class CommWatchdog:
     def timed_out_tasks(self):
         with self._lock:
             return [r for r in self.records if r.timed_out]
+
+    def dump_diagnosis(self, path: str | None = None) -> str:
+        """Write a rank-annotated JSON post-mortem of every recorded task
+        (hung ones flagged) and return its path. Used by the ``kill``
+        action right before ``os._exit`` so the abort leaves evidence; also
+        callable from signal handlers / debuggers. Destination:
+        ``path`` arg > ``diagnosis_dir`` > ``$PADDLE_WATCHDOG_DIR`` > cwd."""
+        rank = _rank()
+        d = (path or self.diagnosis_dir
+             or os.environ.get("PADDLE_WATCHDOG_DIR") or ".")
+        os.makedirs(d, exist_ok=True)
+        out = os.path.join(d, f"watchdog_diagnosis.rank{rank}.json")
+        now = time.monotonic()
+        with self._lock:
+            payload = {
+                "rank": int(rank) if rank.isdigit() else rank,
+                "timeout_s": self.timeout,
+                "action": self.action,
+                "tasks": [{
+                    "name": r.name,
+                    "meta": {k: repr(v) for k, v in r.meta.items()},
+                    "timed_out": r.timed_out,
+                    "finished": r.finished,
+                    "elapsed_s": round(
+                        r.elapsed if r.finished else now - r.started, 3),
+                } for r in self.records],
+            }
+        tmp = out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, out)
+        return out
 
 
 _default: list[CommWatchdog | None] = [None]

@@ -137,6 +137,11 @@ class TrainStep:
         return loss, aux, grads, new_buffers
 
     def __call__(self, *batch):
+        # fault-injection site: advance the harness's step cursor and give
+        # chaos tests a per-step hook (no-op unless a FaultPlan is armed)
+        from ..distributed import fault
+        fault.set_step(self._host_step)
+        fault.trip("train.step")
         # grad hooks are baked into the traced program; retrace when the
         # registry changed after compilation
         from ..autograd import param_grad_hooks_version

@@ -91,7 +91,8 @@ def _in_manual_trace() -> bool:
     point (pipeline, sequence parallel, user code) is covered without
     per-call-site flags."""
     try:
-        am = jax.sharding.get_abstract_mesh()
+        from ...core.compat import get_abstract_mesh
+        am = get_abstract_mesh()
         return any("Manual" in str(t) for t in getattr(am, "axis_types", ()))
     except Exception:
         return False
@@ -106,11 +107,17 @@ def _flash_sharded_fn(mesh, batch_axes, head_axes, is_causal, mask_mode,
     ``mask_mode``: None (no mask) or a (batch_sharded, head_sharded) bool
     pair describing which mask dims follow q's sharding (size-1 dims stay
     replicated). With ``dropout_p`` > 0 the call takes a (2,) int32
-    (seed, offset) array, replicated; each shard folds its linear mesh
-    position into the offset so the in-kernel PRNG streams are distinct
-    across shards (the five-tuple already separates heads/blocks *within*
-    a shard, but local indices restart at 0 on every shard)."""
-    from jax import shard_map
+    (seed, offset) array, replicated; each shard adds its linear mesh
+    position times a Weyl stride (0x9E3779B1, coprime to 2**32) to the
+    offset word so the in-kernel PRNG streams are distinct across shards
+    (the five-tuple already separates heads/blocks *within* a shard, but
+    local indices restart at 0 on every shard). Offset-space consumption:
+    shard ``i`` draws from the coset ``user_offset + i*0x9E3779B1 (mod
+    2**32)``, so consecutive user offsets (the per-step/per-layer
+    increment pattern) never collide with another shard's stream — unlike
+    a plain ``offset + i`` fold, where user offsets closer together than
+    the shard count would overlap a neighbour shard's stream."""
+    from ...core.compat import shard_map
     from jax.sharding import PartitionSpec as P
     from ...ops.pallas.flash_attention import flash_attention as _fa
     spec = P(batch_axes or None, None, head_axes or None, None)
@@ -135,7 +142,10 @@ def _flash_sharded_fn(mesh, batch_axes, head_axes, is_causal, mask_mode,
             idx = jnp.int32(0)
             for a, size in zip((*batch_axes, *head_axes), shard_sizes):
                 idx = idx * size + jax.lax.axis_index(a)
-            seed = seed.at[1].add(idx)
+            # Weyl stride (0x9E3779B1 as int32; int32 mul wraps mod 2**32):
+            # decorrelates per-shard streams without eating the low offset
+            # range — see the docstring for the offset-space contract
+            seed = seed.at[1].add(idx * jnp.int32(-1640531535))
         return _fa(q, k, v, causal=is_causal, attn_mask=m,
                    dropout_p=dropout_p, fixed_seed_offset=seed)
 

@@ -82,9 +82,19 @@ class Initializer:
     def __call__(self, shape, dtype="float32") -> jax.Array:
         raise NotImplementedError
 
+    def _lazy_struct(self, shape, dtype) -> jax.ShapeDtypeStruct:
+        """Abstract stand-in returned under LazyGuard. Overridden where the
+        concrete output would differ from the request (Assign reports the
+        stored value's shape)."""
+        return jax.ShapeDtypeStruct(
+            tuple(int(s) for s in shape),
+            canonical_dtype(dtype) or jnp.dtype(dtype))
+
     def __init_subclass__(cls, **kw):
         """Wrap every subclass ``__call__`` with the LazyGuard short-circuit
-        (one hook instead of a check in each of the ~12 initializers)."""
+        (one hook instead of a check in each of the ~12 initializers).
+        Extra positional/keyword arguments of user subclasses pass through
+        untouched on the concrete path."""
         super().__init_subclass__(**kw)
         orig = cls.__dict__.get("__call__")
         if orig is None:
@@ -93,12 +103,10 @@ class Initializer:
         import functools
 
         @functools.wraps(orig)
-        def wrapper(self, shape, dtype="float32", _orig=orig):
+        def wrapper(self, shape, dtype="float32", *args, _orig=orig, **kwargs):
             if lazy_init_active():
-                return jax.ShapeDtypeStruct(
-                    tuple(int(s) for s in shape),
-                    canonical_dtype(dtype) or jnp.dtype(dtype))
-            return _orig(self, shape, dtype)
+                return self._lazy_struct(shape, dtype)
+            return _orig(self, shape, dtype, *args, **kwargs)
 
         cls.__call__ = wrapper
 
@@ -192,6 +200,18 @@ class KaimingUniform(Initializer):
 class Assign(Initializer):
     def __init__(self, value):
         self.value = value
+
+    def _lazy_struct(self, shape, dtype):
+        # under lazy build the abstract param must mirror what the concrete
+        # build would produce: the STORED value's shape (validated against
+        # the request exactly like __call__) and the canonical dtype
+        arr = np.asarray(self.value)
+        if shape is not None and tuple(arr.shape) != tuple(shape):
+            raise ValueError(
+                f"Assign initializer shape {arr.shape} != {tuple(shape)}")
+        d = canonical_dtype(dtype)
+        return jax.ShapeDtypeStruct(tuple(arr.shape),
+                                    d if d is not None else arr.dtype)
 
     def __call__(self, shape, dtype="float32"):
         arr = jnp.asarray(self.value, canonical_dtype(dtype))

@@ -20,3 +20,13 @@ import jax  # noqa: E402
 
 if not _REAL_CHIP:
     jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 run "
+        "(multi-second multiprocess gangs, big models)")
+    config.addinivalue_line(
+        "markers", "faults: deterministic fault-injection (chaos) tests — "
+        "kill/restart/torn-checkpoint scenarios driven by "
+        "paddle_tpu.distributed.fault")

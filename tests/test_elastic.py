@@ -79,9 +79,10 @@ def test_elastic_exhausted_restarts_fails(tmp_path):
 
 def test_elastic_manager_checkpoint_discovery(tmp_path):
     from paddle_tpu.distributed.fleet.elastic import ElasticManager
-    (tmp_path / "step_10").mkdir()
-    (tmp_path / "step_200").mkdir()
-    (tmp_path / "step_30").mkdir()
+    for name in ("step_10", "step_200", "step_30"):
+        (tmp_path / name).mkdir()
+        # discovery only returns COMMITTED checkpoints
+        (tmp_path / name / "COMMIT").write_text("")
     em = ElasticManager(checkpoint_dir=str(tmp_path))
     assert em.latest_checkpoint().endswith("step_200")
     assert not em.is_restart

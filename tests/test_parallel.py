@@ -261,7 +261,7 @@ def test_moe_capacity_drops_tokens():
 
 def test_collectives_inside_shard_map(sep_mesh):
     from paddle_tpu import distributed as dist
-    from jax import shard_map
+    from paddle_tpu.core.compat import shard_map
 
     x = jnp.arange(8.0)
 
@@ -311,7 +311,7 @@ def test_global_scatter_gather_roundtrip(sep_mesh):
     """Explicit EP all-to-all dispatch (parity: moe_utils.py
     global_scatter/global_gather): tokens routed to expert ranks, processed,
     and returned must equal applying each expert directly."""
-    from jax import shard_map
+    from paddle_tpu.core.compat import shard_map
     from jax.sharding import PartitionSpec as P
     from paddle_tpu.distributed.moe import global_gather, global_scatter
     mesh = mesh_lib.current_mesh()
@@ -418,7 +418,8 @@ def test_current_mesh_inside_jit_under_set_mesh():
         seen["quiet"] = no_mesh_active()
         return x * 2
 
-    with jax.sharding.set_mesh(mesh):
+    from paddle_tpu.core.compat import set_mesh
+    with set_mesh(mesh):
         out = fwd(jnp.ones((4, 4)))
     assert seen["shape"] == {"dp": 2, "mp": 4}
     assert seen["quiet"] is False
@@ -435,7 +436,8 @@ def test_moe_sorted_dispatch_jitted_under_set_mesh():
     x = jnp.asarray(RNG.standard_normal((8, 16)), jnp.float32)
     mesh = mesh_lib.make_mesh({"dp": 2, "mp": 4})
 
+    from paddle_tpu.core.compat import set_mesh
     fwd = jax.jit(lambda t: layer(t))
-    with jax.sharding.set_mesh(mesh):
+    with set_mesh(mesh):
         out = fwd(x)
     assert np.isfinite(np.asarray(out)).all()
