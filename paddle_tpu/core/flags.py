@@ -121,13 +121,6 @@ define_flag("flash_batch_axes", "dp",
 define_flag("flash_head_axes", "mp",
             "Comma-separated mesh axis names the flash SPMD rule shards the "
             "HEADS dim over (see flash_batch_axes).")
-define_flag("moe_fused_routing", False,
-            "Route MoE top-2 gating through the fused Pallas routing "
-            "kernel (single-device / manual-shard_map shapes). Default "
-            "False: measured NEUTRAL-to-slightly-negative in situ on v5e "
-            "— the kernel wins 5x in isolation but XLA already hides the "
-            "gating chain behind the expert GEMMs and fuses it with the "
-            "logits matmul (PROFILE_qwen2_moe.md round-5 addendum).")
 define_flag("comm_watchdog_timeout", 300.0,
             "Seconds before the comm watchdog flags a blocking comm/sync "
             "call as hung (parity: FLAGS_enable_async_trace timeout).")

@@ -33,7 +33,7 @@ from ..nn.module import Layer, Parameter
 
 __all__ = ["MoELayer", "TopKGate", "SwitchGate", "GShardGate", "ExpertFFN",
            "moe_dispatch_combine", "moe_ragged_compute", "moe_grouped_compute",
-           "global_scatter", "global_gather"]
+           "moe_fused_compute", "global_scatter", "global_gather"]
 
 
 def global_scatter(x, local_count, global_count, axis: str = "mp"):
@@ -101,50 +101,61 @@ def _fcfs_cumsum(mask, block: int = 512):
     return out.reshape(T, E).astype(mask.dtype)
 
 
-def _fused_routing_ok(T, E) -> bool:
-    """Route ``_top2_parts`` through the fused Pallas kernel when: the
-    flag allows it, shapes fit the kernel's block grid, and we are either
-    meshless or inside a manual shard_map region (local shapes — the
-    all-to-all EP path). Under auto-GSPMD meshes the kernel carries no
-    partitioning rule, so the XLA chain keeps the dense path partitionable."""
-    from ..core import flags
-    if not flags.get_flag("moe_fused_routing"):
-        return False
-    from ..ops.pallas.moe_routing import fused_routing_applicable
-    if not fused_routing_applicable(T, E):
-        return False
+def _kernel_path_ok() -> bool:
+    """Pallas MoE kernels (routing front-end and fused dispatch) carry no
+    GSPMD partitioning rule, so they only run meshless or inside a manual
+    shard_map region (local shapes — the all-to-all EP path). Under
+    auto-GSPMD meshes the XLA chain keeps the dense path partitionable."""
     from .._mesh_gate import no_mesh_active
     from ..nn.functional.attention import _in_manual_trace
     return no_mesh_active() or _in_manual_trace()
 
 
-def _top2_parts(logits, capacity, *, second_policy="random", key=None,
-                balance_loss_weight=1.0):
-    """GShard top-2 gating core. logits: [tokens, E]. Returns the routing
-    decision pieces shared by the dense (one-hot) and sparse (sorted/ragged)
-    dispatch builders so the two paths share one set of gating rules:
-    (g1_idx, g2_idx, w1, w2, keep1, keep2f, p1, p2, aux) — w1/w2 are already
-    zeroed for capacity-dropped slots and renormalized over kept experts.
+def _top2_epilogue(g1, g2, keep1, keep2f):
+    """THE capacity/renormalization contract: combine weights are the raw
+    top-2 probs, zeroed for capacity-dropped copies, renormalized over the
+    kept experts (GShard). Single definition shared by the XLA chain, the
+    fused routing kernel's epilogue (ops/pallas/moe_routing.py) and — via
+    the w arrays the sparse form hands over — the fused dispatch, so the
+    paths cannot drift on what a 'dropped' copy contributes."""
+    denom = jnp.maximum(g1 * keep1 + g2 * keep2f, 1e-9)
+    w1 = jnp.where(keep1, g1, 0.0) / denom
+    w2 = jnp.where(keep2f, g2, 0.0) / denom
+    return w1, w2
 
-    Two implementations, identical up to float tie-breaks: the fused Pallas
-    kernel (ops/pallas/moe_routing.py — one pass + analytic VJP; the top
-    sink named by PROFILE_qwen2_moe.md) and the XLA chain below. The random
-    second-expert keep draws its uniforms OUTSIDE both paths from the same
-    key, so the compared randomness is shared — but each path computes its
-    OWN softmax, and argmax ties or keep2 threshold comparisons that land
-    exactly on differently-rounded probabilities can resolve differently
-    between the two."""
+
+def _top2_parts(logits, capacity, *, second_policy="random", key=None,
+                balance_loss_weight=1.0, impl="xla"):
+    """GShard top-2 gating core. logits: [tokens, E]. Returns the routing
+    decision pieces shared by the dense (one-hot) and sparse (sorted/ragged/
+    fused) dispatch builders so every path shares one set of gating rules:
+    (g1_idx, g2_idx, w1, w2, keep1, keep2f, p1, p2, aux) — w1/w2 are already
+    zeroed for capacity-dropped slots and renormalized over kept experts
+    (the shared ``_top2_epilogue``).
+
+    ``impl`` selects the implementation: "xla" is the dense chain below;
+    "fused" routes through the one-pass Pallas kernel
+    (ops/pallas/moe_routing.py — the fused dispatch's routing front-end),
+    falling back to the XLA chain when shapes or mesh state don't fit.
+    Identical up to float tie-breaks: the random second-expert keep draws
+    its uniforms OUTSIDE both paths from the same key, so the compared
+    randomness is shared — but each path computes its OWN softmax, and
+    argmax ties or keep2 threshold comparisons that land exactly on
+    differently-rounded probabilities can resolve differently between the
+    two."""
     T, E = logits.shape
     if second_policy == "random":
         k = key if key is not None else rng.next_key()
         u = jax.random.uniform(k, (T,))
     else:
         u = None
-    if _fused_routing_ok(T, E):
-        from ..ops.pallas.moe_routing import fused_top2_routing
-        return fused_top2_routing(logits, u, int(capacity),
-                                  second_policy == "random",
-                                  float(balance_loss_weight))
+    if impl == "fused":
+        from ..ops.pallas.moe_routing import (fused_routing_applicable,
+                                              fused_top2_routing)
+        if fused_routing_applicable(T, E) and _kernel_path_ok():
+            return fused_top2_routing(logits, u, int(capacity),
+                                      second_policy == "random",
+                                      float(balance_loss_weight))
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     g1_idx = jnp.argmax(probs, axis=-1)
     g1 = jnp.take_along_axis(probs, g1_idx[:, None], axis=1)[:, 0]
@@ -170,9 +181,7 @@ def _top2_parts(logits, capacity, *, second_policy="random", key=None,
     keep2f = (jnp.sum(pos2 * mask2, axis=1) < capacity) & (jnp.sum(mask2, 1) > 0)
     p1 = jnp.sum(pos1 * mask1, axis=1)
     p2 = jnp.sum(pos2 * mask2, axis=1)
-    denom = jnp.maximum(g1 * keep1 + g2 * keep2f, 1e-9)
-    w1 = jnp.where(keep1, g1, 0.0) / denom
-    w2 = jnp.where(keep2f, g2, 0.0) / denom
+    w1, w2 = _top2_epilogue(g1, g2, keep1, keep2f)
     return g1_idx, g2_idx, w1, w2, keep1, keep2f, p1, p2, aux
 
 
@@ -261,12 +270,13 @@ class TopKGate(Layer):
     def forward(self, x):
         return self._route(self.logits(x), self.capacity(x.shape[0]))
 
-    def forward_sparse(self, x):
+    def forward_sparse(self, x, impl="xla"):
         """Sparse-form routing for the sorted grouped-GEMM dispatch modes:
         (idx, w, pos, keep, aux, capacity) — same logits/capacity as
-        forward."""
+        forward. ``impl="fused"`` asks for the Pallas routing front-end
+        (falls back to the XLA chain when shapes/mesh don't fit)."""
         cap = self.capacity(x.shape[0])
-        return (*self._route_sparse(self.logits(x), cap), cap)
+        return (*self._route_sparse(self.logits(x), cap, impl=impl), cap)
 
     def _route(self, logits, cap):
         """Post-logits routing policy — the single definition used by both
@@ -280,7 +290,7 @@ class TopKGate(Layer):
                             balance_loss_weight=self.balance_loss_weight,
                             second_policy="random" if self.training else "all")
 
-    def _route_sparse(self, logits, cap):
+    def _route_sparse(self, logits, cap, impl="xla"):
         """Same routing decisions as _route, in sparse form for the sorted
         grouped-GEMM paths: (idx, w, pos, keep, aux), each [T, k] — w is
         zero for capacity-dropped slots and pos/keep are the SAME
@@ -295,7 +305,7 @@ class TopKGate(Layer):
                     keep[:, None], aux)
         g1_idx, g2_idx, w1, w2, keep1, keep2f, p1, p2, aux = _top2_parts(
             logits, cap, balance_loss_weight=self.balance_loss_weight,
-            second_policy="random" if self.training else "all")
+            second_policy="random" if self.training else "all", impl=impl)
         return (jnp.stack([g1_idx, g2_idx], axis=1),
                 jnp.stack([w1, w2], axis=1),
                 jnp.stack([p1, p2], axis=1),
@@ -493,6 +503,67 @@ def _slot_structures(idx, pos, keep, E, C):
     return slot, keep_f, fill_copy[:ec], occupied[:ec]
 
 
+def moe_fused_compute(x, idx, w, pos, keep, capacity, w_in, w_gate, w_out,
+                      activation):
+    """Fused grouped-GEMM dispatch (ops/pallas/moe_grouped_gemm.py): same
+    contract as ``moe_grouped_compute`` but WITHOUT the [E, capacity, D]
+    packed buffer on either side of the expert FFN — the Pallas kernel's
+    LHS load gathers token rows by routing index straight from x, and its
+    epilogue gate-weights and scatter-adds straight into the [T, D]
+    combine output (parity: the reference's fusion/cutlass/moe kernels,
+    which consume dispatched tokens directly).
+
+    Routing semantics are byte-identical to the grouped path: the SAME
+    router pos/keep decide slot assignment and drops; the capacity is only
+    PADDED up to the kernel's block size, which widens each expert's slot
+    segment without ever admitting a dropped copy (keep was decided
+    against the real capacity).
+
+    Callers must pre-check :func:`fused_dispatch_applicable`; see
+    ``MoELayer._forward_sorted`` for the fallback policy."""
+    from ..ops.pallas.moe_grouped_gemm import (act_name_of, fused_grouped_moe,
+                                               padded_capacity, slot_maps)
+    T = x.shape[0]
+    K = idx.shape[1]
+    E = w_in.shape[0]
+    cpad = padded_capacity(int(capacity))
+    slot, keep_f, fill_copy, occupied = _slot_structures(idx, pos, keep, E,
+                                                         cpad)
+    row_id, gate_w = slot_maps(slot, fill_copy, occupied, w.reshape(-1),
+                               T, E, cpad, K)
+    return fused_grouped_moe(x, row_id, gate_w, w_in, w_gate, w_out,
+                             act_name_of(activation))
+
+
+def _fused_inbox_ffn(inbox, w_in, w_gate, w_out, activation):
+    """Run an EP inbox [E_local, slots, d] through the fused grouped-GEMM
+    kernel in identity arrangement: each slot row gathers itself (row_id =
+    iota, combine weight 1), so the all-to-all's output feeds the kernel's
+    gather-LHS/scatter-epilogue machinery directly with the per-expert
+    grouped grid intact. The EP transport itself REQUIRES the capacity-
+    packed layout on the wire (see PERF.md), so unlike the local path this
+    removes no buffer — it is the same batched FFN with the kernel's
+    pipelining. Falls back to the einsum FFN when shapes don't fit."""
+    from ..ops.pallas.moe_grouped_gemm import (act_name_of,
+                                               fused_dispatch_applicable,
+                                               fused_grouped_moe,
+                                               padded_capacity)
+    El, S, d = inbox.shape
+    if not fused_dispatch_applicable(El * S, d, w_in.shape[2], El, S,
+                                     inbox.dtype, activation,
+                                     w_gate is not None):
+        return ExpertFFN.apply(inbox, w_in, w_gate, w_out, activation)
+    T = El * S
+    cpad = padded_capacity(S)
+    s_ids = jnp.arange(cpad, dtype=jnp.int32)[None, :]
+    e_ids = jnp.arange(El, dtype=jnp.int32)[:, None]
+    row_id = jnp.where(s_ids < S, e_ids * S + s_ids, T).astype(jnp.int32)
+    gate_w = jnp.broadcast_to((s_ids < S).astype(jnp.float32), (El, cpad))
+    out = fused_grouped_moe(inbox.reshape(T, d), row_id, gate_w,
+                            w_in, w_gate, w_out, act_name_of(activation))
+    return out.reshape(El, S, d)
+
+
 class MoELayer(Layer):
     """Parity: paddle.incubate.distributed.models.moe.MoELayer(:263).
 
@@ -511,13 +582,15 @@ class MoELayer(Layer):
                     "naive": SwitchGate}[gate](d_model, num_experts)
         self.gate = gate
         self.ep_axis = ep_axis
-        if dispatch not in ("einsum", "alltoall", "ragged", "grouped"):
+        if dispatch not in ("einsum", "alltoall", "ragged", "grouped",
+                            "fused"):
             raise ValueError(f"dispatch must be 'einsum', 'alltoall', "
-                             f"'ragged' or 'grouped', got {dispatch!r}")
+                             f"'ragged', 'grouped' or 'fused', got "
+                             f"{dispatch!r}")
         self.dispatch = dispatch
         self.experts = experts if experts is not None else ExpertFFN(
             num_experts, d_model, d_hidden, ep_axis=ep_axis)
-        if dispatch in ("alltoall", "ragged", "grouped") and \
+        if dispatch in ("alltoall", "ragged", "grouped", "fused") and \
                 not isinstance(self.experts, ExpertFFN):
             raise ValueError(f"dispatch={dispatch!r} requires ExpertFFN experts")
         self.register_buffer("aux_loss", jnp.zeros((), jnp.float32),
@@ -528,7 +601,7 @@ class MoELayer(Layer):
         t = x.reshape(-1, shape[-1])
         if self.dispatch == "alltoall":
             out, aux = self._forward_alltoall(t)
-        elif self.dispatch in ("ragged", "grouped"):
+        elif self.dispatch in ("ragged", "grouped", "fused"):
             out, aux = self._forward_sorted(t)
         else:
             dispatch, combine, aux = self.gate(t)
@@ -538,22 +611,41 @@ class MoELayer(Layer):
 
     def _forward_sorted(self, t):
         """Single-device sorted dispatch: 'grouped' = capacity-packed dense
-        batched GEMM with gather-VJP pack/unpack (moe_grouped_compute, the
-        fast path); 'ragged' = jax.lax.ragged_dot over sorted token copies
+        batched GEMM with gather-VJP pack/unpack (moe_grouped_compute);
+        'fused' = the Pallas grouped-GEMM kernel that removes the packed
+        buffer entirely (moe_fused_compute; falls back to 'grouped' —
+        identical semantics — when shapes/dtype/activation don't fit the
+        kernel); 'ragged' = jax.lax.ragged_dot over sorted token copies
         (no capacity padding in the compute, but capacity DROPS still apply
         via zeroed combine weights — identical routing semantics to the
-        einsum oracle). Neither carries a GSPMD partitioning rule, so
-        under a multi-device mesh both fall back to the dense einsum path
-        (GSPMD partitions it; explicit EP uses dispatch='alltoall')."""
+        einsum oracle). None carries a GSPMD partitioning rule, so under a
+        multi-device mesh: 'fused' with the EP axis present hands off to
+        the all-to-all path (whose inbox feeds the fused kernel), and the
+        rest fall back to the dense einsum path (GSPMD partitions it;
+        explicit EP uses dispatch='alltoall')."""
         from ..core import mesh as mesh_lib
         mesh = mesh_lib.current_mesh()
         if mesh is not None and any(s > 1 for s in mesh.shape.values()):
+            if self.dispatch == "fused" and mesh.shape.get(self.ep_axis, 1) > 1:
+                return self._forward_alltoall(t)
             dispatch, combine, aux = self.gate(t)
             return moe_dispatch_combine(t, dispatch, combine, self.experts), aux
-        idx, w, pos, keep, aux, cap = self.gate.forward_sparse(t)
         experts = self.experts
         w_gate = experts.w_gate if experts.gated else None
-        if self.dispatch == "grouped":
+        fused = False
+        if self.dispatch == "fused":
+            from ..ops.pallas.moe_grouped_gemm import fused_dispatch_applicable
+            fused = fused_dispatch_applicable(
+                t.shape[0], t.shape[1], experts.w_in.shape[2],
+                self.gate.num_experts, self.gate.capacity(t.shape[0]),
+                t.dtype, experts.activation, experts.gated)
+        idx, w, pos, keep, aux, cap = self.gate.forward_sparse(
+            t, impl="fused" if fused else "xla")
+        if fused:
+            out = moe_fused_compute(t, idx, w, pos, keep, cap,
+                                    experts.w_in, w_gate, experts.w_out,
+                                    experts.activation)
+        elif self.dispatch in ("grouped", "fused"):
             out = moe_grouped_compute(t, idx, w, pos, keep, cap,
                                       experts.w_in, w_gate, experts.w_out,
                                       experts.activation)
@@ -600,6 +692,7 @@ class MoELayer(Layer):
         gate_layer = self.gate
         experts = self.experts
         w_gate = experts.w_gate if experts.gated else None
+        use_fused = self.dispatch == "fused"
 
         def fn(t_local, gw, w_in, w_out, *rest):
             w_g = rest[0] if rest else None
@@ -607,7 +700,8 @@ class MoELayer(Layer):
             # per-rank capacity packing by GATHER (same machinery as the
             # single-device grouped path — no [T, E, C] one-hot dispatch
             # tensors before/after the all-to-all)
-            idx, w, pos, keep, aux = gate_layer._route_sparse(logits, cap)
+            idx, w, pos, keep, aux = gate_layer._route_sparse(
+                logits, cap, impl="fused" if use_fused else "xla")
             K = idx.shape[1]
             Tl, d = t_local.shape
             slot, keep_f, fill_copy, occupied = _slot_structures(
@@ -615,7 +709,12 @@ class MoELayer(Layer):
             expert_in = _pack_rows(t_local, fill_copy // K, occupied, slot,
                                    keep_f, K).reshape(E, cap, d)
             inbox = global_scatter(expert_in, None, None, axis)
-            out = ExpertFFN.apply(inbox, w_in, w_g, w_out, experts.activation)
+            if use_fused:
+                out = _fused_inbox_ffn(inbox, w_in, w_g, w_out,
+                                       experts.activation)
+            else:
+                out = ExpertFFN.apply(inbox, w_in, w_g, w_out,
+                                      experts.activation)
             back = global_gather(out, None, None, axis)  # [E, cap, d]
             per_copy = _unpack_rows(back.reshape(E * cap, d), slot, keep_f,
                                     fill_copy, occupied)

@@ -51,8 +51,12 @@ class Qwen2MoeConfig:
     fsdp_axis: str | None = "fsdp"
     ep_axis: str | None = "mp"             # expert-weight sharding axis
     # 'grouped' (capacity-packed grouped GEMM, single-device; falls back to
-    # einsum under a mesh) | 'ragged' (dropless ragged_dot) | 'einsum'
-    # (GSPMD dense dispatch) | 'alltoall' (explicit EP)
+    # einsum under a mesh) | 'fused' (Pallas gather/scatter grouped-GEMM
+    # kernel, no [E, C, h] packed buffer; under an EP mesh hands off to the
+    # all-to-all path with the inbox fed through the kernel; falls back to
+    # 'grouped' off-TPU-unfriendly shapes — see PERF.md) | 'ragged'
+    # (dropless ragged_dot) | 'einsum' (GSPMD dense dispatch) | 'alltoall'
+    # (explicit EP)
     ep_dispatch: str = "grouped"
     sep_axis: str | None = None
 

@@ -1,6 +1,12 @@
-"""Fused top-2 MoE routing kernel.
+"""Fused top-2 MoE routing kernel — the routing FRONT-END of the fused
+grouped-GEMM dispatch (``dispatch="fused"``: this kernel decides, then
+ops/pallas/moe_grouped_gemm.py gathers/computes/scatters). Selected via
+``_top2_parts(..., impl="fused")``; there is no standalone flag — the
+round-5 A/B showed the in-situ routing cost is too small (~0.1-0.2 ms) to
+justify an independent switch, so it rides with the dispatch that needs
+its sparse outputs anyway.
 
-PROFILE_qwen2_moe.md names routing/gating as the sparse block's top sink:
+PROFILE_qwen2_moe.md (round 5) named routing/gating as a suspected sink:
 the XLA lowering of ``_top2_parts`` is ~30 small serially-dependent
 kernels over a [T, E] logits tile (softmax, two argmaxes, one-hots,
 position cumsums, renorm) — latency-bound on the VPU, ~1.2 ms forward at
@@ -168,14 +174,15 @@ def _fused_fwd(logits, u, capacity, random_keep2, balance_loss_weight):
     T, E = logits.shape
     g1i, g2i, g1, g2, p1, c2, keep2, count1, me_sum = _run_kernel(
         logits, u, random_keep2)
-    # epilogue: capacity + renorm + aux (a few fused elementwise XLA ops)
+    # epilogue: capacity + renorm + aux (a few fused elementwise XLA ops);
+    # the renorm is the SHARED contract — the XLA chain uses the same
+    # function, so the two implementations cannot drift on drop semantics
+    from ...distributed.moe import _top2_epilogue
     keep1 = p1 < capacity
     claimed2 = keep2 > 0
     p2 = jnp.where(claimed2, c2 + count1[g2i].astype(jnp.int32), 0)
     keep2f = (p2 < capacity) & claimed2
-    denom = jnp.maximum(g1 * keep1 + g2 * keep2f, 1e-9)
-    w1 = jnp.where(keep1, g1, 0.0) / denom
-    w2 = jnp.where(keep2f, g2, 0.0) / denom
+    w1, w2 = _top2_epilogue(g1, g2, keep1, keep2f)
     ce = count1 / T
     aux = jnp.sum((me_sum / T) * ce) * E * balance_loss_weight
     out = (g1i, g2i, w1, w2, keep1, keep2f, p1, p2, aux)

@@ -1,0 +1,66 @@
+"""CI smoke test for the bench driver contract: the LAST stdout line of
+bench.py is a single JSON object ``{"bench_summary": {config: {value,
+mfu, spread}}}`` carrying every default config. Runs bench.py --dry in a
+subprocess — dry mode skips the jax import and all device work, so this
+stays in the fast (-m 'not slow') tier."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parents[1]
+
+_DEFAULT_CONFIGS = {
+    "llama_420m", "resnet50", "bert_base", "qwen2_moe", "lenet_mnist",
+    "llama8b_shape", "llama_decode", "llama_longctx",
+}
+
+
+def _run_dry(*argv):
+    return subprocess.run(
+        [sys.executable, str(_REPO / "bench.py"), "--dry", *argv],
+        capture_output=True, text=True, timeout=120, cwd=_REPO)
+
+
+def test_dry_summary_line_has_all_default_configs():
+    out = _run_dry()
+    assert out.returncode == 0, out.stderr
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    assert lines, "bench.py --dry printed nothing"
+    last = json.loads(lines[-1])
+    summary = last["bench_summary"]
+    assert _DEFAULT_CONFIGS <= set(summary), (
+        f"missing configs: {_DEFAULT_CONFIGS - set(summary)}")
+    for name, cell in summary.items():
+        assert set(cell) >= {"value", "mfu", "spread"}, (name, cell)
+
+
+def test_dry_subset_and_unknown_config():
+    out = _run_dry("qwen2_moe", "qwen2_moe_fused")
+    assert out.returncode == 0, out.stderr
+    last = json.loads(out.stdout.splitlines()[-1])
+    assert set(last["bench_summary"]) == {"qwen2_moe", "qwen2_moe_fused"}
+    bad = _run_dry("not_a_config")
+    assert bad.returncode != 0
+
+
+def test_summary_entry_picks_the_configs_efficiency_ratio():
+    sys.path.insert(0, str(_REPO))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    dense = {"value": 1.0, "extra": {"mfu": 0.5, "spread": 0.01}}
+    moe = {"value": 2.0, "extra": {"mfu_active": 0.3, "spread": 0.02}}
+    decode = {"value": 3.0, "extra": {"batches": {8: {"mbu": 0.7}},
+                                      "spread": 0.03}}
+    err = {"metric": "x", "value": None, "extra": {"error": "boom"}}
+    assert bench._summary_entry(dense) == {
+        "value": 1.0, "mfu": 0.5, "spread": 0.01}
+    assert bench._summary_entry(moe) == {
+        "value": 2.0, "mfu": 0.3, "spread": 0.02}
+    assert bench._summary_entry(decode) == {
+        "value": 3.0, "mfu": 0.7, "spread": 0.03}
+    assert bench._summary_entry(err) == {
+        "value": None, "mfu": None, "spread": None}

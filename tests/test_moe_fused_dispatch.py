@@ -1,0 +1,288 @@
+"""Fused grouped-GEMM MoE dispatch (ops/pallas/moe_grouped_gemm.py,
+``dispatch="fused"``) vs the capacity-packed grouped path: same routing
+decisions by construction (shared ``_top2_parts``), so outputs and
+gradients must agree to fp tolerance — forward and backward, tight and
+padded capacity, capacity-overflow drops, E not dividing T, bf16 and
+fp32, tie-broken routing, and the ep=2 virtual-mesh all-to-all handoff.
+
+Runs the real kernels in Pallas interpret mode on the CPU mesh; every
+test asserts the fused path actually ENGAGES (applicability gate), so a
+regression can't silently pass by falling back to the grouped path.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core import mesh as mesh_lib
+from paddle_tpu.distributed.moe import (MoELayer, TopKGate, _top2_parts,
+                                        moe_fused_compute,
+                                        moe_grouped_compute)
+from paddle_tpu.ops.pallas.moe_grouped_gemm import fused_dispatch_applicable
+
+RNG = np.random.default_rng(20)
+
+
+def _route(T, E, capfac, seed=0):
+    """Deterministic top-2 routing (XLA chain, no second-expert rng) in
+    the sparse form both compute paths consume."""
+    r = np.random.default_rng(seed)
+    logits = jnp.asarray(r.standard_normal((T, E)) * 1.5, jnp.float32)
+    cap = max(4, int(capfac * T * 2 / E))
+    g1, g2, w1, w2, k1, k2, p1, p2, aux = _top2_parts(
+        logits, cap, second_policy="all")
+    return (jnp.stack([g1, g2], 1), jnp.stack([w1, w2], 1),
+            jnp.stack([p1, p2], 1), jnp.stack([k1, k2], 1), cap)
+
+
+def _weights(E, D, H, dtype, seed=0):
+    r = np.random.default_rng(seed)
+    mk = lambda *s: jnp.asarray(r.standard_normal(s) * 0.05, dtype)
+    return mk(E, D, H), mk(E, D, H), mk(E, H, D)
+
+
+def _tols(dtype):
+    # fp32: both paths accumulate in fp32 — 1e-4 is the ISSUE's contract,
+    # observed ~1e-7. bf16: the packed path rounds its GEMM outputs to
+    # bf16 where the kernel keeps fp32 through the epilogue.
+    return dict(rtol=1e-4, atol=1e-5) if dtype == jnp.float32 \
+        else dict(rtol=3e-2, atol=3e-3)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("capfac", [1.0, 1.25])
+@pytest.mark.parametrize("T", [256, 250])  # 250: E does not divide T
+def test_fused_matches_grouped_fwd(dtype, capfac, T):
+    D, H, E = 128, 96, 4
+    idx, w, pos, keep, cap = _route(T, E, capfac)
+    w_in, w_gate, w_out = _weights(E, D, H, dtype)
+    assert fused_dispatch_applicable(T, D, H, E, cap, dtype, F.silu, True)
+    x = jnp.asarray(RNG.standard_normal((T, D)), dtype)
+    got = moe_fused_compute(x, idx, w, pos, keep, cap, w_in, w_gate, w_out,
+                            F.silu)
+    want = moe_grouped_compute(x, idx, w, pos, keep, cap, w_in, w_gate,
+                               w_out, F.silu)
+    assert got.dtype == want.dtype == dtype
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32), **_tols(dtype))
+
+
+@pytest.mark.parametrize("capfac", [1.0, 1.25])
+def test_fused_matches_grouped_grads(capfac):
+    """dX through the scatter-accumulating index map, d(combine weights),
+    and dW through the grouped grid — all against the packed path."""
+    T, D, H, E = 256, 128, 96, 4
+    dtype = jnp.float32
+    idx, w, pos, keep, cap = _route(T, E, capfac, seed=1)
+    w_in, w_gate, w_out = _weights(E, D, H, dtype, seed=1)
+    assert fused_dispatch_applicable(T, D, H, E, cap, dtype, F.silu, True)
+    x = jnp.asarray(RNG.standard_normal((T, D)), dtype)
+    ct = jnp.asarray(RNG.standard_normal((T, D)), dtype)
+
+    def loss(fn, x, w, w_in, w_gate, w_out):
+        return jnp.sum(fn(x, idx, w, pos, keep, cap, w_in, w_gate, w_out,
+                          F.silu) * ct)
+
+    gf = jax.grad(lambda *a: loss(moe_fused_compute, *a),
+                  argnums=(0, 1, 2, 3, 4))(x, w, w_in, w_gate, w_out)
+    gg = jax.grad(lambda *a: loss(moe_grouped_compute, *a),
+                  argnums=(0, 1, 2, 3, 4))(x, w, w_in, w_gate, w_out)
+    for name, a, b in zip(("dx", "dw_combine", "dw_in", "dw_gate", "dw_out"),
+                          gf, gg):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+def test_fused_grads_bf16():
+    T, D, H, E = 256, 128, 64, 4
+    idx, w, pos, keep, cap = _route(T, E, 1.25, seed=2)
+    w_in, w_gate, w_out = _weights(E, D, H, jnp.bfloat16, seed=2)
+    assert fused_dispatch_applicable(T, D, H, E, cap, jnp.bfloat16, F.silu,
+                                     True)
+    x = jnp.asarray(RNG.standard_normal((T, D)), jnp.bfloat16)
+
+    def loss(fn, x, w_in):
+        return jnp.sum((fn(x, idx, w, pos, keep, cap, w_in, w_gate, w_out,
+                           F.silu).astype(jnp.float32)) ** 2)
+
+    gf = jax.grad(lambda *a: loss(moe_fused_compute, *a),
+                  argnums=(0, 1))(x, w_in)
+    gg = jax.grad(lambda *a: loss(moe_grouped_compute, *a),
+                  argnums=(0, 1))(x, w_in)
+    for a, b in zip(gf, gg):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=5e-2, atol=5e-2)
+
+
+def test_overflow_drops_match():
+    """Tight capacity: dropped copies contribute exactly zero on both
+    paths (the fused kernel's sentinel trash-row + zero gate weight must
+    reproduce the packed path's drop semantics bit-for-bit in routing)."""
+    T, D, H, E = 256, 128, 64, 4
+    idx, w, pos, keep, cap = _route(T, E, 0.3, seed=3)
+    assert int(jnp.sum(~keep)) > 0  # overflow actually happened
+    w_in, w_gate, w_out = _weights(E, D, H, jnp.float32, seed=3)
+    assert fused_dispatch_applicable(T, D, H, E, cap, jnp.float32, F.silu,
+                                     True)
+    x = jnp.asarray(RNG.standard_normal((T, D)), jnp.float32)
+    got = moe_fused_compute(x, idx, w, pos, keep, cap, w_in, w_gate, w_out,
+                            F.silu)
+    want = moe_grouped_compute(x, idx, w, pos, keep, cap, w_in, w_gate,
+                               w_out, F.silu)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+    # a fully-dropped token must come out exactly zero from both
+    dead = np.asarray(jnp.sum(keep, 1) == 0)
+    if dead.any():
+        assert np.abs(np.asarray(got)[dead]).max() == 0.0
+
+
+def test_tie_cases_match():
+    """Duplicate tokens and flat logits produce argmax ties and FCFS
+    position contention; both paths must resolve them identically (shared
+    routing) and dispatch identically (this test)."""
+    T, D, H, E = 256, 128, 64, 4
+    r = np.random.default_rng(5)
+    base = r.standard_normal((T // 4, E))
+    logits = jnp.asarray(np.concatenate([base] * 4), jnp.float32)
+    logits = logits.at[:8].set(0.0)  # fully tied rows
+    cap = max(4, int(1.0 * T * 2 / E))
+    g1, g2, w1, w2, k1, k2, p1, p2, _ = _top2_parts(logits, cap,
+                                                    second_policy="all")
+    idx = jnp.stack([g1, g2], 1)
+    w = jnp.stack([w1, w2], 1)
+    pos = jnp.stack([p1, p2], 1)
+    keep = jnp.stack([k1, k2], 1)
+    w_in, w_gate, w_out = _weights(E, D, H, jnp.float32, seed=5)
+    x = jnp.asarray(np.concatenate([r.standard_normal((T // 4, D))] * 4),
+                    jnp.float32)
+    got = moe_fused_compute(x, idx, w, pos, keep, cap, w_in, w_gate, w_out,
+                            F.silu)
+    want = moe_grouped_compute(x, idx, w, pos, keep, cap, w_in, w_gate,
+                               w_out, F.silu)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ungated_relu_fused():
+    """Kernel branch coverage: gated=False + relu activation."""
+    T, D, H, E = 256, 128, 64, 4
+    idx, w, pos, keep, cap = _route(T, E, 1.25, seed=6)
+    w_in, _, w_out = _weights(E, D, H, jnp.float32, seed=6)
+    assert fused_dispatch_applicable(T, D, H, E, cap, jnp.float32, F.relu,
+                                     False)
+    x = jnp.asarray(RNG.standard_normal((T, D)), jnp.float32)
+    args = (x, idx, w, pos, keep, cap, w_in, None, w_out, F.relu)
+    got = moe_fused_compute(*args)
+    want = moe_grouped_compute(*args)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+    gf = jax.grad(lambda x: jnp.sum(moe_fused_compute(
+        x, *args[1:]) ** 2))(x)
+    gg = jax.grad(lambda x: jnp.sum(moe_grouped_compute(
+        x, *args[1:]) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gg),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_layer_falls_back_off_kernel_shapes():
+    """dispatch='fused' with D % 128 != 0 must take the grouped fallback
+    and still match dispatch='grouped' exactly."""
+    assert not fused_dispatch_applicable(64, 96, 32, 4, 32, jnp.float32,
+                                         F.silu, True)
+    outs = []
+    for disp in ("fused", "grouped"):
+        pt.seed(9)
+        layer = MoELayer(96, num_experts=4, d_hidden=32, dispatch=disp)
+        layer.eval()
+        x = jnp.asarray(np.random.default_rng(9).standard_normal((64, 96)),
+                        jnp.float32)
+        outs.append(np.asarray(layer(x)))
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+@pytest.fixture()
+def ep2_mesh():
+    mesh = mesh_lib.make_mesh({"dp": 2, "pp": 1, "fsdp": 1, "sep": 1,
+                               "mp": 2})
+    with mesh_lib.use_mesh(mesh):
+        yield mesh
+
+
+def test_ep2_fused_loss_matches_einsum(ep2_mesh):
+    """dispatch='fused' under an ep=2 mesh hands off to the all-to-all
+    path whose inbox feeds the fused kernel (identity arrangement); the
+    step loss must match the dense GSPMD einsum path. Ample capacity so
+    neither path drops (per-rank vs global overflow picks differ)."""
+    from paddle_tpu.ops.pallas.moe_grouped_gemm import padded_capacity
+
+    def build(disp):
+        pt.seed(12)
+        return MoELayer(d_model=128, num_experts=8, d_hidden=64,
+                        gate=TopKGate(128, 8, top_k=2,
+                                      eval_capacity_factor=16.0),
+                        ep_axis="mp", dispatch=disp)
+
+    moe_e = build("einsum")
+    moe_a = build("alltoall")
+    moe_f = build("fused")
+    moe_a.set_state_dict(moe_e.state_dict())
+    moe_f.set_state_dict(moe_e.state_dict())
+    moe_e.eval(); moe_a.eval(); moe_f.eval()
+    x = jnp.asarray(RNG.standard_normal((4, 8, 128)), jnp.float32)
+    tgt = jnp.asarray(RNG.standard_normal((4, 8, 128)), jnp.float32)
+
+    # the inbox the all-to-all hands the kernel must fit the kernel
+    cap = moe_f.gate.capacity(x.shape[0] * x.shape[1] // 2)
+    El, S = 8 // 2, 2 * cap
+    assert fused_dispatch_applicable(El * S, 128, 64, El, S, jnp.float32,
+                                     F.silu, True)
+    assert padded_capacity(S) >= S
+
+    def step(moe):
+        def loss_fn(v):
+            out = moe(v)
+            return F.mse_loss(out, tgt) + moe.aux_loss, out
+        (l, out), dx = jax.jit(
+            lambda v: jax.value_and_grad(loss_fn, has_aux=True)(v))(x)
+        return float(l), np.asarray(out), np.asarray(dx)
+
+    le, oe, ge = step(moe_e)
+    la, oa, ga = step(moe_a)
+    lf, of, gf = step(moe_f)
+    # vs einsum: outputs/grads agree (the aux term is computed per-rank
+    # and pmean'd on the all-to-all paths vs globally on the dense path —
+    # a documented, legitimate difference, so losses are compared only
+    # between the two all-to-all variants)
+    np.testing.assert_allclose(of, oe, rtol=2e-4, atol=2e-4)
+    # vs alltoall (same routing, same aux semantics): the fused-inbox
+    # handoff must be a drop-in for ExpertFFN.apply, loss and grad alike
+    np.testing.assert_allclose(lf, la, rtol=1e-5)
+    np.testing.assert_allclose(of, oa, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(gf, ga, rtol=1e-3, atol=1e-5)
+
+
+def test_qwen2_moe_fused_dispatch_config():
+    """The model config accepts ep_dispatch='fused' and its loss matches
+    the grouped default (tiny config: kernel falls back — the point is
+    the wiring, the kernel parity is covered above)."""
+    from paddle_tpu.models.qwen2_moe import Qwen2MoeForCausalLM, \
+        qwen2_moe_tiny
+
+    losses = {}
+    for disp in ("fused", "grouped"):
+        cfg = qwen2_moe_tiny(mp_axis=None, fsdp_axis=None, ep_axis=None,
+                             ep_dispatch=disp)
+        pt.seed(0)
+        m = Qwen2MoeForCausalLM(cfg)
+        m.eval()
+        ids = jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (2, 16)), jnp.int32)
+        losses[disp] = float(m.loss(m(ids), ids))
+    np.testing.assert_allclose(losses["fused"], losses["grouped"],
+                               rtol=1e-5)

@@ -170,25 +170,31 @@ def test_fcfs_cumsum_matches_jnp_cumsum():
 
 
 class TestFusedRouting:
-    """Fused Pallas top-2 routing (ops/pallas/moe_routing.py) vs the XLA
-    chain: identical decisions (indices, positions, keeps), matching
-    weights/aux to fp32 tolerance, matching logits-gradients. Runs in
-    interpret mode on CPU; T a multiple of the kernel's 1024-token
+    """Fused Pallas top-2 routing (ops/pallas/moe_routing.py — the fused
+    dispatch's routing front-end, selected via _top2_parts(impl="fused"))
+    vs the XLA chain: identical decisions (indices, positions, keeps),
+    matching weights/aux to fp32 tolerance, matching logits-gradients.
+    Runs in interpret mode on CPU; T a multiple of the kernel's 1024-token
     block triggers the fused path (asserted, not assumed)."""
+
+    @staticmethod
+    def _engages(T, E):
+        from paddle_tpu.distributed.moe import _kernel_path_ok
+        from paddle_tpu.ops.pallas.moe_routing import fused_routing_applicable
+        return fused_routing_applicable(T, E) and _kernel_path_ok()
 
     def _both(self, T=1024, E=16, seed=0, policy="random", cap=None):
         import jax
         import jax.numpy as jnp
-        from paddle_tpu.core.flags import flag_guard
         from paddle_tpu.distributed.moe import _top2_parts
         r = np.random.default_rng(seed)
         logits = jnp.asarray(r.standard_normal((T, E)) * 2, jnp.float32)
         cap = cap if cap is not None else int(1.25 * T * 2 / E)
         key = jax.random.key(7)
-        with flag_guard(moe_fused_routing=True):
-            fused = _top2_parts(logits, cap, second_policy=policy, key=key)
-        with flag_guard(moe_fused_routing=False):
-            ref = _top2_parts(logits, cap, second_policy=policy, key=key)
+        assert self._engages(T, E)  # kernel engages, not vacuous
+        fused = _top2_parts(logits, cap, second_policy=policy, key=key,
+                            impl="fused")
+        ref = _top2_parts(logits, cap, second_policy=policy, key=key)
         return logits, cap, key, fused, ref
 
     @pytest.mark.parametrize("policy", ["random", "all"])
@@ -216,46 +222,39 @@ class TestFusedRouting:
     def test_gradients_match_xla_chain(self):
         import jax
         import jax.numpy as jnp
-        from paddle_tpu.core.flags import flag_guard
         from paddle_tpu.distributed.moe import _top2_parts
         r = np.random.default_rng(1)
         T, E, cap = 1024, 8, 320
         logits = jnp.asarray(r.standard_normal((T, E)), jnp.float32)
         key = jax.random.key(3)
-        from paddle_tpu.distributed.moe import _fused_routing_ok
-        with flag_guard(moe_fused_routing=True):
-            assert _fused_routing_ok(T, E)  # kernel engages, not vacuous
+        assert self._engages(T, E)  # kernel engages, not vacuous
         cw1 = jnp.asarray(r.standard_normal((T,)), jnp.float32)
         cw2 = jnp.asarray(r.standard_normal((T,)), jnp.float32)
 
-        def loss(lg, fused):
-            with flag_guard(moe_fused_routing=fused):
-                out = _top2_parts(lg, cap, second_policy="random", key=key)
+        def loss(lg, impl):
+            out = _top2_parts(lg, cap, second_policy="random", key=key,
+                              impl=impl)
             _, _, w1, w2, _, _, _, _, aux = out
             return jnp.sum(w1 * cw1) + jnp.sum(w2 * cw2) + 3.0 * aux
 
-        g_fused = jax.grad(lambda lg: loss(lg, True))(logits)
-        g_ref = jax.grad(lambda lg: loss(lg, False))(logits)
+        g_fused = jax.grad(lambda lg: loss(lg, "fused"))(logits)
+        g_ref = jax.grad(lambda lg: loss(lg, "xla"))(logits)
         np.testing.assert_allclose(np.asarray(g_fused), np.asarray(g_ref),
                                    rtol=1e-4, atol=1e-6)
 
     def test_moe_layer_parity_fused_vs_xla(self):
-        """End-to-end: grouped MoE layer output identical routing under
-        both implementations (same framework seed)."""
+        """End-to-end: the fused dispatch (whose routing front-end is this
+        kernel) matches the grouped layer routed by the XLA chain (same
+        framework seed). D=128 so the dispatch kernel engages too."""
         import paddle_tpu as pt
         import jax.numpy as jnp
-        from paddle_tpu.core.flags import flag_guard
         from paddle_tpu.distributed.moe import MoELayer
         r = np.random.default_rng(2)
-        x = jnp.asarray(r.standard_normal((1024, 32)), jnp.float32)
-        from paddle_tpu.distributed.moe import _fused_routing_ok
-        with flag_guard(moe_fused_routing=True):
-            assert _fused_routing_ok(1024, 8)
+        x = jnp.asarray(r.standard_normal((1024, 128)), jnp.float32)
+        assert self._engages(1024, 8)
         outs = []
-        for fused in (True, False):
+        for disp in ("fused", "grouped"):
             pt.seed(11)
-            layer = MoELayer(32, num_experts=8, d_hidden=64,
-                             dispatch="grouped")
-            with flag_guard(moe_fused_routing=fused):
-                outs.append(np.asarray(layer(x)))
+            layer = MoELayer(128, num_experts=8, d_hidden=64, dispatch=disp)
+            outs.append(np.asarray(layer(x)))
         np.testing.assert_allclose(outs[0], outs[1], rtol=2e-5, atol=2e-6)
