@@ -47,7 +47,7 @@ def main():
     # prefill + scan-decode programs (no retrace) — the serving pattern
     out2 = model.generate(prompts, max_new_tokens=16)
     assert np.array_equal(np.asarray(out), np.asarray(out2))
-    assert len(model._decode_prog_cache) == 1  # one signature, one entry
+    assert model.decode_cache_stats()["signatures"] == 1  # one entry
 
     # nucleus sampling: seeded, reproducible
     s1 = model.generate(prompts, max_new_tokens=16, do_sample=True,
@@ -63,9 +63,30 @@ def main():
     print("eager-loop  : identical to scan decode")
     # program economy: greedy reuses ONE (prefill, decode) pair across its
     # two calls; the sampled signature adds its own pair; the eager loop
-    # adds its per-token step program
-    print(f"ok: {len(model._decode_prog_cache)} cached signatures "
-          f"served 10 sequences (5 calls x batch 2)")
+    # adds its per-token step program — all visible through the PUBLIC
+    # decode_cache_stats() accessor (never poke private model attributes)
+    stats = model.decode_cache_stats()
+    print(f"ok: {stats['signatures']} cached signatures "
+          f"(capacity {stats['capacity']}) served 10 sequences")
+
+    # --- continuous batching: ragged prompts, one paged KV pool ---------
+    # generate() pads a fixed batch to the longest prompt; the serving
+    # engine (SERVING.md) instead shares a paged pool with iteration-level
+    # scheduling — and its greedy tokens are bitwise identical to
+    # per-request generate()
+    from paddle_tpu.serving import ServingEngine
+    eng = ServingEngine(model, num_pages=64, page_size=4, max_slots=4)
+    ragged = [list(rng.integers(0, cfg.vocab_size, n)) for n in (5, 12, 9)]
+    rids = [eng.add_request(p, max_new_tokens=8) for p in ragged]
+    results = eng.run_to_completion()
+    for p, rid in zip(ragged, rids):
+        ref = np.asarray(model.generate(np.asarray([p]),
+                                        max_new_tokens=8))[0, len(p):]
+        assert results[rid] == ref.tolist()
+    assert eng.decode_program_count() == 1  # churn never retraced decode
+    print("engine      :", results[rids[0]],
+          f"(3 ragged requests, decode stayed 1 program, "
+          f"{eng.metrics.summary()['tokens_generated']} tokens)")
 
 
 if __name__ == "__main__":

@@ -66,6 +66,7 @@ from . import device  # noqa: E402
 from . import geometric  # noqa: E402
 from . import strings  # noqa: E402
 from . import models  # noqa: E402
+from . import serving  # noqa: E402
 from . import onnx  # noqa: E402
 from .hapi import Model  # noqa: E402  (paddle.Model parity)
 from .hapi import callbacks  # noqa: E402  (paddle.callbacks parity)

@@ -172,19 +172,11 @@ def masked_multihead_attention(q, k_new, v_new, cache_k, cache_v, seq_lens,
 
 
 def _grouped_decode_attn(q, kc, vc, seq_lens, scale):
-    """GQA decode core: group the h query heads as [kvh, h/kvh] and attend
-    against the UNREPEATED cache — no h/kvh-times HBM copy of the cache."""
-    b, _, h, d = q.shape
-    kvh = kc.shape[2]
-    S = kc.shape[1]
-    g = h // kvh
-    qg = q[:, 0].reshape(b, kvh, g, d).astype(jnp.float32)
-    s = jnp.einsum("bngd,bsnd->bngs", qg, kc.astype(jnp.float32)) * scale
-    mask = jnp.arange(S)[None, None, None, :] <= seq_lens[:, None, None, None]
-    s = jnp.where(mask, s, jnp.float32(-1e30))
-    p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bngs,bsnd->bngd", p, vc.astype(jnp.float32))
-    return out.reshape(b, 1, h, d).astype(q.dtype)
+    """GQA decode core — shared with the paged serving path; lives in
+    nn.functional.attention so contiguous and block-table decode stay one
+    implementation (bit-identical tokens either way)."""
+    from ....nn.functional.attention import _grouped_decode_attn as _core
+    return _core(q, kc, vc, seq_lens, scale)
 
 
 def block_multihead_attention(q, pool_k, pool_v, block_tables, seq_lens,
@@ -205,17 +197,17 @@ def block_multihead_attention(q, pool_k, pool_v, block_tables, seq_lens,
     nb, bs, kvh, _ = pool_k.shape
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     if k_new is not None:
-        bidx = jnp.arange(b)
         blk = jnp.take_along_axis(block_tables, (seq_lens // bs)[:, None],
                                   axis=1)[:, 0]
         pool_k = pool_k.at[blk, seq_lens % bs].set(
             k_new[:, 0].astype(pool_k.dtype))
         pool_v = pool_v.at[blk, seq_lens % bs].set(
             v_new[:, 0].astype(pool_v.dtype))
-    # gather this batch's pages: [b, max_blocks, bs, kvh, d] -> [b, S, kvh, d]
-    kg = pool_k[block_tables].reshape(b, -1, kvh, d)
-    vg = pool_v[block_tables].reshape(b, -1, kvh, d)
-    out = _grouped_decode_attn(q, kg, vg, seq_lens, scale)
+    # gather + grouped-GQA attention, shared with the serving engine
+    # (Pallas block-table kernel on TPU, XLA gather elsewhere)
+    from ....nn.functional.attention import paged_attention_decode
+    out = paged_attention_decode(q, pool_k, pool_v, block_tables, seq_lens,
+                                 scale=scale)
     return out, pool_k, pool_v
 
 

@@ -6,15 +6,25 @@ TPU-native collapse: the reference's analysis passes (fusion, subgraph
 offload, memory optimization) are XLA's job; what remains is the loading +
 serving contract: load a source-free artifact, expose named IO, run
 batches. The artifact is the StableHLO export from ``paddle_tpu.jit.save``.
+
+``paddle_tpu.inference.llm`` adds the LLM serving front-end: an
+``LLMPredictor`` over the continuous-batching engine in
+``paddle_tpu.serving`` (see SERVING.md).
 """
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from ..jit.save_load import load as _load
+from .llm import LLMPredictor, create_llm_predictor  # noqa: F401
 
-__all__ = ["Config", "Predictor", "create_predictor"]
+__all__ = ["Config", "Predictor", "create_predictor",
+           "LLMPredictor", "create_llm_predictor"]
+
+_ARTIFACT_SUFFIXES = (".pdmodel", ".pdiparams", ".pdmeta")
 
 
 class Config:
@@ -40,30 +50,72 @@ class Predictor:
     program."""
 
     def __init__(self, config: Config):
+        missing = [config.prefix + s for s in _ARTIFACT_SUFFIXES
+                   if not os.path.exists(config.prefix + s)]
+        if missing:
+            raise FileNotFoundError(
+                f"no saved model at prefix {config.prefix!r}: missing "
+                f"{missing} (artifacts are written by paddle_tpu.jit.save)")
         self._layer = _load(config.prefix)
         self._inputs = [None] * len(self._layer.input_shapes)
 
     def get_input_names(self):
         return [f"input_{i}" for i in range(len(self._inputs))]
 
+    def _input_index(self, name: str) -> int:
+        names = self.get_input_names()
+        if name not in names:
+            raise KeyError(f"unknown input name {name!r}; this model's "
+                           f"inputs are {names}")
+        return names.index(name)
+
     def get_input_handle(self, name: str):
-        idx = int(name.split("_")[-1])
+        idx = self._input_index(name)
         pred = self
 
         class _Handle:
             def copy_from_cpu(self, arr):
-                pred._inputs[idx] = np.asarray(arr)
+                pred._inputs[idx] = pred._check_input(idx, np.asarray(arr))
 
             def reshape(self, shape):
                 pass
 
         return _Handle()
 
+    def _check_input(self, idx: int, arr: np.ndarray) -> np.ndarray:
+        """Validate against the saved meta — XLA export traced STATIC
+        shapes, so a mismatch here would otherwise surface as an opaque
+        StableHLO call error."""
+        want_shape = tuple(self._layer.input_shapes[idx])
+        want_dtype = np.dtype(self._layer.input_dtypes[idx])
+        if tuple(arr.shape) != want_shape:
+            raise ValueError(
+                f"input_{idx}: shape mismatch — the saved program was "
+                f"exported for {want_shape}, got {tuple(arr.shape)} "
+                f"(shapes are static under XLA export; re-export with the "
+                f"serving shape)")
+        if arr.dtype != want_dtype:
+            raise TypeError(
+                f"input_{idx}: dtype mismatch — the saved program was "
+                f"exported for {want_dtype}, got {arr.dtype}")
+        return arr
+
     def run(self, inputs=None):
-        args = inputs if inputs is not None else self._inputs
-        if any(a is None for a in args):
-            raise ValueError("inputs not set; pass them to run() or via "
-                             "get_input_handle().copy_from_cpu")
+        if inputs is not None:
+            if len(inputs) != len(self._inputs):
+                raise ValueError(
+                    f"model takes {len(self._inputs)} inputs, got "
+                    f"{len(inputs)}")
+            args = [self._check_input(i, np.asarray(a))
+                    for i, a in enumerate(inputs)]
+        else:
+            unset = [f"input_{i}" for i, a in enumerate(self._inputs)
+                     if a is None]
+            if unset:
+                raise ValueError(f"inputs not set: {unset}; pass them to "
+                                 f"run() or via "
+                                 f"get_input_handle().copy_from_cpu")
+            args = self._inputs
         out = self._layer(*args)
         self._outputs = out if isinstance(out, (tuple, list)) else [out]
         return [np.asarray(o) for o in self._outputs]
@@ -72,7 +124,11 @@ class Predictor:
         return [f"output_{i}" for i in range(len(getattr(self, "_outputs", [0])))]
 
     def get_output_handle(self, name: str):
-        idx = int(name.split("_")[-1])
+        names = self.get_output_names()
+        if name not in names:
+            raise KeyError(f"unknown output name {name!r}; available after "
+                           f"run(): {names}")
+        idx = names.index(name)
         pred = self
 
         class _Handle:

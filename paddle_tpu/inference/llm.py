@@ -1,0 +1,89 @@
+"""LLM serving front-end (the Predictor analogue for generative decode).
+
+``create_predictor`` serves fixed-shape programs; LLM serving is the
+opposite regime — ragged prompts arriving over time, each wanting its
+own decode length and sampling. ``LLMPredictor`` closes that gap by
+fronting the continuous-batching engine in ``paddle_tpu.serving``: a
+Predictor-shaped object (create → feed → fetch) whose ``generate`` runs
+every prompt through one paged KV pool with iteration-level scheduling,
+and whose ``stream`` exposes tokens as they decode.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["LLMPredictor", "create_llm_predictor"]
+
+
+class LLMPredictor:
+    """Batch-of-prompts front door over ``serving.ServingEngine``.
+
+    Unlike ``generate()`` on the model (one fixed-shape batch, padded to
+    the longest prompt), requests here share the paged pool: no padding
+    waste, arrivals can be staggered, and greedy outputs are bitwise
+    identical to per-request ``model.generate`` (SERVING.md).
+    """
+
+    def __init__(self, model, num_pages: int = 128, page_size: int = 16,
+                 max_slots: int = 8, max_pages_per_slot: int | None = None,
+                 prefill_token_budget: int = 2048, kv_dtype=None,
+                 clock=None):
+        from ..serving import ServingEngine
+        self.model = model
+        self._mk = lambda: ServingEngine(
+            model, num_pages=num_pages, page_size=page_size,
+            max_slots=max_slots, max_pages_per_slot=max_pages_per_slot,
+            prefill_token_budget=prefill_token_budget, kv_dtype=kv_dtype,
+            clock=clock)
+        self.engine = self._mk()
+
+    def generate(self, prompts, max_new_tokens: int = 32,
+                 eos_token_id: int | None = None, sampling=None,
+                 max_steps: int | None = None):
+        """Run a batch of ragged prompts to completion; returns a list of
+        generated-token lists in prompt order. ``sampling`` is one
+        SamplingParams for all, or a per-prompt list."""
+        if sampling is not None and isinstance(sampling, (list, tuple)):
+            if len(sampling) != len(prompts):
+                raise ValueError(
+                    f"{len(sampling)} sampling params for "
+                    f"{len(prompts)} prompts")
+            per = list(sampling)
+        else:
+            per = [sampling] * len(prompts)
+        rids = [self.engine.add_request(np.asarray(p).reshape(-1),
+                                        max_new_tokens, sampling=sp,
+                                        eos_token_id=eos_token_id)
+                for p, sp in zip(prompts, per)]
+        results = self.engine.run_to_completion(max_steps=max_steps)
+        return [results[rid] for rid in rids]
+
+    def stream(self, prompts, max_new_tokens: int = 32,
+               eos_token_id: int | None = None, sampling=None):
+        """Token-at-a-time iterator: yields ``{"index", "rid", "token",
+        "finished", "finish_reason"}`` with ``index`` the prompt's
+        position in the input batch."""
+        rids = [self.engine.add_request(np.asarray(p).reshape(-1),
+                                        max_new_tokens, sampling=sampling,
+                                        eos_token_id=eos_token_id)
+                for p in prompts]
+        pos = {rid: i for i, rid in enumerate(rids)}
+        for ev in self.engine.stream():
+            if ev["rid"] in pos:
+                yield {"index": pos[ev["rid"]], **ev}
+
+    def metrics_summary(self) -> dict:
+        return self.engine.metrics.summary()
+
+    def stats(self) -> dict:
+        return self.engine.stats()
+
+    def reset(self) -> None:
+        """Fresh engine: drops metrics and the request table. Prefer one
+        long-lived predictor — a new engine builds a new decode program."""
+        self.engine = self._mk()
+
+
+def create_llm_predictor(model, **kw) -> LLMPredictor:
+    return LLMPredictor(model, **kw)

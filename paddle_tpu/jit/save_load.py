@@ -117,6 +117,12 @@ class TranslatedLayer:
     def input_shapes(self):
         return self._meta["input_shapes"]
 
+    @property
+    def input_dtypes(self):
+        # older artifacts predate the dtype field; treat them as fp32
+        return self._meta.get(
+            "input_dtypes", ["float32"] * self._meta["n_inputs"])
+
     def eval(self):
         return self
 

@@ -109,13 +109,38 @@ class LlamaAttention(Layer):
         self.o_proj = nn.Linear(h * d, config.hidden_size, bias_attr=False,
                                 weight_spec=(mp, None))
 
-    def forward(self, x, cos, sin, attn_mask=None, kv_cache=None, position_offset=0):
+    def forward(self, x, cos, sin, attn_mask=None, kv_cache=None, position_offset=0,
+                paged=None):
         b, s, _ = x.shape
         cfg = self.config
         h, kvh, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
         q = self.q_proj(x).reshape(b, s, h, d)
         k = self.k_proj(x).reshape(b, s, kvh, d)
         v = self.v_proj(x).reshape(b, s, kvh, d)
+        if paged is not None:
+            # slot-indexed decode over a paged KV pool (the serving engine's
+            # one-compiled-program step): b is the fixed slot count, s == 1.
+            # ``paged`` = (block_tables [b, max_pages] int32, seq_lens [b]
+            # int32, active [b] bool); ``kv_cache`` is this layer's
+            # (pool_k, pool_v) [num_pages, page_size, kvh, d]. Inactive
+            # slots write to the reserved scratch page 0 (never allocated,
+            # never read unmasked) so joins/leaves never retrace.
+            if s != 1:
+                raise ValueError("paged decode takes one token per slot")
+            tables, seq_lens, active = paged
+            pos = jnp.broadcast_to(seq_lens[:, None], (b, s))
+            q = apply_rotary_pos_emb(q, cos, sin, pos)
+            k = apply_rotary_pos_emb(k, cos, sin, pos)
+            pk, pv = kv_cache
+            ps = pk.shape[1]
+            page = jnp.take_along_axis(tables, (seq_lens // ps)[:, None],
+                                       axis=1)[:, 0]
+            page = jnp.where(active, page, 0)
+            off = jnp.where(active, seq_lens % ps, 0)
+            pk = pk.at[page, off].set(k[:, 0].astype(pk.dtype))
+            pv = pv.at[page, off].set(v[:, 0].astype(pv.dtype))
+            out = F.paged_attention_decode(q, pk, pv, tables, seq_lens)
+            return self.o_proj(out.reshape(b, s, h * d)), (pk, pv)
         # sequence parallelism: when tracing inside a manual-sep shard_map
         # region (the pipelined train step), x is the LOCAL seq shard —
         # rope positions are offset by the shard start and attention runs
@@ -209,12 +234,13 @@ class LlamaDecoderLayer(Layer):
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
                                                    config.rms_norm_eps)
 
-    def forward(self, x, cos, sin, attn_mask=None, kv_cache=None, position_offset=0):
+    def forward(self, x, cos, sin, attn_mask=None, kv_cache=None, position_offset=0,
+                paged=None):
         res = x
         h = self.input_layernorm(x)
         if kv_cache is not None:
             h, new_cache = self.self_attn(h, cos, sin, attn_mask, kv_cache,
-                                          position_offset)
+                                          position_offset, paged)
         else:
             h = self.self_attn(h, cos, sin, attn_mask)
             new_cache = None
@@ -240,13 +266,15 @@ class LlamaModel(Layer):
         self.register_buffer("rope_cos", cos, persistable=False)
         self.register_buffer("rope_sin", sin, persistable=False)
 
-    def forward(self, input_ids, attn_mask=None, kv_caches=None, position_offset=0):
+    def forward(self, input_ids, attn_mask=None, kv_caches=None, position_offset=0,
+                paged=None):
         x = self.embed_tokens(input_ids)
         cos, sin = self.rope_cos, self.rope_sin
         new_caches = []
         for i, layer in enumerate(self.layers):
             if kv_caches is not None:
-                x, c = layer(x, cos, sin, attn_mask, kv_caches[i], position_offset)
+                x, c = layer(x, cos, sin, attn_mask, kv_caches[i], position_offset,
+                             paged)
                 new_caches.append(c)
             elif (self.config.recompute and self.training
                   and i % max(self.config.recompute_interval, 1) == 0):
@@ -276,8 +304,9 @@ class LlamaForCausalLM(Layer):
                                      bias_attr=False,
                                      weight_spec=(None, config.mp_axis))
 
-    def forward(self, input_ids, attn_mask=None, kv_caches=None, position_offset=0):
-        out = self.model(input_ids, attn_mask, kv_caches, position_offset)
+    def forward(self, input_ids, attn_mask=None, kv_caches=None, position_offset=0,
+                paged=None):
+        out = self.model(input_ids, attn_mask, kv_caches, position_offset, paged)
         if kv_caches is not None:
             hidden, new_caches = out
         else:
@@ -295,9 +324,23 @@ class LlamaForCausalLM(Layer):
         return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
                 for _ in range(cfg.num_hidden_layers)]
 
+    def decode_cache_stats(self) -> dict:
+        """Public view of the compiled decode-program cache (the supported
+        replacement for poking ``_decode_prog_cache``): ``signatures`` is
+        the number of distinct (batch, prompt_len, new_tokens, sampling)
+        signatures holding compiled (prefill, decode, step) triples,
+        ``capacity`` the LRU bound, ``signature_keys`` the cached keys in
+        LRU order (oldest first). A serving loop should see ``signatures``
+        stay flat — growth means unbucketed prompt shapes are retracing."""
+        cache = self.__dict__.get("_decode_prog_cache") or {}
+        return {"signatures": len(cache), "capacity": 16,
+                "signature_keys": list(cache.keys())}
+
     def decode_programs(self, b: int, s0: int, max_new_tokens: int,
                         max_len: int | None = None, do_sample: bool = False,
-                        top_p: float = 1.0, temperature: float = 1.0):
+                        top_p: float = 1.0, temperature: float = 1.0,
+                        eos_token_id: int | None = None,
+                        pad_token_id: int | None = None):
         """Build (and cache per signature) the compiled serving programs:
 
         - ``prefill(state, ids, caches, key) -> (tok, caches)`` — one
@@ -321,8 +364,9 @@ class LlamaForCausalLM(Layer):
         from ..nn.module import functional_call
         from ..ops.random import top_p_sampling
         max_len = max_len or (s0 + max_new_tokens)
+        pad_token_id = pad_token_id if pad_token_id is not None else eos_token_id
         sig = (b, s0, max_new_tokens, max_len, do_sample, float(top_p),
-               float(temperature))
+               float(temperature), eos_token_id, pad_token_id)
         cache = self.__dict__.setdefault("_decode_prog_cache", OrderedDict())
         if sig in cache:
             cache.move_to_end(sig)
@@ -344,16 +388,25 @@ class LlamaForCausalLM(Layer):
         @jax.jit
         def decode(state, tok, caches, keys):
             def body(carry, xs):
-                tok, caches = carry
+                tok, caches, done = carry
                 key, pos = xs
                 (logits, caches), _ = functional_call(
                     self, state, tok[:, None], None, caches, pos,
                     training=False)
                 nt = pick(logits[:, -1], key)
-                return (nt, caches), nt
+                if eos_token_id is not None:
+                    # once a row emits EOS, its later tokens pin to pad
+                    # INSIDE the scan (the serving engine keys per-request
+                    # stop off the same mask)
+                    nt = jnp.where(done, jnp.int32(pad_token_id),
+                                   nt.astype(jnp.int32))
+                    done = done | (nt == eos_token_id)
+                return (nt, caches, done), nt
+            done0 = (tok == eos_token_id if eos_token_id is not None
+                     else jnp.zeros((b,), bool))
             positions = s0 + jnp.arange(max_new_tokens - 1)
-            (tok, caches), toks = jax.lax.scan(
-                body, (tok, caches), (keys, positions))
+            (tok, caches, _), toks = jax.lax.scan(
+                body, (tok, caches, done0), (keys, positions))
             return toks  # [max_new_tokens - 1, b]
 
         @jax.jit
@@ -370,7 +423,8 @@ class LlamaForCausalLM(Layer):
     def generate(self, input_ids, max_new_tokens: int = 32, max_len: int | None = None,
                  do_sample: bool = False, top_p: float = 1.0,
                  temperature: float = 1.0, seed: int | None = None,
-                 jit_loop: bool = True):
+                 jit_loop: bool = True, eos_token_id: int | None = None,
+                 pad_token_id: int | None = None):
         """Decode: one jitted prefill + the WHOLE token loop as one jitted
         ``lax.scan`` over the fixed-size KV cache (decode routes through the
         fused masked-MHA path). Two compiled programs total — the per-token
@@ -386,7 +440,11 @@ class LlamaForCausalLM(Layer):
 
         do_sample=True draws each token with nucleus sampling via
         ``ops.random.top_p_sampling`` (parity: tensor/search.py:1235 feeding
-        the reference's sampling decode); default is greedy argmax."""
+        the reference's sampling decode); default is greedy argmax.
+
+        ``eos_token_id``: once a row emits EOS, its subsequent tokens are
+        pinned to ``pad_token_id`` (default: the EOS id) inside the scan —
+        output shape stays static [b, s0 + max_new_tokens]."""
         input_ids = jnp.asarray(input_ids)
         b, s0 = input_ids.shape
         max_len = max_len or (s0 + max_new_tokens)
@@ -394,7 +452,9 @@ class LlamaForCausalLM(Layer):
         caches = self.init_kv_caches(b, max_len)
         key0 = jax.random.key(seed if seed is not None else 0)
         prefill, decode, step = self.decode_programs(
-            b, s0, max_new_tokens, max_len, do_sample, top_p, temperature)
+            b, s0, max_new_tokens, max_len, do_sample, top_p, temperature,
+            eos_token_id, pad_token_id)
+        pad = pad_token_id if pad_token_id is not None else eos_token_id
 
         keys = jax.random.split(key0, max_new_tokens)
         tok, caches = prefill(state, input_ids, caches, keys[0])
@@ -406,8 +466,12 @@ class LlamaForCausalLM(Layer):
             return jnp.concatenate([input_ids, new], axis=1)
 
         out = [tok]
+        done = (tok == eos_token_id) if eos_token_id is not None else None
         for i in range(1, max_new_tokens):
             tok, caches = step(state, tok, caches, s0 + i - 1, keys[i])
+            if eos_token_id is not None:  # same pinning as the scan path
+                tok = jnp.where(done, jnp.int32(pad), tok.astype(jnp.int32))
+                done = done | (tok == eos_token_id)
             out.append(tok)
         return jnp.concatenate([input_ids, jnp.stack(out, axis=1)], axis=1)
 

@@ -18,7 +18,8 @@ import math
 import jax
 import jax.numpy as jnp
 
-__all__ = ["scaled_dot_product_attention", "flash_attention", "sdp_kernel"]
+__all__ = ["scaled_dot_product_attention", "flash_attention", "sdp_kernel",
+           "paged_attention_decode"]
 
 # sdp_kernel override; None -> read FLAGS_flash_min_seq (default 256). The
 # Pallas kernel's block logic covers seq >= 256 (blocks halve to divide the
@@ -374,6 +375,65 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax
             scores = jnp.where(jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq), scores, -jnp.inf)
         return out, jax.nn.softmax(scores, -1).astype(q.dtype)
     return out, None
+
+
+def _grouped_decode_attn(q, kc, vc, seq_lens, scale):
+    """GQA decode core shared by the contiguous (masked_multihead) and
+    paged (block-table) decode paths: group the h query heads as
+    [kvh, h/kvh] and attend against the UNREPEATED cache — no h/kvh-times
+    HBM copy of the cache. One implementation for both cache layouts so
+    the paged engine's tokens stay bit-identical to contiguous decode.
+
+    q: [b, 1, h, d]; kc/vc: [b, S, kvh, d]; seq_lens: [b] — attends cache
+    positions <= seq_lens (the just-written step token included).
+    """
+    b, _, h, d = q.shape
+    kvh = kc.shape[2]
+    S = kc.shape[1]
+    g = h // kvh
+    qg = q[:, 0].reshape(b, kvh, g, d).astype(jnp.float32)
+    s = jnp.einsum("bngd,bsnd->bngs", qg, kc.astype(jnp.float32)) * scale
+    mask = jnp.arange(S)[None, None, None, :] <= seq_lens[:, None, None, None]
+    s = jnp.where(mask, s, jnp.float32(-1e30))
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bngs,bsnd->bngd", p, vc.astype(jnp.float32))
+    return out.reshape(b, 1, h, d).astype(q.dtype)
+
+
+def paged_attention_decode(q, pool_k, pool_v, block_tables, seq_lens,
+                           scale=None):
+    """Single-token decode attention over a PAGED KV pool (the serving
+    engine's attention; parity: vLLM PagedAttention / incubate
+    block_multihead_attention without the write step).
+
+    q:            [b, 1, h, d] — this step's query (h a multiple of kvh).
+    pool_k/v:     [num_pages, page_size, kvh, d] — the shared page pool.
+    block_tables: [b, max_pages] int32 page ids per sequence (entries past
+                  the live pages may point anywhere — typically the
+                  reserved scratch page 0 — they are masked by seq_lens).
+    seq_lens:     [b] int32 — attends pool positions <= seq_lens (i.e.
+                  seq_lens + 1 tokens, the just-written one included).
+
+    Routing: on a real TPU with kernel-friendly shapes the Pallas
+    block-table kernel (ops/pallas/paged_attention) gathers pages
+    HBM→VMEM by table lookup; anywhere else (tier-1 CPU runs) an XLA
+    gather materializes [b, max_pages*page_size, kvh, d] and reuses the
+    same grouped-GQA core as the contiguous decode path, so both backends
+    and both cache layouts agree.
+    """
+    b, _, h, d = q.shape
+    nb, ps, kvh, _ = pool_k.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if _flash_backend_ok():
+        from ...ops.pallas.paged_attention import (paged_attention_tpu,
+                                                   kernel_applicable)
+        if kernel_applicable(q.shape, pool_k.shape):
+            return paged_attention_tpu(q, pool_k, pool_v, block_tables,
+                                       seq_lens, scale=scale)
+    kg = pool_k[block_tables].reshape(b, -1, kvh, d)
+    vg = pool_v[block_tables].reshape(b, -1, kvh, d)
+    return _grouped_decode_attn(q, kg, vg, seq_lens, scale)
 
 
 class sdp_kernel:

@@ -1,0 +1,156 @@
+"""Paged-attention decode as a Pallas TPU kernel (block-table gather).
+
+The serving engine's decode attention: one query token per slot attends a
+KV cache scattered across fixed-size pages of a shared pool (vLLM /
+PagedAttention, SOSP '23; parity target: the reference's incubate
+block_multihead_attention decode kernel). The XLA fallback in
+nn/functional/attention.py materializes the gathered cache
+[b, max_pages*page_size, kvh, d] in HBM before attending; this kernel
+never does — pages stream HBM→VMEM directly by block-table lookup.
+
+TPU mapping:
+- grid (slots, kv_heads, pages), pages innermost: the page id for step
+  (s, n, j) comes from the scalar-prefetched block table in SMEM via the
+  BlockSpec index map, so the K/V page DMA is issued ahead of compute
+  (the Pallas analogue of the CUDA kernel's per-block table fetch).
+- online softmax over pages: fp32 accumulators (acc, m, l) persist in
+  VMEM scratch across the page dimension — same stored-stats scheme as
+  the flash kernel.
+- dead pages (j past the slot's last live page, seq_lens[s] // page_size)
+  skip compute via pl.when AND their DMAs: the index map clamps dead j to
+  the last live page id, and Mosaic elides the repeated copy.
+- GQA: the g = h/kvh query heads of one kv head attend together as a
+  [g, page_size] score tile; the cache is never head-repeated.
+
+Masking matches the XLA path exactly: position <= seq_lens[s] keeps a
+score, others take -1e30 (finite, so a fully-padded tail underflows to
+exactly 0 probability in fp32).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific pieces; absent on CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+__all__ = ["paged_attention_tpu", "kernel_applicable"]
+
+_LANES = 128
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def kernel_applicable(q_shape, pool_shape) -> bool:
+    """Shape gate for the kernel route (the caller falls back to the XLA
+    gather path otherwise): head_dim must fill the lanes, the page the
+    sublanes, and q heads must group evenly over the cache kv heads."""
+    b, s, h, d = q_shape
+    _, ps, kvh, _ = pool_shape
+    return (s == 1 and d % _LANES == 0 and ps % 8 == 0
+            and h % kvh == 0)
+
+
+def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, page_size, n_pages, scale):
+    s = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -1e30)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    seq_len = lens_ref[s]
+    live = seq_len // page_size  # page holding position seq_len
+
+    @pl.when(j <= live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)            # [g, d]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)      # [page_size, d]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        sc = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [g, page_size]
+        pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        sc = jnp.where(pos <= seq_len, sc, jnp.float32(-1e30))
+        # every computed page holds >= 1 live position (j <= live), so the
+        # running max is finite and -1e30 pads underflow to exact 0
+        m_prev = m_ref[:, 0:1]
+        l_prev = l_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(sc - m_new)                        # [g, page_size]
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32)  # [g, d]
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == n_pages - 1)
+    def _finish():
+        o_ref[0, 0] = (acc_ref[...] / l_ref[:, 0:1]).astype(o_ref.dtype)
+
+
+def paged_attention_tpu(q, pool_k, pool_v, block_tables, seq_lens,
+                        scale: float | None = None):
+    """q: [b, 1, h, d]; pool_k/v: [num_pages, page_size, kvh, d];
+    block_tables: [b, max_pages] int32; seq_lens: [b] int32 (attends
+    positions <= seq_lens). Returns [b, 1, h, d]."""
+    b, s, h, d = q.shape
+    _, ps, kvh, _ = pool_k.shape
+    M = block_tables.shape[1]
+    g = h // kvh
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    q4 = q.reshape(b, kvh, g, d)
+    tables = jnp.asarray(block_tables, jnp.int32)
+    lens = jnp.asarray(seq_lens, jnp.int32)
+
+    def q_index(s_, n, j, tables_ref, lens_ref):
+        return (s_, n, 0, 0)
+
+    def kv_index(s_, n, j, tables_ref, lens_ref):
+        # clamp dead page steps to the last live page: the repeated block
+        # index lets Mosaic elide the DMA (flash-kernel dead-block idiom)
+        jj = jnp.minimum(j, lens_ref[s_] // ps)
+        return (tables_ref[s_, jj], 0, n, 0)
+
+    kernel = functools.partial(_decode_kernel, page_size=ps, n_pages=M,
+                               scale=scale)
+    grid = (b, kvh, M)
+    if pltpu is None:  # pragma: no cover
+        raise RuntimeError("pallas TPU support unavailable; use the XLA "
+                           "gather path (nn.functional.paged_attention_decode)")
+    scratch = [pltpu.VMEM((g, d), jnp.float32),
+               pltpu.VMEM((g, _LANES), jnp.float32),
+               pltpu.VMEM((g, _LANES), jnp.float32)]
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, g, d), q_index),
+                pl.BlockSpec((1, ps, 1, d), kv_index),
+                pl.BlockSpec((1, ps, 1, d), kv_index),
+            ],
+            out_specs=pl.BlockSpec((1, 1, g, d), q_index),
+            scratch_shapes=scratch),
+        out_shape=jax.ShapeDtypeStruct((b, kvh, g, d), q.dtype),
+        compiler_params=None if _interpret() else pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
+        interpret=_interpret(),
+    )(tables, lens, q4, pool_k, pool_v)
+    return out.reshape(b, 1, h, d)

@@ -1,0 +1,26 @@
+"""paddle_tpu.serving — continuous-batching LLM serving on TPU.
+
+A paged KV-cache pool (PagedAttention, SOSP '23) plus an
+iteration-level continuous-batching engine (Orca, OSDI '22) whose
+decode step is one compiled program over a fixed slot axis — request
+churn changes array values, never shapes, so nothing ever retraces.
+See SERVING.md for the design and the determinism contract.
+
+    from paddle_tpu.serving import ServingEngine, SamplingParams
+    eng = ServingEngine(model, num_pages=64, page_size=16, max_slots=4)
+    rid = eng.add_request(prompt_ids, max_new_tokens=32, eos_token_id=2)
+    for ev in eng.stream():
+        print(ev["rid"], ev["token"])
+"""
+
+from .engine import ServingEngine
+from .kv_cache import KVCachePool, PoolExhaustedError
+from .metrics import ServingMetrics, percentile
+from .scheduler import (FINISHED, PREEMPTED, RUNNING, WAITING, Request,
+                        SamplingParams, Scheduler)
+
+__all__ = [
+    "ServingEngine", "KVCachePool", "PoolExhaustedError", "ServingMetrics",
+    "percentile", "Request", "SamplingParams", "Scheduler",
+    "WAITING", "RUNNING", "PREEMPTED", "FINISHED",
+]

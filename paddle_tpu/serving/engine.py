@@ -1,0 +1,298 @@
+"""Continuous-batching serving engine over the paged KV-cache pool.
+
+The decode step is ONE compiled program for the engine's lifetime: it
+always runs over the fixed ``[max_slots]`` slot axis, with block tables
+``[max_slots, max_pages_per_slot]``, position offsets, the active-slot
+mask, and every per-request sampling parameter passed as ARRAY inputs.
+Requests joining, finishing, or being preempted only change array
+*values*, never shapes or the jaxpr — ``decode_program_count()`` stays
+at 1 across arbitrary churn (asserted by tests/test_serving.py).
+
+Prefill runs one admitted request at a time through per-bucket compiled
+programs (prompt lengths rounded up to power-of-two page multiples, so
+the program count is O(log max_len)): a contiguous forward over the
+padded prompt fills a temporary ``[1, L_bucket]`` cache which is then
+scattered page-by-page into the pool through the request's block table.
+Bucket-padding positions land in the reserved scratch page 0.
+
+Determinism: greedy decode is argmax over logits that are bitwise equal
+to ``LlamaForCausalLM.generate()``'s (shared attention core, masked
+padding contributes exact zeros — see SERVING.md); sampled requests
+draw token *n* with ``fold_in(PRNGKey(seed), n)`` so a preempted and
+recomputed request reproduces its original stream regardless of slot
+placement or batch composition.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .kv_cache import KVCachePool
+from .metrics import ServingMetrics
+from .scheduler import Request, SamplingParams, Scheduler
+
+__all__ = ["ServingEngine"]
+
+
+class ServingEngine:
+    def __init__(self, model, num_pages: int, page_size: int,
+                 max_slots: int = 4, max_pages_per_slot: int | None = None,
+                 prefill_token_budget: int = 2048, kv_dtype=None,
+                 clock=None):
+        cfg = model.config
+        self.model = model
+        self.page_size = page_size
+        self.max_slots = max_slots
+        self.max_pages_per_slot = (max_pages_per_slot
+                                   if max_pages_per_slot is not None
+                                   else (num_pages - 1))
+        self.pool = KVCachePool.from_config(
+            cfg, num_pages, page_size,
+            dtype=kv_dtype if kv_dtype is not None else jnp.bfloat16)
+        self.scheduler = Scheduler(max_slots, prefill_token_budget)
+        self.metrics = ServingMetrics(clock)
+        self._state = model.state_dict(include_non_persistable_buffer=True)
+        self._requests: dict[str, Request] = {}
+        self._rid_counter = itertools.count()
+        self._steps = 0
+        self._decode_step = self._build_decode_step()
+        self._prefill_progs: dict[int, object] = {}
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def add_request(self, prompt, max_new_tokens: int,
+                    sampling: SamplingParams | None = None,
+                    eos_token_id: int | None = None,
+                    rid: str | None = None) -> str:
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise ValueError("prompt must be non-empty")
+        total = len(prompt) + max_new_tokens
+        need = self.pool.pages_for(total)
+        if need > self.max_pages_per_slot:
+            raise ValueError(
+                f"request needs {need} pages "
+                f"(max_pages_per_slot={self.max_pages_per_slot})")
+        if need > self.pool.capacity:
+            raise ValueError(
+                f"request needs {need} pages but the pool only has "
+                f"{self.pool.capacity} — it could never run")
+        rid = rid if rid is not None else f"req-{next(self._rid_counter)}"
+        if rid in self._requests:
+            raise ValueError(f"duplicate request id {rid!r}")
+        req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
+                      sampling=sampling or SamplingParams(),
+                      eos_token_id=eos_token_id)
+        self._requests[rid] = req
+        self.scheduler.add(req)
+        self.metrics.on_arrival(rid)
+        return rid
+
+    def step(self) -> list[dict]:
+        """One scheduling iteration: admit + prefill newly runnable
+        requests, guarantee decode pages (preempting if needed), then one
+        batched decode step over every running slot. Returns this step's
+        token/finish events."""
+        if not self.scheduler.has_work():
+            return []
+        events: list[dict] = []
+        for req in self.scheduler.admit(self.pool):
+            self._run_prefill(req, events)
+        preempted = self.scheduler.ensure_decode_pages(self.pool)
+        for _ in preempted:
+            self.metrics.on_preemption()
+        if self.scheduler.running:
+            self._run_decode(events)
+        self.metrics.on_step(self.scheduler.queue_depth,
+                             self.pool.utilization())
+        self._steps += 1
+        return events
+
+    def stream(self):
+        """Drive the engine to completion, yielding events as they are
+        produced: ``{"rid", "token", "finished", "finish_reason"}``."""
+        while self.scheduler.has_work():
+            yield from self.step()
+
+    def run_to_completion(self, max_steps: int | None = None) -> dict:
+        """Drain the queue; returns {rid: generated token list}."""
+        steps = 0
+        while self.scheduler.has_work():
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(f"engine did not drain in {steps} steps")
+        return {rid: list(r.tokens) for rid, r in self._requests.items()}
+
+    def request(self, rid: str) -> Request:
+        return self._requests[rid]
+
+    def decode_program_count(self) -> int:
+        """Compiled-program count of the decode step — the no-retrace
+        contract says this stays 1 no matter how requests churn."""
+        return int(self._decode_step._cache_size())
+
+    def stats(self) -> dict:
+        return {"steps": self._steps,
+                "pool": self.pool.stats(),
+                "queue_depth": self.scheduler.queue_depth,
+                "running": len(self.scheduler.running),
+                "preemptions": self.scheduler.num_preemptions,
+                "decode_programs": self.decode_program_count(),
+                "prefill_programs": len(self._prefill_progs)}
+
+    # ------------------------------------------------------------------
+    # compiled programs
+    # ------------------------------------------------------------------
+
+    def _build_decode_step(self):
+        from ..nn.module import functional_call
+        model = self.model
+
+        @jax.jit
+        def decode_step(state, pools, tok, tables, seq_lens, active,
+                        temps, top_ps, greedy, seeds, counts):
+            (logits, pools), _ = functional_call(
+                model, state, tok[:, None], None, pools, 0,
+                (tables, seq_lens, active), training=False)
+            nt = _sample_rows(logits[:, -1], temps, top_ps, greedy,
+                              seeds, counts)
+            return nt, pools
+
+        return decode_step
+
+    def _bucket(self, n_tokens: int) -> int:
+        """Prompt-length bucket: the next power-of-two page count, in
+        tokens. Bounds the prefill program count at O(log max_len)."""
+        pages = self.pool.pages_for(n_tokens)
+        p2 = 1
+        while p2 < pages:
+            p2 *= 2
+        return p2 * self.page_size
+
+    def _prefill_prog(self, L: int):
+        if L in self._prefill_progs:
+            return self._prefill_progs[L]
+        from ..nn.module import functional_call
+        model, cfg = self.model, self.model.config
+        ps = self.page_size
+        n_pages = L // ps
+        kv_dtype = self.pool.dtype
+
+        @jax.jit
+        def prefill(state, ids, n_valid, scatter_pages, pools,
+                    temp, top_p, greedy, seed):
+            caches = model.init_kv_caches(1, L, dtype=kv_dtype)
+            (logits, caches), _ = functional_call(
+                model, state, ids, None, caches, 0, training=False)
+            lg = jax.lax.dynamic_index_in_dim(logits[0], n_valid - 1,
+                                              axis=0, keepdims=False)
+            tok = _sample_rows(lg[None], temp[None], top_p[None],
+                               greedy[None], seed[None],
+                               jnp.zeros((1,), jnp.int32))[0]
+            new_pools = []
+            for (ck, cv), (pk, pv) in zip(caches, pools):
+                kvh, d = ck.shape[2], ck.shape[3]
+                pk = pk.at[scatter_pages].set(
+                    ck[0].reshape(n_pages, ps, kvh, d))
+                pv = pv.at[scatter_pages].set(
+                    cv[0].reshape(n_pages, ps, kvh, d))
+                new_pools.append((pk, pv))
+            return tok, new_pools
+
+        self._prefill_progs[L] = prefill
+        return prefill
+
+    # ------------------------------------------------------------------
+    # per-step work
+    # ------------------------------------------------------------------
+
+    def _run_prefill(self, req: Request, events: list[dict]) -> None:
+        n_valid = req.context_len  # == recompute_len, set by admit()
+        L = self._bucket(n_valid)
+        n_pages = L // self.page_size
+        ids = np.zeros((1, L), np.int32)
+        ids[0, :n_valid] = req.prompt + req.tokens[:-1]
+        scatter = np.zeros((n_pages,), np.int32)
+        scatter[:len(req.pages)] = req.pages
+        sp = req.sampling
+        tok, new_pools = self._prefill_prog(L)(
+            self._state, jnp.asarray(ids), jnp.int32(n_valid),
+            jnp.asarray(scatter), self.pool.pools,
+            jnp.float32(sp.temperature), jnp.float32(sp.top_p),
+            jnp.asarray(not sp.do_sample), jnp.int32(sp.seed))
+        self.pool.pools = new_pools
+        if req.tokens:
+            return  # recompute after preemption: cache rebuilt, the stored
+                    # last token is the next decode input — no new emission
+        self._emit(req, int(tok), events)
+
+    def _run_decode(self, events: list[dict]) -> None:
+        S, M = self.max_slots, self.max_pages_per_slot
+        tok = np.zeros((S,), np.int32)
+        tables = np.zeros((S, M), np.int32)
+        seq_lens = np.zeros((S,), np.int32)
+        active = np.zeros((S,), bool)
+        temps = np.ones((S,), np.float32)
+        top_ps = np.ones((S,), np.float32)
+        greedy = np.ones((S,), bool)
+        seeds = np.zeros((S,), np.int32)
+        counts = np.zeros((S,), np.int32)
+        for slot, req in self.scheduler.running.items():
+            tok[slot] = req.tokens[-1]
+            tables[slot, :len(req.pages)] = req.pages
+            seq_lens[slot] = req.context_len
+            active[slot] = True
+            temps[slot] = req.sampling.temperature
+            top_ps[slot] = req.sampling.top_p
+            greedy[slot] = not req.sampling.do_sample
+            seeds[slot] = req.sampling.seed
+            counts[slot] = len(req.tokens)
+        nt, new_pools = self._decode_step(
+            self._state, self.pool.pools, jnp.asarray(tok),
+            jnp.asarray(tables), jnp.asarray(seq_lens), jnp.asarray(active),
+            jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(greedy),
+            jnp.asarray(seeds), jnp.asarray(counts))
+        self.pool.pools = new_pools
+        nt = np.asarray(nt)
+        for slot, req in list(self.scheduler.running.items()):
+            req.context_len += 1  # this step's KV write at old context_len
+            self._emit(req, int(nt[slot]), events)
+
+    def _emit(self, req: Request, token: int, events: list[dict]) -> None:
+        req.tokens.append(token)
+        self.metrics.on_token(req.rid)
+        reason = None
+        if req.eos_token_id is not None and token == req.eos_token_id:
+            reason = "stop"
+        elif len(req.tokens) >= req.max_new_tokens:
+            reason = "length"
+        if reason is not None:
+            self.scheduler.finish(req, self.pool, reason)
+            self.metrics.on_finish(req.rid)
+        events.append({"rid": req.rid, "token": token,
+                       "finished": reason is not None,
+                       "finish_reason": reason})
+
+
+def _sample_rows(logits, temps, top_ps, greedy, seeds, counts):
+    """Per-slot next-token choice: greedy argmax or nucleus sampling with
+    a per-request key stream fold_in(PRNGKey(seed), token_index) —
+    independent of slot placement and batch composition, so recompute
+    after preemption reproduces the original draws."""
+    from ..ops.random import top_p_sampling
+
+    def row(lg, t, p, g, seed, cnt):
+        gd = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), cnt)
+        probs = jax.nn.softmax(lg.astype(jnp.float32) / t, axis=-1)
+        _, idx = top_p_sampling(probs[None], p[None], key=key)
+        return jnp.where(g, gd, idx[0, 0].astype(jnp.int32))
+
+    return jax.vmap(row)(logits, temps, top_ps, greedy, seeds, counts)

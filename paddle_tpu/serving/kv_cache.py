@@ -1,0 +1,112 @@
+"""Paged KV-cache pool for the continuous-batching serving engine.
+
+One fixed ``[num_pages, page_size, n_kv_heads, head_dim]`` array pair per
+layer (the PagedAttention pool, SOSP '23); sequences own pages through
+per-request int32 block tables instead of contiguous ``[B, max_len]``
+buffers, so cache memory fragments at page granularity instead of
+request granularity and a request's reservation grows one page at a
+time as it decodes.
+
+Invariants (relied on by the engine's no-retrace contract, SERVING.md):
+- the device arrays are allocated ONCE at pool construction and only
+  ever updated functionally inside the compiled prefill/decode programs
+  — alloc/free move host-side integers, never device memory;
+- page 0 is reserved as the scratch page: never handed out, used as the
+  write/gather target for inactive slots and padded block-table entries
+  (always masked by seq_lens, so its garbage is never read into a
+  softmax with weight > 0);
+- alloc is all-or-nothing: a partial grab is rolled back so a failed
+  allocation leaves the free list unchanged (the scheduler turns the
+  failure into a preemption, not a torn reservation).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+__all__ = ["KVCachePool", "PoolExhaustedError"]
+
+
+class PoolExhaustedError(RuntimeError):
+    """Raised by ``alloc`` when the pool cannot satisfy a request; the
+    scheduler catches it and preempts (never propagates to users)."""
+
+
+class KVCachePool:
+    def __init__(self, num_layers: int, num_pages: int, page_size: int,
+                 num_kv_heads: int, head_dim: int, dtype=jnp.bfloat16):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is the "
+                             "reserved scratch page)")
+        self.num_layers = num_layers
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.num_kv_heads = num_kv_heads
+        self.head_dim = head_dim
+        self.dtype = dtype
+        shape = (num_pages, page_size, num_kv_heads, head_dim)
+        # per-layer (pool_k, pool_v); functionally replaced by the compiled
+        # programs each step, so the handles here always name the latest
+        self.pools = [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                      for _ in range(num_layers)]
+        # LIFO free list, page 0 reserved (scratch)
+        self._free = list(range(num_pages - 1, 0, -1))
+        self._peak_in_use = 0
+
+    @classmethod
+    def from_config(cls, config, num_pages: int, page_size: int,
+                    dtype=jnp.bfloat16) -> "KVCachePool":
+        """Build from a model config carrying num_hidden_layers /
+        num_key_value_heads / head_dim (LlamaConfig shape)."""
+        return cls(config.num_hidden_layers, num_pages, page_size,
+                   config.num_key_value_heads, config.head_dim, dtype)
+
+    # ---- accounting ----
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable pages (excludes the scratch page)."""
+        return self.num_pages - 1
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_in_use(self) -> int:
+        return self.capacity - self.num_free
+
+    def utilization(self) -> float:
+        return self.num_in_use / self.capacity
+
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages needed to hold n_tokens cache positions."""
+        return max(1, math.ceil(n_tokens / self.page_size))
+
+    def stats(self) -> dict:
+        return {"num_pages": self.num_pages, "page_size": self.page_size,
+                "capacity": self.capacity, "in_use": self.num_in_use,
+                "free": self.num_free, "utilization": self.utilization(),
+                "peak_in_use": self._peak_in_use}
+
+    # ---- alloc / free ----
+
+    def alloc(self, n: int) -> list[int]:
+        """Grab n pages (all-or-nothing); raises PoolExhaustedError."""
+        if n > len(self._free):
+            raise PoolExhaustedError(
+                f"need {n} pages, {len(self._free)} free "
+                f"(capacity {self.capacity})")
+        pages = [self._free.pop() for _ in range(n)]
+        self._peak_in_use = max(self._peak_in_use, self.num_in_use)
+        return pages
+
+    def free(self, pages: list[int]) -> None:
+        for p in pages:
+            if p == 0 or p >= self.num_pages:
+                raise ValueError(f"page {p} is not an allocatable page")
+            if p in self._free:
+                raise ValueError(f"double free of page {p}")
+        self._free.extend(pages)

@@ -1,0 +1,123 @@
+"""Serving metrics: per-request latency percentiles and engine gauges.
+
+Tracks the three latencies the serving literature reports —
+- TTFT  (time to first token): arrival -> first token emitted;
+- TPOT  (time per output token): (last_token_t - first_token_t) / (n-1);
+- ITL   (inter-token latency): each consecutive token gap —
+plus queue-depth and KV-pool-utilization gauges sampled once per engine
+step. The clock is injectable so tests (and ``bench.py --dry``) can feed
+a deterministic virtual time.
+"""
+
+from __future__ import annotations
+
+import time
+
+__all__ = ["ServingMetrics", "percentile"]
+
+
+def percentile(values, p: float) -> float:
+    """Nearest-rank percentile (p in [0, 100]); 0.0 on empty input."""
+    if not values:
+        return 0.0
+    xs = sorted(values)
+    if len(xs) == 1:
+        return float(xs[0])
+    rank = (p / 100.0) * (len(xs) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(xs) - 1)
+    frac = rank - lo
+    return float(xs[lo] * (1.0 - frac) + xs[hi] * frac)
+
+
+class ServingMetrics:
+    def __init__(self, clock=None):
+        self._clock = clock if clock is not None else time.monotonic
+        self._arrival: dict[str, float] = {}
+        self._first_token: dict[str, float] = {}
+        self._last_token: dict[str, float] = {}
+        self._n_tokens: dict[str, int] = {}
+        self._itl: list[float] = []
+        self._queue_depth: list[int] = []
+        self._pool_util: list[float] = []
+        self._finished = 0
+        self._preemptions = 0
+        self._start = None
+        self._end = None
+
+    def now(self) -> float:
+        return self._clock()
+
+    # ---- request lifecycle ----
+
+    def on_arrival(self, rid: str) -> None:
+        t = self.now()
+        if self._start is None:
+            self._start = t
+        self._arrival[rid] = t
+
+    def on_token(self, rid: str) -> None:
+        t = self.now()
+        if rid not in self._first_token:
+            self._first_token[rid] = t
+        else:
+            self._itl.append(t - self._last_token[rid])
+        self._last_token[rid] = t
+        self._n_tokens[rid] = self._n_tokens.get(rid, 0) + 1
+        self._end = t
+
+    def on_finish(self, rid: str) -> None:
+        self._finished += 1
+        self._end = self.now()
+
+    def on_preemption(self) -> None:
+        self._preemptions += 1
+
+    # ---- per-step gauges ----
+
+    def on_step(self, queue_depth: int, pool_utilization: float) -> None:
+        self._queue_depth.append(queue_depth)
+        self._pool_util.append(pool_utilization)
+
+    # ---- aggregation ----
+
+    def ttfts(self) -> list[float]:
+        return [self._first_token[r] - self._arrival[r]
+                for r in self._first_token if r in self._arrival]
+
+    def tpots(self) -> list[float]:
+        out = []
+        for r, n in self._n_tokens.items():
+            if n > 1:
+                out.append((self._last_token[r] - self._first_token[r])
+                           / (n - 1))
+        return out
+
+    @property
+    def total_tokens(self) -> int:
+        return sum(self._n_tokens.values())
+
+    def summary(self) -> dict:
+        ttft = self.ttfts()
+        tpot = self.tpots()
+        wall = ((self._end - self._start)
+                if self._start is not None and self._end is not None else 0.0)
+        return {
+            "requests_finished": self._finished,
+            "tokens_generated": self.total_tokens,
+            "wall_s": wall,
+            "tokens_per_s": (self.total_tokens / wall) if wall > 0 else 0.0,
+            "ttft_p50_s": percentile(ttft, 50),
+            "ttft_p99_s": percentile(ttft, 99),
+            "tpot_mean_s": (sum(tpot) / len(tpot)) if tpot else 0.0,
+            "itl_p50_s": percentile(self._itl, 50),
+            "itl_p99_s": percentile(self._itl, 99),
+            "preemptions": self._preemptions,
+            "queue_depth_max": max(self._queue_depth, default=0),
+            "queue_depth_mean": (sum(self._queue_depth)
+                                 / len(self._queue_depth)
+                                 if self._queue_depth else 0.0),
+            "kv_util_mean": (sum(self._pool_util) / len(self._pool_util)
+                             if self._pool_util else 0.0),
+            "kv_util_peak": max(self._pool_util, default=0.0),
+        }

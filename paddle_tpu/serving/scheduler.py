@@ -1,0 +1,177 @@
+"""Iteration-level (continuous-batching) scheduler — Orca, OSDI '22.
+
+FCFS with a per-step prefill token budget: every engine step the
+scheduler first guarantees the running slots their next decode-write
+page (preempting from the youngest when the pool is exhausted —
+preempt-and-recompute, vLLM's recompute policy), then admits waiting
+requests in strict arrival order while slots, pool pages and the token
+budget allow. Requests therefore join and leave the running batch at
+token granularity; nothing ever waits for a whole batch to drain.
+
+All state here is host-side Python (deques and integer lists); the
+device-side consequences (block tables, active masks, position offsets)
+are materialized by the engine as plain array inputs to its single
+compiled decode program.
+"""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass, field
+
+from .kv_cache import KVCachePool, PoolExhaustedError
+
+__all__ = ["Request", "SamplingParams", "Scheduler",
+           "WAITING", "RUNNING", "FINISHED", "PREEMPTED"]
+
+WAITING = "waiting"
+RUNNING = "running"
+PREEMPTED = "preempted"
+FINISHED = "finished"
+
+
+@dataclass
+class SamplingParams:
+    """Per-request decode controls (each becomes a per-slot array lane in
+    the compiled decode step — changing them never retraces)."""
+    temperature: float = 1.0
+    top_p: float = 1.0
+    do_sample: bool = False  # False -> greedy argmax
+    seed: int = 0
+
+
+@dataclass
+class Request:
+    rid: str
+    prompt: list[int]
+    max_new_tokens: int
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    eos_token_id: int | None = None
+
+    # lifecycle
+    state: str = WAITING
+    arrival_seq: int = 0          # admission priority (FCFS tiebreak)
+    tokens: list[int] = field(default_factory=list)   # generated so far
+    finish_reason: str | None = None
+    preemptions: int = 0
+
+    # cache bookkeeping (valid while RUNNING)
+    slot: int | None = None
+    pages: list[int] = field(default_factory=list)
+    context_len: int = 0          # tokens currently materialized in cache
+
+    @property
+    def recompute_len(self) -> int:
+        """Prefill length on (re-)admission: the prompt plus all generated
+        tokens except the last, which is the decode input (after a
+        preemption the cache is rebuilt exactly to where it was)."""
+        return len(self.prompt) + max(0, len(self.tokens) - 1)
+
+    @property
+    def done(self) -> bool:
+        return self.state == FINISHED
+
+
+class Scheduler:
+    def __init__(self, max_slots: int, prefill_token_budget: int = 2048):
+        self.max_slots = max_slots
+        self.prefill_token_budget = prefill_token_budget
+        self.waiting: list[Request] = []   # kept sorted by arrival_seq
+        self.running: dict[int, Request] = {}   # slot -> request
+        self._free_slots = list(range(max_slots - 1, -1, -1))
+        self._arrival_counter = 0
+        self.num_preemptions = 0
+
+    # ---- queue ----
+
+    def add(self, req: Request) -> None:
+        req.arrival_seq = self._arrival_counter
+        self._arrival_counter += 1
+        req.state = WAITING
+        self.waiting.append(req)
+
+    def _requeue(self, req: Request) -> None:
+        """Put a preempted request back, keeping FCFS (arrival) order."""
+        req.state = PREEMPTED
+        keys = [r.arrival_seq for r in self.waiting]
+        self.waiting.insert(bisect.bisect_left(keys, req.arrival_seq), req)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # ---- preemption ----
+
+    def _preempt_youngest(self, pool: KVCachePool) -> Request:
+        victim = max(self.running.values(), key=lambda r: r.arrival_seq)
+        self._release(victim, pool)
+        victim.preemptions += 1
+        self.num_preemptions += 1
+        self._requeue(victim)
+        return victim
+
+    def _release(self, req: Request, pool: KVCachePool) -> None:
+        pool.free(req.pages)
+        req.pages = []
+        self._free_slots.append(req.slot)
+        del self.running[req.slot]
+        req.slot = None
+        req.context_len = 0
+
+    def finish(self, req: Request, pool: KVCachePool, reason: str) -> None:
+        self._release(req, pool)
+        req.state = FINISHED
+        req.finish_reason = reason
+
+    # ---- the per-step scheduling decision ----
+
+    def ensure_decode_pages(self, pool: KVCachePool) -> list[Request]:
+        """Before a decode step: every running request writes its next
+        token at position context_len — make sure that page exists.
+        Oldest requests are served first; when the pool is exhausted the
+        youngest running request is preempted (possibly the one asking).
+        Returns the requests preempted this call."""
+        preempted: list[Request] = []
+        for req in sorted(self.running.values(), key=lambda r: r.arrival_seq):
+            if req.slot is None:  # lost its slot to an earlier preemption
+                continue
+            needed = pool.pages_for(req.context_len + 1) - len(req.pages)
+            while needed > 0:
+                try:
+                    req.pages.extend(pool.alloc(needed))
+                    needed = 0
+                except PoolExhaustedError:
+                    victim = self._preempt_youngest(pool)
+                    preempted.append(victim)
+                    if victim is req:
+                        break  # it preempted itself; nothing left to grow
+        return preempted
+
+    def admit(self, pool: KVCachePool) -> list[Request]:
+        """Admit waiting requests in strict FCFS order while a slot, the
+        pool, and the per-step prefill token budget allow. Stops at the
+        first request that does not fit (no queue jumping). Returns the
+        admitted requests with slot + prompt pages assigned; the engine
+        runs their prefills."""
+        admitted: list[Request] = []
+        budget = self.prefill_token_budget
+        while self.waiting and self._free_slots:
+            req = self.waiting[0]
+            need_tokens = max(req.recompute_len, 1)
+            if admitted and need_tokens > budget:
+                break
+            n_pages = pool.pages_for(need_tokens)
+            if n_pages > pool.num_free:
+                break
+            self.waiting.pop(0)
+            req.pages = pool.alloc(n_pages)
+            req.slot = self._free_slots.pop()
+            req.state = RUNNING
+            req.context_len = need_tokens
+            self.running[req.slot] = req
+            admitted.append(req)
+            budget -= need_tokens
+        return admitted

@@ -13,7 +13,7 @@ _REPO = Path(__file__).resolve().parents[1]
 
 _DEFAULT_CONFIGS = {
     "llama_420m", "resnet50", "bert_base", "qwen2_moe", "lenet_mnist",
-    "llama8b_shape", "llama_decode", "llama_longctx",
+    "llama8b_shape", "llama_decode", "llama_longctx", "llama_serving",
 }
 
 
@@ -64,3 +64,18 @@ def test_summary_entry_picks_the_configs_efficiency_ratio():
         "value": 3.0, "mfu": 0.7, "spread": 0.03}
     assert bench._summary_entry(err) == {
         "value": None, "mfu": None, "spread": None}
+    serving = {"value": 4.0, "extra": {"mbu_weights_only": 0.2,
+                                       "ttft_p50": 0.1, "ttft_p99": 0.4,
+                                       "tpot": 0.02, "spread": None}}
+    assert bench._summary_entry(serving, "llama_serving") == {
+        "value": 4.0, "mfu": 0.2, "spread": None,
+        "ttft_p50": 0.1, "ttft_p99": 0.4, "tpot": 0.02}
+
+
+def test_dry_serving_cell_carries_latency_keys():
+    out = _run_dry("llama_serving")
+    assert out.returncode == 0, out.stderr
+    last = json.loads(out.stdout.splitlines()[-1])
+    cell = last["bench_summary"]["llama_serving"]
+    assert set(cell) >= {"value", "mfu", "spread",
+                         "ttft_p50", "ttft_p99", "tpot"}, cell

@@ -19,3 +19,21 @@ def test_example_runs(name):
         [sys.executable, os.path.join(REPO, "examples", f"{name}.py")],
         capture_output=True, text=True, timeout=420, env=env)
     assert proc.returncode == 0, (proc.stdout[-1500:], proc.stderr[-1500:])
+
+
+def test_examples_use_public_surfaces_only():
+    """Examples are copy-paste templates: they must not poke private
+    model attributes (the decode program cache has a public accessor,
+    LlamaForCausalLM.decode_cache_stats)."""
+    examples_dir = os.path.join(REPO, "examples")
+    offenders = []
+    for fn in sorted(os.listdir(examples_dir)):
+        if not fn.endswith(".py"):
+            continue
+        with open(os.path.join(examples_dir, fn)) as f:
+            src = f.read()
+        if "_decode_prog_cache" in src:
+            offenders.append(fn)
+    assert not offenders, (
+        f"examples poke the private decode program cache: {offenders}; "
+        f"use model.decode_cache_stats() instead")

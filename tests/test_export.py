@@ -79,6 +79,40 @@ def test_predictor_api(tmp_path):
     np.testing.assert_allclose(out_h.copy_to_cpu(), want, rtol=1e-5, atol=1e-6)
 
 
+def test_predictor_missing_artifact_prefix(tmp_path):
+    import pytest
+    with pytest.raises(FileNotFoundError, match="missing"):
+        pt.inference.create_predictor(
+            pt.inference.Config(str(tmp_path / "no-such-model")))
+
+
+def test_predictor_input_validation_errors(tmp_path):
+    import pytest
+    model = _make_model()
+    model.eval()
+    prefix = str(tmp_path / "m")
+    pt.jit.save(model, prefix, input_spec=[pt.jit.InputSpec([2, 16])])
+    predictor = pt.inference.create_predictor(pt.inference.Config(prefix))
+    with pytest.raises(KeyError, match="unknown input name"):
+        predictor.get_input_handle("input_9")
+    with pytest.raises(ValueError, match="shape mismatch"):
+        predictor.run([np.zeros((3, 16), np.float32)])
+    with pytest.raises(TypeError, match="dtype mismatch"):
+        predictor.run([np.zeros((2, 16), np.float64)])
+    with pytest.raises(ValueError, match="inputs not set"):
+        predictor.run()
+    with pytest.raises(ValueError, match="takes 1 input"):
+        predictor.run([np.zeros((2, 16), np.float32)] * 2)
+    handle = predictor.get_input_handle("input_0")
+    with pytest.raises(ValueError, match="shape mismatch"):
+        handle.copy_from_cpu(np.zeros((5, 16), np.float32))
+    with pytest.raises(KeyError, match="unknown output"):
+        predictor.get_output_handle("output_42")
+    # after the failures, a valid run still works
+    handle.copy_from_cpu(np.zeros((2, 16), np.float32))
+    assert predictor.run()[0].shape == (2, 4)
+
+
 def test_save_llama_reload_same_logits(tmp_path):
     """Flagship export: save Llama, reload, same logits (verdict done-bar)."""
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM

@@ -1,0 +1,369 @@
+"""paddle_tpu.serving — continuous batching over the paged KV pool.
+
+The two contracts that define the subsystem (SERVING.md):
+
+1. DETERMINISM — greedy requests fed through the engine (staggered
+   arrivals, shared pool, preempt-and-recompute) produce tokens bitwise
+   identical to a standalone per-request ``model.generate()`` (fp32 CPU).
+2. NO RETRACE — the decode step is ONE compiled program for the
+   engine's lifetime; requests joining/finishing/preempting never change
+   its compiled-program count.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import (KVCachePool, PoolExhaustedError, Request,
+                                SamplingParams, Scheduler, ServingEngine,
+                                ServingMetrics, percentile)
+
+RNG = np.random.default_rng(7)
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(123)
+    m = LlamaForCausalLM(llama_tiny(dtype="float32",
+                                    mp_axis=None, fsdp_axis=None))
+    m.eval()
+    return m
+
+
+def _reference(model, prompt, max_new, **kw):
+    out = model.generate(jnp.asarray([prompt]), max_new_tokens=max_new, **kw)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+# ---------------------------------------------------------------------------
+# KV-cache pool
+# ---------------------------------------------------------------------------
+
+class TestKVCachePool:
+    def test_shapes_and_reserved_scratch_page(self):
+        pool = KVCachePool(num_layers=3, num_pages=8, page_size=4,
+                           num_kv_heads=2, head_dim=16)
+        assert len(pool.pools) == 3
+        assert pool.pools[0][0].shape == (8, 4, 2, 16)
+        assert pool.capacity == 7  # page 0 reserved
+        got = pool.alloc(7)
+        assert 0 not in got
+
+    def test_alloc_all_or_nothing_and_accounting(self):
+        pool = KVCachePool(1, 6, 4, 2, 8)
+        a = pool.alloc(2)
+        assert pool.num_in_use == 2 and pool.num_free == 3
+        with pytest.raises(PoolExhaustedError):
+            pool.alloc(4)  # only 3 free — must not tear off a partial grab
+        assert pool.num_free == 3
+        pool.free(a)
+        assert pool.num_in_use == 0
+        assert pool.utilization() == 0.0
+        assert pool.stats()["peak_in_use"] == 2
+
+    def test_free_rejects_scratch_double_and_bogus(self):
+        pool = KVCachePool(1, 6, 4, 2, 8)
+        pages = pool.alloc(1)
+        pool.free(pages)
+        with pytest.raises(ValueError, match="double free"):
+            pool.free(pages)
+        with pytest.raises(ValueError, match="not an allocatable"):
+            pool.free([0])
+        with pytest.raises(ValueError, match="not an allocatable"):
+            pool.free([99])
+
+    def test_pages_for(self):
+        pool = KVCachePool(1, 6, 4, 2, 8)
+        assert pool.pages_for(1) == 1
+        assert pool.pages_for(4) == 1
+        assert pool.pages_for(5) == 2
+        assert pool.pages_for(0) == 1  # a slot always owns >= 1 page
+
+
+# ---------------------------------------------------------------------------
+# scheduler
+# ---------------------------------------------------------------------------
+
+class TestScheduler:
+    def _pool(self, pages=16, ps=4):
+        return KVCachePool(1, pages, ps, 2, 8)
+
+    def test_fcfs_admission_respects_budget_and_slots(self):
+        pool = self._pool()
+        sched = Scheduler(max_slots=2, prefill_token_budget=8)
+        for i, n in enumerate((4, 6, 3)):
+            sched.add(Request(rid=f"r{i}", prompt=list(range(n)),
+                              max_new_tokens=4))
+        admitted = sched.admit(pool)
+        # r0 (4 tokens) fits; r1 (6) exceeds the remaining budget (4) so
+        # it waits for the next step — the budget bounds per-step prefill
+        assert [r.rid for r in admitted] == ["r0"]
+        assert sched.queue_depth == 2
+        assert admitted[0].slot is not None and admitted[0].pages
+        # next step: r1 goes first (FCFS), r2 again over the leftover budget
+        assert [r.rid for r in sched.admit(pool)] == ["r1"]
+        assert sched.admit(pool) == []  # both slots now occupied
+
+    def test_no_queue_jumping_when_head_does_not_fit(self):
+        pool = self._pool(pages=3, ps=4)  # capacity 2 pages
+        sched = Scheduler(max_slots=2, prefill_token_budget=64)
+        sched.add(Request(rid="big", prompt=list(range(12)),
+                          max_new_tokens=1))  # needs 3 pages > capacity
+        sched.add(Request(rid="small", prompt=[1], max_new_tokens=1))
+        assert sched.admit(pool) == []  # strict FCFS: small must wait
+
+    def test_preempt_youngest_and_requeue_order(self):
+        pool = self._pool(pages=5, ps=4)  # capacity 4
+        sched = Scheduler(max_slots=2)
+        r0 = Request(rid="r0", prompt=list(range(8)), max_new_tokens=8)
+        r1 = Request(rid="r1", prompt=list(range(8)), max_new_tokens=8)
+        sched.add(r0)
+        sched.add(r1)
+        assert len(sched.admit(pool)) == 2  # 2 pages each
+        r0.tokens, r1.tokens = [5], [6]
+        # growing r0 to a 3rd page must evict r1 (youngest), not r0
+        r0.context_len = r1.context_len = 8
+        preempted = sched.ensure_decode_pages(pool)
+        assert [r.rid for r in preempted] == ["r1"]
+        assert r1.state == "preempted" and r1.pages == [] and r1.slot is None
+        assert sched.waiting[0].rid == "r1"  # back at its arrival position
+        assert len(r0.pages) == 3  # the oldest got its page
+
+    def test_finish_releases_resources(self):
+        pool = self._pool()
+        sched = Scheduler(max_slots=1)
+        r = Request(rid="r", prompt=[1, 2], max_new_tokens=2)
+        sched.add(r)
+        sched.admit(pool)
+        sched.finish(r, pool, "length")
+        assert r.done and r.finish_reason == "length"
+        assert pool.num_in_use == 0 and not sched.running
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+class TestMetrics:
+    def test_percentile(self):
+        assert percentile([], 50) == 0.0
+        assert percentile([3.0], 99) == 3.0
+        assert percentile([1.0, 2.0, 3.0, 4.0], 50) == 2.5
+        assert percentile([1.0, 2.0, 3.0, 4.0], 100) == 4.0
+
+    def test_ttft_tpot_itl_with_virtual_clock(self):
+        t = [0.0]
+        m = ServingMetrics(clock=lambda: t[0])
+        m.on_arrival("a")
+        t[0] = 1.0
+        m.on_token("a")           # TTFT = 1.0
+        t[0] = 1.5
+        m.on_token("a")           # ITL 0.5
+        t[0] = 2.5
+        m.on_token("a")           # ITL 1.0
+        m.on_finish("a")
+        m.on_step(queue_depth=2, pool_utilization=0.5)
+        s = m.summary()
+        assert s["ttft_p50_s"] == pytest.approx(1.0)
+        assert s["tpot_mean_s"] == pytest.approx(0.75)  # (2.5-1.0)/2
+        assert s["itl_p50_s"] == pytest.approx(0.75)
+        assert s["tokens_generated"] == 3
+        assert s["requests_finished"] == 1
+        assert s["queue_depth_max"] == 2
+        assert s["kv_util_peak"] == 0.5
+        assert s["tokens_per_s"] == pytest.approx(3 / 2.5)
+
+
+# ---------------------------------------------------------------------------
+# the engine: determinism + no-retrace contracts
+# ---------------------------------------------------------------------------
+
+class TestServingEngine:
+    def test_greedy_equivalence_staggered_arrivals(self, model):
+        prompts = [list(RNG.integers(0, 512, n)) for n in (5, 9, 3, 12)]
+        max_new = 8
+        refs = [_reference(model, p, max_new) for p in prompts]
+        eng = ServingEngine(model, num_pages=64, page_size=4, max_slots=4,
+                            max_pages_per_slot=8)
+        rids = [eng.add_request(prompts[0], max_new),
+                eng.add_request(prompts[1], max_new)]
+        eng.step()
+        rids.append(eng.add_request(prompts[2], max_new))
+        eng.step()
+        rids.append(eng.add_request(prompts[3], max_new))
+        res = eng.run_to_completion(max_steps=200)
+        for rid, ref in zip(rids, refs):
+            assert res[rid] == ref  # bitwise: same argmax stream
+        assert eng.decode_program_count() == 1
+
+    def test_greedy_equivalence_through_preemption(self, model):
+        prompts = [list(RNG.integers(0, 512, n)) for n in (6, 7)]
+        max_new = 10
+        refs = [_reference(model, p, max_new) for p in prompts]
+        # capacity 6 pages; the two requests need 4 + 5 at full length,
+        # so decode growth must preempt-and-recompute
+        eng = ServingEngine(model, num_pages=7, page_size=4, max_slots=2,
+                            max_pages_per_slot=6)
+        rids = [eng.add_request(p, max_new) for p in prompts]
+        res = eng.run_to_completion(max_steps=500)
+        assert eng.scheduler.num_preemptions > 0, \
+            "config failed to exercise preemption"
+        for rid, ref in zip(rids, refs):
+            assert res[rid] == ref
+        assert eng.decode_program_count() == 1
+        assert eng.metrics.summary()["preemptions"] > 0
+
+    def test_no_retrace_across_scheduling_epochs(self, model):
+        """Join/leave churn across >= 3 drain epochs with varying prompt
+        lengths, batch sizes and sampling params: the decode step must
+        stay ONE compiled program."""
+        eng = ServingEngine(model, num_pages=64, page_size=4, max_slots=4,
+                            max_pages_per_slot=8)
+        for epoch in range(3):
+            lens = [3 + epoch, 5, 8][: 2 + epoch % 2]
+            for i, n in enumerate(lens):
+                sp = (SamplingParams(do_sample=True, top_p=0.8,
+                                     temperature=0.7, seed=epoch * 10 + i)
+                      if i % 2 else None)
+                eng.add_request(list(RNG.integers(0, 512, n)),
+                                max_new_tokens=4 + epoch, sampling=sp)
+            eng.run_to_completion(max_steps=200)
+            assert eng.decode_program_count() == 1, f"retraced in epoch {epoch}"
+        assert eng.stats()["decode_programs"] == 1
+
+    def test_eos_stops_request_early(self, model):
+        prompt = list(RNG.integers(0, 512, 6))
+        ref = _reference(model, prompt, 8)
+        eos = ref[2]  # a token the greedy stream actually emits
+        k = ref.index(eos)  # first occurrence is where decode stops
+        eng = ServingEngine(model, num_pages=32, page_size=4, max_slots=2)
+        rid = eng.add_request(prompt, 8, eos_token_id=eos)
+        res = eng.run_to_completion(max_steps=100)
+        assert res[rid] == ref[: k + 1]  # stops AT the eos token
+        assert eng.request(rid).finish_reason == "stop"
+
+    @pytest.mark.slow
+    def test_sampled_stream_invariant_to_batch_composition(self, model):
+        """fold_in(PRNGKey(seed), token_index) keying: a sampled request
+        draws the same tokens alone as when sharing the engine."""
+        prompt = list(RNG.integers(0, 512, 5))
+        sp = SamplingParams(do_sample=True, top_p=0.9, temperature=0.8,
+                            seed=42)
+        eng1 = ServingEngine(model, num_pages=64, page_size=4, max_slots=4)
+        r_alone = eng1.add_request(prompt, 6, sampling=sp)
+        alone = eng1.run_to_completion(max_steps=100)[r_alone]
+        eng2 = ServingEngine(model, num_pages=64, page_size=4, max_slots=4)
+        eng2.add_request(list(RNG.integers(0, 512, 7)), 6)  # companion
+        r_shared = eng2.add_request(prompt, 6, sampling=sp)
+        shared = eng2.run_to_completion(max_steps=100)[r_shared]
+        assert alone == shared
+
+    def test_stream_yields_tokens_and_finish(self, model):
+        eng = ServingEngine(model, num_pages=32, page_size=4, max_slots=2)
+        rid = eng.add_request(list(RNG.integers(0, 512, 4)), 3)
+        evs = list(eng.stream())
+        mine = [e for e in evs if e["rid"] == rid]
+        assert len(mine) == 3
+        assert mine[-1]["finished"] and mine[-1]["finish_reason"] == "length"
+        assert [e["token"] for e in mine] == eng.request(rid).tokens
+
+    def test_request_too_large_rejected_upfront(self, model):
+        eng = ServingEngine(model, num_pages=8, page_size=4, max_slots=2,
+                            max_pages_per_slot=4)
+        with pytest.raises(ValueError, match="pages"):
+            eng.add_request(list(range(1, 30)), 8)  # > max_pages_per_slot
+        with pytest.raises(ValueError, match="non-empty"):
+            eng.add_request([], 4)
+
+    def test_pool_drains_clean_after_completion(self, model):
+        eng = ServingEngine(model, num_pages=32, page_size=4, max_slots=2)
+        for n in (4, 6, 5):
+            eng.add_request(list(RNG.integers(0, 512, n)), 4)
+        eng.run_to_completion(max_steps=200)
+        assert eng.pool.num_in_use == 0
+        assert eng.scheduler.queue_depth == 0
+        assert not eng.scheduler.running
+        m = eng.metrics.summary()
+        assert m["requests_finished"] == 3
+        assert m["tokens_generated"] == 12
+
+
+# ---------------------------------------------------------------------------
+# the Pallas block-table kernel (interpret mode on CPU)
+# ---------------------------------------------------------------------------
+
+class TestPagedAttentionKernel:
+    def test_kernel_applicable_gate(self):
+        from paddle_tpu.ops.pallas.paged_attention import kernel_applicable
+        assert kernel_applicable((2, 1, 4, 128), (8, 8, 2, 128))
+        assert not kernel_applicable((2, 2, 4, 128), (8, 8, 2, 128))  # s>1
+        assert not kernel_applicable((2, 1, 4, 64), (8, 8, 2, 64))    # lanes
+        assert not kernel_applicable((2, 1, 4, 128), (8, 6, 2, 128))  # page
+        assert not kernel_applicable((2, 1, 3, 128), (8, 8, 2, 128))  # GQA
+
+    def test_kernel_matches_xla_gather_path(self):
+        from paddle_tpu.nn.functional.attention import _grouped_decode_attn
+        from paddle_tpu.ops.pallas.paged_attention import (
+            kernel_applicable, paged_attention_tpu)
+        b, h, kvh, d, ps, M, npages = 3, 4, 2, 128, 8, 3, 8
+        assert kernel_applicable((b, 1, h, d), (npages, ps, kvh, d))
+        q = jnp.asarray(RNG.standard_normal((b, 1, h, d)), jnp.float32)
+        pk = jnp.asarray(RNG.standard_normal((npages, ps, kvh, d)),
+                         jnp.float32)
+        pv = jnp.asarray(RNG.standard_normal((npages, ps, kvh, d)),
+                         jnp.float32)
+        tables = jnp.asarray(RNG.permutation(np.arange(1, npages))[: b * M]
+                             .reshape(b, M) if b * M < npages else
+                             RNG.integers(1, npages, (b, M)), jnp.int32)
+        lens = jnp.asarray([5, ps * M - 1, ps + 3], jnp.int32)
+        got = paged_attention_tpu(q, pk, pv, tables, lens)
+        kg = pk[tables].reshape(b, M * ps, kvh, d)
+        vg = pv[tables].reshape(b, M * ps, kvh, d)
+        want = _grouped_decode_attn(q, kg, vg, lens, 1.0 / np.sqrt(d))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-6, atol=2e-6)
+
+
+# ---------------------------------------------------------------------------
+# front-end + model surface satellites
+# ---------------------------------------------------------------------------
+
+class TestFrontEnds:
+    @pytest.mark.slow
+    def test_llm_predictor_matches_generate(self, model):
+        from paddle_tpu.inference import create_llm_predictor
+        prompts = [list(RNG.integers(0, 512, n)) for n in (4, 7)]
+        pred = create_llm_predictor(model, num_pages=32, page_size=4,
+                                    max_slots=4)
+        outs = pred.generate(prompts, max_new_tokens=5)
+        for p, got in zip(prompts, outs):
+            assert got == _reference(model, p, 5)
+        assert pred.metrics_summary()["requests_finished"] == 2
+        assert pred.stats()["decode_programs"] == 1
+
+    def test_decode_cache_stats_public_surface(self, model):
+        stats = model.decode_cache_stats()
+        assert set(stats) >= {"signatures", "capacity", "signature_keys"}
+        before = stats["signatures"]
+        model.generate(jnp.asarray([[1, 2, 3]]), max_new_tokens=2)
+        model.generate(jnp.asarray([[4, 5, 6]]), max_new_tokens=2)  # same sig
+        after = model.decode_cache_stats()
+        assert after["signatures"] == before + 1
+        assert after["capacity"] == 16
+        assert len(after["signature_keys"]) == after["signatures"]
+
+    def test_generate_eos_pins_tail_to_pad(self, model):
+        prompt = list(RNG.integers(0, 512, 5))
+        ref = _reference(model, prompt, 8)
+        eos = ref[1]
+        got = _reference(model, prompt, 8, eos_token_id=eos, pad_token_id=0)
+        k = ref.index(eos)
+        assert got[: k + 1] == ref[: k + 1]
+        assert got[k + 1:] == [0] * (len(ref) - k - 1)
+        # eager loop path pins identically (bitwise scan/eager parity)
+        eager = _reference(model, prompt, 8, eos_token_id=eos,
+                           pad_token_id=0, jit_loop=False)
+        assert eager == got
