@@ -684,6 +684,10 @@ def bench_llama_serving(peak, peak_kind, n_requests=12, max_new_tokens=64):
                   "tpot": round(m["tpot_mean_s"], 5),
                   "itl_p99": round(m["itl_p99_s"], 5),
                   "preemptions": m["preemptions"],
+                  "rejected": m["rejected"],
+                  "timed_out": m["timed_out"],
+                  "quarantined": m["quarantined"],
+                  "queue_wait_p99": round(m["queue_wait_p99_s"], 4),
                   "kv_util_peak": round(m["kv_util_peak"], 4),
                   "queue_depth_max": m["queue_depth_max"],
                   "mbu_weights_only": round(mbu, 4),
@@ -762,7 +766,8 @@ _CONFIGS = {
 # {value, mfu, spread} — mirrored as nulls in --dry skeleton mode so the
 # driver sees a stable schema either way
 _SUMMARY_EXTRA_KEYS = {
-    "llama_serving": ("ttft_p50", "ttft_p99", "tpot"),
+    "llama_serving": ("ttft_p50", "ttft_p99", "tpot",
+                      "rejected", "timed_out", "quarantined"),
 }
 
 # opt-in configs (not in the default driver run — kept out to bound its

@@ -19,12 +19,19 @@ Named sites wired into the runtime (see RESILIENCE.md):
   dir is renamed into place
 - ``ckpt.barrier``     — the cross-rank checkpoint barrier
 - ``collective.barrier`` — the eager collective barrier
+- ``serving.step`` / ``serving.prefill`` / ``serving.decode`` /
+  ``serving.alloc`` — the serving engine's per-step, per-request and
+  page-allocation sites (SERVING.md "Serving failure modes"); the
+  per-request sites pass the request id as ``ctx['path']`` so ``match``
+  pins a fault to ONE request.
 
 Actions: ``hang`` (sleep ``arg`` seconds — trips the comm watchdog),
 ``kill`` (SIGKILL self: the un-catchable death), ``exit`` (``os._exit(arg)``),
 ``raise`` (raise :class:`FaultInjected`), ``torn`` (truncate the file in
 ``ctx['path']`` to half its size — a torn write), ``corrupt`` (flip one
-byte mid-file).
+byte mid-file), ``poison`` (invoke the site's ``ctx['poison']`` callback —
+serving sites pass one that writes NaN into the request's KV pages, the
+device-buffer analogue of ``corrupt``).
 
 Activation: programmatically via :func:`activate`, or across process
 boundaries via the ``PADDLE_FAULT_PLAN`` env var holding
@@ -74,7 +81,7 @@ class FaultSpec:
 
     def __post_init__(self):
         if self.action not in ("hang", "kill", "exit", "raise", "torn",
-                               "corrupt"):
+                               "corrupt", "poison"):
             raise ValueError(f"unknown fault action {self.action!r}")
 
 
@@ -159,6 +166,11 @@ class FaultPlan:
             os._exit(int(spec.arg if spec.arg is not None else 1))
         elif spec.action == "raise":
             raise FaultInjected(tag)
+        elif spec.action == "poison":
+            fn = ctx.get("poison")
+            if fn is None:
+                raise FaultInjected(f"{tag}: site passed no poison callback")
+            fn()
         elif spec.action in ("torn", "corrupt"):
             path = ctx.get("path")
             if not path or not os.path.exists(path):

@@ -73,7 +73,12 @@ class CommWatchdog:
         self._lock = threading.Lock()
 
     @contextlib.contextmanager
-    def task(self, name: str, **meta):
+    def task(self, name: str, timeout: float | None = None, **meta):
+        """Watch one blocking call. ``timeout`` overrides the watchdog
+        default for this task only — the serving engine uses it to hold
+        its per-step device sync to a much tighter budget than a
+        checkpoint barrier."""
+        limit = self.timeout if timeout is None else float(timeout)
         rec = _TaskRecord(name=name, started=time.monotonic(), meta=meta)
         with self._lock:
             self.records.append(rec)
@@ -85,10 +90,10 @@ class CommWatchdog:
         done = threading.Event()
 
         def monitor():
-            if not done.wait(self.timeout):
+            if not done.wait(limit):
                 rec.timed_out = True
                 msg = (f"[comm watchdog] task {name!r} exceeded "
-                       f"{self.timeout:.1f}s "
+                       f"{limit:.1f}s "
                        f"(rank={_rank()}, "
                        f"meta={meta}) — possible hung collective")
                 logger.error(msg)
@@ -116,7 +121,7 @@ class CommWatchdog:
             if rec.timed_out and self.action == "raise":
                 raise TimeoutError(
                     f"comm task {name!r} took {rec.elapsed:.1f}s "
-                    f"(timeout {self.timeout:.1f}s)")
+                    f"(timeout {limit:.1f}s)")
 
     def timed_out_tasks(self):
         with self._lock:
@@ -168,6 +173,6 @@ def default_watchdog() -> CommWatchdog:
     return _default[0]
 
 
-def watch(name: str, **meta):
+def watch(name: str, timeout: float | None = None, **meta):
     """Convenience: ``with watch('barrier'):`` on the default watchdog."""
-    return default_watchdog().task(name, **meta)
+    return default_watchdog().task(name, timeout=timeout, **meta)

@@ -28,22 +28,41 @@ class LLMPredictor:
     def __init__(self, model, num_pages: int = 128, page_size: int = 16,
                  max_slots: int = 8, max_pages_per_slot: int | None = None,
                  prefill_token_budget: int = 2048, kv_dtype=None,
-                 clock=None):
+                 clock=None, max_queue_depth: int | None = None,
+                 max_preemptions: int | None = None,
+                 step_timeout_s: float | None = None,
+                 drain_timeout_s: float | None = 30.0):
         from ..serving import ServingEngine
         self.model = model
         self._mk = lambda: ServingEngine(
             model, num_pages=num_pages, page_size=page_size,
             max_slots=max_slots, max_pages_per_slot=max_pages_per_slot,
             prefill_token_budget=prefill_token_budget, kv_dtype=kv_dtype,
-            clock=clock)
+            clock=clock, max_queue_depth=max_queue_depth,
+            max_preemptions=max_preemptions, step_timeout_s=step_timeout_s,
+            drain_timeout_s=drain_timeout_s)
         self.engine = self._mk()
+
+    #: typed serving error -> the stable ``error`` string reported by
+    #: :meth:`generate_detailed` (documented in SERVING.md "Serving
+    #: failure modes"; the set is append-only — callers may switch on it)
+    FAILURE_CODES = {
+        "QueueFullError": "queue_full",
+        "RequestTooLargeError": "too_large",
+        "EngineDrainingError": "draining",
+        "SchedulerStalledError": "scheduler_stalled",
+    }
 
     def generate(self, prompts, max_new_tokens: int = 32,
                  eos_token_id: int | None = None, sampling=None,
                  max_steps: int | None = None):
         """Run a batch of ragged prompts to completion; returns a list of
         generated-token lists in prompt order. ``sampling`` is one
-        SamplingParams for all, or a per-prompt list."""
+        SamplingParams for all, or a per-prompt list. Raises the typed
+        serving errors (QueueFullError / RequestTooLargeError /
+        EngineDrainingError / SchedulerStalledError) — use
+        :meth:`generate_detailed` for per-prompt failure results
+        instead of exceptions."""
         if sampling is not None and isinstance(sampling, (list, tuple)):
             if len(sampling) != len(prompts):
                 raise ValueError(
@@ -58,6 +77,68 @@ class LLMPredictor:
                 for p, sp in zip(prompts, per)]
         results = self.engine.run_to_completion(max_steps=max_steps)
         return [results[rid] for rid in rids]
+
+    def generate_detailed(self, prompts, max_new_tokens: int = 32,
+                          eos_token_id: int | None = None, sampling=None,
+                          deadline_s: float | None = None,
+                          max_queue_wait_s: float | None = None,
+                          max_steps: int | None = None):
+        """Like :meth:`generate`, but every typed serving failure becomes
+        a stable per-prompt result instead of an exception. Returns one
+        dict per prompt, in order:
+
+        ``{"tokens": [...], "finish_reason": str | None, "error":
+        None | "queue_full" | "too_large" | "draining" |
+        "scheduler_stalled"}``
+
+        Rejected prompts carry ``finish_reason="rejected"`` and empty
+        tokens; accepted prompts carry the engine's classified
+        finish_reason (``stop`` / ``length`` / ``timeout`` /
+        ``nonfinite`` / ``preempted`` / ``preempted_limit`` /
+        ``injected`` — SERVING.md). A scheduler stall marks every
+        still-unfinished prompt ``scheduler_stalled`` rather than
+        raising."""
+        from ..serving import SchedulerStalledError, ServingError
+        if sampling is not None and isinstance(sampling, (list, tuple)):
+            per = list(sampling)
+        else:
+            per = [sampling] * len(prompts)
+        outcomes = [None] * len(prompts)
+        rids: dict[str, int] = {}
+        for i, (p, sp) in enumerate(zip(prompts, per)):
+            try:
+                rid = self.engine.add_request(
+                    np.asarray(p).reshape(-1), max_new_tokens, sampling=sp,
+                    eos_token_id=eos_token_id, deadline_s=deadline_s,
+                    max_queue_wait_s=max_queue_wait_s)
+                rids[rid] = i
+            except ServingError as e:
+                outcomes[i] = {"tokens": [], "finish_reason": "rejected",
+                               "error": self.FAILURE_CODES.get(
+                                   type(e).__name__, "serving_error")}
+        stalled = False
+        try:
+            self.engine.run_to_completion(max_steps=max_steps)
+        except SchedulerStalledError:
+            stalled = True
+        for rid, i in rids.items():
+            req = self.engine.request(rid)
+            if req.finish_reason is None:
+                outcomes[i] = {"tokens": list(req.tokens),
+                               "finish_reason": "stalled" if stalled
+                               else None,
+                               "error": "scheduler_stalled" if stalled
+                               else None}
+            else:
+                outcomes[i] = {"tokens": list(req.tokens),
+                               "finish_reason": req.finish_reason,
+                               "error": None}
+        return outcomes
+
+    def drain(self, timeout_s: float | None = None) -> dict:
+        """Graceful shutdown passthrough: ``engine.drain`` — stops
+        admission and reports per-request outcomes (SERVING.md)."""
+        return self.engine.drain(timeout_s=timeout_s)
 
     def stream(self, prompts, max_new_tokens: int = 32,
                eos_token_id: int | None = None, sampling=None):

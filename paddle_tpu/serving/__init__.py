@@ -14,6 +14,9 @@ See SERVING.md for the design and the determinism contract.
 """
 
 from .engine import ServingEngine
+from .errors import (EngineDrainingError, QueueFullError,
+                     RequestTooLargeError, SchedulerStalledError,
+                     ServingError)
 from .kv_cache import KVCachePool, PoolExhaustedError
 from .metrics import ServingMetrics, percentile
 from .scheduler import (FINISHED, PREEMPTED, RUNNING, WAITING, Request,
@@ -23,4 +26,6 @@ __all__ = [
     "ServingEngine", "KVCachePool", "PoolExhaustedError", "ServingMetrics",
     "percentile", "Request", "SamplingParams", "Scheduler",
     "WAITING", "RUNNING", "PREEMPTED", "FINISHED",
+    "ServingError", "QueueFullError", "RequestTooLargeError",
+    "SchedulerStalledError", "EngineDrainingError",
 ]

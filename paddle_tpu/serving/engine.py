@@ -21,6 +21,20 @@ padding contributes exact zeros — see SERVING.md); sampled requests
 draw token *n* with ``fold_in(PRNGKey(seed), n)`` so a preempted and
 recomputed request reproduces its original stream regardless of slot
 placement or batch composition.
+
+Robustness (SERVING.md "Serving failure modes"): every failure mode is
+a classified per-request outcome or a typed :mod:`.errors` exception,
+never an engine-wide hang — bounded-queue backpressure and
+reject-at-add for impossible requests, per-request deadlines enforced
+at step boundaries on the injectable metrics clock, a per-request
+preemption cap, a non-finite logit sentinel that quarantines only the
+offending slot (its pages are scrubbed back to zero so the pool's
+masked-garbage-is-zero invariant survives reuse), zero-progress stall
+detection, and ``drain()`` for graceful (SIGTERM) shutdown. The
+blocking per-step device sync runs under ``watch("serving.step")`` and
+the fault sites ``serving.step`` / ``serving.prefill`` /
+``serving.decode`` / ``serving.alloc`` make all of it deterministically
+chaos-testable.
 """
 
 from __future__ import annotations
@@ -31,18 +45,31 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..distributed import fault as _fault
+from .errors import (EngineDrainingError, QueueFullError,
+                     RequestTooLargeError, SchedulerStalledError)
 from .kv_cache import KVCachePool
 from .metrics import ServingMetrics
-from .scheduler import Request, SamplingParams, Scheduler
+from .scheduler import FINISHED, Request, SamplingParams, Scheduler
 
 __all__ = ["ServingEngine"]
+
+# consecutive zero-progress steps tolerated before SchedulerStalledError:
+# a deterministic livelock (preempt-self treadmill, un-admittable queue
+# head) repeats identically every step, while a transient injected alloc
+# storm recovers as soon as its fault spec stops matching — so > 1, small
+_STALL_PATIENCE = 3
 
 
 class ServingEngine:
     def __init__(self, model, num_pages: int, page_size: int,
                  max_slots: int = 4, max_pages_per_slot: int | None = None,
                  prefill_token_budget: int = 2048, kv_dtype=None,
-                 clock=None):
+                 clock=None, max_queue_depth: int | None = None,
+                 max_preemptions: int | None = None,
+                 step_timeout_s: float | None = None,
+                 drain_timeout_s: float | None = 30.0,
+                 watchdog=None):
         cfg = model.config
         self.model = model
         self.page_size = page_size
@@ -53,12 +80,21 @@ class ServingEngine:
         self.pool = KVCachePool.from_config(
             cfg, num_pages, page_size,
             dtype=kv_dtype if kv_dtype is not None else jnp.bfloat16)
-        self.scheduler = Scheduler(max_slots, prefill_token_budget)
+        self.scheduler = Scheduler(max_slots, prefill_token_budget,
+                                   max_queue_depth=max_queue_depth,
+                                   max_preemptions=max_preemptions)
         self.metrics = ServingMetrics(clock)
+        self.step_timeout_s = step_timeout_s
+        self.drain_timeout_s = drain_timeout_s
+        self._watchdog = watchdog
         self._state = model.state_dict(include_non_persistable_buffer=True)
         self._requests: dict[str, Request] = {}
         self._rid_counter = itertools.count()
         self._steps = 0
+        self._idle_steps = 0
+        self._draining = False
+        self._guard = None
+        self.last_drain_events: list[dict] = []
         self._decode_step = self._build_decode_step()
         self._prefill_progs: dict[int, object] = {}
 
@@ -69,66 +105,187 @@ class ServingEngine:
     def add_request(self, prompt, max_new_tokens: int,
                     sampling: SamplingParams | None = None,
                     eos_token_id: int | None = None,
-                    rid: str | None = None) -> str:
+                    rid: str | None = None,
+                    deadline_s: float | None = None,
+                    max_queue_wait_s: float | None = None) -> str:
+        """Admission control happens HERE, not in the scheduler loop:
+        a request that can never run raises RequestTooLargeError, a full
+        bounded queue raises QueueFullError, a draining engine raises
+        EngineDrainingError — all typed (errors.py), all counted
+        (metrics.counters). ``deadline_s`` / ``max_queue_wait_s`` are
+        budgets from arrival on the metrics clock, enforced at step
+        boundaries with ``finish_reason="timeout"``."""
+        if self._draining:
+            raise EngineDrainingError(
+                "engine is draining (preempted or shut down); "
+                "retry on another replica")
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("prompt must be non-empty")
         total = len(prompt) + max_new_tokens
         need = self.pool.pages_for(total)
         if need > self.max_pages_per_slot:
-            raise ValueError(
+            self.metrics.on_reject("too_large")
+            raise RequestTooLargeError(
                 f"request needs {need} pages "
                 f"(max_pages_per_slot={self.max_pages_per_slot})")
-        if need > self.pool.capacity:
-            raise ValueError(
-                f"request needs {need} pages but the pool only has "
-                f"{self.pool.capacity} — it could never run")
         rid = rid if rid is not None else f"req-{next(self._rid_counter)}"
         if rid in self._requests:
             raise ValueError(f"duplicate request id {rid!r}")
         req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
                       sampling=sampling or SamplingParams(),
-                      eos_token_id=eos_token_id)
+                      eos_token_id=eos_token_id,
+                      deadline_s=deadline_s,
+                      max_queue_wait_s=max_queue_wait_s,
+                      arrival_t=self.metrics.now())
+        try:
+            self.scheduler.add(req, self.pool)
+        except QueueFullError:
+            self.metrics.on_reject("queue_full")
+            raise
+        except RequestTooLargeError:
+            self.metrics.on_reject("too_large")
+            raise
         self._requests[rid] = req
-        self.scheduler.add(req)
         self.metrics.on_arrival(rid)
         return rid
 
     def step(self) -> list[dict]:
-        """One scheduling iteration: admit + prefill newly runnable
-        requests, guarantee decode pages (preempting if needed), then one
-        batched decode step over every running slot. Returns this step's
-        token/finish events."""
+        """One scheduling iteration: expire deadlines, admit + prefill
+        newly runnable requests, guarantee decode pages (preempting if
+        needed), then one batched decode step over every running slot.
+        Returns this step's token/finish events. A zero-progress step
+        with work still pending raises SchedulerStalledError instead of
+        letting ``run_to_completion`` busy-loop."""
         if not self.scheduler.has_work():
             return []
+        # key this step's serving.alloc fault draws by the ENGINE step
+        # (not the process-global training cursor) so probabilistic
+        # storms vary over the engine's lifetime deterministically
+        self.pool.fault_step = self._steps
+        _fault.trip("serving.step", step=self._steps)
         events: list[dict] = []
-        for req in self.scheduler.admit(self.pool):
+        self._expire_deadlines(events)
+        if self._draining:
+            self._flush_waiting(events)
+        admitted = []
+        if not self._draining:
+            admitted = self.scheduler.admit(self.pool)
+        for req in admitted:
+            self.metrics.on_admit(req.rid)
             self._run_prefill(req, events)
         preempted = self.scheduler.ensure_decode_pages(self.pool)
-        for _ in preempted:
+        for victim in preempted:
             self.metrics.on_preemption()
+            if victim.state == FINISHED:  # hit the max_preemptions cap
+                self.metrics.on_outcome("preempted_limit")
+                self.metrics.on_finish(victim.rid)
+                events.append({"rid": victim.rid, "token": None,
+                               "finished": True,
+                               "finish_reason": "preempted_limit"})
         if self.scheduler.running:
             self._run_decode(events)
         self.metrics.on_step(self.scheduler.queue_depth,
                              self.pool.utilization())
         self._steps += 1
+        if events or not self.scheduler.waiting:
+            self._idle_steps = 0
+        else:
+            # work is pending but nothing was admitted, decoded or
+            # finished (the preempt-self livelock / un-admittable-head
+            # shape). A deterministic livelock repeats this identically
+            # every step — after _STALL_PATIENCE of them, surface the
+            # evidence instead of letting run_to_completion busy-loop.
+            self._idle_steps += 1
+            if self._idle_steps >= _STALL_PATIENCE:
+                head = self.scheduler.waiting[0]
+                snapshot = {
+                    "step": self._steps,
+                    "idle_steps": self._idle_steps,
+                    "queue_depth": self.scheduler.queue_depth,
+                    "head_rid": head.rid,
+                    "head_needs_pages": self.pool.pages_for(
+                        max(head.recompute_len, 1)),
+                    "free_pages": self.pool.num_free,
+                    "capacity": self.pool.capacity,
+                    "running": len(self.scheduler.running),
+                }
+                raise SchedulerStalledError(
+                    f"{snapshot['idle_steps']} zero-progress steps with "
+                    f"{snapshot['queue_depth']} request(s) pending: head "
+                    f"{head.rid!r} needs {snapshot['head_needs_pages']} "
+                    f"pages, {snapshot['free_pages']} free "
+                    f"(capacity {snapshot['capacity']})", snapshot)
         return events
 
     def stream(self):
         """Drive the engine to completion, yielding events as they are
-        produced: ``{"rid", "token", "finished", "finish_reason"}``."""
+        produced: ``{"rid", "token", "finished", "finish_reason"}``
+        (abnormal finishes — timeout/nonfinite/preempted_limit/drain —
+        carry ``token=None``). If a preemption guard is attached and
+        trips (SIGTERM), the engine drains and the drain's terminal
+        events are yielded before returning."""
         while self.scheduler.has_work():
+            if self._preemption_pending():
+                self.drain(timeout_s=self.drain_timeout_s)
+                yield from self.last_drain_events
+                return
             yield from self.step()
 
     def run_to_completion(self, max_steps: int | None = None) -> dict:
-        """Drain the queue; returns {rid: generated token list}."""
+        """Drain the queue; returns {rid: generated token list}. On a
+        tripped preemption guard the engine drains gracefully and every
+        unfinished request ends with ``finish_reason="preempted"``."""
         steps = 0
         while self.scheduler.has_work():
+            if self._preemption_pending():
+                self.drain(timeout_s=self.drain_timeout_s)
+                break
             self.step()
             steps += 1
             if max_steps is not None and steps >= max_steps:
                 raise RuntimeError(f"engine did not drain in {steps} steps")
         return {rid: list(r.tokens) for rid, r in self._requests.items()}
+
+    def drain(self, timeout_s: float | None = None) -> dict:
+        """Graceful shutdown: stop admission, evict the waiting queue as
+        ``finish_reason="preempted"`` ("retry elsewhere" — nothing was
+        computed for them), let the running slots decode to their own
+        finish until ``timeout_s`` (metrics clock) runs out, then evict
+        the stragglers as preempted too. Returns the per-request outcome
+        report {rid: {finish_reason, tokens, retriable}}; the terminal
+        events produced during the drain are kept in
+        ``last_drain_events``. Idempotent; after a drain,
+        ``add_request`` raises EngineDrainingError."""
+        events: list[dict] = []
+        self._draining = True
+        t0 = self.metrics.now()
+        self._flush_waiting(events)
+        while self.scheduler.running:
+            if (timeout_s is not None
+                    and self.metrics.now() - t0 >= timeout_s):
+                for req in list(self.scheduler.running.values()):
+                    self._finish_abnormal(req, "preempted", events)
+                break
+            events.extend(self.step())
+        self.last_drain_events = events
+        return {rid: {"finish_reason": r.finish_reason,
+                      "tokens": list(r.tokens),
+                      "retriable": r.finish_reason == "preempted"}
+                for rid, r in self._requests.items()}
+
+    def attach_preemption_guard(self, guard=None):
+        """Wire SIGTERM to a graceful drain: with a guard attached,
+        ``stream`` / ``run_to_completion`` notice ``guard.preempted``
+        at the next step boundary and call ``drain`` — a preempted
+        server returns structured retry-elsewhere outcomes instead of
+        vanishing mid-decode. Pass an existing
+        ``distributed.PreemptionGuard`` or let one be installed."""
+        if guard is None:
+            from ..distributed import PreemptionGuard
+            guard = PreemptionGuard()
+        self._guard = guard
+        return guard
 
     def request(self, rid: str) -> Request:
         return self._requests[rid]
@@ -144,8 +301,72 @@ class ServingEngine:
                 "queue_depth": self.scheduler.queue_depth,
                 "running": len(self.scheduler.running),
                 "preemptions": self.scheduler.num_preemptions,
+                "draining": self._draining,
                 "decode_programs": self.decode_program_count(),
                 "prefill_programs": len(self._prefill_progs)}
+
+    # ------------------------------------------------------------------
+    # robustness internals
+    # ------------------------------------------------------------------
+
+    def _preemption_pending(self) -> bool:
+        return (self._guard is not None and self._guard.preempted
+                and not self._draining)
+
+    def _expire_deadlines(self, events: list[dict]) -> None:
+        """Step-boundary deadline enforcement on the injectable metrics
+        clock: a waiting request past max_queue_wait_s (or its overall
+        deadline_s) and a running request past deadline_s both finish
+        with ``finish_reason="timeout"``."""
+        now = self.metrics.now()
+        for req in list(self.scheduler.waiting):
+            waited = now - req.arrival_t
+            if ((req.deadline_s is not None and waited >= req.deadline_s)
+                    or (req.max_queue_wait_s is not None
+                        and waited >= req.max_queue_wait_s)):
+                self._finish_abnormal(req, "timeout", events)
+        for req in list(self.scheduler.running.values()):
+            if (req.deadline_s is not None
+                    and now - req.arrival_t >= req.deadline_s):
+                self._finish_abnormal(req, "timeout", events)
+
+    def _flush_waiting(self, events: list[dict]) -> None:
+        """Draining: nothing waits — evict the queue as retriable
+        ``preempted`` outcomes (covers preemption requeues mid-drain)."""
+        for req in list(self.scheduler.waiting):
+            self._finish_abnormal(req, "preempted", events)
+
+    def _finish_abnormal(self, req: Request, reason: str,
+                         events: list[dict]) -> None:
+        if reason == "nonfinite":
+            # scrub before the pages return to the free list: a NaN left
+            # in a freed page would break the pool's masked-garbage-is-
+            # exact-zero invariant for its next owner (additive masking
+            # cannot silence a NaN — NaN + -1e30 is still NaN)
+            self._scrub_pages(req.pages)
+        self.scheduler.finish(req, self.pool, reason)
+        self.metrics.on_outcome(reason)
+        self.metrics.on_finish(req.rid)
+        events.append({"rid": req.rid, "token": None, "finished": True,
+                       "finish_reason": reason})
+
+    def _scrub_pages(self, pages: list[int]) -> None:
+        if not pages:
+            return
+        idx = jnp.asarray(pages, jnp.int32)
+        self.pool.pools = [(pk.at[idx].set(0), pv.at[idx].set(0))
+                           for pk, pv in self.pool.pools]
+
+    def _poison_pages(self, req: Request) -> None:
+        """Fault-action callback (``action="poison"``): NaN the
+        request's first KV page in layer 0 — its next decode step reads
+        the NaN through its own block table and its logits go
+        non-finite, while no other slot can see the page."""
+        if not req.pages:
+            return
+        page = req.pages[0]
+        pk, pv = self.pool.pools[0]
+        self.pool.pools[0] = (pk.at[page].set(jnp.nan), pv)
 
     # ------------------------------------------------------------------
     # compiled programs
@@ -161,9 +382,12 @@ class ServingEngine:
             (logits, pools), _ = functional_call(
                 model, state, tok[:, None], None, pools, 0,
                 (tables, seq_lens, active), training=False)
-            nt = _sample_rows(logits[:, -1], temps, top_ps, greedy,
-                              seeds, counts)
-            return nt, pools
+            last = logits[:, -1]
+            # per-slot poison sentinel: rows are independent, so a
+            # non-finite row indicts exactly one slot
+            ok = jnp.all(jnp.isfinite(last.astype(jnp.float32)), axis=-1)
+            nt = _sample_rows(last, temps, top_ps, greedy, seeds, counts)
+            return nt, ok, pools
 
         return decode_step
 
@@ -193,6 +417,7 @@ class ServingEngine:
                 model, state, ids, None, caches, 0, training=False)
             lg = jax.lax.dynamic_index_in_dim(logits[0], n_valid - 1,
                                               axis=0, keepdims=False)
+            ok = jnp.all(jnp.isfinite(lg.astype(jnp.float32)))
             tok = _sample_rows(lg[None], temp[None], top_p[None],
                                greedy[None], seed[None],
                                jnp.zeros((1,), jnp.int32))[0]
@@ -204,7 +429,7 @@ class ServingEngine:
                 pv = pv.at[scatter_pages].set(
                     cv[0].reshape(n_pages, ps, kvh, d))
                 new_pools.append((pk, pv))
-            return tok, new_pools
+            return tok, ok, new_pools
 
         self._prefill_progs[L] = prefill
         return prefill
@@ -222,18 +447,41 @@ class ServingEngine:
         scatter = np.zeros((n_pages,), np.int32)
         scatter[:len(req.pages)] = req.pages
         sp = req.sampling
-        tok, new_pools = self._prefill_prog(L)(
+        tok, ok, new_pools = self._prefill_prog(L)(
             self._state, jnp.asarray(ids), jnp.int32(n_valid),
             jnp.asarray(scatter), self.pool.pools,
             jnp.float32(sp.temperature), jnp.float32(sp.top_p),
             jnp.asarray(not sp.do_sample), jnp.int32(sp.seed))
         self.pool.pools = new_pools
+        if _fault.active_plan() is not None:
+            try:
+                _fault.trip("serving.prefill", step=self._steps,
+                            path=req.rid,
+                            poison=lambda r=req: self._poison_pages(r))
+            except _fault.FaultInjected:
+                self._finish_abnormal(req, "injected", events)
+                return
+        if not bool(ok):
+            # the prompt itself produced non-finite logits — quarantine
+            # at admission, before it ever joins the decode batch
+            self._finish_abnormal(req, "nonfinite", events)
+            return
         if req.tokens:
             return  # recompute after preemption: cache rebuilt, the stored
                     # last token is the next decode input — no new emission
         self._emit(req, int(tok), events)
 
     def _run_decode(self, events: list[dict]) -> None:
+        if _fault.active_plan() is not None:
+            for req in list(self.scheduler.running.values()):
+                try:
+                    _fault.trip("serving.decode", step=self._steps,
+                                path=req.rid,
+                                poison=lambda r=req: self._poison_pages(r))
+                except _fault.FaultInjected:
+                    self._finish_abnormal(req, "injected", events)
+            if not self.scheduler.running:
+                return
         S, M = self.max_slots, self.max_pages_per_slot
         tok = np.zeros((S,), np.int32)
         tables = np.zeros((S, M), np.int32)
@@ -254,15 +502,28 @@ class ServingEngine:
             greedy[slot] = not req.sampling.do_sample
             seeds[slot] = req.sampling.seed
             counts[slot] = len(req.tokens)
-        nt, new_pools = self._decode_step(
+        nt, ok, new_pools = self._decode_step(
             self._state, self.pool.pools, jnp.asarray(tok),
             jnp.asarray(tables), jnp.asarray(seq_lens), jnp.asarray(active),
             jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(greedy),
             jnp.asarray(seeds), jnp.asarray(counts))
         self.pool.pools = new_pools
-        nt = np.asarray(nt)
+        from ..distributed.watchdog import default_watchdog
+        wd = self._watchdog if self._watchdog is not None \
+            else default_watchdog()
+        with wd.task("serving.step", timeout=self.step_timeout_s,
+                     step=self._steps, slots=len(self.scheduler.running)):
+            # np.asarray is the engine's blocking device sync — a hung
+            # device shows up here, so this is where the watchdog looks
+            nt = np.asarray(nt)
+            ok = np.asarray(ok)
         for slot, req in list(self.scheduler.running.items()):
             req.context_len += 1  # this step's KV write at old context_len
+            if not ok[slot]:
+                # poison quarantine: only this slot finishes; survivors'
+                # rows were computed independently and stay bitwise intact
+                self._finish_abnormal(req, "nonfinite", events)
+                continue
             self._emit(req, int(nt[slot]), events)
 
     def _emit(self, req: Request, token: int, events: list[dict]) -> None:

@@ -1,0 +1,55 @@
+"""Typed failure surface of the serving engine.
+
+Every way a request or the engine can fail maps to exactly one of these
+(or to a terminal ``finish_reason`` on the request — see the "Serving
+failure modes" table in SERVING.md). Nothing in ``paddle_tpu.serving``
+fails with a bare RuntimeError or, worse, a silent busy loop: callers
+can catch :class:`ServingError` and know they have seen every
+engine-originated failure.
+
+- :class:`QueueFullError` — backpressure: ``add_request`` refused
+  because the bounded waiting queue is at ``max_queue_depth``. The
+  caller should shed load or retry elsewhere.
+- :class:`RequestTooLargeError` — the request could NEVER run: its
+  prompt + decode budget needs more KV pages than the pool (or a slot)
+  has. Rejected at add time — previously such a request silently spun
+  in ``admit()`` forever.
+- :class:`SchedulerStalledError` — the engine detected a zero-progress
+  step (nothing admitted, nothing decoded, work still pending) and
+  refuses to busy-loop. Carries a ``snapshot`` dict of the queue/pool
+  state for the post-mortem.
+- :class:`EngineDrainingError` — ``add_request`` after ``drain()``
+  began: the engine is shutting down, retry on another replica.
+"""
+
+from __future__ import annotations
+
+__all__ = ["ServingError", "QueueFullError", "RequestTooLargeError",
+           "SchedulerStalledError", "EngineDrainingError"]
+
+
+class ServingError(RuntimeError):
+    """Base of every typed serving failure."""
+
+
+class QueueFullError(ServingError):
+    """Bounded-queue backpressure: the waiting queue is at capacity."""
+
+
+class RequestTooLargeError(ServingError, ValueError):
+    """The request can never fit (prompt+decode pages exceed the pool
+    or the per-slot table) — rejected at ``add`` instead of spinning."""
+
+
+class SchedulerStalledError(ServingError):
+    """A zero-progress engine step: work is pending but nothing can be
+    admitted or decoded, and the state cannot change on its own.
+    ``snapshot`` holds the queue/pool evidence."""
+
+    def __init__(self, msg: str, snapshot: dict | None = None):
+        super().__init__(msg)
+        self.snapshot = dict(snapshot or {})
+
+
+class EngineDrainingError(ServingError):
+    """``add_request`` called after ``drain()``: admission is closed."""

@@ -26,10 +26,12 @@ import math
 
 import jax.numpy as jnp
 
+from .errors import ServingError
+
 __all__ = ["KVCachePool", "PoolExhaustedError"]
 
 
-class PoolExhaustedError(RuntimeError):
+class PoolExhaustedError(ServingError):
     """Raised by ``alloc`` when the pool cannot satisfy a request; the
     scheduler catches it and preempts (never propagates to users)."""
 
@@ -54,6 +56,11 @@ class KVCachePool:
         # LIFO free list, page 0 reserved (scratch)
         self._free = list(range(num_pages - 1, 0, -1))
         self._peak_in_use = 0
+        # fault-draw step context for the serving.alloc site, advanced by
+        # the engine once per step — without it, probabilistic specs
+        # would fall back to the process-global training-step cursor and
+        # draw ONE outcome for the engine's whole lifetime
+        self.fault_step: int | None = None
 
     @classmethod
     def from_config(cls, config, num_pages: int, page_size: int,
@@ -94,7 +101,20 @@ class KVCachePool:
     # ---- alloc / free ----
 
     def alloc(self, n: int) -> list[int]:
-        """Grab n pages (all-or-nothing); raises PoolExhaustedError."""
+        """Grab n pages (all-or-nothing); raises PoolExhaustedError.
+
+        Fault site ``serving.alloc``: an armed ``raise`` spec here
+        surfaces as a PoolExhaustedError — the scheduler's normal
+        exhaustion path — so chaos tests can drive deterministic
+        pool-exhaustion storms (preemption cascades) without actually
+        shrinking the pool."""
+        from ..distributed import fault as _fault
+        try:
+            _fault.trip("serving.alloc", step=self.fault_step,
+                        need=n, free=len(self._free))
+        except _fault.FaultInjected as e:
+            raise PoolExhaustedError(
+                f"injected exhaustion (serving.alloc): {e}") from e
         if n > len(self._free):
             raise PoolExhaustedError(
                 f"need {n} pages, {len(self._free)} free "

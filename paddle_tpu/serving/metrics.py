@@ -5,8 +5,12 @@ Tracks the three latencies the serving literature reports —
 - TPOT  (time per output token): (last_token_t - first_token_t) / (n-1);
 - ITL   (inter-token latency): each consecutive token gap —
 plus queue-depth and KV-pool-utilization gauges sampled once per engine
-step. The clock is injectable so tests (and ``bench.py --dry``) can feed
-a deterministic virtual time.
+step, queue-wait percentiles (arrival -> first admission), and the
+failure-outcome counters of the robustness layer (rejects, timeouts,
+quarantines, preemption-limit kills, drain evictions — see the
+"Serving failure modes" table in SERVING.md). The clock is injectable
+so tests (and ``bench.py --dry``) can feed a deterministic virtual
+time; deadline enforcement in the engine runs on this same clock.
 """
 
 from __future__ import annotations
@@ -44,6 +48,14 @@ class ServingMetrics:
         self._preemptions = 0
         self._start = None
         self._end = None
+        self._admit_t: dict[str, float] = {}
+        self._queue_wait: list[float] = []
+        # failure-outcome counters (typed error surface, SERVING.md)
+        self.counters: dict[str, int] = {
+            "rejected_queue_full": 0, "rejected_too_large": 0,
+            "timed_out": 0, "quarantined": 0, "preempted_limit": 0,
+            "drained": 0, "injected": 0,
+        }
 
     def now(self) -> float:
         return self._clock()
@@ -72,6 +84,27 @@ class ServingMetrics:
 
     def on_preemption(self) -> None:
         self._preemptions += 1
+
+    def on_admit(self, rid: str) -> None:
+        """First admission of a request: records its queue wait
+        (re-admissions after preemption are not new queue waits)."""
+        if rid in self._admit_t or rid not in self._arrival:
+            return
+        t = self.now()
+        self._admit_t[rid] = t
+        self._queue_wait.append(t - self._arrival[rid])
+
+    def on_reject(self, kind: str) -> None:
+        """An add_request rejection: kind is 'queue_full' or 'too_large'."""
+        self.counters[f"rejected_{kind}"] += 1
+
+    def on_outcome(self, finish_reason: str) -> None:
+        """Count an abnormal terminal outcome by its finish_reason."""
+        key = {"timeout": "timed_out", "nonfinite": "quarantined",
+               "preempted_limit": "preempted_limit", "preempted": "drained",
+               "injected": "injected"}.get(finish_reason)
+        if key is not None:
+            self.counters[key] += 1
 
     # ---- per-step gauges ----
 
@@ -120,4 +153,9 @@ class ServingMetrics:
             "kv_util_mean": (sum(self._pool_util) / len(self._pool_util)
                              if self._pool_util else 0.0),
             "kv_util_peak": max(self._pool_util, default=0.0),
+            "queue_wait_p50_s": percentile(self._queue_wait, 50),
+            "queue_wait_p99_s": percentile(self._queue_wait, 99),
+            "rejected": (self.counters["rejected_queue_full"]
+                         + self.counters["rejected_too_large"]),
+            **self.counters,
         }

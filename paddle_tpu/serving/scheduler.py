@@ -19,6 +19,7 @@ from __future__ import annotations
 import bisect
 from dataclasses import dataclass, field
 
+from .errors import QueueFullError, RequestTooLargeError
 from .kv_cache import KVCachePool, PoolExhaustedError
 
 __all__ = ["Request", "SamplingParams", "Scheduler",
@@ -55,6 +56,13 @@ class Request:
     finish_reason: str | None = None
     preemptions: int = 0
 
+    # robustness (SERVING.md "Serving failure modes"): deadlines are
+    # measured from arrival on the engine's injectable metrics clock and
+    # enforced at step boundaries
+    deadline_s: float | None = None        # arrival -> completion budget
+    max_queue_wait_s: float | None = None  # arrival -> first admission
+    arrival_t: float = 0.0                 # stamped by engine.add_request
+
     # cache bookkeeping (valid while RUNNING)
     slot: int | None = None
     pages: list[int] = field(default_factory=list)
@@ -73,9 +81,13 @@ class Request:
 
 
 class Scheduler:
-    def __init__(self, max_slots: int, prefill_token_budget: int = 2048):
+    def __init__(self, max_slots: int, prefill_token_budget: int = 2048,
+                 max_queue_depth: int | None = None,
+                 max_preemptions: int | None = None):
         self.max_slots = max_slots
         self.prefill_token_budget = prefill_token_budget
+        self.max_queue_depth = max_queue_depth
+        self.max_preemptions = max_preemptions
         self.waiting: list[Request] = []   # kept sorted by arrival_seq
         self.running: dict[int, Request] = {}   # slot -> request
         self._free_slots = list(range(max_slots - 1, -1, -1))
@@ -84,7 +96,28 @@ class Scheduler:
 
     # ---- queue ----
 
-    def add(self, req: Request) -> None:
+    def add(self, req: Request, pool: KVCachePool | None = None) -> None:
+        """Enqueue a new request. With ``pool`` given, rejects requests
+        that could NEVER run (prompt+decode pages beyond the pool's
+        capacity) with :class:`RequestTooLargeError` — without this,
+        ``admit()`` would spin on the queue head forever. A full bounded
+        queue (``max_queue_depth``) rejects with
+        :class:`QueueFullError` (backpressure, not an engine fault)."""
+        if (self.max_queue_depth is not None
+                and len(self.waiting) >= self.max_queue_depth):
+            raise QueueFullError(
+                f"waiting queue at max_queue_depth={self.max_queue_depth}; "
+                f"request {req.rid!r} rejected (shed load or retry "
+                f"elsewhere)")
+        if pool is not None:
+            need = pool.pages_for(len(req.prompt) + req.max_new_tokens)
+            if need > pool.capacity:
+                raise RequestTooLargeError(
+                    f"request {req.rid!r} needs {need} pages for its "
+                    f"prompt ({len(req.prompt)} tokens) + "
+                    f"{req.max_new_tokens} decode tokens, but the pool "
+                    f"has only {pool.capacity} allocatable pages — it "
+                    f"could never run")
         req.arrival_seq = self._arrival_counter
         self._arrival_counter += 1
         req.state = WAITING
@@ -110,7 +143,16 @@ class Scheduler:
         self._release(victim, pool)
         victim.preemptions += 1
         self.num_preemptions += 1
-        self._requeue(victim)
+        if (self.max_preemptions is not None
+                and victim.preemptions > self.max_preemptions):
+            # starvation guard: a request bounced out of the pool more
+            # than max_preemptions times stops competing — it finishes
+            # with a classified reason instead of thrashing recompute
+            # prefills forever (the engine emits the terminal event)
+            victim.state = FINISHED
+            victim.finish_reason = "preempted_limit"
+        else:
+            self._requeue(victim)
         return victim
 
     def _release(self, req: Request, pool: KVCachePool) -> None:
@@ -122,7 +164,18 @@ class Scheduler:
         req.context_len = 0
 
     def finish(self, req: Request, pool: KVCachePool, reason: str) -> None:
-        self._release(req, pool)
+        """Terminal transition from ANY live state: a running request
+        releases its slot and pages; a waiting/preempted one just leaves
+        the queue (deadline expiry and drain finish requests that never
+        held resources)."""
+        if req.slot is not None:
+            self._release(req, pool)
+        else:
+            if req in self.waiting:
+                self.waiting.remove(req)
+            if req.pages:
+                pool.free(req.pages)
+                req.pages = []
         req.state = FINISHED
         req.finish_reason = reason
 
@@ -166,8 +219,13 @@ class Scheduler:
             n_pages = pool.pages_for(need_tokens)
             if n_pages > pool.num_free:
                 break
+            try:
+                pages = pool.alloc(n_pages)
+            except PoolExhaustedError:
+                break  # injected exhaustion (serving.alloc) — the head
+                       # stays queued, never torn out of the FCFS order
             self.waiting.pop(0)
-            req.pages = pool.alloc(n_pages)
+            req.pages = pages
             req.slot = self._free_slots.pop()
             req.state = RUNNING
             req.context_len = need_tokens

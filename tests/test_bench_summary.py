@@ -66,16 +66,20 @@ def test_summary_entry_picks_the_configs_efficiency_ratio():
         "value": None, "mfu": None, "spread": None}
     serving = {"value": 4.0, "extra": {"mbu_weights_only": 0.2,
                                        "ttft_p50": 0.1, "ttft_p99": 0.4,
-                                       "tpot": 0.02, "spread": None}}
+                                       "tpot": 0.02, "rejected": 1,
+                                       "timed_out": 2, "quarantined": 0,
+                                       "spread": None}}
     assert bench._summary_entry(serving, "llama_serving") == {
         "value": 4.0, "mfu": 0.2, "spread": None,
-        "ttft_p50": 0.1, "ttft_p99": 0.4, "tpot": 0.02}
+        "ttft_p50": 0.1, "ttft_p99": 0.4, "tpot": 0.02,
+        "rejected": 1, "timed_out": 2, "quarantined": 0}
 
 
-def test_dry_serving_cell_carries_latency_keys():
+def test_dry_serving_cell_carries_latency_and_failure_keys():
     out = _run_dry("llama_serving")
     assert out.returncode == 0, out.stderr
     last = json.loads(out.stdout.splitlines()[-1])
     cell = last["bench_summary"]["llama_serving"]
     assert set(cell) >= {"value", "mfu", "spread",
-                         "ttft_p50", "ttft_p99", "tpot"}, cell
+                         "ttft_p50", "ttft_p99", "tpot",
+                         "rejected", "timed_out", "quarantined"}, cell

@@ -8,6 +8,12 @@ The two contracts that define the subsystem (SERVING.md):
 2. NO RETRACE — the decode step is ONE compiled program for the
    engine's lifetime; requests joining/finishing/preempting never change
    its compiled-program count.
+3. CLASSIFIED FAILURE — every failure mode is a typed exception at
+   admission or a per-request finish_reason at a step boundary, never an
+   engine-wide hang; quarantining a poisoned request leaves the
+   survivors' token streams bitwise intact ("Serving failure modes",
+   SERVING.md). Chaos tests (deterministic FaultPlan replays) carry the
+   ``faults`` marker.
 """
 
 import numpy as np
@@ -16,10 +22,14 @@ import pytest
 import jax.numpy as jnp
 
 import paddle_tpu as pt
+from paddle_tpu.distributed import fault
 from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
-from paddle_tpu.serving import (KVCachePool, PoolExhaustedError, Request,
-                                SamplingParams, Scheduler, ServingEngine,
-                                ServingMetrics, percentile)
+from paddle_tpu.serving import (EngineDrainingError, KVCachePool,
+                                PoolExhaustedError, QueueFullError, Request,
+                                RequestTooLargeError, SamplingParams,
+                                Scheduler, SchedulerStalledError,
+                                ServingEngine, ServingError, ServingMetrics,
+                                percentile)
 
 RNG = np.random.default_rng(7)
 
@@ -292,6 +302,319 @@ class TestServingEngine:
 
 
 # ---------------------------------------------------------------------------
+# the robustness layer: typed errors, classified outcomes, chaos replays
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def fault_free(monkeypatch):
+    """Guarantee no FaultPlan leaks out of a chaos test — and no rank
+    env leaked IN by an earlier launcher test skews the hash draws."""
+    fault.deactivate()
+    monkeypatch.delenv("PADDLE_TRAINER_ID", raising=False)
+    monkeypatch.delenv("PROCESS_ID", raising=False)
+    monkeypatch.delenv("PADDLE_RESTART_EPOCH", raising=False)
+    yield
+    fault.deactivate()
+
+
+class TestServingRobustness:
+    def test_queue_full_backpressure(self, model):
+        eng = ServingEngine(model, num_pages=32, page_size=4, max_slots=1,
+                            max_queue_depth=2)
+        eng.add_request([1, 2, 3], 4)
+        eng.step()                             # admits it into the only slot
+        eng.add_request([4, 5], 4)             # waiting[0]
+        eng.add_request([6, 7], 4)             # waiting[1] — queue now full
+        with pytest.raises(QueueFullError, match="max_queue_depth=2"):
+            eng.add_request([8, 9], 4, rid="overflow")
+        assert "overflow" not in eng._requests  # rejected, never registered
+        m = eng.metrics.summary()
+        assert m["rejected_queue_full"] == 1 and m["rejected"] == 1
+        # backpressure is not engine damage: the admitted three still run
+        res = eng.run_to_completion(max_steps=200)
+        assert all(len(t) == 4 for t in res.values())
+
+    def test_request_too_large_typed_at_both_layers(self, model):
+        # layer 1: per-slot cap (engine-level reject)
+        eng = ServingEngine(model, num_pages=16, page_size=4, max_slots=2,
+                            max_pages_per_slot=2)
+        with pytest.raises(RequestTooLargeError, match="pages"):
+            eng.add_request(list(range(1, 20)), 8)
+        # layer 2: pool capacity (scheduler-level reject — the fix for
+        # admit() spinning forever on an impossible queue head); the slot
+        # cap is raised past the pool so THIS layer is the one that fires
+        eng2 = ServingEngine(model, num_pages=4, page_size=4, max_slots=2,
+                             max_pages_per_slot=20)
+        with pytest.raises(RequestTooLargeError,
+                           match=r"needs \d+ pages .* only 3 allocatable"):
+            eng2.add_request(list(range(1, 30)), 8)
+        # typed, but still a ValueError for pre-existing callers
+        assert issubclass(RequestTooLargeError, ValueError)
+        assert issubclass(RequestTooLargeError, ServingError)
+        assert eng2.metrics.summary()["rejected_too_large"] == 1
+
+    def test_scheduler_rejects_never_runnable_head(self):
+        pool = KVCachePool(1, 4, 4, 2, 8)  # capacity 3
+        sched = Scheduler(max_slots=2, max_queue_depth=1)
+        with pytest.raises(RequestTooLargeError, match="could never run"):
+            sched.add(Request(rid="huge", prompt=list(range(30)),
+                              max_new_tokens=4), pool)
+        sched.add(Request(rid="ok", prompt=[1], max_new_tokens=1), pool)
+        with pytest.raises(QueueFullError):
+            sched.add(Request(rid="ok2", prompt=[2], max_new_tokens=1), pool)
+
+    def test_preempted_limit_starvation_guard(self, model):
+        # capacity 6; both requests want 5 pages at full length, so decode
+        # growth must preempt the youngest — with a cap of 0 the first
+        # eviction becomes a classified terminal outcome
+        eng = ServingEngine(model, num_pages=7, page_size=4, max_slots=2,
+                            max_pages_per_slot=6, max_preemptions=0)
+        prompts = [list(RNG.integers(0, 512, 8)), list(RNG.integers(0, 512, 8))]
+        rids = [eng.add_request(p, 12) for p in prompts]
+        evs = []
+        while eng.scheduler.has_work():
+            evs.extend(eng.step())
+        survivor, victim = eng.request(rids[0]), eng.request(rids[1])
+        assert survivor.finish_reason == "length"
+        assert survivor.tokens == _reference(model, prompts[0], 12)
+        assert victim.finish_reason == "preempted_limit"
+        term = [e for e in evs if e["rid"] == rids[1] and e["finished"]]
+        assert term == [{"rid": rids[1], "token": None, "finished": True,
+                         "finish_reason": "preempted_limit"}]
+        assert eng.metrics.summary()["preempted_limit"] == 1
+        assert eng.pool.num_in_use == 0
+
+    def test_deadline_and_queue_wait_timeouts_virtual_clock(self, model):
+        t = [0.0]
+        eng = ServingEngine(model, num_pages=32, page_size=4, max_slots=1,
+                            clock=lambda: t[0])
+        r0 = eng.add_request([1, 2, 3], 64, deadline_s=5.0)
+        r1 = eng.add_request([4, 5, 6], 8, max_queue_wait_s=2.0)
+        eng.step()   # r0 admitted + prefilled at t=0
+        t[0] = 3.0
+        eng.step()   # r1 has waited 3s >= 2s -> timeout, never admitted
+        assert eng.request(r1).finish_reason == "timeout"
+        assert eng.request(r1).tokens == []
+        assert eng.request(r0).finish_reason is None  # within deadline
+        t[0] = 6.0
+        eng.step()   # r0 now past its 5s completion deadline
+        assert eng.request(r0).finish_reason == "timeout"
+        assert eng.request(r0).tokens  # partial output kept
+        assert not eng.scheduler.has_work()
+        m = eng.metrics.summary()
+        assert m["timed_out"] == 2
+        assert m["queue_wait_p99_s"] == 0.0  # only r0 was admitted, at t=0
+
+    def test_scheduler_stall_raises_with_snapshot(self, model):
+        eng = ServingEngine(model, num_pages=4, page_size=4, max_slots=2)
+        # bypass add_request validation to plant a never-admittable head —
+        # the stall detector is the backstop for exactly this class of bug
+        req = Request(rid="huge", prompt=list(range(40)), max_new_tokens=4)
+        eng.scheduler.add(req)
+        eng._requests["huge"] = req
+        with pytest.raises(SchedulerStalledError, match="zero-progress") as ei:
+            eng.run_to_completion(max_steps=50)
+        snap = ei.value.snapshot
+        assert snap["head_rid"] == "huge"
+        assert snap["head_needs_pages"] > snap["capacity"]
+        assert snap["queue_depth"] == 1 and snap["running"] == 0
+        assert snap["idle_steps"] == 3
+
+    def test_drain_reports_outcomes_and_blocks_admission(self, model):
+        eng = ServingEngine(model, num_pages=32, page_size=4, max_slots=2)
+        rids = [eng.add_request(list(RNG.integers(0, 512, 4)), 16)
+                for _ in range(4)]
+        eng.step()
+        eng.step()
+        report = eng.drain(timeout_s=0.0)  # evict everything immediately
+        assert set(report) == set(rids)
+        for rid in rids:
+            assert report[rid]["finish_reason"] == "preempted"
+            assert report[rid]["retriable"] is True
+            assert report[rid]["tokens"] == eng.request(rid).tokens
+        assert {e["finish_reason"] for e in eng.last_drain_events} \
+            == {"preempted"}
+        with pytest.raises(EngineDrainingError):
+            eng.add_request([1, 2], 4)
+        m = eng.metrics.summary()
+        assert m["drained"] == 4
+        assert eng.pool.num_in_use == 0
+
+    def test_sigterm_guard_drains_mid_stream(self, model):
+        eng = ServingEngine(model, num_pages=32, page_size=4, max_slots=2)
+        guard = eng.attach_preemption_guard()
+        try:
+            rids = [eng.add_request(list(RNG.integers(0, 512, 4)), 32)
+                    for _ in range(3)]
+            it = eng.stream()
+            next(it)            # engine is mid-flight...
+            guard.request()     # ...when the SIGTERM lands
+            evs = list(it)      # stream drains instead of vanishing
+        finally:
+            guard.uninstall()
+        assert eng._draining
+        for rid in rids:
+            assert eng.request(rid).finish_reason is not None
+        # the waiting third request never held a slot: retriable eviction
+        assert eng.request(rids[2]).finish_reason == "preempted"
+        assert any(e["finish_reason"] == "preempted" for e in evs)
+        with pytest.raises(EngineDrainingError):
+            eng.add_request([7], 2)
+
+    def test_watchdog_wraps_the_step_sync(self, model):
+        from paddle_tpu.distributed.watchdog import CommWatchdog
+        wd = CommWatchdog(timeout=600.0)
+        eng = ServingEngine(model, num_pages=32, page_size=4, max_slots=2,
+                            watchdog=wd, step_timeout_s=120.0)
+        eng.add_request([1, 2, 3], 3)
+        eng.run_to_completion(max_steps=50)
+        recs = [r for r in wd.records if r.name == "serving.step"]
+        assert recs, "device sync ran outside the watchdog"
+        assert all(r.finished and not r.timed_out for r in recs)
+        assert recs[0].meta["slots"] == 1
+
+    def test_generate_detailed_maps_typed_errors(self, model):
+        from paddle_tpu.inference import create_llm_predictor
+        # all four prompts are enqueued BEFORE the first step, so the
+        # bounded queue (depth 2) takes the first two admissible ones
+        pred = create_llm_predictor(model, num_pages=16, page_size=4,
+                                    max_slots=1, max_pages_per_slot=3,
+                                    max_queue_depth=2)
+        prompts = [[1, 2, 3],                 # runs
+                   list(range(1, 40)),        # too large for a slot
+                   [4, 5, 6],                 # fills the queue
+                   [7, 8, 9]]                 # queue full
+        out = pred.generate_detailed(prompts, max_new_tokens=4)
+        assert out[0]["error"] is None
+        assert out[0]["finish_reason"] == "length"
+        assert out[0]["tokens"] == _reference(model, prompts[0], 4)
+        assert out[1] == {"tokens": [], "finish_reason": "rejected",
+                          "error": "too_large"}
+        assert out[2]["error"] is None
+        assert out[3] == {"tokens": [], "finish_reason": "rejected",
+                          "error": "queue_full"}
+
+
+@pytest.mark.faults
+class TestServingChaos:
+    """Deterministic FaultPlan replays over the engine's fault sites —
+    the same plan fires the same failure every run (RESILIENCE.md)."""
+
+    def test_poison_quarantines_only_the_offending_slot(self, model,
+                                                        fault_free):
+        prompts = [list(RNG.integers(0, 512, n)) for n in (5, 7, 4)]
+        refs = [_reference(model, p, 10) for p in prompts]
+        fault.activate(fault.FaultPlan([
+            fault.FaultSpec(site="serving.decode", action="poison",
+                            step=3, match=r"^victim$"),
+        ]))
+        eng = ServingEngine(model, num_pages=64, page_size=4, max_slots=4)
+        rids = [eng.add_request(prompts[0], 10, rid="ok-0"),
+                eng.add_request(prompts[1], 10, rid="victim"),
+                eng.add_request(prompts[2], 10, rid="ok-1")]
+        res = eng.run_to_completion(max_steps=200)
+        victim = eng.request("victim")
+        assert victim.finish_reason == "nonfinite"
+        # tokens emitted before the poison are valid: a strict prefix
+        assert len(victim.tokens) < 10
+        assert victim.tokens == refs[1][: len(victim.tokens)]
+        # survivors never saw the NaN page: bitwise parity holds
+        assert res["ok-0"] == refs[0] and res["ok-1"] == refs[2]
+        assert eng.decode_program_count() == 1
+        assert eng.metrics.summary()["quarantined"] == 1
+        # quarantined pages were scrubbed before returning to the free
+        # list — nothing non-finite survives anywhere in the pool
+        for pk, pv in eng.pool.pools:
+            assert bool(jnp.all(jnp.isfinite(pk.astype(jnp.float32))))
+            assert bool(jnp.all(jnp.isfinite(pv.astype(jnp.float32))))
+
+    def test_injected_prefill_failure_is_classified(self, model, fault_free):
+        fault.activate(fault.FaultPlan([
+            fault.FaultSpec(site="serving.prefill", action="raise",
+                            match=r"^doomed$"),
+        ]))
+        eng = ServingEngine(model, num_pages=32, page_size=4, max_slots=2)
+        eng.add_request([1, 2, 3], 4, rid="doomed")
+        ok = eng.add_request([4, 5, 6], 4)
+        res = eng.run_to_completion(max_steps=100)
+        assert eng.request("doomed").finish_reason == "injected"
+        assert res["doomed"] == []
+        assert len(res[ok]) == 4
+        assert eng.metrics.summary()["injected"] == 1
+        assert eng.pool.num_in_use == 0
+
+    def test_alloc_storm_preempts_but_stays_deterministic(self, model,
+                                                          fault_free):
+        prompts = [list(RNG.integers(0, 512, n)) for n in (6, 7)]
+        refs = [_reference(model, p, 10) for p in prompts]
+        # ~40% of page allocations report injected exhaustion; the hash
+        # draw is keyed by (seed, rank, step, site) so the storm pattern
+        # is identical every run
+        fault.activate(fault.FaultPlan([
+            fault.FaultSpec(site="serving.alloc", action="raise",
+                            prob=0.4, once=False),
+        ], seed=11))
+        eng = ServingEngine(model, num_pages=8, page_size=4, max_slots=2,
+                            max_pages_per_slot=6)
+        rids = [eng.add_request(p, 10) for p in prompts]
+        res = eng.run_to_completion(max_steps=500)
+        # churn happened, yet recompute reproduced every stream bitwise
+        assert eng.scheduler.num_preemptions > 0
+        for rid, ref in zip(rids, refs):
+            assert res[rid] == ref
+        assert eng.decode_program_count() == 1
+
+    def test_acceptance_chaos_storm(self, model, fault_free):
+        """ISSUE.md acceptance: NaN poison + pool-exhaustion storm +
+        mid-stream SIGTERM drain. Every request must end classified,
+        untouched survivors bitwise-match generate(), and the decode
+        step must still be ONE compiled program."""
+        prompts = [list(RNG.integers(0, 512, n))
+                   for n in (5, 6, 4, 7, 5, 6)]
+        refs = [_reference(model, p, 12) for p in prompts]
+        fault.activate(fault.FaultPlan([
+            # once=True + match: fires on c1's first decode step, whenever
+            # the storm lets that be — no step pin to go stale against it
+            fault.FaultSpec(site="serving.decode", action="poison",
+                            match=r"^c1$"),
+            fault.FaultSpec(site="serving.alloc", action="raise",
+                            prob=0.3, once=False),
+        ], seed=5))
+        eng = ServingEngine(model, num_pages=16, page_size=4, max_slots=3,
+                            max_pages_per_slot=8)
+        guard = eng.attach_preemption_guard()
+        try:
+            rids = [eng.add_request(p, 12, rid=f"c{i}")
+                    for i, p in enumerate(prompts)]
+            evs = []
+            for i, ev in enumerate(eng.stream()):
+                evs.append(ev)
+                if i == 11:
+                    guard.request()  # SIGTERM mid-decode
+        finally:
+            guard.uninstall()
+        seen_reasons = set()
+        for rid, ref in zip(rids, refs):
+            req = eng.request(rid)
+            assert req.finish_reason is not None, f"{rid} left unclassified"
+            seen_reasons.add(req.finish_reason)
+            if req.finish_reason == "nonfinite":
+                assert rid == "c1"
+                assert req.tokens == ref[: len(req.tokens)]
+            elif req.finish_reason == "length":
+                assert req.tokens == ref  # survivors bitwise intact
+            else:  # preempted by the drain: a valid, retriable prefix
+                assert req.finish_reason == "preempted"
+                assert req.tokens == ref[: len(req.tokens)]
+        assert "nonfinite" in seen_reasons
+        assert "preempted" in seen_reasons  # the drain actually evicted
+        assert eng.decode_program_count() == 1
+        assert eng.pool.num_in_use == 0
+        m = eng.metrics.summary()
+        assert m["quarantined"] == 1 and m["drained"] >= 1
+
+
+# ---------------------------------------------------------------------------
 # the Pallas block-table kernel (interpret mode on CPU)
 # ---------------------------------------------------------------------------
 
@@ -344,7 +667,14 @@ class TestFrontEnds:
         assert pred.metrics_summary()["requests_finished"] == 2
         assert pred.stats()["decode_programs"] == 1
 
-    def test_decode_cache_stats_public_surface(self, model):
+    def test_decode_cache_stats_public_surface(self):
+        # fresh model: the module-scoped one's signature LRU may be at
+        # capacity from the other tests' generate() calls, which would
+        # turn the +1 assertion into an eviction-order puzzle
+        pt.seed(3)
+        model = LlamaForCausalLM(llama_tiny(dtype="float32",
+                                            mp_axis=None, fsdp_axis=None))
+        model.eval()
         stats = model.decode_cache_stats()
         assert set(stats) >= {"signatures", "capacity", "signature_keys"}
         before = stats["signatures"]
