@@ -31,7 +31,8 @@ class LLMPredictor:
                  clock=None, max_queue_depth: int | None = None,
                  max_preemptions: int | None = None,
                  step_timeout_s: float | None = None,
-                 drain_timeout_s: float | None = 30.0):
+                 drain_timeout_s: float | None = 30.0,
+                 prefix_cache: bool = True):
         from ..serving import ServingEngine
         self.model = model
         self._mk = lambda: ServingEngine(
@@ -40,7 +41,7 @@ class LLMPredictor:
             prefill_token_budget=prefill_token_budget, kv_dtype=kv_dtype,
             clock=clock, max_queue_depth=max_queue_depth,
             max_preemptions=max_preemptions, step_timeout_s=step_timeout_s,
-            drain_timeout_s=drain_timeout_s)
+            drain_timeout_s=drain_timeout_s, prefix_cache=prefix_cache)
         self.engine = self._mk()
 
     #: typed serving error -> the stable ``error`` string reported by
@@ -155,6 +156,10 @@ class LLMPredictor:
                 yield {"index": pos[ev["rid"]], **ev}
 
     def metrics_summary(self) -> dict:
+        """Engine metrics incl. the prefix-cache view: ``cache_hit_rate``
+        (fraction of prefill context tokens served from cached pages)
+        plus the pool's lookup/hit/eviction/COW counters (SERVING.md
+        "Prefix caching")."""
         return self.engine.metrics.summary()
 
     def stats(self) -> dict:

@@ -165,7 +165,13 @@ class LlamaAttention(Layer):
         if static_zero:
             q = apply_rotary_pos_emb(q, cos, sin)
             k = apply_rotary_pos_emb(k, cos, sin)
-        else:  # offset may be a traced scalar (jitted decode step)
+        else:  # offset may be a TRACED scalar: the jitted decode step and
+            # the serving engine's suffix-only prefill both feed the
+            # cached-context length here as an array argument, so a
+            # varying prefix-cache hit length never retraces (SERVING.md
+            # "Prefix caching") — rope rows are selected by value
+            # (jnp.take, bitwise-equal to the static slice) and the
+            # cache mask below derives from the same offset
             pos = position_offset + jnp.arange(s)[None, :]
             pos = jnp.broadcast_to(pos, (b, s))
             q = apply_rotary_pos_emb(q, cos, sin, pos)

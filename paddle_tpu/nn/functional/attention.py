@@ -391,12 +391,22 @@ def _grouped_decode_attn(q, kc, vc, seq_lens, scale):
     kvh = kc.shape[2]
     S = kc.shape[1]
     g = h // kvh
-    qg = q[:, 0].reshape(b, kvh, g, d).astype(jnp.float32)
-    s = jnp.einsum("bngd,bsnd->bngs", qg, kc.astype(jnp.float32)) * scale
+    # the einsums run in the CACHE dtype with fp32 accumulation
+    # (preferred_element_type) instead of upcasting kc/vc to fp32 first:
+    # a materialized fp32 copy of a bf16 cache doubles the KV read
+    # traffic of a bandwidth-bound decode step (PERF.md "Decode
+    # bandwidth"). bf16xbf16->fp32 is the MXU's native accumulation
+    # mode and bf16 products are exact in fp32, so the scores are
+    # unchanged; for fp32 caches every cast here is a no-op and the
+    # math is bitwise identical to the upcast form.
+    qg = q[:, 0].reshape(b, kvh, g, d).astype(kc.dtype)
+    s = jnp.einsum("bngd,bsnd->bngs", qg, kc,
+                   preferred_element_type=jnp.float32) * scale
     mask = jnp.arange(S)[None, None, None, :] <= seq_lens[:, None, None, None]
     s = jnp.where(mask, s, jnp.float32(-1e30))
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bngs,bsnd->bngd", p, vc.astype(jnp.float32))
+    out = jnp.einsum("bngs,bsnd->bngd", p.astype(vc.dtype), vc,
+                     preferred_element_type=jnp.float32)
     return out.reshape(b, 1, h, d).astype(q.dtype)
 
 

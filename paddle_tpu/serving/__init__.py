@@ -17,13 +17,14 @@ from .engine import ServingEngine
 from .errors import (EngineDrainingError, QueueFullError,
                      RequestTooLargeError, SchedulerStalledError,
                      ServingError)
-from .kv_cache import KVCachePool, PoolExhaustedError
+from .kv_cache import KVCachePool, PoolExhaustedError, PrefixMatch
 from .metrics import ServingMetrics, percentile
 from .scheduler import (FINISHED, PREEMPTED, RUNNING, WAITING, Request,
                         SamplingParams, Scheduler)
 
 __all__ = [
-    "ServingEngine", "KVCachePool", "PoolExhaustedError", "ServingMetrics",
+    "ServingEngine", "KVCachePool", "PoolExhaustedError", "PrefixMatch",
+    "ServingMetrics",
     "percentile", "Request", "SamplingParams", "Scheduler",
     "WAITING", "RUNNING", "PREEMPTED", "FINISHED",
     "ServingError", "QueueFullError", "RequestTooLargeError",

@@ -9,11 +9,18 @@ Requests joining, finishing, or being preempted only change array
 at 1 across arbitrary churn (asserted by tests/test_serving.py).
 
 Prefill runs one admitted request at a time through per-bucket compiled
-programs (prompt lengths rounded up to power-of-two page multiples, so
-the program count is O(log max_len)): a contiguous forward over the
-padded prompt fills a temporary ``[1, L_bucket]`` cache which is then
-scattered page-by-page into the pool through the request's block table.
-Bucket-padding positions land in the reserved scratch page 0.
+programs (UNCACHED-suffix lengths rounded up to power-of-two page
+multiples, so the program count is O(log max_len)): the request's pages
+— including any prefix-cache hits mapped in by the scheduler — are
+gathered into a contiguous cache prefix, the model runs over the suffix
+ids only with a TRACED ``start_pos`` offset (never a bucket axis), and
+the buffer is scattered back page-by-page through the block table.
+Bucket-padding and already-cached positions land in the reserved
+scratch page 0. With ``prefix_cache=True`` (default) the pool indexes
+full pages by chained content hash, shares them across requests via
+refcounts, reuses partial pages copy-on-write, and LRU-evicts
+refcount-0 cached pages when allocation would otherwise fail — see
+SERVING.md "Prefix caching".
 
 Determinism: greedy decode is argmax over logits that are bitwise equal
 to ``LlamaForCausalLM.generate()``'s (shared attention core, masked
@@ -69,7 +76,7 @@ class ServingEngine:
                  max_preemptions: int | None = None,
                  step_timeout_s: float | None = None,
                  drain_timeout_s: float | None = 30.0,
-                 watchdog=None):
+                 watchdog=None, prefix_cache: bool = True):
         cfg = model.config
         self.model = model
         self.page_size = page_size
@@ -77,9 +84,20 @@ class ServingEngine:
         self.max_pages_per_slot = (max_pages_per_slot
                                    if max_pages_per_slot is not None
                                    else (num_pages - 1))
+        self.prefix_cache = prefix_cache
         self.pool = KVCachePool.from_config(
             cfg, num_pages, page_size,
-            dtype=kv_dtype if kv_dtype is not None else jnp.bfloat16)
+            dtype=kv_dtype if kv_dtype is not None else jnp.bfloat16,
+            cache_enabled=prefix_cache)
+        # the prefill gather window: every prefill program reads the
+        # request's cached-prefix pages through a fixed-length gather of
+        # _ctx_pages pages (unused entries point at scratch page 0, all
+        # masked), so the CACHED length rides as a traced start_pos and
+        # the program count stays keyed by the suffix bucket alone —
+        # O(log max_len), not O(log^2)
+        self._ctx_pages = min(self.max_pages_per_slot,
+                              self.pool.pages_for(
+                                  cfg.max_position_embeddings))
         self.scheduler = Scheduler(max_slots, prefill_token_budget,
                                    max_queue_depth=max_queue_depth,
                                    max_preemptions=max_preemptions)
@@ -129,6 +147,16 @@ class ServingEngine:
             raise RequestTooLargeError(
                 f"request needs {need} pages "
                 f"(max_pages_per_slot={self.max_pages_per_slot})")
+        # any (re-)admission prefill must fit the gather window: the
+        # longest possible recompute is prompt + max_new - 1 tokens
+        ctx = self._ctx_pages * self.page_size
+        if total - 1 > ctx:
+            self.metrics.on_reject("too_large")
+            raise RequestTooLargeError(
+                f"request context ({total} tokens) exceeds the prefill "
+                f"window of {ctx} tokens ({self._ctx_pages} pages; "
+                f"bounded by max_position_embeddings and "
+                f"max_pages_per_slot)")
         rid = rid if rid is not None else f"req-{next(self._rid_counter)}"
         if rid in self._requests:
             raise ValueError(f"duplicate request id {rid!r}")
@@ -168,12 +196,24 @@ class ServingEngine:
         self._expire_deadlines(events)
         if self._draining:
             self._flush_waiting(events)
-        admitted = []
+        # admit one request at a time and run its prefill immediately:
+        # the NEXT admission's prefix lookup then sees the pages this
+        # prefill just registered, so a same-step burst sharing a system
+        # prompt prefills the common prefix exactly once
         if not self._draining:
-            admitted = self.scheduler.admit(self.pool)
-        for req in admitted:
-            self.metrics.on_admit(req.rid)
-            self._run_prefill(req, events)
+            budget = self.scheduler.prefill_token_budget
+            first = True
+            while True:
+                batch = self.scheduler.admit(self.pool, limit=1,
+                                             budget=budget, first=first)
+                if not batch:
+                    break
+                req = batch[0]
+                budget -= req.context_len - req.cached_len
+                first = False
+                self.metrics.on_admit(req.rid)
+                self.metrics.on_prefill(req.cached_len, req.context_len)
+                self._run_prefill(req, events)
         preempted = self.scheduler.ensure_decode_pages(self.pool)
         for victim in preempted:
             self.metrics.on_preemption()
@@ -185,6 +225,7 @@ class ServingEngine:
                                "finish_reason": "preempted_limit"})
         if self.scheduler.running:
             self._run_decode(events)
+        self.metrics.on_prefix_counters(self.pool.counters)
         self.metrics.on_step(self.scheduler.queue_depth,
                              self.pool.utilization())
         self._steps += 1
@@ -303,7 +344,8 @@ class ServingEngine:
                 "preemptions": self.scheduler.num_preemptions,
                 "draining": self._draining,
                 "decode_programs": self.decode_program_count(),
-                "prefill_programs": len(self._prefill_progs)}
+                "prefill_programs": len(self._prefill_progs),
+                "prefix_cache": self.prefix_cache}
 
     # ------------------------------------------------------------------
     # robustness internals
@@ -339,11 +381,16 @@ class ServingEngine:
     def _finish_abnormal(self, req: Request, reason: str,
                          events: list[dict]) -> None:
         if reason == "nonfinite":
-            # scrub before the pages return to the free list: a NaN left
-            # in a freed page would break the pool's masked-garbage-is-
-            # exact-zero invariant for its next owner (additive masking
-            # cannot silence a NaN — NaN + -1e30 is still NaN)
-            self._scrub_pages(req.pages)
+            # poison containment: deregister the pages from the prefix
+            # index NOW (no future request may match NaN content) and
+            # mark them scrub-on-zero. The scrub itself happens when the
+            # last reference drops — pages shared with a live request
+            # are never zeroed under the reader; pages this request
+            # holds alone are scrubbed as its release lands. (A NaN left
+            # behind would break the pool's masked-garbage-is-exact-zero
+            # invariant: additive masking cannot silence a NaN —
+            # NaN + -1e30 is still NaN.)
+            self.pool.quarantine(req.pages)
         self.scheduler.finish(req, self.pool, reason)
         self.metrics.on_outcome(reason)
         self.metrics.on_finish(req.rid)
@@ -351,20 +398,22 @@ class ServingEngine:
                        "finish_reason": reason})
 
     def _scrub_pages(self, pages: list[int]) -> None:
-        if not pages:
-            return
-        idx = jnp.asarray(pages, jnp.int32)
-        self.pool.pools = [(pk.at[idx].set(0), pv.at[idx].set(0))
-                           for pk, pv in self.pool.pools]
+        self.pool.scrub(pages)
 
     def _poison_pages(self, req: Request) -> None:
         """Fault-action callback (``action="poison"``): NaN the
-        request's first KV page in layer 0 — its next decode step reads
-        the NaN through its own block table and its logits go
-        non-finite, while no other slot can see the page."""
+        request's LAST KV page in layer 0 — its next decode step reads
+        the NaN through its own block table (additive masking cannot
+        silence a NaN) and its logits go non-finite, while no other
+        slot can see the page. The last page — not the first: under
+        prefix caching the leading pages may be SHARED cached pages,
+        and poisoning one would blast every request mapping it. The
+        trailing page is never in the prefix index while its owner
+        runs (only full prompt pages are registered at prefill; the
+        partial tail waits for release), so it is always private."""
         if not req.pages:
             return
-        page = req.pages[0]
+        page = req.pages[-1]
         pk, pv = self.pool.pools[0]
         self.pool.pools[0] = (pk.at[page].set(jnp.nan), pv)
 
@@ -401,21 +450,43 @@ class ServingEngine:
         return p2 * self.page_size
 
     def _prefill_prog(self, L: int):
+        """Suffix prefill program for suffix bucket L (tokens). ONE
+        program family serves both cold prefills (start_pos = 0, no
+        cached pages) and prefix-cache hits: the request's pages are
+        gathered into a contiguous ``[1, CTX]`` cache prefix (unused
+        gather entries read scratch page 0 — masked), a ``[1, L]``
+        zero tail is appended, and the model runs over the suffix ids
+        with a TRACED ``start_pos`` offset (rope positions and the
+        cache mask honor it inside LlamaAttention), so the cached
+        length never becomes a bucket axis — program count stays
+        O(log max_len). The whole buffer is scattered back page-by-page;
+        prefix pages scatter into scratch (their pool content is
+        already identical), suffix pages land in the request's pages."""
         if L in self._prefill_progs:
             return self._prefill_progs[L]
         from ..nn.module import functional_call
-        model, cfg = self.model, self.model.config
+        model = self.model
         ps = self.page_size
-        n_pages = L // ps
-        kv_dtype = self.pool.dtype
+        CTX = self._ctx_pages * ps
+        n_buf_pages = self._ctx_pages + L // ps
 
         @jax.jit
-        def prefill(state, ids, n_valid, scatter_pages, pools,
-                    temp, top_p, greedy, seed):
-            caches = model.init_kv_caches(1, L, dtype=kv_dtype)
+        def prefill(state, ids, n_sfx, start_pos, gather_pages,
+                    scatter_pages, pools, temp, top_p, greedy, seed):
+            caches = []
+            for pk, pv in pools:
+                kvh, d = pk.shape[2], pk.shape[3]
+                ck = jnp.concatenate(
+                    [pk[gather_pages].reshape(1, CTX, kvh, d),
+                     jnp.zeros((1, L, kvh, d), pk.dtype)], axis=1)
+                cv = jnp.concatenate(
+                    [pv[gather_pages].reshape(1, CTX, kvh, d),
+                     jnp.zeros((1, L, kvh, d), pv.dtype)], axis=1)
+                caches.append((ck, cv))
             (logits, caches), _ = functional_call(
-                model, state, ids, None, caches, 0, training=False)
-            lg = jax.lax.dynamic_index_in_dim(logits[0], n_valid - 1,
+                model, state, ids, None, caches, start_pos,
+                training=False)
+            lg = jax.lax.dynamic_index_in_dim(logits[0], n_sfx - 1,
                                               axis=0, keepdims=False)
             ok = jnp.all(jnp.isfinite(lg.astype(jnp.float32)))
             tok = _sample_rows(lg[None], temp[None], top_p[None],
@@ -425,9 +496,9 @@ class ServingEngine:
             for (ck, cv), (pk, pv) in zip(caches, pools):
                 kvh, d = ck.shape[2], ck.shape[3]
                 pk = pk.at[scatter_pages].set(
-                    ck[0].reshape(n_pages, ps, kvh, d))
+                    ck[0].reshape(n_buf_pages, ps, kvh, d))
                 pv = pv.at[scatter_pages].set(
-                    cv[0].reshape(n_pages, ps, kvh, d))
+                    cv[0].reshape(n_buf_pages, ps, kvh, d))
                 new_pools.append((pk, pv))
             return tok, ok, new_pools
 
@@ -439,17 +510,39 @@ class ServingEngine:
     # ------------------------------------------------------------------
 
     def _run_prefill(self, req: Request, events: list[dict]) -> None:
-        n_valid = req.context_len  # == recompute_len, set by admit()
-        L = self._bucket(n_valid)
-        n_pages = L // self.page_size
+        n_valid = req.context_len   # == max(recompute_len, 1), from admit()
+        cached = req.cached_len     # prefix tokens served from cached pages
+        n_sfx = n_valid - cached
+        seq = req.prompt + req.tokens[:-1]
+        if n_sfx == 0:
+            # recompute fully served from the prefix cache: the pages
+            # already hold the materialized context bit-for-bit and the
+            # recompute prefill's prediction would be discarded anyway —
+            # no program runs, the stored last token drives the next
+            # decode step. (Only reachable for req.tokens non-empty:
+            # fresh admissions cap the match at n_valid - 1.)
+            return
+        ps = self.page_size
+        L = self._bucket(n_sfx)
+        n_buf_pages = self._ctx_pages + L // ps
         ids = np.zeros((1, L), np.int32)
-        ids[0, :n_valid] = req.prompt + req.tokens[:-1]
-        scatter = np.zeros((n_pages,), np.int32)
-        scatter[:len(req.pages)] = req.pages
+        ids[0, :n_sfx] = seq[cached:]
+        gather = np.zeros((self._ctx_pages,), np.int32)
+        gather[:len(req.pages)] = req.pages
+        # scatter only from the first suffix page on: the cached full
+        # pages (indices < cached // ps) are immutable and already hold
+        # these exact bits — their buffer rows scatter into scratch.
+        # The COW page (partial hit) IS scattered: rows below the hit
+        # length come back from the gather bit-identical, rows above it
+        # carry the freshly-computed suffix KV.
+        first_sfx_page = cached // ps
+        scatter = np.zeros((n_buf_pages,), np.int32)
+        scatter[first_sfx_page:len(req.pages)] = req.pages[first_sfx_page:]
         sp = req.sampling
         tok, ok, new_pools = self._prefill_prog(L)(
-            self._state, jnp.asarray(ids), jnp.int32(n_valid),
-            jnp.asarray(scatter), self.pool.pools,
+            self._state, jnp.asarray(ids), jnp.int32(n_sfx),
+            jnp.int32(cached), jnp.asarray(gather), jnp.asarray(scatter),
+            self.pool.pools,
             jnp.float32(sp.temperature), jnp.float32(sp.top_p),
             jnp.asarray(not sp.do_sample), jnp.int32(sp.seed))
         self.pool.pools = new_pools
@@ -466,6 +559,13 @@ class ServingEngine:
             # at admission, before it ever joins the decode batch
             self._finish_abnormal(req, "nonfinite", events)
             return
+        # index the prompt's full pages NOW (not at release) so requests
+        # arriving while this one is still decoding can already share
+        # its prefix — the staggered shared-system-prompt workload. Full
+        # pages are immutable from here on; the trailing partial page
+        # keeps filling during decode and is registered at release.
+        self.pool.register_prefix(seq[:n_valid], req.pages,
+                                  include_partial=False)
         if req.tokens:
             return  # recompute after preemption: cache rebuilt, the stored
                     # last token is the next decode input — no new emission

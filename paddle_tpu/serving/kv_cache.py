@@ -7,28 +7,66 @@ buffers, so cache memory fragments at page granularity instead of
 request granularity and a request's reservation grows one page at a
 time as it decodes.
 
-Invariants (relied on by the engine's no-retrace contract, SERVING.md):
+On top of the allocator sits **automatic prefix caching** (RadixAttention,
+SGLang): pages are reference counted, full pages are indexed by a
+chained content hash ``h_i = H(h_{i-1}, page_tokens_i)``, and a released
+request's pages stay resident as refcount-0 *cached* pages on an LRU
+instead of returning to the free list. A later request whose prompt
+shares the prefix maps those pages straight into its block table
+(``match_prefix`` + ``acquire``) and prefills only the uncached suffix.
+Partially-filled last pages are indexed too and reused copy-on-write:
+a hit never writes the cached page in place — the hitter receives a
+fresh page holding a device copy (``cow_into``) and extends that.
+``alloc`` evicts cached pages LRU-oldest only when the free list alone
+cannot satisfy it, scrubbing them back to zero on the way out.
+
+Invariants (relied on by the engine's no-retrace + determinism
+contracts, SERVING.md):
 - the device arrays are allocated ONCE at pool construction and only
   ever updated functionally inside the compiled prefill/decode programs
-  — alloc/free move host-side integers, never device memory;
+  — alloc/free/match move host-side integers, never device memory
+  (the two exceptions, ``cow_into`` and scrub-on-evict, are single
+  functional ``.at[]`` updates);
 - page 0 is reserved as the scratch page: never handed out, used as the
   write/gather target for inactive slots and padded block-table entries
   (always masked by seq_lens, so its garbage is never read into a
   softmax with weight > 0);
 - alloc is all-or-nothing: a partial grab is rolled back so a failed
   allocation leaves the free list unchanged (the scheduler turns the
-  failure into a preemption, not a torn reservation).
+  failure into a preemption, not a torn reservation);
+- a page with refcount > 0 is never written by anyone but its single
+  writer (shared full pages are immutable; partial pages are shared
+  only through COW copies) and never scrubbed — quarantined pages
+  (``quarantine``) are deregistered immediately but scrubbed only when
+  the last holder releases them (refcount 0).
 """
 
 from __future__ import annotations
 
+import hashlib
 import math
+import struct
+from collections import OrderedDict
+from dataclasses import dataclass, field
 
 import jax.numpy as jnp
 
 from .errors import ServingError
 
-__all__ = ["KVCachePool", "PoolExhaustedError"]
+__all__ = ["KVCachePool", "PoolExhaustedError", "PrefixMatch"]
+
+# chain root for the page-content hash (the "parent" of the first page)
+_HASH_ROOT = b"\x00" * 16
+
+
+def _page_hash(parent: bytes, tokens) -> bytes:
+    """Chained page-content key: H(parent_hash, page_tokens). Collision
+    resistance matters — a false positive would serve another prompt's
+    KV — so this is blake2b-128 over the exact token bytes, not
+    Python's 64-bit ``hash``."""
+    h = hashlib.blake2b(parent, digest_size=16)
+    h.update(struct.pack(f"<{len(tokens)}q", *tokens))
+    return h.digest()
 
 
 class PoolExhaustedError(ServingError):
@@ -36,9 +74,26 @@ class PoolExhaustedError(ServingError):
     scheduler catches it and preempts (never propagates to users)."""
 
 
+@dataclass
+class PrefixMatch:
+    """Result of ``match_prefix``: the longest cached prefix of a token
+    sequence, at page granularity. ``full_pages`` are immutable shared
+    pages to map directly; ``partial_page`` (if any) must be reused via
+    ``cow_into`` a freshly-allocated page, never written in place."""
+    full_pages: list[int] = field(default_factory=list)
+    partial_page: int | None = None
+    partial_len: int = 0
+    cached_tokens: int = 0
+
+    @property
+    def hit(self) -> bool:
+        return self.cached_tokens > 0
+
+
 class KVCachePool:
     def __init__(self, num_layers: int, num_pages: int, page_size: int,
-                 num_kv_heads: int, head_dim: int, dtype=jnp.bfloat16):
+                 num_kv_heads: int, head_dim: int, dtype=jnp.bfloat16,
+                 cache_enabled: bool = True):
         if num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is the "
                              "reserved scratch page)")
@@ -62,13 +117,29 @@ class KVCachePool:
         # draw ONE outcome for the engine's whole lifetime
         self.fault_step: int | None = None
 
+        # ---- prefix cache state (all host-side integers) ----
+        self.cache_enabled = cache_enabled
+        self._ref: dict[int, int] = {}          # page -> refcount (>0 only)
+        self._full_index: dict[bytes, int] = {}      # chained hash -> page
+        self._partial_index: dict[bytes, int] = {}   # chained hash -> page
+        self._page_key: dict[int, tuple[str, bytes]] = {}  # page -> index key
+        self._lru: "OrderedDict[int, None]" = OrderedDict()  # refcount-0 cached
+        self._scrub_on_zero: set[int] = set()   # quarantined, shared pages
+        self.counters: dict[str, int] = {
+            "prefix_lookups": 0, "prefix_hits": 0, "prefix_hit_pages": 0,
+            "prefix_partial_hits": 0, "prefix_evictions": 0,
+            "prefix_cow_copies": 0, "prefix_pages_registered": 0,
+        }
+
     @classmethod
     def from_config(cls, config, num_pages: int, page_size: int,
-                    dtype=jnp.bfloat16) -> "KVCachePool":
+                    dtype=jnp.bfloat16, cache_enabled: bool = True
+                    ) -> "KVCachePool":
         """Build from a model config carrying num_hidden_layers /
         num_key_value_heads / head_dim (LlamaConfig shape)."""
         return cls(config.num_hidden_layers, num_pages, page_size,
-                   config.num_key_value_heads, config.head_dim, dtype)
+                   config.num_key_value_heads, config.head_dim, dtype,
+                   cache_enabled=cache_enabled)
 
     # ---- accounting ----
 
@@ -82,8 +153,20 @@ class KVCachePool:
         return len(self._free)
 
     @property
+    def num_cached(self) -> int:
+        """Refcount-0 pages kept resident for prefix reuse (evictable)."""
+        return len(self._lru)
+
+    @property
+    def num_available(self) -> int:
+        """Pages an ``alloc`` can hand out: free + evictable cached."""
+        return len(self._free) + len(self._lru)
+
+    @property
     def num_in_use(self) -> int:
-        return self.capacity - self.num_free
+        """Pages pinned by live requests (refcount > 0). Cached
+        refcount-0 pages are NOT in use — they are reclaimable."""
+        return self.capacity - len(self._free) - len(self._lru)
 
     def utilization(self) -> float:
         return self.num_in_use / self.capacity
@@ -95,13 +178,22 @@ class KVCachePool:
     def stats(self) -> dict:
         return {"num_pages": self.num_pages, "page_size": self.page_size,
                 "capacity": self.capacity, "in_use": self.num_in_use,
+                "pinned": self.num_in_use, "cached": self.num_cached,
                 "free": self.num_free, "utilization": self.utilization(),
-                "peak_in_use": self._peak_in_use}
+                "peak_in_use": self._peak_in_use,
+                "indexed_pages": len(self._page_key),
+                **self.counters}
 
     # ---- alloc / free ----
 
     def alloc(self, n: int) -> list[int]:
         """Grab n pages (all-or-nothing); raises PoolExhaustedError.
+
+        The free list is consumed first; when it runs dry, refcount-0
+        cached pages are evicted LRU-oldest — deregistered from the
+        prefix index and scrubbed back to zero (the masked-garbage-is-
+        zero invariant survives reuse) — until the grab fits. Pinned
+        pages (refcount > 0) are never touched.
 
         Fault site ``serving.alloc``: an armed ``raise`` spec here
         surfaces as a PoolExhaustedError — the scheduler's normal
@@ -111,22 +203,218 @@ class KVCachePool:
         from ..distributed import fault as _fault
         try:
             _fault.trip("serving.alloc", step=self.fault_step,
-                        need=n, free=len(self._free))
+                        need=n, free=self.num_available)
         except _fault.FaultInjected as e:
             raise PoolExhaustedError(
                 f"injected exhaustion (serving.alloc): {e}") from e
-        if n > len(self._free):
+        if n > self.num_available:
             raise PoolExhaustedError(
-                f"need {n} pages, {len(self._free)} free "
-                f"(capacity {self.capacity})")
+                f"need {n} pages, {len(self._free)} free + "
+                f"{len(self._lru)} cached (capacity {self.capacity})")
+        evicted: list[int] = []
+        while len(self._free) < n and self._lru:
+            page, _ = self._lru.popitem(last=False)  # oldest first
+            self._deregister(page)
+            evicted.append(page)
+            self._free.append(page)
+        if evicted:
+            self.scrub(evicted)
+            self.counters["prefix_evictions"] += len(evicted)
         pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._ref[p] = 1
         self._peak_in_use = max(self._peak_in_use, self.num_in_use)
         return pages
 
     def free(self, pages: list[int]) -> None:
+        """Unconditionally return pages to the free list (no refcount /
+        cache semantics — the low-level inverse of ``alloc``). The
+        refcounted paths go through ``release``."""
         for p in pages:
             if p == 0 or p >= self.num_pages:
                 raise ValueError(f"page {p} is not an allocatable page")
             if p in self._free:
                 raise ValueError(f"double free of page {p}")
+        for p in pages:
+            self._ref.pop(p, None)
+            self._lru.pop(p, None)
+            self._scrub_on_zero.discard(p)
+            self._deregister(p)
         self._free.extend(pages)
+
+    # ---- reference counting ----
+
+    def acquire(self, pages: list[int]) -> None:
+        """Take a reference on each page (a cache hit mapping shared
+        pages into a block table). A refcount-0 cached page is pinned —
+        pulled off the eviction LRU — by its first new holder."""
+        for p in pages:
+            r = self._ref.get(p, 0)
+            if r == 0:
+                self._lru.pop(p, None)
+            self._ref[p] = r + 1
+        self._peak_in_use = max(self._peak_in_use, self.num_in_use)
+
+    def release(self, pages: list[int]) -> None:
+        """Drop one reference per page. At refcount 0 a page either
+        stays resident as a cached page (registered in the prefix index
+        and cache enabled), is scrubbed-then-freed (quarantined), or
+        returns to the free list."""
+        scrub: list[int] = []
+        for p in pages:
+            r = self._ref.get(p, 0) - 1
+            if r > 0:
+                self._ref[p] = r
+                continue
+            self._ref.pop(p, None)
+            if p in self._scrub_on_zero:
+                # quarantined while shared: only now, with no holder
+                # left, is it safe to zero the poisoned content
+                self._scrub_on_zero.discard(p)
+                self._deregister(p)
+                scrub.append(p)
+                self._free.append(p)
+            elif self.cache_enabled and p in self._page_key:
+                self._lru[p] = None
+                self._lru.move_to_end(p)
+            else:
+                self._deregister(p)
+                self._free.append(p)
+        if scrub:
+            self.scrub(scrub)
+
+    def refcount(self, page: int) -> int:
+        return self._ref.get(page, 0)
+
+    def quarantine(self, pages: list[int]) -> None:
+        """Poison containment for a request whose pages may hold
+        non-finite values: deregister every page from the prefix index
+        immediately (no future request may match it) and mark it
+        scrub-on-zero. Pages still shared with live requests are NOT
+        scrubbed here — zeroing under a reader would corrupt its
+        stream; the scrub happens in ``release`` when the last
+        reference drops."""
+        todo = []
+        for p in set(pages):
+            self._deregister(p)
+            if self._ref.get(p, 0) > 0:
+                self._scrub_on_zero.add(p)
+            elif p in self._lru:        # cached, no holders: scrub now
+                self._lru.pop(p)
+                todo.append(p)
+                self._free.append(p)
+        if todo:
+            self.scrub(todo)
+
+    # ---- the prefix index ----
+
+    def match_prefix(self, tokens, max_tokens: int | None = None,
+                     count: bool = False) -> PrefixMatch:
+        """Longest cached prefix of ``tokens`` at page granularity:
+        full pages walked by the chained content hash, then the longest
+        indexed partial continuation of the next page. Pure lookup —
+        takes no references (callers ``acquire`` what they keep). Pass
+        ``count=True`` to tally the hit counters (one tally per
+        admission, not per probe)."""
+        limit = len(tokens) if max_tokens is None else min(max_tokens,
+                                                           len(tokens))
+        m = PrefixMatch()
+        if not self.cache_enabled or limit <= 0:
+            return m
+        ps = self.page_size
+        parent = _HASH_ROOT
+        pos = 0
+        while pos + ps <= limit:
+            key = _page_hash(parent, tokens[pos:pos + ps])
+            page = self._full_index.get(key)
+            if page is None:
+                break
+            m.full_pages.append(page)
+            parent = key
+            pos += ps
+        for q in range(min(limit - pos, ps - 1), 0, -1):
+            page = self._partial_index.get(
+                _page_hash(parent, tokens[pos:pos + q]))
+            if page is not None:
+                m.partial_page, m.partial_len = page, q
+                break
+        m.cached_tokens = pos + m.partial_len
+        if count:
+            self.count_match(m)
+        return m
+
+    def count_match(self, m: PrefixMatch) -> None:
+        self.counters["prefix_lookups"] += 1
+        if m.hit:
+            self.counters["prefix_hits"] += 1
+            self.counters["prefix_hit_pages"] += (
+                len(m.full_pages) + (1 if m.partial_page is not None else 0))
+            if m.partial_page is not None:
+                self.counters["prefix_partial_hits"] += 1
+
+    def register_prefix(self, tokens, pages: list[int],
+                        include_partial: bool = True) -> int:
+        """Index a request's materialized prefix: page i of ``pages``
+        holds ``tokens[i*ps:(i+1)*ps]``. Full pages are registered under
+        the chained hash; the trailing partial page (content frozen —
+        callers register it only once no further writes can land, i.e.
+        at release) under the partial index. First writer wins: an
+        existing index entry for the same content keeps its page. Pages
+        must be held by the caller (refcount > 0); returns how many
+        pages were newly registered."""
+        if not self.cache_enabled:
+            return 0
+        ps = self.page_size
+        n_full = min(len(tokens) // ps, len(pages))
+        parent = _HASH_ROOT
+        registered = 0
+        for i in range(n_full):
+            key = _page_hash(parent, tokens[i * ps:(i + 1) * ps])
+            page = pages[i]
+            if (key not in self._full_index and page not in self._page_key
+                    and self._ref.get(page, 0) > 0
+                    and page not in self._scrub_on_zero):
+                self._full_index[key] = page
+                self._page_key[page] = ("full", key)
+                registered += 1
+            parent = key  # the content chain continues either way
+        q = len(tokens) - n_full * ps
+        if include_partial and 0 < q < ps and n_full < len(pages):
+            key = _page_hash(parent, tokens[n_full * ps:])
+            page = pages[n_full]
+            if (key not in self._partial_index and page not in self._page_key
+                    and self._ref.get(page, 0) > 0
+                    and page not in self._scrub_on_zero):
+                self._partial_index[key] = page
+                self._page_key[page] = ("partial", key)
+                registered += 1
+        self.counters["prefix_pages_registered"] += registered
+        return registered
+
+    def _deregister(self, page: int) -> None:
+        kind_key = self._page_key.pop(page, None)
+        if kind_key is None:
+            return
+        kind, key = kind_key
+        index = self._full_index if kind == "full" else self._partial_index
+        if index.get(key) == page:
+            del index[key]
+
+    # ---- device-side page ops ----
+
+    def cow_into(self, src: int, dst: int) -> None:
+        """Copy-on-write materialization: device-copy page ``src`` into
+        the freshly-allocated page ``dst``. The cached source is never
+        written in place — the hitter extends its own copy."""
+        self.pools = [(pk.at[dst].set(pk[src]), pv.at[dst].set(pv[src]))
+                      for pk, pv in self.pools]
+        self.counters["prefix_cow_copies"] += 1
+
+    def scrub(self, pages: list[int]) -> None:
+        """Zero pages (eviction / quarantine): restores the
+        masked-garbage-is-zero invariant before reuse."""
+        if not pages:
+            return
+        idx = jnp.asarray(sorted(set(pages)), jnp.int32)
+        self.pools = [(pk.at[idx].set(0), pv.at[idx].set(0))
+                      for pk, pv in self.pools]

@@ -56,6 +56,13 @@ class ServingMetrics:
             "timed_out": 0, "quarantined": 0, "preempted_limit": 0,
             "drained": 0, "injected": 0,
         }
+        # prefix-cache accounting (SERVING.md "Prefix caching"):
+        # per-admission token totals accumulate here; the pool's page
+        # counters (lookups/hits/evictions/COW) are mirrored in by the
+        # engine each step
+        self._prefill_tokens = 0
+        self._prefill_cached_tokens = 0
+        self._prefix_counters: dict[str, int] = {}
 
     def now(self) -> float:
         return self._clock()
@@ -105,6 +112,24 @@ class ServingMetrics:
                "injected": "injected"}.get(finish_reason)
         if key is not None:
             self.counters[key] += 1
+
+    def on_prefill(self, cached_tokens: int, total_tokens: int) -> None:
+        """One admission's prefill accounting: ``cached_tokens`` of the
+        ``total_tokens`` context were served from the prefix cache (the
+        engine only ran the suffix). Feeds ``cache_hit_rate``."""
+        self._prefill_tokens += total_tokens
+        self._prefill_cached_tokens += cached_tokens
+
+    def on_prefix_counters(self, counters: dict) -> None:
+        """Mirror the pool's prefix-cache page counters (lookups, hits,
+        partial hits, evictions, COW copies) into the summary."""
+        self._prefix_counters = dict(counters)
+
+    def cache_hit_rate(self) -> float:
+        """Fraction of prefill context tokens served from cached pages."""
+        if self._prefill_tokens == 0:
+            return 0.0
+        return self._prefill_cached_tokens / self._prefill_tokens
 
     # ---- per-step gauges ----
 
@@ -157,5 +182,9 @@ class ServingMetrics:
             "queue_wait_p99_s": percentile(self._queue_wait, 99),
             "rejected": (self.counters["rejected_queue_full"]
                          + self.counters["rejected_too_large"]),
+            "cache_hit_rate": self.cache_hit_rate(),
+            "prefill_tokens": self._prefill_tokens,
+            "prefill_cached_tokens": self._prefill_cached_tokens,
+            **self._prefix_counters,
             **self.counters,
         }

@@ -67,6 +67,12 @@ class Request:
     slot: int | None = None
     pages: list[int] = field(default_factory=list)
     context_len: int = 0          # tokens currently materialized in cache
+    # prefix-cache bookkeeping for the CURRENT admission: how many
+    # leading tokens were served from cached pages (the engine prefills
+    # only the suffix beyond them), and whether the last cached page was
+    # a copy-on-write partial hit
+    cached_len: int = 0
+    cached_partial: bool = False
 
     @property
     def recompute_len(self) -> int:
@@ -112,12 +118,21 @@ class Scheduler:
         if pool is not None:
             need = pool.pages_for(len(req.prompt) + req.max_new_tokens)
             if need > pool.capacity:
-                raise RequestTooLargeError(
-                    f"request {req.rid!r} needs {need} pages for its "
-                    f"prompt ({len(req.prompt)} tokens) + "
-                    f"{req.max_new_tokens} decode tokens, but the pool "
-                    f"has only {pool.capacity} allocatable pages — it "
-                    f"could never run")
+                # prefix-cache accounting: only the UNCACHED suffix has
+                # to be newly allocated — a prompt whose cached prefix
+                # pages already sit in the pool can run even when its
+                # total page count exceeds the capacity check above
+                cached = 0
+                if pool.cache_enabled:
+                    cached = len(pool.match_prefix(req.prompt).full_pages)
+                if need - cached > pool.capacity:
+                    raise RequestTooLargeError(
+                        f"request {req.rid!r} needs {need} pages for its "
+                        f"prompt ({len(req.prompt)} tokens) + "
+                        f"{req.max_new_tokens} decode tokens "
+                        f"({cached} cached), but the pool has only "
+                        f"{pool.capacity} allocatable pages — it "
+                        f"could never run")
         req.arrival_seq = self._arrival_counter
         self._arrival_counter += 1
         req.state = WAITING
@@ -155,9 +170,21 @@ class Scheduler:
             self._requeue(victim)
         return victim
 
-    def _release(self, req: Request, pool: KVCachePool) -> None:
-        pool.free(req.pages)
+    def _release(self, req: Request, pool: KVCachePool,
+                 register: bool = True) -> None:
+        """Drop the request's slot and page REFERENCES (shared prefix
+        pages may outlive it under other holders). With ``register``
+        (every release except poison quarantine), its materialized
+        prefix — full pages plus the frozen partial tail — is indexed
+        first, so a preempted request's recompute, or a later request
+        sharing the prompt, can map these pages instead of re-prefilling."""
+        if register and req.pages:
+            seq = (req.prompt + req.tokens)[:req.context_len]
+            pool.register_prefix(seq, req.pages, include_partial=True)
+        pool.release(req.pages)
         req.pages = []
+        req.cached_len = 0
+        req.cached_partial = False
         self._free_slots.append(req.slot)
         del self.running[req.slot]
         req.slot = None
@@ -167,14 +194,17 @@ class Scheduler:
         """Terminal transition from ANY live state: a running request
         releases its slot and pages; a waiting/preempted one just leaves
         the queue (deadline expiry and drain finish requests that never
-        held resources)."""
+        held resources). Poisoned/injected finishes never register their
+        pages in the prefix index (the engine quarantined them already —
+        registering NaN content would serve it to future hits)."""
+        register = reason not in ("nonfinite", "injected")
         if req.slot is not None:
-            self._release(req, pool)
+            self._release(req, pool, register=register)
         else:
             if req in self.waiting:
                 self.waiting.remove(req)
             if req.pages:
-                pool.free(req.pages)
+                pool.release(req.pages)
                 req.pages = []
         req.state = FINISHED
         req.finish_reason = reason
@@ -203,33 +233,81 @@ class Scheduler:
                         break  # it preempted itself; nothing left to grow
         return preempted
 
-    def admit(self, pool: KVCachePool) -> list[Request]:
+    def admit(self, pool: KVCachePool, limit: int | None = None,
+              budget: int | None = None,
+              first: bool = True) -> list[Request]:
         """Admit waiting requests in strict FCFS order while a slot, the
         pool, and the per-step prefill token budget allow. Stops at the
         first request that does not fit (no queue jumping). Returns the
         admitted requests with slot + prompt pages assigned; the engine
-        runs their prefills."""
+        runs their prefills.
+
+        The engine calls this with ``limit=1`` in a loop, running each
+        prefill before the next admission, so a same-step burst sharing
+        a prompt prefix hits the pages the previous prefill just
+        registered; ``budget`` carries the remaining step budget across
+        those calls and ``first=False`` says an admission already
+        happened this step (the first admission of a step ignores the
+        budget so an oversized prompt cannot deadlock)."""
         admitted: list[Request] = []
-        budget = self.prefill_token_budget
-        while self.waiting and self._free_slots:
+        budget = self.prefill_token_budget if budget is None else budget
+        while (self.waiting and self._free_slots
+               and (limit is None or len(admitted) < limit)):
             req = self.waiting[0]
-            need_tokens = max(req.recompute_len, 1)
-            if admitted and need_tokens > budget:
+            n_valid = max(req.recompute_len, 1)
+            # prefix-cache lookup: a fresh request caps the match at
+            # n_valid - 1 (at least one suffix token must run through the
+            # prefill program to produce its first logits); a recompute
+            # (req.tokens non-empty — the prefill's prediction is
+            # discarded anyway) may match fully and skip the program
+            match = None
+            cached = 0
+            if pool.cache_enabled:
+                cap = n_valid if req.tokens else n_valid - 1
+                seq = req.prompt + req.tokens[:-1]
+                match = pool.match_prefix(seq, max_tokens=cap)
+                cached = match.cached_tokens
+            suffix = n_valid - cached
+            # only the UNCACHED suffix charges the prefill token budget
+            if (admitted or not first) and suffix > budget:
                 break
-            n_pages = pool.pages_for(need_tokens)
-            if n_pages > pool.num_free:
+            n_new = (pool.pages_for(n_valid)
+                     - (len(match.full_pages) if match else 0))
+            if n_new > pool.num_available:
                 break
+            # commit order matters: pin the matched pages FIRST so this
+            # admission's own alloc cannot LRU-evict them, then allocate
+            # the suffix pages, then materialize the COW copy. Rollback
+            # on failure leaves the pool exactly as found.
+            pinned: list[int] = []
+            if match is not None and match.hit:
+                pinned = list(match.full_pages)
+                if match.partial_page is not None:
+                    pinned.append(match.partial_page)
+                pool.acquire(pinned)
             try:
-                pages = pool.alloc(n_pages)
+                pages = pool.alloc(n_new)
             except PoolExhaustedError:
+                pool.release(pinned)
                 break  # injected exhaustion (serving.alloc) — the head
                        # stays queued, never torn out of the FCFS order
+            if match is not None and match.partial_page is not None:
+                # copy-at-map COW: the hitter gets a fresh page holding a
+                # copy of the cached partial page and extends THAT; the
+                # cached page itself is never written, then unpinned
+                pool.cow_into(match.partial_page, pages[0])
+                pool.release([match.partial_page])
+            if match is not None:
+                pool.count_match(match)
             self.waiting.pop(0)
-            req.pages = pages
+            req.pages = (list(match.full_pages) if match else []) + pages
+            req.cached_len = cached
+            req.cached_partial = bool(match and match.partial_page
+                                      is not None)
             req.slot = self._free_slots.pop()
             req.state = RUNNING
-            req.context_len = need_tokens
+            req.context_len = n_valid
             self.running[req.slot] = req
             admitted.append(req)
-            budget -= need_tokens
+            budget -= suffix
         return admitted

@@ -14,6 +14,7 @@ _REPO = Path(__file__).resolve().parents[1]
 _DEFAULT_CONFIGS = {
     "llama_420m", "resnet50", "bert_base", "qwen2_moe", "lenet_mnist",
     "llama8b_shape", "llama_decode", "llama_longctx", "llama_serving",
+    "llama_serving_prefix",
 }
 
 
@@ -83,3 +84,15 @@ def test_dry_serving_cell_carries_latency_and_failure_keys():
     assert set(cell) >= {"value", "mfu", "spread",
                          "ttft_p50", "ttft_p99", "tpot",
                          "rejected", "timed_out", "quarantined"}, cell
+
+
+def test_dry_serving_prefix_cell_carries_cache_keys():
+    out = _run_dry("llama_serving_prefix")
+    assert out.returncode == 0, out.stderr
+    last = json.loads(out.stdout.splitlines()[-1])
+    cell = last["bench_summary"]["llama_serving_prefix"]
+    assert set(cell) >= {"value", "mfu", "spread",
+                         "ttft_p50", "ttft_p99", "tpot",
+                         "cache_hit_rate", "prefix_hits",
+                         "prefix_evictions"}, cell
+    assert all(v is None for v in cell.values()), cell

@@ -615,6 +615,361 @@ class TestServingChaos:
 
 
 # ---------------------------------------------------------------------------
+# automatic prefix caching (SERVING.md "Prefix caching")
+# ---------------------------------------------------------------------------
+
+class TestPrefixCachePool:
+    def _pool(self, pages=10, ps=4, **kw):
+        return KVCachePool(1, pages, ps, 2, 8, **kw)
+
+    def test_release_of_registered_pages_caches_instead_of_freeing(self):
+        pool = self._pool()
+        pages = pool.alloc(2)
+        pool.register_prefix(list(range(8)), pages)
+        pool.release(pages)
+        assert pool.num_cached == 2 and pool.num_in_use == 0
+        assert pool.num_available == pool.capacity
+        s = pool.stats()
+        assert s["pinned"] == 0 and s["cached"] == 2 and s["free"] == 7
+        # re-acquiring pins them again (off the eviction LRU)
+        pool.acquire(pages)
+        assert pool.num_cached == 0 and pool.num_in_use == 2
+        assert pool.refcount(pages[0]) == 1
+
+    def test_match_full_and_partial_pages_and_cap(self):
+        pool = self._pool()
+        toks = list(range(10))  # 2 full pages + a 2-token partial
+        pages = pool.alloc(3)
+        pool.register_prefix(toks, pages)
+        m = pool.match_prefix(toks)
+        assert m.full_pages == pages[:2]
+        assert m.partial_page == pages[2] and m.partial_len == 2
+        assert m.cached_tokens == 10 and m.hit
+        # the partial index stores the EXACT content hash, so a cap that
+        # truncates mid-partial misses it (q=1 was never registered)
+        m2 = pool.match_prefix(toks, max_tokens=9)
+        assert m2.full_pages == pages[:2] and m2.partial_page is None
+        assert m2.cached_tokens == 8
+        # divergent content stops the chained-hash walk at the split
+        m3 = pool.match_prefix(toks[:4] + [999] * 6)
+        assert m3.full_pages == pages[:1] and m3.cached_tokens == 4
+        assert not pool.match_prefix([999] * 8).hit
+
+    def test_register_first_writer_wins(self):
+        pool = self._pool()
+        a = pool.alloc(1)
+        assert pool.register_prefix(list(range(4)), a) == 1
+        b = pool.alloc(1)
+        # same content under a different page: the index keeps page a
+        assert pool.register_prefix(list(range(4)), b) == 0
+        assert pool.match_prefix(list(range(4))).full_pages == a
+        pool.release(b)  # unregistered -> straight back to the free list
+        assert pool.num_cached == 0 and pool.num_free == 8
+
+    def test_alloc_evicts_lru_oldest_and_scrubs(self):
+        pool = self._pool(pages=6)  # capacity 5
+        a = pool.alloc(2)
+        pool.register_prefix(list(range(8)), a)
+        pk, pv = pool.pools[0]
+        pool.pools[0] = (pk.at[a[0]].set(1.0), pv)  # sentinel content
+        pool.release(a)
+        b = pool.alloc(2)
+        pool.register_prefix(list(range(100, 108)), b)
+        pool.release(b)
+        assert pool.num_free == 1 and pool.num_cached == 4
+        # a was released first -> LRU-oldest -> evicted to satisfy 3 > 1
+        got = pool.alloc(3)
+        assert pool.counters["prefix_evictions"] == 2
+        assert not pool.match_prefix(list(range(8))).hit
+        assert pool.match_prefix(list(range(100, 108))).hit  # b survived
+        assert bool(jnp.all(pool.pools[0][0][a[0]] == 0))  # scrubbed
+        pool.free(got)
+
+    def test_acquire_release_refreshes_lru_recency(self):
+        pool = self._pool(pages=6)  # capacity 5
+        a = pool.alloc(2)
+        pool.register_prefix(list(range(8)), a)
+        pool.release(a)
+        b = pool.alloc(2)
+        pool.register_prefix(list(range(100, 108)), b)
+        pool.release(b)
+        pool.acquire(a)   # a touched -> most recent
+        pool.release(a)
+        pool.alloc(3)     # evicts the now-oldest b, not a
+        assert pool.match_prefix(list(range(8))).hit
+        assert not pool.match_prefix(list(range(100, 108))).hit
+
+    def test_quarantine_scrubs_shared_pages_only_at_refcount_zero(self):
+        pool = self._pool()
+        shared = pool.alloc(1)       # holder 1 (the poisoned request)
+        pool.acquire(shared)         # holder 2 (an innocent sharer)
+        pool.register_prefix(list(range(4)), shared)
+        pk, pv = pool.pools[0]
+        pool.pools[0] = (pk.at[shared[0]].set(jnp.nan), pv)
+        pool.quarantine(shared)
+        # deregistered IMMEDIATELY: no future request can match it
+        assert not pool.match_prefix(list(range(4))).hit
+        # but the content survives while the sharer still reads it
+        assert pool.refcount(shared[0]) == 2
+        assert bool(jnp.isnan(pool.pools[0][0][shared[0]]).any())
+        pool.release(shared)         # poisoned holder exits
+        assert bool(jnp.isnan(pool.pools[0][0][shared[0]]).any())
+        pool.release(shared)         # last holder exits -> scrub + free
+        assert bool(jnp.all(jnp.isfinite(pool.pools[0][0])))
+        assert pool.num_free == pool.capacity and pool.num_cached == 0
+
+    def test_quarantine_of_cached_page_scrubs_immediately(self):
+        pool = self._pool()
+        a = pool.alloc(1)
+        pool.register_prefix(list(range(4)), a)
+        pool.release(a)              # cached, refcount 0
+        pool.quarantine(a)
+        assert pool.num_cached == 0 and pool.num_free == pool.capacity
+        assert not pool.match_prefix(list(range(4))).hit
+
+    def test_cache_disabled_pool_never_caches(self):
+        pool = self._pool(cache_enabled=False)
+        a = pool.alloc(2)
+        assert pool.register_prefix(list(range(8)), a) == 0
+        pool.release(a)
+        assert pool.num_cached == 0 and pool.num_free == pool.capacity
+        assert not pool.match_prefix(list(range(8))).hit
+
+    def test_cow_into_copies_device_content(self):
+        pool = self._pool()
+        a, b = pool.alloc(2)
+        pk, pv = pool.pools[0]
+        pool.pools[0] = (pk.at[a].set(3.0), pv)
+        pool.cow_into(a, b)
+        assert bool(jnp.all(pool.pools[0][0][b] == 3.0))
+        assert pool.counters["prefix_cow_copies"] == 1
+
+
+class TestPrefixScheduler:
+    def test_admission_charges_only_the_uncached_suffix(self):
+        shared = list(range(100, 108))
+        pool = KVCachePool(1, 32, 4, 2, 8)
+        seed = pool.alloc(2)
+        pool.register_prefix(shared, seed)
+        pool.release(seed)
+        sched = Scheduler(max_slots=2, prefill_token_budget=12)
+        sched.add(Request(rid="r0", prompt=list(range(6)),
+                          max_new_tokens=4))
+        r1 = Request(rid="r1", prompt=shared + [1, 2, 3, 4],
+                     max_new_tokens=4)
+        sched.add(r1)
+        # r0 takes 6 of the 12-token budget; r1 is 12 tokens but 8 are
+        # cached, so its suffix (4) fits the remaining 6 — both admitted
+        # in ONE call where an uncached r1 would have waited a step
+        admitted = sched.admit(pool)
+        assert [r.rid for r in admitted] == ["r0", "r1"]
+        assert r1.cached_len == 8 and not r1.cached_partial
+        assert r1.pages[:2] == seed
+        assert pool.refcount(seed[0]) == 1  # mapped = pinned
+        # control: same shape, cold pool -> the second request waits
+        pool2 = KVCachePool(1, 32, 4, 2, 8)
+        sched2 = Scheduler(max_slots=2, prefill_token_budget=12)
+        sched2.add(Request(rid="c0", prompt=list(range(6)),
+                           max_new_tokens=4))
+        sched2.add(Request(rid="c1", prompt=shared + [1, 2, 3, 4],
+                           max_new_tokens=4))
+        assert [r.rid for r in sched2.admit(pool2)] == ["c0"]
+
+    def test_add_accounts_cached_pages_against_capacity(self):
+        shared = list(range(64))
+        pool = KVCachePool(1, 21, 4, 2, 8)  # capacity 20
+        seed = pool.alloc(16)
+        pool.register_prefix(shared, seed)
+        pool.release(seed)
+        sched = Scheduler(max_slots=1)
+        # 64 prompt + 16 decode = 20 pages: equals capacity, admissible
+        # only because 16 prompt pages are already cached
+        sched.add(Request(rid="ok", prompt=shared, max_new_tokens=16),
+                  pool)
+        cold = KVCachePool(1, 21, 4, 2, 8)
+        with pytest.raises(RequestTooLargeError):
+            sched.add(Request(rid="no", prompt=shared + [1] * 20,
+                              max_new_tokens=16), cold)
+
+
+class TestPrefixCacheEngine:
+    def test_shared_prefix_staggered_hit_parity(self, model):
+        shared = list(RNG.integers(0, 512, 11))
+        prompts = [shared + list(RNG.integers(0, 512, n))
+                   for n in (3, 5, 2)]
+        max_new = 8
+        refs = [_reference(model, p, max_new) for p in prompts]
+        eng = ServingEngine(model, num_pages=64, page_size=4, max_slots=4,
+                            max_pages_per_slot=16)
+        rids = [eng.add_request(prompts[0], max_new)]
+        eng.step()   # the first prefill registers the shared pages
+        rids.append(eng.add_request(prompts[1], max_new))
+        eng.step()
+        rids.append(eng.add_request(prompts[2], max_new))
+        res = eng.run_to_completion(max_steps=200)
+        for rid, ref in zip(rids, refs):
+            assert res[rid] == ref  # bitwise: cache hits change nothing
+        m = eng.metrics.summary()
+        assert m["prefix_hits"] >= 2
+        assert m["cache_hit_rate"] > 0.3
+        assert eng.decode_program_count() == 1
+        # suffix-only prefill keeps the program count log-bounded: the
+        # full-prompt bucket plus the (smaller) suffix buckets
+        assert eng.stats()["prefill_programs"] <= 3
+
+    def test_same_step_burst_shares_the_first_prefill(self, model):
+        """Interleaved admission: requests arriving in the SAME step as
+        the prefix writer still hit — prefill-time registration."""
+        shared = list(RNG.integers(0, 512, 9))
+        prompts = [shared + list(RNG.integers(0, 512, n)) for n in (2, 4)]
+        refs = [_reference(model, p, 6) for p in prompts]
+        eng = ServingEngine(model, num_pages=64, page_size=4, max_slots=4,
+                            max_pages_per_slot=16)
+        rids = [eng.add_request(p, 6) for p in prompts]
+        res = eng.run_to_completion(max_steps=100)
+        for rid, ref in zip(rids, refs):
+            assert res[rid] == ref
+        assert eng.metrics.summary()["prefix_hits"] >= 1
+
+    def test_partial_page_cow_hit_then_divergence(self, model):
+        """Multi-turn shape: follow-ups extend a finished request's full
+        context (prompt + its reply), so the match runs THROUGH the
+        frozen partial page — both hitters get COW copies and extend
+        them divergently; the cached page itself is never written."""
+        shared = list(RNG.integers(0, 512, 6))
+        eng = ServingEngine(model, num_pages=64, page_size=4, max_slots=4,
+                            max_pages_per_slot=16)
+        r0 = eng.add_request(shared, 2)
+        out0 = eng.run_to_completion(max_steps=50)[r0]
+        assert out0 == _reference(model, shared, 2)
+        # r0's release registered (shared + out0)[:7]: one full page and
+        # a 3-token partial page
+        hist = shared + out0
+        prompts = [hist + list(RNG.integers(0, 512, n)) for n in (3, 2)]
+        refs = [_reference(model, p, 6) for p in prompts]
+        rids = [eng.add_request(p, 6) for p in prompts]
+        res = eng.run_to_completion(max_steps=100)
+        for rid, ref in zip(rids, refs):
+            assert res[rid] == ref  # bitwise through the COW copies
+        m = eng.metrics.summary()
+        # the FIRST hitter partial-hits and COWs; the second full-hits
+        # the page the first hitter's prefill completed and registered
+        # (a partial page upgraded to a shared full page)
+        assert m["prefix_hits"] >= 2
+        assert m["prefix_partial_hits"] >= 1
+        assert m["prefix_cow_copies"] >= 1
+        # the original context replays bitwise too: its cached page was
+        # never written in place by the diverging hitters
+        r3 = eng.add_request(shared, 2)
+        assert eng.run_to_completion(max_steps=50)[r3] == out0
+
+    def test_parity_after_eviction_and_reprefill(self, model):
+        pa = list(RNG.integers(0, 512, 8))
+        ref = _reference(model, pa, 4)
+        eng = ServingEngine(model, num_pages=9, page_size=4, max_slots=2,
+                            max_pages_per_slot=8)
+        ra = eng.add_request(pa, 4)
+        assert eng.run_to_completion(max_steps=100)[ra] == ref
+        # disjoint churn overruns the tiny pool's cache -> evictions
+        for _ in range(4):
+            eng.add_request(list(RNG.integers(0, 512, 8)), 4)
+            eng.run_to_completion(max_steps=100)
+        assert eng.pool.counters["prefix_evictions"] > 0
+        # pa's pages may be gone; a re-run must re-prefill and match
+        ra2 = eng.add_request(pa, 4)
+        assert eng.run_to_completion(max_steps=100)[ra2] == ref
+        assert eng.decode_program_count() == 1
+        for pk, pv in eng.pool.pools:
+            assert bool(jnp.all(jnp.isfinite(pk.astype(jnp.float32))))
+
+    def test_parity_across_preemption_recompute_hits_cache(self, model):
+        prompts = [list(RNG.integers(0, 512, n)) for n in (6, 7)]
+        refs = [_reference(model, p, 10) for p in prompts]
+        eng = ServingEngine(model, num_pages=7, page_size=4, max_slots=2,
+                            max_pages_per_slot=6)
+        rids = [eng.add_request(p, 10) for p in prompts]
+        res = eng.run_to_completion(max_steps=500)
+        assert eng.scheduler.num_preemptions > 0
+        for rid, ref in zip(rids, refs):
+            assert res[rid] == ref
+        # the victim's pages were registered at preemption, so its
+        # recompute mapped them back instead of re-prefilling everything
+        assert eng.pool.counters["prefix_hits"] > 0
+        assert eng.decode_program_count() == 1
+
+    def test_prefix_cache_off_is_the_old_engine(self, model):
+        shared = list(RNG.integers(0, 512, 11))
+        prompts = [shared + list(RNG.integers(0, 512, n)) for n in (3, 5)]
+        refs = [_reference(model, p, 6) for p in prompts]
+        eng = ServingEngine(model, num_pages=64, page_size=4, max_slots=4,
+                            max_pages_per_slot=16, prefix_cache=False)
+        rids = [eng.add_request(p, 6) for p in prompts]
+        res = eng.run_to_completion(max_steps=100)
+        for rid, ref in zip(rids, refs):
+            assert res[rid] == ref
+        assert eng.stats()["prefix_cache"] is False
+        m = eng.metrics.summary()
+        assert m["cache_hit_rate"] == 0.0 and m["prefix_hits"] == 0
+        assert eng.pool.num_cached == 0
+
+    def test_summary_carries_prefix_counters(self, model):
+        eng = ServingEngine(model, num_pages=32, page_size=4, max_slots=2)
+        eng.add_request(list(RNG.integers(0, 512, 5)), 3)
+        eng.run_to_completion(max_steps=50)
+        m = eng.metrics.summary()
+        for k in ("cache_hit_rate", "prefill_tokens",
+                  "prefill_cached_tokens", "prefix_lookups", "prefix_hits",
+                  "prefix_hit_pages", "prefix_partial_hits",
+                  "prefix_evictions", "prefix_cow_copies",
+                  "prefix_pages_registered"):
+            assert k in m, k
+        assert 0.0 <= m["cache_hit_rate"] <= 1.0
+
+
+@pytest.mark.faults
+class TestPrefixCacheChaos:
+    def test_poison_never_scrubs_under_a_live_sharer(self, model,
+                                                     fault_free):
+        """A poisoned request sharing cached prefix pages with a live
+        reader: quarantine deregisters the pages immediately (no future
+        hit can map NaNs) but scrubs them only when the LAST reference
+        drops — the sharer's stream stays bitwise intact, and the pool
+        ends all-finite."""
+        shared = list(RNG.integers(0, 512, 11))
+        prompts = [shared + list(RNG.integers(0, 512, n)) for n in (3, 5)]
+        refs = [_reference(model, p, 12) for p in prompts]
+        fault.activate(fault.FaultPlan([
+            fault.FaultSpec(site="serving.decode", action="poison",
+                            step=4, match=r"^victim$"),
+        ]))
+        eng = ServingEngine(model, num_pages=64, page_size=4, max_slots=4,
+                            max_pages_per_slot=16)
+        eng.add_request(prompts[0], 12, rid="victim")
+        eng.step()  # victim prefills + registers the shared pages
+        eng.add_request(prompts[1], 12, rid="sharer")
+        res = eng.run_to_completion(max_steps=200)
+        victim = eng.request("victim")
+        assert victim.finish_reason == "nonfinite"
+        assert victim.tokens == refs[0][: len(victim.tokens)]
+        # the sharer mapped the victim's prefix pages, held them through
+        # the quarantine, and still matches the cold reference bitwise
+        assert eng.metrics.summary()["prefix_hits"] >= 1
+        assert res["sharer"] == refs[1]
+        # a post-quarantine arrival must NOT hit the deregistered pages
+        # (they may hold poison until the last release) — and must still
+        # generate correctly via a fresh prefill
+        hits_before = eng.pool.counters["prefix_hits"]
+        r3 = eng.add_request(shared + [7], 4)
+        out3 = eng.run_to_completion(max_steps=100)[r3]
+        assert out3 == _reference(model, shared + [7], 4)
+        assert eng.pool.counters["prefix_hits"] >= hits_before  # sharer's
+        for pk, pv in eng.pool.pools:
+            assert bool(jnp.all(jnp.isfinite(pk.astype(jnp.float32))))
+            assert bool(jnp.all(jnp.isfinite(pv.astype(jnp.float32))))
+        assert eng.decode_program_count() == 1
+
+
+# ---------------------------------------------------------------------------
 # the Pallas block-table kernel (interpret mode on CPU)
 # ---------------------------------------------------------------------------
 

@@ -19,6 +19,13 @@ engine's determinism contract, SERVING.md), asserted before timing.
 Run: python tools/profile_serving.py            (real TPU)
      python tools/profile_serving.py --smoke    (CPU logic check,
                                                  timings meaningless)
+     python tools/profile_serving.py --prefix   (prefix-cache A/B: the
+                                                 same staggered shared-
+                                                 system-prompt trace with
+                                                 the cache OFF then ON —
+                                                 bitwise parity asserted,
+                                                 TTFT/throughput deltas
+                                                 printed)
      python tools/profile_serving.py --chaos    (replay the fixed
                                                  FaultPlan below and print
                                                  the outcome histogram —
@@ -120,6 +127,129 @@ def chaos():
           f"(no-retrace contract held); unclassified requests: "
           f"{unclassified}")
     assert unclassified == 0, "a request ended without a finish_reason"
+
+
+def prefix():
+    """Prefix-cache A/B (SERVING.md "Prefix caching"): one staggered
+    arrival trace — every request a shared long system prompt plus a
+    short ragged user suffix — replayed twice on identically-configured
+    engines, cache OFF then cache ON. Both arms must produce bitwise-
+    identical greedy tokens (and both must match per-request
+    ``generate()``); the deltas printed at the end are the cache's
+    whole value proposition: TTFT p50/p99 collapse (followers prefill
+    only their suffix) at equal-or-better throughput, with the hit rate
+    explaining how much prefill work was skipped."""
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                         llama_tiny)
+    from paddle_tpu.serving import ServingEngine, ServingMetrics
+
+    backend = jax.default_backend()
+    smoke = "--smoke" in sys.argv[1:] or backend != "tpu"
+    if backend != "tpu":
+        print(f"WARNING: backend={backend} — timings are meaningless "
+              f"off-chip, running the smoke shapes")
+
+    pt.seed(0)
+    if smoke:
+        cfg = llama_tiny(mp_axis=None, fsdp_axis=None)
+        n_requests, max_new = 6, 8
+        prefix_len, sfx_lohi = 48, (4, 16)
+        page_size, num_pages, max_slots = 4, 128, 4
+    else:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5632, num_hidden_layers=8,
+                          num_attention_heads=16, num_key_value_heads=8,
+                          max_position_embeddings=4096, dtype="bfloat16",
+                          mp_axis=None, fsdp_axis=None)
+        n_requests, max_new = 16, 64
+        prefix_len, sfx_lohi = 768, (16, 64)
+        page_size, num_pages, max_slots = 16, 1024, 8
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+
+    rng = np.random.default_rng(0)
+    system = rng.integers(0, cfg.vocab_size, prefix_len).astype(np.int32)
+    sfx_lens = [int(x) for x in rng.integers(*sfx_lohi, n_requests)]
+    prompts = [np.concatenate(
+        [system, rng.integers(0, cfg.vocab_size, n).astype(np.int32)])
+        for n in sfx_lens]
+    lens = [len(p) for p in prompts]
+    print(f"trace: {n_requests} requests sharing a {prefix_len}-token "
+          f"system prompt, suffixes {min(sfx_lens)}-{max(sfx_lens)} "
+          f"tokens, staggered arrivals, max_new={max_new}, greedy")
+
+    # cold reference: per-request contiguous generate (both arms must
+    # match it bitwise — the determinism contract survives the cache)
+    refs = [np.asarray(model.generate(np.asarray([p]),
+                                      max_new_tokens=max_new)
+                       )[0, len(p):].tolist() for p in prompts]
+
+    mpps = max((n + max_new) // page_size + 2 for n in lens)
+
+    def run_arm(cache_on):
+        eng = ServingEngine(model, num_pages=num_pages,
+                            page_size=page_size, max_slots=max_slots,
+                            max_pages_per_slot=mpps,
+                            prefix_cache=cache_on)
+        # warm on a DISJOINT trace (fresh random tokens) so arm timings
+        # exclude compile AND the measured trace starts with a cold
+        # prefix index for its own system prompt. EVERY prefill bucket
+        # up to the longest prompt gets warmed — a follower's
+        # suffix-only prefill lands in whatever small bucket its
+        # uncached tail rounds up to (O(log max_len) programs total)
+        for b in sorted({eng._bucket(n)
+                         for n in range(1, max(lens) + 1)}):
+            eng.add_request(
+                rng.integers(0, cfg.vocab_size, b).astype(np.int32), 2)
+        eng.run_to_completion(max_steps=500)
+        eng.metrics = ServingMetrics()
+
+        t0 = time.perf_counter()
+        rids = [eng.add_request(p, max_new) for p in prompts[:2]]
+        added, steps = 2, 0
+        while eng.scheduler.has_work() or added < n_requests:
+            eng.step()
+            steps += 1
+            if added < n_requests and steps % 2 == 0:
+                rids.append(eng.add_request(prompts[added], max_new))
+                added += 1
+        wall = time.perf_counter() - t0
+        assert eng.decode_program_count() == 1
+        outs = [list(eng.request(r).tokens) for r in rids]
+        return outs, wall, eng.metrics.summary()
+
+    out_off, t_off, m_off = run_arm(False)
+    out_on, t_on, m_on = run_arm(True)
+
+    for ref, a, b in zip(refs, out_off, out_on):
+        assert a == ref, "cache-OFF arm diverged from generate() — bug"
+        assert b == ref, "cache-ON arm diverged from generate() — bug"
+    print("parity: cache-ON == cache-OFF == generate(), bitwise, "
+          "all requests")
+
+    total = sum(len(r) for r in refs)
+    for label, t, m in (("cache OFF", t_off, m_off),
+                        ("cache ON ", t_on, m_on)):
+        print(f"{label}: {t:7.3f}s  {total / t:8.1f} tok/s  "
+              f"ttft p50/p99 = {m['ttft_p50_s'] * 1000:7.1f}/"
+              f"{m['ttft_p99_s'] * 1000:7.1f}ms  "
+              f"hit_rate = {m['cache_hit_rate']:.3f}  "
+              f"(prefill {m['prefill_cached_tokens']}/"
+              f"{m['prefill_tokens']} tokens cached)")
+    print(f"\ndeltas (ON vs OFF): "
+          f"ttft_p50 {m_on['ttft_p50_s'] / max(m_off['ttft_p50_s'], 1e-9):.2f}x  "
+          f"ttft_p99 {m_on['ttft_p99_s'] / max(m_off['ttft_p99_s'], 1e-9):.2f}x  "
+          f"throughput {(total / t_on) / (total / t_off):.2f}x  "
+          f"hits={m_on.get('prefix_hits', 0)} "
+          f"hit_pages={m_on.get('prefix_hit_pages', 0)} "
+          f"cow={m_on.get('prefix_cow_copies', 0)} "
+          f"evictions={m_on.get('prefix_evictions', 0)}")
+    if smoke:
+        print("(smoke mode: deltas are logic evidence only — rerun "
+              "on-chip for the PERF.md numbers)")
 
 
 def main():
@@ -224,5 +354,7 @@ def main():
 if __name__ == "__main__":
     if "--chaos" in sys.argv[1:]:
         chaos()
+    elif "--prefix" in sys.argv[1:]:
+        prefix()
     else:
         main()
