@@ -72,6 +72,16 @@ def _detect_peak(dev) -> tuple[float, str]:
 
 _RUNS = 3  # timed windows per config (reported in extra.runs)
 
+# latency SLOs the serving configs score goodput_at_slo against
+# (SERVING.md "Tracing & SLOs"): requests/s that finished normally AND
+# met both budgets — TTFT from arrival, p99 of the request's own
+# inter-token gaps. The prefix config gets the tighter TTFT budget its
+# cache exists to deliver.
+_SERVING_SLOS = {
+    "llama_serving": {"ttft_p99_s": 2.0, "itl_p99_s": 0.25},
+    "llama_serving_prefix": {"ttft_p99_s": 1.0, "itl_p99_s": 0.25},
+}
+
 
 def _time_windows(step_fn, feed, iters=30, runs=_RUNS):
     """Median step time over `runs` timed windows of `iters` steps, the
@@ -612,7 +622,29 @@ def bench_llama_decode(peak, peak_kind, prefill_len=2048, new_tokens=256):
     }
 
 
-def bench_llama_serving(peak, peak_kind, n_requests=12, max_new_tokens=64):
+def _make_tracer(trace_path):
+    """Tracer for the serving configs when ``--trace PATH`` was given
+    (None otherwise — tracing stays off and the engine holds the no-op
+    NULL_TRACER)."""
+    if trace_path is None:
+        return None
+    from paddle_tpu.observability import Tracer
+    return Tracer()
+
+
+def _dump_trace(tracer, trace_path, name):
+    """Write the config's Chrome trace next to ``trace_path`` with the
+    config name spliced in before the extension (two serving configs in
+    one run must not clobber each other); returns the written path."""
+    if tracer is None:
+        return None
+    import os
+    root, ext = os.path.splitext(trace_path)
+    return tracer.dump_chrome_trace(f"{root}.{name}{ext or '.json'}")
+
+
+def bench_llama_serving(peak, peak_kind, n_requests=12, max_new_tokens=64,
+                        trace_path=None):
     """Continuous-batching serving throughput (SERVING.md): the paged
     KV-pool engine (paddle_tpu.serving) driven by a staggered-arrival
     trace — 2 requests queued at t=0, then one more every 4 engine steps,
@@ -639,8 +671,9 @@ def bench_llama_serving(peak, peak_kind, n_requests=12, max_new_tokens=64):
     lens = [int(x) for x in rng.integers(64, 256, n_requests)]
     prompts = [rng.integers(0, cfg.vocab_size, n).astype(np.int32)
                for n in lens]
+    tracer = _make_tracer(trace_path)
     eng = ServingEngine(model, num_pages=512, page_size=16, max_slots=8,
-                        max_pages_per_slot=32)
+                        max_pages_per_slot=32, tracer=tracer)
     # warm every program the trace will hit: the decode step plus one
     # prefill bucket per distinct prompt-length bucket
     for n in sorted({eng._bucket(s) for s in lens}):
@@ -648,6 +681,7 @@ def bench_llama_serving(peak, peak_kind, n_requests=12, max_new_tokens=64):
                         else rng.integers(0, cfg.vocab_size, n), 2)
     eng.run_to_completion(max_steps=100)
     eng.metrics = ServingMetrics()  # compile time stays out of the trace
+    eng.metrics.set_slo(**_SERVING_SLOS["llama_serving"])
 
     added = 2
     for p in prompts[:2]:
@@ -671,6 +705,7 @@ def bench_llama_serving(peak, peak_kind, n_requests=12, max_new_tokens=64):
     # honest lower bound on bandwidth utilisation)
     wall = max(m["wall_s"], 1e-9)
     mbu = steps * 2.0 * n_params / wall / hbm_bw
+    trace_out = _dump_trace(tracer, trace_path, "llama_serving")
     return {
         "metric": "llama_420m_serving_tokens_per_sec",
         "value": round(m["tokens_per_s"], 1),
@@ -690,6 +725,10 @@ def bench_llama_serving(peak, peak_kind, n_requests=12, max_new_tokens=64):
                   "queue_wait_p99": round(m["queue_wait_p99_s"], 4),
                   "kv_util_peak": round(m["kv_util_peak"], 4),
                   "queue_depth_max": m["queue_depth_max"],
+                  "goodput_at_slo": round(m["goodput_at_slo"], 4),
+                  "slo": _SERVING_SLOS["llama_serving"],
+                  "retraces": eng.decode_program_count() - 1,
+                  "trace": trace_out,
                   "mbu_weights_only": round(mbu, 4),
                   "peak": peak_kind, "hbm_bw": hbm_bw,
                   "pipeline": False, "runs": _RUNS,
@@ -698,7 +737,8 @@ def bench_llama_serving(peak, peak_kind, n_requests=12, max_new_tokens=64):
 
 
 def bench_llama_serving_prefix(peak, peak_kind, n_requests=12,
-                               max_new_tokens=64, prefix_len=384):
+                               max_new_tokens=64, prefix_len=384,
+                               trace_path=None):
     """Prefix-cache serving throughput (SERVING.md "Prefix caching"):
     same engine/model/arrival shape as bench_llama_serving, but every
     request shares a ``prefix_len``-token system prompt followed by a
@@ -730,8 +770,9 @@ def bench_llama_serving_prefix(peak, peak_kind, n_requests=12,
         [system, rng.integers(0, cfg.vocab_size, n).astype(np.int32)])
         for n in sfx_lens]
     lens = [len(p) for p in prompts]
+    tracer = _make_tracer(trace_path)
     eng = ServingEngine(model, num_pages=512, page_size=16, max_slots=8,
-                        max_pages_per_slot=48)
+                        max_pages_per_slot=48, tracer=tracer)
     # warm the programs on a DISJOINT token range so the measured trace
     # starts with a cold prefix index for its own system prompt: the
     # full-prompt bucket (first arrival, cold) and the suffix buckets
@@ -742,6 +783,7 @@ def bench_llama_serving_prefix(peak, peak_kind, n_requests=12,
         eng.add_request(warm[:n], 2)
     eng.run_to_completion(max_steps=200)
     eng.metrics = ServingMetrics()  # compile time stays out of the trace
+    eng.metrics.set_slo(**_SERVING_SLOS["llama_serving_prefix"])
 
     added = 2
     for p in prompts[:2]:
@@ -762,6 +804,7 @@ def bench_llama_serving_prefix(peak, peak_kind, n_requests=12,
               }.get(peak_kind.split("(")[0], 0.82e12)
     wall = max(m["wall_s"], 1e-9)
     mbu = steps * 2.0 * n_params / wall / hbm_bw
+    trace_out = _dump_trace(tracer, trace_path, "llama_serving_prefix")
     return {
         "metric": "llama_420m_serving_prefix_tokens_per_sec",
         "value": round(m["tokens_per_s"], 1),
@@ -785,6 +828,10 @@ def bench_llama_serving_prefix(peak, peak_kind, n_requests=12,
                   "timed_out": m["timed_out"],
                   "quarantined": m["quarantined"],
                   "kv_util_peak": round(m["kv_util_peak"], 4),
+                  "goodput_at_slo": round(m["goodput_at_slo"], 4),
+                  "slo": _SERVING_SLOS["llama_serving_prefix"],
+                  "retraces": eng.decode_program_count() - 1,
+                  "trace": trace_out,
                   "mbu_weights_only": round(mbu, 4),
                   "peak": peak_kind, "hbm_bw": hbm_bw,
                   "pipeline": False, "runs": _RUNS,
@@ -865,10 +912,12 @@ _CONFIGS = {
 # driver sees a stable schema either way
 _SUMMARY_EXTRA_KEYS = {
     "llama_serving": ("ttft_p50", "ttft_p99", "tpot",
-                      "rejected", "timed_out", "quarantined"),
+                      "rejected", "timed_out", "quarantined",
+                      "goodput_at_slo", "retraces"),
     "llama_serving_prefix": ("ttft_p50", "ttft_p99", "tpot",
                              "cache_hit_rate", "prefix_hits",
-                             "prefix_evictions"),
+                             "prefix_evictions",
+                             "goodput_at_slo", "retraces"),
 }
 
 # opt-in configs (not in the default driver run — kept out to bound its
@@ -903,8 +952,20 @@ def _summary_entry(result, name=None):
 
 
 def main():
-    args = [a for a in sys.argv[1:] if not a.startswith("-")]
-    dry = "--dry" in sys.argv[1:]
+    argv = list(sys.argv[1:])
+    # --trace PATH: dump a Chrome trace (Perfetto-loadable) of each
+    # serving config's engine run. PATH gets the config name spliced in
+    # before the extension. Parsed (and removed) BEFORE the config-name
+    # filter below — PATH itself does not start with "-".
+    trace_path = None
+    if "--trace" in argv:
+        i = argv.index("--trace")
+        if i + 1 >= len(argv):
+            raise SystemExit("--trace requires a PATH argument")
+        trace_path = argv[i + 1]
+        del argv[i:i + 2]
+    args = [a for a in argv if not a.startswith("-")]
+    dry = "--dry" in argv
     all_configs = {**_CONFIGS, **_EXTRA_CONFIGS}
     unknown = [a for a in args if a not in all_configs]
     if unknown:
@@ -952,9 +1013,12 @@ def main():
         # object would pin its traceback's frames, whose locals are the
         # very params/opt-state jax Arrays the retry needs freed.
         errs = []
+        kwargs = ({"trace_path": trace_path}
+                  if trace_path is not None and name in _SERVING_SLOS
+                  else {})
         for attempt in (0, 1):
             try:
-                result = all_configs[name](peak, peak_kind)
+                result = all_configs[name](peak, peak_kind, **kwargs)
                 if errs:
                     # a success on the retry must not hide that the config
                     # was flaky: surface the first attempt's failure on the

@@ -71,6 +71,11 @@ class CommWatchdog:
         self.max_records = max_records
         self.records: list[_TaskRecord] = []
         self._lock = threading.Lock()
+        # callables invoked with the timed-out _TaskRecord from the
+        # monitor thread BEFORE any kill action — the serving engine
+        # registers its flight-recorder dump here so a hung device sync
+        # leaves the event ring on disk next to the diagnosis
+        self.post_mortem_hooks: list = []
 
     @contextlib.contextmanager
     def task(self, name: str, timeout: float | None = None, **meta):
@@ -97,6 +102,12 @@ class CommWatchdog:
                        f"(rank={_rank()}, "
                        f"meta={meta}) — possible hung collective")
                 logger.error(msg)
+                for hook in list(self.post_mortem_hooks):
+                    try:
+                        hook(rec)
+                    except Exception:  # noqa: BLE001 — never mask the abort
+                        logger.exception("[comm watchdog] post-mortem "
+                                         "hook failed")
                 if self.action == "kill":
                     # the post-mortem must be on disk BEFORE os._exit —
                     # nothing survives the abort otherwise

@@ -1,0 +1,33 @@
+"""paddle_tpu.observability — tracing, flight recording, SLO export.
+
+Zero-dependency (stdlib-only) observability spine for the serving
+engine (OBSERVABILITY.md):
+
+- :class:`Tracer` (trace.py): typed spans/events on per-request and
+  per-engine-step tracks, Chrome trace-event JSON export
+  (Perfetto-loadable), compile/retrace counters. Off by default and a
+  strict no-op on the hot path (``NULL_TRACER``).
+- :class:`FlightRecorder` (recorder.py): bounded ring buffer over the
+  event stream, auto-dumped to rank-annotated JSON by the engine on
+  scheduler stall, nonfinite quarantine, drain and watchdog timeout.
+- :func:`render_prometheus` / :class:`MetricsServer` /
+  :func:`goodput_at_slo` (export.py): Prometheus text exposition of
+  metrics + pool + trace counters, an optional ``/metrics`` +
+  ``/healthz`` endpoint, and goodput-under-SLO — the metric that ranks
+  schedulers and cache tiers (ROADMAP item 5).
+
+    from paddle_tpu.observability import Tracer
+    tr = Tracer()
+    eng = ServingEngine(model, ..., tracer=tr)
+    ...
+    tr.dump_chrome_trace("serve.trace.json")   # open in Perfetto
+"""
+
+from .export import (MetricsServer, goodput_at_slo, parse_prometheus,
+                     render_prometheus)
+from .recorder import FlightRecorder
+from .trace import NULL_TRACER, Tracer
+
+__all__ = ["Tracer", "NULL_TRACER", "FlightRecorder",
+           "render_prometheus", "parse_prometheus", "MetricsServer",
+           "goodput_at_slo"]

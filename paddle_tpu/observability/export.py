@@ -1,0 +1,198 @@
+"""Prometheus-text export and the SLO-goodput metric.
+
+``render_prometheus`` turns the serving engine's numeric state —
+``ServingMetrics.summary()``, the pool's ``stats()``, the tracer's
+counters — into Prometheus text exposition format (version 0.0.4, the
+format every scraper accepts), with stable names:
+
+- ``paddle_serving_<key>``        gauges from the metrics summary
+  (``_s`` latency keys become ``_seconds``);
+- ``paddle_serving_pool_<key>``   gauges from ``KVCachePool.stats()``;
+- ``paddle_serving_trace_<key>_total``  counters from the tracer
+  (compiles, preempts, ...).
+
+``MetricsServer`` serves that text on a stdlib ``http.server`` endpoint
+(``/metrics``) next to a ``/healthz`` JSON liveness probe — zero
+dependencies, daemon thread, ephemeral-port friendly (``port=0``).
+
+``goodput_at_slo`` is ROADMAP item 5's ranking metric: requests per
+second that finished normally AND met their latency SLOs (TTFT and
+per-request ITL p99) — the number that actually compares schedulers,
+cache tiers and admission policies. The computation lives on
+``ServingMetrics`` (it owns the per-request latencies); this module
+re-exports it for symmetry with the renderer.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import re
+import threading
+
+__all__ = ["render_prometheus", "parse_prometheus", "MetricsServer",
+           "goodput_at_slo"]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+# one sample line: metric_name value (no labels emitted by this renderer)
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r" (?:[+-]?(?:\d+\.?\d*(?:[eE][+-]?\d+)?|inf|nan))$")
+
+
+def _metric_name(prefix: str, key: str) -> str:
+    name = _NAME_RE.sub("_", key)
+    if name.endswith("_s"):  # latency keys: ttft_p50_s -> ttft_p50_seconds
+        name = name[:-2] + "_seconds"
+    return prefix + name
+
+
+def _fmt(value) -> str:
+    v = float(value)
+    return repr(int(v)) if v == int(v) and abs(v) < 1e15 else repr(v)
+
+
+def render_prometheus(summary: dict | None = None,
+                      pool_stats: dict | None = None,
+                      trace_counters: dict | None = None) -> str:
+    """Render the given dicts as Prometheus text. Non-numeric values are
+    skipped (the summary may carry notes); every emitted metric gets its
+    ``# TYPE`` line so strict parsers accept the page."""
+    lines: list[str] = []
+
+    def emit(prefix: str, data: dict, mtype: str, suffix: str = ""):
+        for key in sorted(data):
+            value = data[key]
+            if isinstance(value, bool) or not isinstance(value,
+                                                         (int, float)):
+                continue
+            name = _metric_name(prefix, key) + suffix
+            lines.append(f"# TYPE {name} {mtype}")
+            lines.append(f"{name} {_fmt(value)}")
+
+    emit("paddle_serving_", summary or {}, "gauge")
+    emit("paddle_serving_pool_", pool_stats or {}, "gauge")
+    emit("paddle_serving_trace_", trace_counters or {}, "counter",
+         suffix="_total")
+    return "\n".join(lines) + "\n"
+
+
+def parse_prometheus(text: str) -> dict[str, float]:
+    """Strict check of a text-format page (tests + the /metrics smoke):
+    every non-comment line must be a well-formed sample. Returns
+    {metric_name: value}; raises ValueError on a malformed line."""
+    out: dict[str, float] = {}
+    for ln in text.splitlines():
+        if not ln.strip() or ln.startswith("#"):
+            continue
+        if not _SAMPLE_RE.match(ln):
+            raise ValueError(f"malformed Prometheus sample: {ln!r}")
+        name, value = ln.split(" ", 1)
+        out[name] = float(value)
+    return out
+
+
+def goodput_at_slo(metrics, ttft_p99_s: float | None = None,
+                   itl_p99_s: float | None = None) -> float:
+    """Requests/s that finished normally and met the SLOs — see
+    :meth:`ServingMetrics.goodput_at_slo` (the implementation)."""
+    return metrics.goodput_at_slo(ttft_p99_s=ttft_p99_s,
+                                  itl_p99_s=itl_p99_s)
+
+
+class MetricsServer:
+    """``/metrics`` + ``/healthz`` over stdlib http.server.
+
+    Construct with a ``ServingEngine`` (scrapes its metrics summary,
+    pool stats and tracer counters live on every GET) or with explicit
+    callables. ``start()`` binds (``port=0`` = ephemeral), serves from
+    a daemon thread, and returns the bound port.
+
+        srv = MetricsServer(engine=eng)
+        port = srv.start()
+        # curl http://127.0.0.1:{port}/metrics
+        srv.stop()
+    """
+
+    def __init__(self, engine=None, render=None, health=None,
+                 host: str = "127.0.0.1", port: int = 0):
+        if engine is None and render is None:
+            raise ValueError("pass engine= or render=")
+        self._engine = engine
+        self._render = render
+        self._health = health
+        self.host = host
+        self.port = port
+        self._httpd = None
+        self._thread = None
+
+    # ---- content ----
+
+    def metrics_text(self) -> str:
+        if self._render is not None:
+            return self._render()
+        eng = self._engine
+        return render_prometheus(eng.metrics.summary(), eng.pool.stats(),
+                                 eng.tracer.counters)
+
+    def health(self) -> dict:
+        if self._health is not None:
+            return self._health()
+        if self._engine is None:
+            return {"status": "ok"}
+        st = self._engine.stats()
+        return {"status": "draining" if st["draining"] else "ok",
+                "steps": st["steps"],
+                "running": st["running"],
+                "queue_depth": st["queue_depth"]}
+
+    # ---- lifecycle ----
+
+    def start(self) -> int:
+        if self._httpd is not None:
+            return self.port
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                try:
+                    if self.path.split("?")[0] == "/metrics":
+                        body = server.metrics_text().encode()
+                        ctype = "text/plain; version=0.0.4"
+                    elif self.path.split("?")[0] == "/healthz":
+                        body = json.dumps(server.health()).encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception as e:  # noqa: BLE001 — scrape must not kill
+                    self.send_error(500, explain=repr(e))
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes are not log lines
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            (self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name="paddle-metrics-server")
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"

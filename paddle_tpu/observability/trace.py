@@ -1,0 +1,186 @@
+"""Typed event tracing for the serving engine (OBSERVABILITY.md).
+
+One ``Tracer`` records a flat stream of timestamped events on named
+*tracks* — the engine's per-step phases on the ``engine`` track, each
+request's lifecycle on its own ``rid`` track, the KV pool on ``pool``
+— and renders it as Chrome trace-event JSON (``dump_chrome_trace``),
+loadable in Perfetto / ``chrome://tracing`` with one row per track.
+
+Event vocabulary (mirrors the Chrome ``ph`` phases):
+- ``span(name, ...)``     — a scoped duration (``ph="X"``, carries dur):
+                            the per-step engine phases;
+- ``begin/end(name, ...)`` — an open duration (``ph="B"/"E"``): request
+                            lifecycle phases that open and close in
+                            different engine calls (queued, decode);
+- ``instant(name, ...)``  — a point event (``ph="i"``): admit, preempt,
+                            finish, compile, eviction;
+- ``bump(name)``          — a named counter (``ph="C"``): compiles,
+                            preempts — Perfetto draws these as a graph.
+
+The clock is injectable (share it with ``ServingMetrics`` so spans and
+latency percentiles are in the same timebase); timestamps are stored in
+clock seconds and scaled to the microseconds Chrome expects at dump
+time.
+
+Tracing must cost nothing when off: every recording method checks
+``self.enabled`` first and returns immediately (``span`` returns a
+shared null context manager — no allocation), and the module-level
+``NULL_TRACER`` singleton is what the engine holds when no tracer was
+passed. Sinks (``add_sink``) observe every recorded event — the
+``FlightRecorder`` ring buffer subscribes this way.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["Tracer", "NULL_TRACER"]
+
+
+class _NullCtx:
+    """Shared no-op context manager returned by a disabled tracer's
+    ``span`` — entering/exiting records nothing and allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_CTX = _NullCtx()
+
+
+class _Span:
+    """Scoped-duration recorder: one complete ``ph="X"`` event on exit."""
+
+    __slots__ = ("_tracer", "_name", "_track", "_args", "_t0")
+
+    def __init__(self, tracer, name, track, args):
+        self._tracer = tracer
+        self._name = name
+        self._track = track
+        self._args = args
+
+    def __enter__(self):
+        self._t0 = self._tracer.now()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = self._tracer.now()
+        self._tracer._emit({"name": self._name, "ph": "X", "ts": self._t0,
+                            "dur": t1 - self._t0, "track": self._track,
+                            "args": self._args})
+        return False
+
+
+class Tracer:
+    def __init__(self, clock=None, enabled: bool = True):
+        self.enabled = enabled
+        self._clock = clock if clock is not None else time.monotonic
+        self.events: list[dict] = []
+        self.counters: dict[str, int] = {}
+        self._sinks: list = []
+        # track name -> tid; "engine" registered first so it is row 0
+        self._tracks: dict[str, int] = {"engine": 0}
+
+    def now(self) -> float:
+        return self._clock()
+
+    def add_sink(self, fn) -> None:
+        """Subscribe ``fn(event_dict)`` to every recorded event (the
+        FlightRecorder ring buffer attaches here). Idempotent — the
+        engine re-attaches its recorder without double-recording."""
+        if fn not in self._sinks:
+            self._sinks.append(fn)
+
+    # ---- recording ----
+
+    def _emit(self, ev: dict) -> None:
+        self._tracks.setdefault(ev["track"], len(self._tracks))
+        self.events.append(ev)
+        for fn in self._sinks:
+            fn(ev)
+
+    def span(self, name: str, track: str = "engine", **args):
+        """Scoped duration: ``with tracer.span("decode_dispatch"): ...``
+        records one complete event with its measured dur."""
+        if not self.enabled:
+            return _NULL_CTX
+        return _Span(self, name, track, args)
+
+    def begin(self, name: str, track: str = "engine", **args) -> None:
+        """Open a duration that closes in a later call (``end``)."""
+        if not self.enabled:
+            return
+        self._emit({"name": name, "ph": "B", "ts": self.now(),
+                    "track": track, "args": args})
+
+    def end(self, name: str, track: str = "engine", **args) -> None:
+        if not self.enabled:
+            return
+        self._emit({"name": name, "ph": "E", "ts": self.now(),
+                    "track": track, "args": args})
+
+    def instant(self, name: str, track: str = "engine", **args) -> None:
+        if not self.enabled:
+            return
+        self._emit({"name": name, "ph": "i", "ts": self.now(),
+                    "track": track, "args": args})
+
+    def bump(self, name: str, n: int = 1, track: str = "engine") -> None:
+        """Increment a named counter and record its new value as a
+        Chrome counter event (Perfetto draws a step graph)."""
+        if not self.enabled:
+            return
+        value = self.counters.get(name, 0) + n
+        self.counters[name] = value
+        self._emit({"name": name, "ph": "C", "ts": self.now(),
+                    "track": track, "args": {name: value}})
+
+    # ---- export ----
+
+    def chrome_trace(self) -> dict:
+        """The event stream as a Chrome trace-event JSON object: every
+        track becomes a thread (tid) of one process, requests therefore
+        render as parallel rows; ``thread_name`` metadata labels them."""
+        out = [{"name": "process_name", "ph": "M", "ts": 0, "pid": 0,
+                "tid": 0, "args": {"name": "paddle_tpu.serving"}}]
+        for track, tid in self._tracks.items():
+            out.append({"name": "thread_name", "ph": "M", "ts": 0,
+                        "pid": 0, "tid": tid,
+                        "args": {"name": track}})
+            out.append({"name": "thread_sort_index", "ph": "M", "ts": 0,
+                        "pid": 0, "tid": tid, "args": {"sort_index": tid}})
+        for ev in self.events:
+            ce = {"name": ev["name"], "ph": ev["ph"],
+                  "ts": ev["ts"] * 1e6, "pid": 0,
+                  "tid": self._tracks[ev["track"]],
+                  "args": ev.get("args") or {}}
+            if ev["ph"] == "X":
+                ce["dur"] = ev["dur"] * 1e6
+            if ev["ph"] == "i":
+                ce["s"] = "t"  # thread-scoped instant
+            out.append(ce)
+        return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+    def dump_chrome_trace(self, path: str) -> str:
+        """Write the Chrome trace JSON to ``path`` (atomic) and return
+        the path — load it at https://ui.perfetto.dev."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        os.replace(tmp, path)
+        return path
+
+
+# what the engine holds when tracing is off: every method returns before
+# touching state, so the hot path stays a no-op
+NULL_TRACER = Tracer(enabled=False)

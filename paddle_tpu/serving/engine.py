@@ -53,6 +53,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..distributed import fault as _fault
+from ..observability.trace import NULL_TRACER
 from .errors import (EngineDrainingError, QueueFullError,
                      RequestTooLargeError, SchedulerStalledError)
 from .kv_cache import KVCachePool
@@ -76,7 +77,8 @@ class ServingEngine:
                  max_preemptions: int | None = None,
                  step_timeout_s: float | None = None,
                  drain_timeout_s: float | None = 30.0,
-                 watchdog=None, prefix_cache: bool = True):
+                 watchdog=None, prefix_cache: bool = True,
+                 tracer=None, flight_recorder=None):
         cfg = model.config
         self.model = model
         self.page_size = page_size
@@ -102,6 +104,21 @@ class ServingEngine:
                                    max_queue_depth=max_queue_depth,
                                    max_preemptions=max_preemptions)
         self.metrics = ServingMetrics(clock)
+        # observability (OBSERVABILITY.md): the tracer is shared with
+        # the scheduler (request-lifecycle spans) and the pool
+        # (eviction/COW/quarantine events); construct it on the same
+        # clock as the metrics so spans and percentiles line up. The
+        # flight recorder subscribes to the event stream and is
+        # auto-dumped at terminal conditions (stall, nonfinite, drain,
+        # watchdog timeout).
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.scheduler.tracer = self.tracer
+        self.pool.tracer = self.tracer
+        self.flight_recorder = flight_recorder
+        if flight_recorder is not None:
+            self.tracer.add_sink(flight_recorder.record)
+        self._decode_traces = 0       # retrace detection (tracing on)
+        self._wd_hooked: set[int] = set()
         self.step_timeout_s = step_timeout_s
         self.drain_timeout_s = drain_timeout_s
         self._watchdog = watchdog
@@ -192,8 +209,10 @@ class ServingEngine:
         # storms vary over the engine's lifetime deterministically
         self.pool.fault_step = self._steps
         _fault.trip("serving.step", step=self._steps)
+        tr = self.tracer
         events: list[dict] = []
-        self._expire_deadlines(events)
+        with tr.span("deadline_sweep", queue=self.scheduler.queue_depth):
+            self._expire_deadlines(events)
         if self._draining:
             self._flush_waiting(events)
         # admit one request at a time and run its prefill immediately:
@@ -204,8 +223,9 @@ class ServingEngine:
             budget = self.scheduler.prefill_token_budget
             first = True
             while True:
-                batch = self.scheduler.admit(self.pool, limit=1,
-                                             budget=budget, first=first)
+                with tr.span("admission"):
+                    batch = self.scheduler.admit(self.pool, limit=1,
+                                                 budget=budget, first=first)
                 if not batch:
                     break
                 req = batch[0]
@@ -213,13 +233,16 @@ class ServingEngine:
                 first = False
                 self.metrics.on_admit(req.rid)
                 self.metrics.on_prefill(req.cached_len, req.context_len)
-                self._run_prefill(req, events)
-        preempted = self.scheduler.ensure_decode_pages(self.pool)
+                with tr.span("prefill_dispatch", rid=req.rid):
+                    self._run_prefill(req, events)
+        with tr.span("ensure_pages"):
+            preempted = self.scheduler.ensure_decode_pages(self.pool)
         for victim in preempted:
             self.metrics.on_preemption()
             if victim.state == FINISHED:  # hit the max_preemptions cap
                 self.metrics.on_outcome("preempted_limit")
-                self.metrics.on_finish(victim.rid)
+                self.metrics.on_finish(victim.rid, "preempted_limit")
+                self._trace_finish(victim, "preempted_limit")
                 events.append({"rid": victim.rid, "token": None,
                                "finished": True,
                                "finish_reason": "preempted_limit"})
@@ -251,6 +274,11 @@ class ServingEngine:
                     "capacity": self.pool.capacity,
                     "running": len(self.scheduler.running),
                 }
+                tr.instant("stall", idle_steps=self._idle_steps,
+                           queue=self.scheduler.queue_depth)
+                dump = self._dump_flight("scheduler_stalled", snapshot)
+                if dump is not None:
+                    snapshot["flight_recorder"] = dump
                 raise SchedulerStalledError(
                     f"{snapshot['idle_steps']} zero-progress steps with "
                     f"{snapshot['queue_depth']} request(s) pending: head "
@@ -310,10 +338,14 @@ class ServingEngine:
                 break
             events.extend(self.step())
         self.last_drain_events = events
-        return {rid: {"finish_reason": r.finish_reason,
-                      "tokens": list(r.tokens),
-                      "retriable": r.finish_reason == "preempted"}
-                for rid, r in self._requests.items()}
+        report = {rid: {"finish_reason": r.finish_reason,
+                        "tokens": list(r.tokens),
+                        "retriable": r.finish_reason == "preempted"}
+                  for rid, r in self._requests.items()}
+        self._dump_flight("drain", {
+            "outcomes": {rid: o["finish_reason"]
+                         for rid, o in report.items()}})
+        return report
 
     def attach_preemption_guard(self, guard=None):
         """Wire SIGTERM to a graceful drain: with a guard attached,
@@ -345,7 +377,8 @@ class ServingEngine:
                 "draining": self._draining,
                 "decode_programs": self.decode_program_count(),
                 "prefill_programs": len(self._prefill_progs),
-                "prefix_cache": self.prefix_cache}
+                "prefix_cache": self.prefix_cache,
+                "tracing": self.tracer.enabled}
 
     # ------------------------------------------------------------------
     # robustness internals
@@ -354,6 +387,26 @@ class ServingEngine:
     def _preemption_pending(self) -> bool:
         return (self._guard is not None and self._guard.preempted
                 and not self._draining)
+
+    def _trace_finish(self, req: Request, reason: str | None) -> None:
+        """Request-track terminal marker (the scheduler already closed
+        the request's queued/running span)."""
+        tr = self.tracer
+        if tr.enabled:
+            tr.instant("finish", track=req.rid, reason=reason or "",
+                       tokens=len(req.tokens))
+            tr.bump("finishes")
+
+    def _dump_flight(self, reason: str, snapshot: dict | None = None):
+        """Auto-dump the attached flight recorder at a terminal
+        condition; returns the dump path (None without a recorder — and
+        an unwritable destination never masks the original failure)."""
+        if self.flight_recorder is None:
+            return None
+        try:
+            return self.flight_recorder.dump(reason, snapshot=snapshot)
+        except OSError:
+            return None
 
     def _expire_deadlines(self, events: list[dict]) -> None:
         """Step-boundary deadline enforcement on the injectable metrics
@@ -391,9 +444,12 @@ class ServingEngine:
             # invariant: additive masking cannot silence a NaN —
             # NaN + -1e30 is still NaN.)
             self.pool.quarantine(req.pages)
+            self._dump_flight("nonfinite", {"rid": req.rid,
+                                            "step": self._steps})
         self.scheduler.finish(req, self.pool, reason)
         self.metrics.on_outcome(reason)
-        self.metrics.on_finish(req.rid)
+        self.metrics.on_finish(req.rid, reason)
+        self._trace_finish(req, reason)
         events.append({"rid": req.rid, "token": None, "finished": True,
                        "finish_reason": reason})
 
@@ -464,6 +520,13 @@ class ServingEngine:
         already identical), suffix pages land in the request's pages."""
         if L in self._prefill_progs:
             return self._prefill_progs[L]
+        # a new suffix bucket means a new XLA trace — make it visible as
+        # a compile event + counter so retrace regressions jump out of
+        # the timeline instead of hiding as latency spikes
+        self.tracer.instant("compile", program=f"prefill[{L}]",
+                            bucket=L)
+        self.tracer.bump("compiles")
+        self.tracer.bump("prefill_programs")
         from ..nn.module import functional_call
         model = self.model
         ps = self.page_size
@@ -510,11 +573,13 @@ class ServingEngine:
     # ------------------------------------------------------------------
 
     def _run_prefill(self, req: Request, events: list[dict]) -> None:
+        tr = self.tracer
         n_valid = req.context_len   # == max(recompute_len, 1), from admit()
         cached = req.cached_len     # prefix tokens served from cached pages
         n_sfx = n_valid - cached
         seq = req.prompt + req.tokens[:-1]
         if n_sfx == 0:
+            tr.instant("prefill_cached", track=req.rid, cached=cached)
             # recompute fully served from the prefix cache: the pages
             # already hold the materialized context bit-for-bit and the
             # recompute prefill's prediction would be discarded anyway —
@@ -539,12 +604,14 @@ class ServingEngine:
         scatter = np.zeros((n_buf_pages,), np.int32)
         scatter[first_sfx_page:len(req.pages)] = req.pages[first_sfx_page:]
         sp = req.sampling
-        tok, ok, new_pools = self._prefill_prog(L)(
-            self._state, jnp.asarray(ids), jnp.int32(n_sfx),
-            jnp.int32(cached), jnp.asarray(gather), jnp.asarray(scatter),
-            self.pool.pools,
-            jnp.float32(sp.temperature), jnp.float32(sp.top_p),
-            jnp.asarray(not sp.do_sample), jnp.int32(sp.seed))
+        with tr.span("prefill", track=req.rid, cached=cached,
+                     suffix=n_sfx, bucket=L):
+            tok, ok, new_pools = self._prefill_prog(L)(
+                self._state, jnp.asarray(ids), jnp.int32(n_sfx),
+                jnp.int32(cached), jnp.asarray(gather),
+                jnp.asarray(scatter), self.pool.pools,
+                jnp.float32(sp.temperature), jnp.float32(sp.top_p),
+                jnp.asarray(not sp.do_sample), jnp.int32(sp.seed))
         self.pool.pools = new_pools
         if _fault.active_plan() is not None:
             try:
@@ -582,53 +649,84 @@ class ServingEngine:
                     self._finish_abnormal(req, "injected", events)
             if not self.scheduler.running:
                 return
+        tr = self.tracer
         S, M = self.max_slots, self.max_pages_per_slot
-        tok = np.zeros((S,), np.int32)
-        tables = np.zeros((S, M), np.int32)
-        seq_lens = np.zeros((S,), np.int32)
-        active = np.zeros((S,), bool)
-        temps = np.ones((S,), np.float32)
-        top_ps = np.ones((S,), np.float32)
-        greedy = np.ones((S,), bool)
-        seeds = np.zeros((S,), np.int32)
-        counts = np.zeros((S,), np.int32)
-        for slot, req in self.scheduler.running.items():
-            tok[slot] = req.tokens[-1]
-            tables[slot, :len(req.pages)] = req.pages
-            seq_lens[slot] = req.context_len
-            active[slot] = True
-            temps[slot] = req.sampling.temperature
-            top_ps[slot] = req.sampling.top_p
-            greedy[slot] = not req.sampling.do_sample
-            seeds[slot] = req.sampling.seed
-            counts[slot] = len(req.tokens)
-        nt, ok, new_pools = self._decode_step(
-            self._state, self.pool.pools, jnp.asarray(tok),
-            jnp.asarray(tables), jnp.asarray(seq_lens), jnp.asarray(active),
-            jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(greedy),
-            jnp.asarray(seeds), jnp.asarray(counts))
-        self.pool.pools = new_pools
+        with tr.span("decode_dispatch", slots=len(self.scheduler.running)):
+            tok = np.zeros((S,), np.int32)
+            tables = np.zeros((S, M), np.int32)
+            seq_lens = np.zeros((S,), np.int32)
+            active = np.zeros((S,), bool)
+            temps = np.ones((S,), np.float32)
+            top_ps = np.ones((S,), np.float32)
+            greedy = np.ones((S,), bool)
+            seeds = np.zeros((S,), np.int32)
+            counts = np.zeros((S,), np.int32)
+            for slot, req in self.scheduler.running.items():
+                tok[slot] = req.tokens[-1]
+                tables[slot, :len(req.pages)] = req.pages
+                seq_lens[slot] = req.context_len
+                active[slot] = True
+                temps[slot] = req.sampling.temperature
+                top_ps[slot] = req.sampling.top_p
+                greedy[slot] = not req.sampling.do_sample
+                seeds[slot] = req.sampling.seed
+                counts[slot] = len(req.tokens)
+            nt, ok, new_pools = self._decode_step(
+                self._state, self.pool.pools, jnp.asarray(tok),
+                jnp.asarray(tables), jnp.asarray(seq_lens),
+                jnp.asarray(active), jnp.asarray(temps),
+                jnp.asarray(top_ps), jnp.asarray(greedy),
+                jnp.asarray(seeds), jnp.asarray(counts))
+            self.pool.pools = new_pools
+        if tr.enabled:
+            # retrace sentinel: the no-retrace contract says this stays
+            # at 1; any growth lands a compile bar + counter bump in the
+            # trace right where the regression happened
+            n = self.decode_program_count()
+            if n != self._decode_traces:
+                tr.instant("compile", program="decode", programs=n)
+                tr.bump("compiles", n - self._decode_traces)
+                if self._decode_traces:
+                    tr.bump("decode_retraces", n - self._decode_traces)
+                self._decode_traces = n
         from ..distributed.watchdog import default_watchdog
         wd = self._watchdog if self._watchdog is not None \
             else default_watchdog()
+        if self.flight_recorder is not None and id(wd) not in self._wd_hooked:
+            # one hook per watchdog instance: a hung device sync dumps
+            # the event ring before any kill action fires
+            self._wd_hooked.add(id(wd))
+            recorder = self.flight_recorder
+
+            def _post_mortem(task_rec, _fr=recorder):
+                _fr.dump("watchdog_timeout", snapshot={
+                    "task": task_rec.name,
+                    "meta": {k: repr(v) for k, v in task_rec.meta.items()}})
+
+            wd.post_mortem_hooks.append(_post_mortem)
         with wd.task("serving.step", timeout=self.step_timeout_s,
                      step=self._steps, slots=len(self.scheduler.running)):
             # np.asarray is the engine's blocking device sync — a hung
             # device shows up here, so this is where the watchdog looks
-            nt = np.asarray(nt)
-            ok = np.asarray(ok)
-        for slot, req in list(self.scheduler.running.items()):
-            req.context_len += 1  # this step's KV write at old context_len
-            if not ok[slot]:
-                # poison quarantine: only this slot finishes; survivors'
-                # rows were computed independently and stay bitwise intact
-                self._finish_abnormal(req, "nonfinite", events)
-                continue
-            self._emit(req, int(nt[slot]), events)
+            with tr.span("device_sync"):
+                nt = np.asarray(nt)
+                ok = np.asarray(ok)
+        with tr.span("sample_emit"):
+            for slot, req in list(self.scheduler.running.items()):
+                req.context_len += 1  # this step's KV write at old
+                                      # context_len
+                if not ok[slot]:
+                    # poison quarantine: only this slot finishes;
+                    # survivors' rows were computed independently and
+                    # stay bitwise intact
+                    self._finish_abnormal(req, "nonfinite", events)
+                    continue
+                self._emit(req, int(nt[slot]), events)
 
     def _emit(self, req: Request, token: int, events: list[dict]) -> None:
         req.tokens.append(token)
         self.metrics.on_token(req.rid)
+        self.tracer.bump("tokens")
         reason = None
         if req.eos_token_id is not None and token == req.eos_token_id:
             reason = "stop"
@@ -636,7 +734,8 @@ class ServingEngine:
             reason = "length"
         if reason is not None:
             self.scheduler.finish(req, self.pool, reason)
-            self.metrics.on_finish(req.rid)
+            self.metrics.on_finish(req.rid, reason)
+            self._trace_finish(req, reason)
         events.append({"rid": req.rid, "token": token,
                        "finished": reason is not None,
                        "finish_reason": reason})

@@ -51,6 +51,7 @@ from dataclasses import dataclass, field
 
 import jax.numpy as jnp
 
+from ..observability.trace import NULL_TRACER
 from .errors import ServingError
 
 __all__ = ["KVCachePool", "PoolExhaustedError", "PrefixMatch"]
@@ -125,6 +126,9 @@ class KVCachePool:
         self._page_key: dict[int, tuple[str, bytes]] = {}  # page -> index key
         self._lru: "OrderedDict[int, None]" = OrderedDict()  # refcount-0 cached
         self._scrub_on_zero: set[int] = set()   # quarantined, shared pages
+        # injected by the engine when tracing is on; pool events (LRU
+        # eviction, COW copies, quarantine) land on the "pool" track
+        self.tracer = NULL_TRACER
         self.counters: dict[str, int] = {
             "prefix_lookups": 0, "prefix_hits": 0, "prefix_hit_pages": 0,
             "prefix_partial_hits": 0, "prefix_evictions": 0,
@@ -220,6 +224,10 @@ class KVCachePool:
         if evicted:
             self.scrub(evicted)
             self.counters["prefix_evictions"] += len(evicted)
+            self.tracer.instant("prefix_evict", track="pool",
+                                pages=len(evicted))
+            self.tracer.bump("prefix_evictions", len(evicted),
+                             track="pool")
         pages = [self._free.pop() for _ in range(n)]
         for p in pages:
             self._ref[p] = 1
@@ -305,6 +313,8 @@ class KVCachePool:
                 self._free.append(p)
         if todo:
             self.scrub(todo)
+        self.tracer.instant("quarantine", track="pool",
+                            pages=len(set(pages)))
 
     # ---- the prefix index ----
 
@@ -409,6 +419,7 @@ class KVCachePool:
         self.pools = [(pk.at[dst].set(pk[src]), pv.at[dst].set(pv[src]))
                       for pk, pv in self.pools]
         self.counters["prefix_cow_copies"] += 1
+        self.tracer.instant("cow_copy", track="pool", src=src, dst=dst)
 
     def scrub(self, pages: list[int]) -> None:
         """Zero pages (eviction / quarantine): restores the

@@ -10,7 +10,15 @@ failure-outcome counters of the robustness layer (rejects, timeouts,
 quarantines, preemption-limit kills, drain evictions — see the
 "Serving failure modes" table in SERVING.md). The clock is injectable
 so tests (and ``bench.py --dry``) can feed a deterministic virtual
-time; deadline enforcement in the engine runs on this same clock.
+time; deadline enforcement in the engine runs on this same clock, and a
+``Tracer`` (paddle_tpu.observability) constructed on the same clock
+puts spans and percentiles in one timebase.
+
+``goodput_at_slo`` is the SLO view (ROADMAP item 5): requests/s that
+finished normally AND met the TTFT / per-request-ITL-p99 SLOs — the
+metric that ranks schedulers, cache tiers and admission policies
+against each other, exported via ``summary()`` (``set_slo`` arms the
+thresholds) and rendered by ``observability.render_prometheus``.
 """
 
 from __future__ import annotations
@@ -21,7 +29,9 @@ __all__ = ["ServingMetrics", "percentile"]
 
 
 def percentile(values, p: float) -> float:
-    """Nearest-rank percentile (p in [0, 100]); 0.0 on empty input."""
+    """Linearly-interpolated percentile (p in [0, 100]), numpy's default
+    ``linear`` method: the rank ``p/100 * (n-1)`` is interpolated
+    between its two neighbouring order statistics. 0.0 on empty input."""
     if not values:
         return 0.0
     xs = sorted(values)
@@ -42,6 +52,12 @@ class ServingMetrics:
         self._last_token: dict[str, float] = {}
         self._n_tokens: dict[str, int] = {}
         self._itl: list[float] = []
+        self._itl_by_rid: dict[str, list[float]] = {}
+        self._finish_reason: dict[str, str | None] = {}
+        # SLO thresholds for goodput_at_slo in summary() (set_slo);
+        # None = that dimension unconstrained
+        self.slo_ttft_s: float | None = None
+        self.slo_itl_s: float | None = None
         self._queue_depth: list[int] = []
         self._pool_util: list[float] = []
         self._finished = 0
@@ -80,13 +96,19 @@ class ServingMetrics:
         if rid not in self._first_token:
             self._first_token[rid] = t
         else:
-            self._itl.append(t - self._last_token[rid])
+            gap = t - self._last_token[rid]
+            self._itl.append(gap)
+            self._itl_by_rid.setdefault(rid, []).append(gap)
         self._last_token[rid] = t
         self._n_tokens[rid] = self._n_tokens.get(rid, 0) + 1
         self._end = t
 
-    def on_finish(self, rid: str) -> None:
+    def on_finish(self, rid: str, reason: str | None = None) -> None:
+        """Terminal transition; ``reason`` (the finish_reason) feeds
+        goodput — only normal finishes (stop/length, or legacy ``None``)
+        can count as good requests."""
         self._finished += 1
+        self._finish_reason[rid] = reason
         self._end = self.now()
 
     def on_preemption(self) -> None:
@@ -124,6 +146,48 @@ class ServingMetrics:
         """Mirror the pool's prefix-cache page counters (lookups, hits,
         partial hits, evictions, COW copies) into the summary."""
         self._prefix_counters = dict(counters)
+
+    # ---- SLO goodput (ROADMAP item 5) ----
+
+    def set_slo(self, ttft_p99_s: float | None = None,
+                itl_p99_s: float | None = None) -> None:
+        """Arm the SLO thresholds ``summary()`` scores goodput against.
+        ``None`` leaves a dimension unconstrained."""
+        self.slo_ttft_s = ttft_p99_s
+        self.slo_itl_s = itl_p99_s
+
+    def goodput_at_slo(self, ttft_p99_s: float | None = None,
+                       itl_p99_s: float | None = None) -> float:
+        """Requests/s that finished normally AND met the SLOs.
+
+        A request is *good* when (a) its finish reason is a normal stop
+        (``stop``/``length``; legacy callers that never passed a reason
+        count too), (b) it emitted a first token, (c) TTFT <= the TTFT
+        SLO, and (d) the p99 of its own inter-token gaps <= the ITL SLO
+        (requests with < 2 tokens have no gaps and trivially pass).
+        ``None`` SLOs are unconstrained. Denominator is the same wall
+        time ``tokens_per_s`` uses; 0.0 before any time has passed.
+        """
+        wall = ((self._end - self._start)
+                if self._start is not None and self._end is not None
+                else 0.0)
+        if wall <= 0:
+            return 0.0
+        good = 0
+        for rid, reason in self._finish_reason.items():
+            if reason not in (None, "stop", "length"):
+                continue
+            if rid not in self._first_token or rid not in self._arrival:
+                continue
+            ttft = self._first_token[rid] - self._arrival[rid]
+            if ttft_p99_s is not None and ttft > ttft_p99_s:
+                continue
+            if itl_p99_s is not None:
+                gaps = self._itl_by_rid.get(rid, [])
+                if gaps and percentile(gaps, 99) > itl_p99_s:
+                    continue
+            good += 1
+        return good / wall
 
     def cache_hit_rate(self) -> float:
         """Fraction of prefill context tokens served from cached pages."""
@@ -185,6 +249,12 @@ class ServingMetrics:
             "cache_hit_rate": self.cache_hit_rate(),
             "prefill_tokens": self._prefill_tokens,
             "prefill_cached_tokens": self._prefill_cached_tokens,
-            **self._prefix_counters,
+            "goodput_at_slo": self.goodput_at_slo(self.slo_ttft_s,
+                                                  self.slo_itl_s),
+            # pool counters live under prefix_* so they can never
+            # shadow a summary key (the pool already uses that prefix
+            # for most of them — normalise the stragglers)
+            **{(k if k.startswith("prefix_") else "prefix_" + k): v
+               for k, v in self._prefix_counters.items()},
             **self.counters,
         }

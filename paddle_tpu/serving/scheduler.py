@@ -19,6 +19,7 @@ from __future__ import annotations
 import bisect
 from dataclasses import dataclass, field
 
+from ..observability.trace import NULL_TRACER
 from .errors import QueueFullError, RequestTooLargeError
 from .kv_cache import KVCachePool, PoolExhaustedError
 
@@ -99,6 +100,13 @@ class Scheduler:
         self._free_slots = list(range(max_slots - 1, -1, -1))
         self._arrival_counter = 0
         self.num_preemptions = 0
+        # injected by the engine when tracing is on. The scheduler owns
+        # every queue/slot state transition, so it owns the request-track
+        # lifecycle spans: "queued" opens at add/_requeue and closes at
+        # admission (or terminal eviction from the queue); "running"
+        # brackets slot occupancy exactly (_release closes it before any
+        # requeue, keeping the track's begin/end stack balanced).
+        self.tracer = NULL_TRACER
 
     # ---- queue ----
 
@@ -137,12 +145,17 @@ class Scheduler:
         self._arrival_counter += 1
         req.state = WAITING
         self.waiting.append(req)
+        self.tracer.begin("queued", track=req.rid,
+                          prompt=len(req.prompt),
+                          max_new=req.max_new_tokens)
 
     def _requeue(self, req: Request) -> None:
         """Put a preempted request back, keeping FCFS (arrival) order."""
         req.state = PREEMPTED
         keys = [r.arrival_seq for r in self.waiting]
         self.waiting.insert(bisect.bisect_left(keys, req.arrival_seq), req)
+        self.tracer.begin("queued", track=req.rid,
+                          preemptions=req.preemptions)
 
     @property
     def queue_depth(self) -> int:
@@ -158,6 +171,9 @@ class Scheduler:
         self._release(victim, pool)
         victim.preemptions += 1
         self.num_preemptions += 1
+        self.tracer.instant("preempt", track=victim.rid,
+                            preemptions=victim.preemptions)
+        self.tracer.bump("preemptions")
         if (self.max_preemptions is not None
                 and victim.preemptions > self.max_preemptions):
             # starvation guard: a request bounced out of the pool more
@@ -178,6 +194,8 @@ class Scheduler:
         prefix — full pages plus the frozen partial tail — is indexed
         first, so a preempted request's recompute, or a later request
         sharing the prompt, can map these pages instead of re-prefilling."""
+        self.tracer.end("running", track=req.rid,
+                        context_len=req.context_len)
         if register and req.pages:
             seq = (req.prompt + req.tokens)[:req.context_len]
             pool.register_prefix(seq, req.pages, include_partial=True)
@@ -203,6 +221,7 @@ class Scheduler:
         else:
             if req in self.waiting:
                 self.waiting.remove(req)
+                self.tracer.end("queued", track=req.rid)
             if req.pages:
                 pool.release(req.pages)
                 req.pages = []
@@ -289,6 +308,10 @@ class Scheduler:
                 pages = pool.alloc(n_new)
             except PoolExhaustedError:
                 pool.release(pinned)
+                self.tracer.instant("admit_rollback", track=req.rid,
+                                    need=n_new,
+                                    available=pool.num_available)
+                self.tracer.bump("admit_rollbacks")
                 break  # injected exhaustion (serving.alloc) — the head
                        # stays queued, never torn out of the FCFS order
             if match is not None and match.partial_page is not None:
@@ -308,6 +331,11 @@ class Scheduler:
             req.state = RUNNING
             req.context_len = n_valid
             self.running[req.slot] = req
+            if self.tracer.enabled:
+                self.tracer.end("queued", track=req.rid)
+                self.tracer.instant("admit", track=req.rid, slot=req.slot,
+                                    cached=cached, suffix=suffix)
+                self.tracer.begin("running", track=req.rid)
             admitted.append(req)
             budget -= suffix
         return admitted

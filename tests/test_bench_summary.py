@@ -69,11 +69,13 @@ def test_summary_entry_picks_the_configs_efficiency_ratio():
                                        "ttft_p50": 0.1, "ttft_p99": 0.4,
                                        "tpot": 0.02, "rejected": 1,
                                        "timed_out": 2, "quarantined": 0,
+                                       "goodput_at_slo": 1.5, "retraces": 0,
                                        "spread": None}}
     assert bench._summary_entry(serving, "llama_serving") == {
         "value": 4.0, "mfu": 0.2, "spread": None,
         "ttft_p50": 0.1, "ttft_p99": 0.4, "tpot": 0.02,
-        "rejected": 1, "timed_out": 2, "quarantined": 0}
+        "rejected": 1, "timed_out": 2, "quarantined": 0,
+        "goodput_at_slo": 1.5, "retraces": 0}
 
 
 def test_dry_serving_cell_carries_latency_and_failure_keys():
@@ -83,7 +85,8 @@ def test_dry_serving_cell_carries_latency_and_failure_keys():
     cell = last["bench_summary"]["llama_serving"]
     assert set(cell) >= {"value", "mfu", "spread",
                          "ttft_p50", "ttft_p99", "tpot",
-                         "rejected", "timed_out", "quarantined"}, cell
+                         "rejected", "timed_out", "quarantined",
+                         "goodput_at_slo", "retraces"}, cell
 
 
 def test_dry_serving_prefix_cell_carries_cache_keys():
@@ -94,5 +97,60 @@ def test_dry_serving_prefix_cell_carries_cache_keys():
     assert set(cell) >= {"value", "mfu", "spread",
                          "ttft_p50", "ttft_p99", "tpot",
                          "cache_hit_rate", "prefix_hits",
-                         "prefix_evictions"}, cell
+                         "prefix_evictions",
+                         "goodput_at_slo", "retraces"}, cell
     assert all(v is None for v in cell.values()), cell
+
+
+def test_dry_trace_flag_path_not_eaten_as_config_name():
+    # --trace PATH: PATH does not start with "-", so the flag must be
+    # stripped before the positional config-name filter sees argv
+    out = _run_dry("--trace", "serve.trace.json", "llama_serving")
+    assert out.returncode == 0, out.stderr
+    last = json.loads(out.stdout.splitlines()[-1])
+    assert set(last["bench_summary"]) == {"llama_serving"}
+    bad = _run_dry("llama_serving", "--trace")
+    assert bad.returncode != 0, "--trace without PATH must fail"
+
+
+def test_metrics_endpoint_serves_parseable_prometheus_text():
+    """Tier-1-safe /metrics smoke: a MetricsServer on an ephemeral port
+    fed by an explicit render callable (no engine, no jax) must serve
+    text every strict Prometheus parser accepts, plus /healthz JSON."""
+    import urllib.request
+
+    from paddle_tpu.observability import (MetricsServer, parse_prometheus,
+                                          render_prometheus)
+
+    text_src = render_prometheus(
+        {"tokens_per_s": 12.5, "ttft_p99_s": 0.25, "goodput_at_slo": 3.0,
+         "note": "non-numeric values are skipped"},
+        {"in_use": 7, "utilization": 0.5},
+        {"compiles": 2})
+    srv = MetricsServer(render=lambda: text_src,
+                        health=lambda: {"status": "ok"})
+    port = srv.start()
+    try:
+        assert port != 0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            body = r.read().decode()
+        metrics = parse_prometheus(body)  # raises on any malformed line
+        assert metrics["paddle_serving_tokens_per_seconds"] == 12.5
+        assert metrics["paddle_serving_ttft_p99_seconds"] == 0.25
+        assert metrics["paddle_serving_goodput_at_slo"] == 3.0
+        assert metrics["paddle_serving_pool_in_use"] == 7
+        assert metrics["paddle_serving_trace_compiles_total"] == 2
+        assert "paddle_serving_note" not in metrics
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+            assert json.loads(r.read().decode()) == {"status": "ok"}
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=10) as r:
+            raise AssertionError("unknown path must 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    finally:
+        srv.stop()

@@ -31,6 +31,15 @@ Run: python tools/profile_serving.py            (real TPU)
                                                  the outcome histogram —
                                                  every request must end
                                                  classified, never hung)
+     python tools/profile_serving.py --flight-recorder
+                                                (same chaos FaultPlan with
+                                                 tracing + the flight
+                                                 recorder attached: prints
+                                                 where the rank-annotated
+                                                 dumps landed and a one-
+                                                 line event histogram —
+                                                 the post-mortem playbook,
+                                                 OBSERVABILITY.md)
 """
 import sys
 sys.path.insert(0, "/root/repo")
@@ -127,6 +136,75 @@ def chaos():
           f"(no-retrace contract held); unclassified requests: "
           f"{unclassified}")
     assert unclassified == 0, "a request ended without a finish_reason"
+
+
+def flight_recorder():
+    """Observability post-mortem playbook (OBSERVABILITY.md): the SAME
+    deterministic chaos FaultPlan as --chaos, but with tracing ON and a
+    FlightRecorder subscribed — the run shows what an operator actually
+    gets when an engine dies in production: rank-annotated JSON dumps at
+    every terminal condition (nonfinite quarantine, scheduler stall,
+    drain), each carrying the last-N event ring, a state snapshot and an
+    event histogram. Prints the dump locations and the ring's one-line
+    histogram at the end."""
+    import os
+    import tempfile
+
+    import paddle_tpu as pt
+    from paddle_tpu.distributed import fault
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.observability import FlightRecorder, Tracer
+    from paddle_tpu.serving import (SchedulerStalledError, ServingEngine,
+                                    ServingError)
+
+    pt.seed(0)
+    model = LlamaForCausalLM(llama_tiny(mp_axis=None, fsdp_axis=None))
+    model.eval()
+
+    plan = fault.FaultPlan([
+        fault.FaultSpec(site="serving.decode", action="poison",
+                        match=r"^chaos-2$"),
+        fault.FaultSpec(site="serving.prefill", action="raise",
+                        match=r"^chaos-5$"),
+        fault.FaultSpec(site="serving.alloc", action="raise",
+                        prob=0.4, once=False),
+    ], seed=7)
+    fault.activate(plan)
+
+    dump_dir = tempfile.mkdtemp(prefix="flight_recorder_")
+    tracer = Tracer()
+    recorder = FlightRecorder(capacity=512, tracer=tracer,
+                              dump_dir=dump_dir)
+    eng = ServingEngine(model, num_pages=13, page_size=4, max_slots=3,
+                        max_queue_depth=8, max_preemptions=4,
+                        tracer=tracer, flight_recorder=recorder)
+    rng = np.random.default_rng(0)
+    for i in range(8):
+        prompt = rng.integers(0, model.config.vocab_size, 6).astype(np.int32)
+        try:
+            eng.add_request(prompt, 12, rid=f"chaos-{i}")
+        except ServingError:
+            pass
+    try:
+        eng.run_to_completion(max_steps=400)
+    except SchedulerStalledError as e:
+        print(f"scheduler stalled; snapshot points at the dump: "
+              f"{e.snapshot.get('flight_recorder')}")
+        eng.drain(timeout_s=0.0)
+    finally:
+        fault.deactivate()
+
+    hist = recorder.histogram()
+    print(f"\n{recorder.dumps} flight-recorder dump(s) in {dump_dir}:")
+    for f in sorted(os.listdir(dump_dir)):
+        print(f"  {os.path.join(dump_dir, f)}")
+    print("event histogram ("
+          + f"{len(recorder)} events in a {recorder.capacity}-slot ring): "
+          + "  ".join(f"{k}={v}" for k, v in hist.items()))
+    trace_path = tracer.dump_chrome_trace(
+        os.path.join(dump_dir, "chaos.trace.json"))
+    print(f"Chrome trace (load at https://ui.perfetto.dev): {trace_path}")
+    assert recorder.dumps > 0, "chaos replay produced no dumps"
 
 
 def prefix():
@@ -354,6 +432,8 @@ def main():
 if __name__ == "__main__":
     if "--chaos" in sys.argv[1:]:
         chaos()
+    elif "--flight-recorder" in sys.argv[1:]:
+        flight_recorder()
     elif "--prefix" in sys.argv[1:]:
         prefix()
     else:
