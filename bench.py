@@ -17,6 +17,10 @@ Configs (BASELINE.md "measurement configs"):
   - llama_longctx: the flagship at seq 16384 with remat — long-context;
                   10-step windows (extra.iters) since each step is ~0.8 s
   - llama_longctx_32k (OPT-IN, run by name): same at seq 32768
+  - llama_decode_int8 / llama_serving_int8: the quantized-serving arms —
+                  int8 KV cache + int8 weight streaming (SERVING.md
+                  "Quantized KV & weights"); MBU against *necessary* int8
+                  bytes, bytes_ratio_vs_bf16 is the bandwidth headroom
 
 Each line: {"metric", "value", "unit", "vs_baseline", "extra"}. The primary
 (first) line is llama_420m — vs_baseline remains MFU/0.40 against the
@@ -80,6 +84,9 @@ _RUNS = 3  # timed windows per config (reported in extra.runs)
 _SERVING_SLOS = {
     "llama_serving": {"ttft_p99_s": 2.0, "itl_p99_s": 0.25},
     "llama_serving_prefix": {"ttft_p99_s": 1.0, "itl_p99_s": 0.25},
+    # int8 arm: same workload and SLOs as llama_serving — quantization
+    # must not be allowed to hide behind looser targets
+    "llama_serving_int8": {"ttft_p99_s": 2.0, "itl_p99_s": 0.25},
 }
 
 
@@ -528,7 +535,8 @@ def bench_llama_longctx(peak, peak_kind, batch=1, seq=16384):
     }
 
 
-def bench_llama_decode(peak, peak_kind, prefill_len=2048, new_tokens=256):
+def bench_llama_decode(peak, peak_kind, prefill_len=2048, new_tokens=256,
+                       kv_int8=False):
     """Serving/decode throughput (VERDICT r4 missing #3): the flagship's
     compiled prefill program and the one-program lax.scan decode loop
     (models/llama.py decode_programs — parity: AnalysisPredictor +
@@ -536,7 +544,15 @@ def bench_llama_decode(peak, peak_kind, prefill_len=2048, new_tokens=256):
     Reports steady-state decode tokens/s at batch 8 as the headline value;
     batch 1 and prefill tokens/s land in extra. Decode is HBM-bound: the
     model-bandwidth utilisation (MBU = bytes-of-weights+cache per token /
-    HBM bandwidth) is the honest efficiency number, reported per batch."""
+    HBM bandwidth) is the honest efficiency number, reported per batch.
+
+    ``kv_int8=True`` is the quantized-serving arm (``llama_decode_int8``,
+    SERVING.md "Quantized KV & weights"): int8 weight streaming
+    (quantize_for_serving — decode matmuls read int8 codes + per-channel
+    scales, dequantized in the matmul epilogue) AND an int8 KV cache
+    (codes + per-row fp32 absmax scales). MBU is then computed against
+    these *necessary* int8 bytes — the smaller denominator is the whole
+    point: the same achieved bandwidth serves ~2x the tokens."""
     import jax
     import jax.numpy as jnp
 
@@ -553,6 +569,13 @@ def bench_llama_decode(peak, peak_kind, prefill_len=2048, new_tokens=256):
     model = LlamaForCausalLM(cfg)
     model.eval()
     n_params = model.num_params()
+    if kv_int8:
+        from paddle_tpu.quantization import (quantize_for_serving,
+                                             serving_state_bytes)
+        quantize_for_serving(model, inplace=True)
+        weight_bytes = float(serving_state_bytes(model))
+    else:
+        weight_bytes = 2.0 * n_params
     state = model.state_dict(include_non_persistable_buffer=True)
     rng = np.random.default_rng(0)
     # HBM bandwidth by generation (public specs), for MBU — keyed by the
@@ -568,7 +591,8 @@ def bench_llama_decode(peak, peak_kind, prefill_len=2048, new_tokens=256):
                                                    new_tokens, seq)
         ids = jnp.asarray(rng.integers(0, cfg.vocab_size,
                                        (batch, prefill_len)), jnp.int32)
-        caches0 = model.init_kv_caches(batch, seq)
+        caches0 = model.init_kv_caches(batch, seq,
+                                       dtype="int8" if kv_int8 else None)
         keys = jax.random.split(jax.random.key(0), new_tokens)
 
         def run_prefill():
@@ -586,14 +610,24 @@ def bench_llama_decode(peak, peak_kind, prefill_len=2048, new_tokens=256):
         dt_dec, spread_dec = t[0], t[1]
         tok_s_decode = batch * (new_tokens - 1) / dt_dec
         ms_per_tok = dt_dec / (new_tokens - 1) * 1000
-        # bytes touched per decode step: all weights (bf16) + the KV cache
-        # read up to the mean filled length + new KV write (negligible)
+        # bytes touched per decode step: all weights + the KV cache read
+        # up to the mean filled length + new KV write (negligible). int8
+        # KV: codes (kvh*d bytes) + fp32 absmax scales (kvh*4) per
+        # token per layer per K/V; bf16: kvh*d*2
+        kv_tok = (cfg.num_key_value_heads * (cfg.head_dim + 4) if kv_int8
+                  else cfg.num_key_value_heads * cfg.head_dim * 2)
         cache_bytes = (2 * cfg.num_hidden_layers * batch
-                       * (prefill_len + new_tokens / 2)
-                       * cfg.num_key_value_heads * cfg.head_dim * 2)
-        mbu = (2.0 * n_params + cache_bytes) / (dt_dec / (new_tokens - 1)) \
+                       * (prefill_len + new_tokens / 2) * kv_tok)
+        cache_bf16 = (2 * cfg.num_hidden_layers * batch
+                      * (prefill_len + new_tokens / 2)
+                      * cfg.num_key_value_heads * cfg.head_dim * 2)
+        mbu = (weight_bytes + cache_bytes) / (dt_dec / (new_tokens - 1)) \
             / hbm_bw
         per_batch[batch] = {
+            "step_bytes": round(weight_bytes + cache_bytes),
+            "bytes_ratio_vs_bf16": round(
+                (2.0 * n_params + cache_bf16)
+                / (weight_bytes + cache_bytes), 4),
             "decode_tokens_per_sec": round(tok_s_decode, 1),
             "decode_ms_per_token": round(ms_per_tok, 3),
             "prefill_tokens_per_sec": round(batch * prefill_len / dt_pre, 1),
@@ -603,8 +637,9 @@ def bench_llama_decode(peak, peak_kind, prefill_len=2048, new_tokens=256):
             "spread_decode": round(spread_dec, 4),
         }
     headline = per_batch[8]["decode_tokens_per_sec"]
+    sfx = "_int8" if kv_int8 else ""
     return {
-        "metric": "llama_420m_decode_tokens_per_sec_batch8",
+        "metric": f"llama_420m_decode{sfx}_tokens_per_sec_batch8",
         "value": headline,
         "unit": "tokens/s",
         # no absolute serving baseline published; report MBU-vs-ideal as
@@ -612,6 +647,8 @@ def bench_llama_decode(peak, peak_kind, prefill_len=2048, new_tokens=256):
         "vs_baseline": per_batch[8]["mbu"],
         "extra": {"params": n_params, "prefill_len": prefill_len,
                   "new_tokens": new_tokens, "batches": per_batch,
+                  "kv_int8": kv_int8,
+                  "bytes_ratio_vs_bf16": per_batch[8]["bytes_ratio_vs_bf16"],
                   "peak": peak_kind, "hbm_bw": hbm_bw,
                   "mbu_note": "MBU vs the SPEC bandwidth; this chip's "
                               "measured streaming ceiling is ~600 GB/s "
@@ -644,7 +681,7 @@ def _dump_trace(tracer, trace_path, name):
 
 
 def bench_llama_serving(peak, peak_kind, n_requests=12, max_new_tokens=64,
-                        trace_path=None):
+                        trace_path=None, quantized=False):
     """Continuous-batching serving throughput (SERVING.md): the paged
     KV-pool engine (paddle_tpu.serving) driven by a staggered-arrival
     trace — 2 requests queued at t=0, then one more every 4 engine steps,
@@ -653,11 +690,20 @@ def bench_llama_serving(peak, peak_kind, n_requests=12, max_new_tokens=64,
     bench_summary cell — the driver's serving SLO view). Programs are
     warmed on a throwaway trace first so compile time doesn't pollute
     TTFT; the measured trace reuses the same engine (decode stays ONE
-    compiled program throughout — asserted, it is the design contract)."""
+    compiled program throughout — asserted, it is the design contract).
+
+    ``quantized=True`` is the int8 arm (``llama_serving_int8``,
+    SERVING.md "Quantized KV & weights"): the engine's paged pool stores
+    int8 KV codes + per-row fp32 absmax scales and the decode matmuls
+    stream int8 weights (quantize_for_serving). The weights-only MBU
+    floor is computed against the *necessary* int8 bytes
+    (serving_state_bytes) — smaller denominator, same achieved
+    bandwidth, ~2x the tokens."""
     import paddle_tpu as pt
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.serving import ServingEngine, ServingMetrics
 
+    name = "llama_serving_int8" if quantized else "llama_serving"
     pt.seed(0)
     cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
                       intermediate_size=5632, num_hidden_layers=8,
@@ -667,13 +713,21 @@ def bench_llama_serving(peak, peak_kind, n_requests=12, max_new_tokens=64,
     model = LlamaForCausalLM(cfg)
     model.eval()
     n_params = model.num_params()
+    if quantized:
+        from paddle_tpu.quantization import (quantize_for_serving,
+                                             serving_state_bytes)
+        quantize_for_serving(model, inplace=True)
+        weight_bytes = float(serving_state_bytes(model))
+    else:
+        weight_bytes = 2.0 * n_params
     rng = np.random.default_rng(0)
     lens = [int(x) for x in rng.integers(64, 256, n_requests)]
     prompts = [rng.integers(0, cfg.vocab_size, n).astype(np.int32)
                for n in lens]
     tracer = _make_tracer(trace_path)
     eng = ServingEngine(model, num_pages=512, page_size=16, max_slots=8,
-                        max_pages_per_slot=32, tracer=tracer)
+                        max_pages_per_slot=32, tracer=tracer,
+                        kv_quant=quantized)
     # warm every program the trace will hit: the decode step plus one
     # prefill bucket per distinct prompt-length bucket
     for n in sorted({eng._bucket(s) for s in lens}):
@@ -681,7 +735,8 @@ def bench_llama_serving(peak, peak_kind, n_requests=12, max_new_tokens=64,
                         else rng.integers(0, cfg.vocab_size, n), 2)
     eng.run_to_completion(max_steps=100)
     eng.metrics = ServingMetrics()  # compile time stays out of the trace
-    eng.metrics.set_slo(**_SERVING_SLOS["llama_serving"])
+    eng.metrics.set_kv_quant(quantized)  # re-arm after the reset
+    eng.metrics.set_slo(**_SERVING_SLOS[name])
 
     added = 2
     for p in prompts[:2]:
@@ -700,18 +755,35 @@ def bench_llama_serving(peak, peak_kind, n_requests=12, max_new_tokens=64,
               "v5p": 2.77e12,
               "v6e": 1.64e12, "trillium": 1.64e12,
               }.get(peak_kind.split("(")[0], 0.82e12)
-    # weights-only traffic floor: every engine step streams the bf16
-    # weights once regardless of slot occupancy (KV traffic excluded —
-    # honest lower bound on bandwidth utilisation)
+    # weights-only traffic floor: every engine step streams the weights
+    # once regardless of slot occupancy (KV traffic excluded — honest
+    # lower bound on bandwidth utilisation). int8 arm: the necessary
+    # bytes are the int8 codes + scales, about half the bf16 stream
     wall = max(m["wall_s"], 1e-9)
-    mbu = steps * 2.0 * n_params / wall / hbm_bw
-    trace_out = _dump_trace(tracer, trace_path, "llama_serving")
+    mbu = steps * weight_bytes / wall / hbm_bw
+    # necessary-bytes-per-decode-step decomposition at full occupancy
+    # (PERF.md): weights once + the 8 slots' mean live context of KV.
+    # The ratio vs the bf16 arm is the bandwidth headroom int8 buys.
+    kv_tok = eng.pool.kv_bytes_per_token()
+    kv_tok_bf16 = (2 * cfg.num_hidden_layers * cfg.num_key_value_heads
+                   * cfg.head_dim * 2)
+    mean_ctx = sum(lens) / len(lens) + max_new_tokens / 2
+    step_bytes = weight_bytes + 8 * mean_ctx * kv_tok
+    step_bytes_bf16 = 2.0 * n_params + 8 * mean_ctx * kv_tok_bf16
+    trace_out = _dump_trace(tracer, trace_path, name)
     return {
-        "metric": "llama_420m_serving_tokens_per_sec",
+        "metric": f"llama_420m_{'serving_int8' if quantized else 'serving'}"
+                  f"_tokens_per_sec",
         "value": round(m["tokens_per_s"], 1),
         "unit": "tokens/s",
         "vs_baseline": round(mbu, 4),
         "extra": {"params": n_params, "n_requests": n_requests,
+                  "kv_quant": int(quantized),
+                  "kv_quant_err_bound": round(m["kv_quant_err_bound"], 6),
+                  "kv_bytes_per_token": kv_tok,
+                  "step_bytes": round(step_bytes),
+                  "bytes_ratio_vs_bf16": round(step_bytes_bf16
+                                               / step_bytes, 4),
                   "max_new_tokens": max_new_tokens,
                   "prompt_lens": lens, "engine_steps": steps,
                   "ttft_p50": round(m["ttft_p50_s"], 4),
@@ -726,7 +798,7 @@ def bench_llama_serving(peak, peak_kind, n_requests=12, max_new_tokens=64,
                   "kv_util_peak": round(m["kv_util_peak"], 4),
                   "queue_depth_max": m["queue_depth_max"],
                   "goodput_at_slo": round(m["goodput_at_slo"], 4),
-                  "slo": _SERVING_SLOS["llama_serving"],
+                  "slo": _SERVING_SLOS[name],
                   "retraces": eng.decode_program_count() - 1,
                   "trace": trace_out,
                   "mbu_weights_only": round(mbu, 4),
@@ -905,6 +977,13 @@ _CONFIGS = {
     # shared-system-prompt serving: prefix-cache hit path (SERVING.md
     # "Prefix caching") — TTFT/hit-rate evidence for the cache
     "llama_serving_prefix": bench_llama_serving_prefix,
+    # int8 quantized serving (SERVING.md "Quantized KV & weights"): the
+    # same decode/serving workloads with int8 KV + int8 weight streaming;
+    # MBU denominators are the *necessary* int8 bytes
+    "llama_decode_int8": lambda peak, kind, **kw: bench_llama_decode(
+        peak, kind, kv_int8=True, **kw),
+    "llama_serving_int8": lambda peak, kind, **kw: bench_llama_serving(
+        peak, kind, quantized=True, **kw),
 }
 
 # configs whose bench_summary cell carries extra keys beyond
@@ -918,6 +997,11 @@ _SUMMARY_EXTRA_KEYS = {
                              "cache_hit_rate", "prefix_hits",
                              "prefix_evictions",
                              "goodput_at_slo", "retraces"),
+    "llama_decode_int8": ("bytes_ratio_vs_bf16",),
+    "llama_serving_int8": ("ttft_p50", "ttft_p99", "tpot",
+                           "rejected", "timed_out", "quarantined",
+                           "goodput_at_slo", "retraces",
+                           "kv_quant_err_bound", "bytes_ratio_vs_bf16"),
 }
 
 # opt-in configs (not in the default driver run — kept out to bound its

@@ -114,6 +114,32 @@ def main():
           f"tokens served from cached pages, {m['prefix_hits']} hits, "
           f"tokens bitwise identical to cold generate())")
 
+    # --- int8 quantized serving: KV cache + weight streaming ------------
+    # decode is bandwidth-bound: every step re-reads the weights and the
+    # whole KV cache. kv_quant=True stores pages as int8 codes + per-row
+    # fp32 absmax scales (~half the KV bytes); quantize_for_serving swaps
+    # decode matmuls to int8 weights dequantized in the matmul epilogue
+    # (SERVING.md "Quantized KV & weights"). Greedy tokens match the fp
+    # cache on this workload — the error model bounds per-element dequant
+    # error at scale/2, and the A/B harness (tools/profile_serving.py
+    # --kv-int8) checks >=99% token agreement on bigger traces.
+    from paddle_tpu.quantization import quantize_for_serving, \
+        serving_state_bytes
+    eng3 = ServingEngine(model, num_pages=64, page_size=4, max_slots=4,
+                         kv_quant=True)
+    rids3 = [eng3.add_request(p, max_new_tokens=8) for p in ragged[:2]]
+    res3 = eng3.run_to_completion()
+    assert all(res3[r3] == results[r] for r3, r in zip(rids3, rids[:2]))
+    assert eng3.decode_program_count() == 1
+    qm = eng3.metrics.summary()
+    qmodel = quantize_for_serving(model)
+    fp_b, q_b = serving_state_bytes(model), serving_state_bytes(qmodel)
+    print(f"int8 serving: tokens identical to fp cache, "
+          f"kv {eng3.pool.kv_bytes_per_token()}B/token vs "
+          f"{eng.pool.kv_bytes_per_token()}B fp, err_bound="
+          f"{qm['kv_quant_err_bound']:.4f}, weights {fp_b/1e6:.1f}MB -> "
+          f"{q_b/1e6:.1f}MB")
+
 
 if __name__ == "__main__":
     main()

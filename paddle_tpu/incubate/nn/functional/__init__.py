@@ -152,21 +152,32 @@ def masked_multihead_attention(q, k_new, v_new, cache_k, cache_v, seq_lens,
     masked_multihead_attention.py — the per-token decode kernel).
 
     q/k_new/v_new: [b, 1, h(kvh), d] — this step's projections.
-    cache_k/v: [b, S_max, kvh, d]; seq_lens: [b] tokens already cached.
+    cache_k/v: [b, S_max, kvh, d] (fp, or int8 QuantizedKV — the step
+    token is quantized HERE, at cache-write time, codes + scale row);
+    seq_lens: [b] tokens already cached.
     Writes the new k/v at position seq_lens, then attends q over positions
     <= seq_lens. GQA supported (q heads a multiple of cache kv heads).
     Returns (out [b, 1, h, d], cache_k, cache_v) — caches functionally
     updated (donate/alias under jit for in-place HBM update).
     """
+    from ....quantization.serving import QuantizedKV, kv_quantize
     b, _, h, d = q.shape
     kvh = cache_k.shape[2]
     S = cache_k.shape[1]
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     bidx = jnp.arange(b)
-    cache_k = cache_k.at[bidx, seq_lens].set(
-        k_new[:, 0].astype(cache_k.dtype))
-    cache_v = cache_v.at[bidx, seq_lens].set(
-        v_new[:, 0].astype(cache_v.dtype))
+    if isinstance(cache_k, QuantizedKV):
+        kq = kv_quantize(k_new[:, 0])          # codes [b,kvh,d], scale [b,kvh]
+        vq = kv_quantize(v_new[:, 0])
+        cache_k = QuantizedKV(cache_k.q.at[bidx, seq_lens].set(kq.q),
+                              cache_k.scale.at[bidx, seq_lens].set(kq.scale))
+        cache_v = QuantizedKV(cache_v.q.at[bidx, seq_lens].set(vq.q),
+                              cache_v.scale.at[bidx, seq_lens].set(vq.scale))
+    else:
+        cache_k = cache_k.at[bidx, seq_lens].set(
+            k_new[:, 0].astype(cache_k.dtype))
+        cache_v = cache_v.at[bidx, seq_lens].set(
+            v_new[:, 0].astype(cache_v.dtype))
     out = _grouped_decode_attn(q, cache_k, cache_v, seq_lens, scale)
     return out, cache_k, cache_v
 
@@ -193,16 +204,28 @@ def block_multihead_attention(q, pool_k, pool_v, block_tables, seq_lens,
     page at position seq_lens (pages must be pre-allocated in block_tables).
     Returns (out [b, 1, h, d], pool_k, pool_v).
     """
+    from ....quantization.serving import QuantizedKV, kv_quantize
     b, _, h, d = q.shape
     nb, bs, kvh, _ = pool_k.shape
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     if k_new is not None:
         blk = jnp.take_along_axis(block_tables, (seq_lens // bs)[:, None],
                                   axis=1)[:, 0]
-        pool_k = pool_k.at[blk, seq_lens % bs].set(
-            k_new[:, 0].astype(pool_k.dtype))
-        pool_v = pool_v.at[blk, seq_lens % bs].set(
-            v_new[:, 0].astype(pool_v.dtype))
+        if isinstance(pool_k, QuantizedKV):
+            kq = kv_quantize(k_new[:, 0])
+            vq = kv_quantize(v_new[:, 0])
+            off = seq_lens % bs
+            pool_k = QuantizedKV(
+                pool_k.q.at[blk, off].set(kq.q),
+                pool_k.scale.at[blk, off].set(kq.scale))
+            pool_v = QuantizedKV(
+                pool_v.q.at[blk, off].set(vq.q),
+                pool_v.scale.at[blk, off].set(vq.scale))
+        else:
+            pool_k = pool_k.at[blk, seq_lens % bs].set(
+                k_new[:, 0].astype(pool_k.dtype))
+            pool_v = pool_v.at[blk, seq_lens % bs].set(
+                v_new[:, 0].astype(pool_v.dtype))
     # gather + grouped-GQA attention, shared with the serving engine
     # (Pallas block-table kernel on TPU, XLA gather elsewhere)
     from ....nn.functional.attention import paged_attention_decode

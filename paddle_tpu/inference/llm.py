@@ -32,8 +32,16 @@ class LLMPredictor:
                  max_preemptions: int | None = None,
                  step_timeout_s: float | None = None,
                  drain_timeout_s: float | None = 30.0,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True, kv_quant: bool = False,
+                 weight_quant: bool = False):
         from ..serving import ServingEngine
+        if weight_quant:
+            # int8 weight streaming (SERVING.md "Quantized KV & weights"):
+            # decode matmuls stream int8 codes + per-channel scales and
+            # dequantize in the matmul epilogue — ~half the weight bytes
+            # of bf16 per decode step
+            from ..quantization.serving import quantize_for_serving
+            model = quantize_for_serving(model)
         self.model = model
         self._mk = lambda: ServingEngine(
             model, num_pages=num_pages, page_size=page_size,
@@ -41,7 +49,8 @@ class LLMPredictor:
             prefill_token_budget=prefill_token_budget, kv_dtype=kv_dtype,
             clock=clock, max_queue_depth=max_queue_depth,
             max_preemptions=max_preemptions, step_timeout_s=step_timeout_s,
-            drain_timeout_s=drain_timeout_s, prefix_cache=prefix_cache)
+            drain_timeout_s=drain_timeout_s, prefix_cache=prefix_cache,
+            kv_quant=kv_quant)
         self.engine = self._mk()
 
     #: typed serving error -> the stable ``error`` string reported by

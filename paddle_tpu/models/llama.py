@@ -137,8 +137,19 @@ class LlamaAttention(Layer):
                                        axis=1)[:, 0]
             page = jnp.where(active, page, 0)
             off = jnp.where(active, seq_lens % ps, 0)
-            pk = pk.at[page, off].set(k[:, 0].astype(pk.dtype))
-            pv = pv.at[page, off].set(v[:, 0].astype(pv.dtype))
+            from ..quantization.serving import QuantizedKV, kv_quantize
+            if isinstance(pk, QuantizedKV):
+                # int8 pool: quantize the step token at write time (codes
+                # + per-row absmax scale); the read side dequantizes
+                # inside the one shared decode core
+                kq, vq = kv_quantize(k[:, 0]), kv_quantize(v[:, 0])
+                pk = QuantizedKV(pk.q.at[page, off].set(kq.q),
+                                 pk.scale.at[page, off].set(kq.scale))
+                pv = QuantizedKV(pv.q.at[page, off].set(vq.q),
+                                 pv.scale.at[page, off].set(vq.scale))
+            else:
+                pk = pk.at[page, off].set(k[:, 0].astype(pk.dtype))
+                pv = pv.at[page, off].set(v[:, 0].astype(pv.dtype))
             out = F.paged_attention_decode(q, pk, pv, tables, seq_lens)
             return self.o_proj(out.reshape(b, s, h * d)), (pk, pv)
         # sequence parallelism: when tracing inside a manual-sep shard_map
@@ -188,11 +199,31 @@ class LlamaAttention(Layer):
             return out, (ck, cv)
         if kv_cache is not None:
             ck, cv = kv_cache
-            ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype),
-                                                     position_offset, axis=1)
-            cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype),
-                                                     position_offset, axis=1)
-            k, v = ck, cv
+            from ..quantization.serving import (QuantizedKV, kv_dequantize,
+                                                kv_quantize)
+            if isinstance(ck, QuantizedKV):
+                # int8 cache: quantize the written tokens (same per-row
+                # absmax codes a later decode append would produce), then
+                # attend over the fp32 dequantized view — the cache keeps
+                # int8 + scales, attention math runs in fp32
+                kq, vq = kv_quantize(k), kv_quantize(v)
+                ck = QuantizedKV(
+                    jax.lax.dynamic_update_slice_in_dim(
+                        ck.q, kq.q, position_offset, axis=1),
+                    jax.lax.dynamic_update_slice_in_dim(
+                        ck.scale, kq.scale, position_offset, axis=1))
+                cv = QuantizedKV(
+                    jax.lax.dynamic_update_slice_in_dim(
+                        cv.q, vq.q, position_offset, axis=1),
+                    jax.lax.dynamic_update_slice_in_dim(
+                        cv.scale, vq.scale, position_offset, axis=1))
+                k, v = kv_dequantize(ck), kv_dequantize(cv)
+            else:
+                ck = jax.lax.dynamic_update_slice_in_dim(
+                    ck, k.astype(ck.dtype), position_offset, axis=1)
+                cv = jax.lax.dynamic_update_slice_in_dim(
+                    cv, v.astype(cv.dtype), position_offset, axis=1)
+                k, v = ck, cv
             new_cache = (ck, cv)
         if kvh != h:  # GQA: repeat kv heads
             rep = h // kvh
@@ -324,9 +355,21 @@ class LlamaForCausalLM(Layer):
         return (logits, new_caches) if kv_caches is not None else logits
 
     def init_kv_caches(self, batch_size, max_len, dtype=None):
+        """Fixed-size contiguous caches; ``dtype="int8"`` (or jnp.int8)
+        builds QuantizedKV caches — int8 codes + fp32 absmax scales —
+        written at cache-write time and dequantized at read time
+        (quantization/serving.py)."""
         cfg = self.config
         dtype = dtype or jnp.bfloat16
         shape = (batch_size, max_len, cfg.num_key_value_heads, cfg.head_dim)
+        if jnp.dtype(dtype) == jnp.int8:
+            from ..quantization.serving import QuantizedKV
+
+            def _zeros():
+                return QuantizedKV(jnp.zeros(shape, jnp.int8),
+                                   jnp.zeros(shape[:3], jnp.float32))
+            return [(_zeros(), _zeros())
+                    for _ in range(cfg.num_hidden_layers)]
         return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
                 for _ in range(cfg.num_hidden_layers)]
 
@@ -430,7 +473,7 @@ class LlamaForCausalLM(Layer):
                  do_sample: bool = False, top_p: float = 1.0,
                  temperature: float = 1.0, seed: int | None = None,
                  jit_loop: bool = True, eos_token_id: int | None = None,
-                 pad_token_id: int | None = None):
+                 pad_token_id: int | None = None, kv_dtype=None):
         """Decode: one jitted prefill + the WHOLE token loop as one jitted
         ``lax.scan`` over the fixed-size KV cache (decode routes through the
         fused masked-MHA path). Two compiled programs total — the per-token
@@ -450,12 +493,16 @@ class LlamaForCausalLM(Layer):
 
         ``eos_token_id``: once a row emits EOS, its subsequent tokens are
         pinned to ``pad_token_id`` (default: the EOS id) inside the scan —
-        output shape stays static [b, s0 + max_new_tokens]."""
+        output shape stays static [b, s0 + max_new_tokens].
+
+        ``kv_dtype``: cache storage dtype — ``"int8"`` decodes over a
+        quantized contiguous cache (the reference arm the serving
+        engine's int8 parity tests compare against)."""
         input_ids = jnp.asarray(input_ids)
         b, s0 = input_ids.shape
         max_len = max_len or (s0 + max_new_tokens)
         state = self.state_dict(include_non_persistable_buffer=True)
-        caches = self.init_kv_caches(b, max_len)
+        caches = self.init_kv_caches(b, max_len, dtype=kv_dtype)
         key0 = jax.random.key(seed if seed is not None else 0)
         prefill, decode, step = self.decode_programs(
             b, s0, max_new_tokens, max_len, do_sample, top_p, temperature,

@@ -384,9 +384,17 @@ def _grouped_decode_attn(q, kc, vc, seq_lens, scale):
     HBM copy of the cache. One implementation for both cache layouts so
     the paged engine's tokens stay bit-identical to contiguous decode.
 
-    q: [b, 1, h, d]; kc/vc: [b, S, kvh, d]; seq_lens: [b] — attends cache
-    positions <= seq_lens (the just-written step token included).
+    q: [b, 1, h, d]; kc/vc: [b, S, kvh, d] — fp arrays, or QuantizedKV
+    (int8 codes + fp32 absmax scales, quantization/serving.py): quantized
+    caches dequantize to fp32 HERE, inside the one shared core, so the
+    int8 serving path changes storage bytes, never program count.
+    seq_lens: [b] — attends cache positions <= seq_lens (the just-written
+    step token included).
     """
+    from ...quantization.serving import QuantizedKV, kv_dequantize
+    if isinstance(kc, QuantizedKV):
+        kc = kv_dequantize(kc)          # fp32: int8*scale is exact in fp32
+        vc = kv_dequantize(vc)
     b, _, h, d = q.shape
     kvh = kc.shape[2]
     S = kc.shape[1]
@@ -431,18 +439,34 @@ def paged_attention_decode(q, pool_k, pool_v, block_tables, seq_lens,
     same grouped-GQA core as the contiguous decode path, so both backends
     and both cache layouts agree.
     """
+    from ...quantization.serving import QuantizedKV
     b, _, h, d = q.shape
     nb, ps, kvh, _ = pool_k.shape
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    quant = isinstance(pool_k, QuantizedKV)
     if _flash_backend_ok():
         from ...ops.pallas.paged_attention import (paged_attention_tpu,
                                                    kernel_applicable)
-        if kernel_applicable(q.shape, pool_k.shape):
+        if kernel_applicable(q.shape, tuple(pool_k.shape)):
+            if quant:
+                return paged_attention_tpu(
+                    q, pool_k.q, pool_v.q, block_tables, seq_lens,
+                    scale=scale, k_scale=pool_k.scale,
+                    v_scale=pool_v.scale)
             return paged_attention_tpu(q, pool_k, pool_v, block_tables,
                                        seq_lens, scale=scale)
-    kg = pool_k[block_tables].reshape(b, -1, kvh, d)
-    vg = pool_v[block_tables].reshape(b, -1, kvh, d)
+    if quant:
+        # gather codes AND scales by table — the gathered cache is still
+        # int8 + scales; the shared core dequantizes it exactly like the
+        # kernel's page loop does
+        kg = QuantizedKV(pool_k.q[block_tables].reshape(b, -1, kvh, d),
+                         pool_k.scale[block_tables].reshape(b, -1, kvh))
+        vg = QuantizedKV(pool_v.q[block_tables].reshape(b, -1, kvh, d),
+                         pool_v.scale[block_tables].reshape(b, -1, kvh))
+    else:
+        kg = pool_k[block_tables].reshape(b, -1, kvh, d)
+        vg = pool_v[block_tables].reshape(b, -1, kvh, d)
     return _grouped_decode_attn(q, kg, vg, seq_lens, scale)
 
 

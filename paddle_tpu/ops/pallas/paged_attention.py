@@ -60,8 +60,15 @@ def kernel_applicable(q_shape, pool_shape) -> bool:
             and h % kvh == 0)
 
 
-def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
-                   acc_ref, m_ref, l_ref, *, page_size, n_pages, scale):
+def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, *rest,
+                   page_size, n_pages, scale, quant):
+    # quant mode rides two extra inputs (the per-row fp32 absmax scales,
+    # DMA'd by the SAME block-table index map as their pages) between the
+    # K/V refs and the output ref
+    if quant:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
     s = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -79,6 +86,11 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0, 0].astype(jnp.float32)            # [g, d]
         k = k_ref[0, :, 0, :].astype(jnp.float32)      # [page_size, d]
         v = v_ref[0, :, 0, :].astype(jnp.float32)
+        if quant:
+            # dequantize inside the page loop: int8 codes stream from
+            # HBM, the fp32 page materializes only in VMEM
+            k = k * ks_ref[0, :, 0][:, None]
+            v = v * vs_ref[0, :, 0][:, None]
         sc = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [g, page_size]
@@ -104,16 +116,25 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_attention_tpu(q, pool_k, pool_v, block_tables, seq_lens,
-                        scale: float | None = None):
+                        scale: float | None = None,
+                        k_scale=None, v_scale=None):
     """q: [b, 1, h, d]; pool_k/v: [num_pages, page_size, kvh, d];
     block_tables: [b, max_pages] int32; seq_lens: [b] int32 (attends
-    positions <= seq_lens). Returns [b, 1, h, d]."""
+    positions <= seq_lens). Returns [b, 1, h, d].
+
+    Int8 KV mode: pass the pools' int8 code arrays as pool_k/v plus
+    their fp32 absmax scales ``k_scale``/``v_scale``
+    [num_pages, page_size, kvh]; the scales ride the same block-table
+    index map as their pages and the dequant (codes * scale per row)
+    happens inside the page loop, in VMEM — HBM only ever streams int8
+    KV bytes."""
     b, s, h, d = q.shape
     _, ps, kvh, _ = pool_k.shape
     M = block_tables.shape[1]
     g = h // kvh
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    quant = k_scale is not None
     q4 = q.reshape(b, kvh, g, d)
     tables = jnp.asarray(block_tables, jnp.int32)
     lens = jnp.asarray(seq_lens, jnp.int32)
@@ -127,12 +148,27 @@ def paged_attention_tpu(q, pool_k, pool_v, block_tables, seq_lens,
         jj = jnp.minimum(j, lens_ref[s_] // ps)
         return (tables_ref[s_, jj], 0, n, 0)
 
+    def scale_index(s_, n, j, tables_ref, lens_ref):
+        jj = jnp.minimum(j, lens_ref[s_] // ps)
+        return (tables_ref[s_, jj], 0, n)
+
     kernel = functools.partial(_decode_kernel, page_size=ps, n_pages=M,
-                               scale=scale)
+                               scale=scale, quant=quant)
     grid = (b, kvh, M)
     if pltpu is None:  # pragma: no cover
         raise RuntimeError("pallas TPU support unavailable; use the XLA "
                            "gather path (nn.functional.paged_attention_decode)")
+    in_specs = [
+        pl.BlockSpec((1, 1, g, d), q_index),
+        pl.BlockSpec((1, ps, 1, d), kv_index),
+        pl.BlockSpec((1, ps, 1, d), kv_index),
+    ]
+    operands = [tables, lens, q4, pool_k, pool_v]
+    if quant:
+        in_specs += [pl.BlockSpec((1, ps, 1), scale_index),
+                     pl.BlockSpec((1, ps, 1), scale_index)]
+        operands += [jnp.asarray(k_scale, jnp.float32),
+                     jnp.asarray(v_scale, jnp.float32)]
     scratch = [pltpu.VMEM((g, d), jnp.float32),
                pltpu.VMEM((g, _LANES), jnp.float32),
                pltpu.VMEM((g, _LANES), jnp.float32)]
@@ -141,16 +177,12 @@ def paged_attention_tpu(q, pool_k, pool_v, block_tables, seq_lens,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, 1, g, d), q_index),
-                pl.BlockSpec((1, ps, 1, d), kv_index),
-                pl.BlockSpec((1, ps, 1, d), kv_index),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, 1, g, d), q_index),
             scratch_shapes=scratch),
         out_shape=jax.ShapeDtypeStruct((b, kvh, g, d), q.dtype),
         compiler_params=None if _interpret() else pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
         interpret=_interpret(),
-    )(tables, lens, q4, pool_k, pool_v)
+    )(*operands)
     return out.reshape(b, 1, h, d)

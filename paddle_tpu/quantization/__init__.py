@@ -20,7 +20,11 @@ from ..nn.module import Layer, Parameter
 __all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMaxObserver",
            "AbsmaxObserver", "GroupWiseWeightObserver", "quant_dequant",
            "quantize_weight", "QuantedLinear", "QuantedConv2D",
-           "QuantizedLinear", "QuantizedConv2D"]
+           "QuantizedLinear", "QuantizedConv2D",
+           # serving-time low-bit subsystem (.serving, re-exported below)
+           "QuantizedKV", "kv_quantize", "kv_dequantize",
+           "Int8ServingLinear", "quantize_for_serving",
+           "serving_state_bytes"]
 
 
 def quant_dequant(x, scale, bits: int = 8):
@@ -380,3 +384,10 @@ class PTQ:
                 group_size: int | None = None) -> Layer:
         return QAT(self.config).convert(model, inplace=inplace,
                                         group_size=group_size)
+
+
+# imported at the BOTTOM: serving.py needs quantize_weight/_dequantize_weight
+# from this module, so a top-of-file import would be circular
+from .serving import (Int8ServingLinear, QuantizedKV,  # noqa: E402
+                      kv_dequantize, kv_quantize, quantize_for_serving,
+                      serving_state_bytes)

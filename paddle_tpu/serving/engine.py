@@ -78,7 +78,8 @@ class ServingEngine:
                  step_timeout_s: float | None = None,
                  drain_timeout_s: float | None = 30.0,
                  watchdog=None, prefix_cache: bool = True,
-                 tracer=None, flight_recorder=None):
+                 tracer=None, flight_recorder=None,
+                 kv_quant: bool = False):
         cfg = model.config
         self.model = model
         self.page_size = page_size
@@ -87,10 +88,18 @@ class ServingEngine:
                                    if max_pages_per_slot is not None
                                    else (num_pages - 1))
         self.prefix_cache = prefix_cache
+        # int8 KV mode: kv_quant=True, or kv_dtype="int8"/jnp.int8 — the
+        # pool stores int8 codes + fp32 absmax scales, quantized at
+        # scatter time and dequantized inside the one shared decode core
+        # (quantization/serving.py; SERVING.md "Quantized KV & weights")
+        if kv_dtype is not None and jnp.dtype(kv_dtype) == jnp.int8:
+            kv_quant = True
+        self.kv_quant = kv_quant
         self.pool = KVCachePool.from_config(
             cfg, num_pages, page_size,
-            dtype=kv_dtype if kv_dtype is not None else jnp.bfloat16,
-            cache_enabled=prefix_cache)
+            dtype=(jnp.bfloat16 if kv_quant or kv_dtype is None
+                   else kv_dtype),
+            cache_enabled=prefix_cache, quantized=kv_quant)
         # the prefill gather window: every prefill program reads the
         # request's cached-prefix pages through a fixed-length gather of
         # _ctx_pages pages (unused entries point at scratch page 0, all
@@ -104,6 +113,7 @@ class ServingEngine:
                                    max_queue_depth=max_queue_depth,
                                    max_preemptions=max_preemptions)
         self.metrics = ServingMetrics(clock)
+        self.metrics.set_kv_quant(kv_quant)
         # observability (OBSERVABILITY.md): the tracer is shared with
         # the scheduler (request-lifecycle spans) and the pool
         # (eviction/COW/quarantine events); construct it on the same
@@ -378,6 +388,7 @@ class ServingEngine:
                 "decode_programs": self.decode_program_count(),
                 "prefill_programs": len(self._prefill_progs),
                 "prefix_cache": self.prefix_cache,
+                "kv_quant": self.kv_quant,
                 "tracing": self.tracer.enabled}
 
     # ------------------------------------------------------------------
@@ -471,7 +482,17 @@ class ServingEngine:
             return
         page = req.pages[-1]
         pk, pv = self.pool.pools[0]
-        self.pool.pools[0] = (pk.at[page].set(jnp.nan), pv)
+        from ..quantization.serving import QuantizedKV
+        if isinstance(pk, QuantizedKV):
+            # int8 codes cannot hold a NaN — poison the page's fp32
+            # SCALE row instead: NaN * code propagates through the
+            # dequant into the attention output exactly like a poisoned
+            # fp page (and the quarantine scrub must therefore zero
+            # scales as well as codes — tested in test_serving_quant)
+            self.pool.pools[0] = (
+                QuantizedKV(pk.q, pk.scale.at[page].set(jnp.nan)), pv)
+        else:
+            self.pool.pools[0] = (pk.at[page].set(jnp.nan), pv)
 
     # ------------------------------------------------------------------
     # compiled programs
@@ -528,24 +549,50 @@ class ServingEngine:
         self.tracer.bump("compiles")
         self.tracer.bump("prefill_programs")
         from ..nn.module import functional_call
+        from ..quantization.serving import QuantizedKV
         model = self.model
         ps = self.page_size
         CTX = self._ctx_pages * ps
         n_buf_pages = self._ctx_pages + L // ps
+        quant = self.kv_quant
+
+        def _gather(arr, gather_pages):
+            """Pool pages -> contiguous [1, CTX(+L)] cache prefix; a
+            quantized pool gathers codes AND scales (the temp cache stays
+            int8 — the model's prefill branch writes quantized tokens
+            into it and the scatter moves raw codes+scales back, so the
+            pool bytes match what a decode append would have written)."""
+            if quant:
+                kvh, d = arr.q.shape[2], arr.q.shape[3]
+                return QuantizedKV(
+                    jnp.concatenate(
+                        [arr.q[gather_pages].reshape(1, CTX, kvh, d),
+                         jnp.zeros((1, L, kvh, d), jnp.int8)], axis=1),
+                    jnp.concatenate(
+                        [arr.scale[gather_pages].reshape(1, CTX, kvh),
+                         jnp.zeros((1, L, kvh), jnp.float32)], axis=1))
+            kvh, d = arr.shape[2], arr.shape[3]
+            return jnp.concatenate(
+                [arr[gather_pages].reshape(1, CTX, kvh, d),
+                 jnp.zeros((1, L, kvh, d), arr.dtype)], axis=1)
+
+        def _scatter(pool_arr, cache_arr, scatter_pages):
+            if quant:
+                kvh, d = cache_arr.q.shape[2], cache_arr.q.shape[3]
+                return QuantizedKV(
+                    pool_arr.q.at[scatter_pages].set(
+                        cache_arr.q[0].reshape(n_buf_pages, ps, kvh, d)),
+                    pool_arr.scale.at[scatter_pages].set(
+                        cache_arr.scale[0].reshape(n_buf_pages, ps, kvh)))
+            kvh, d = cache_arr.shape[2], cache_arr.shape[3]
+            return pool_arr.at[scatter_pages].set(
+                cache_arr[0].reshape(n_buf_pages, ps, kvh, d))
 
         @jax.jit
         def prefill(state, ids, n_sfx, start_pos, gather_pages,
                     scatter_pages, pools, temp, top_p, greedy, seed):
-            caches = []
-            for pk, pv in pools:
-                kvh, d = pk.shape[2], pk.shape[3]
-                ck = jnp.concatenate(
-                    [pk[gather_pages].reshape(1, CTX, kvh, d),
-                     jnp.zeros((1, L, kvh, d), pk.dtype)], axis=1)
-                cv = jnp.concatenate(
-                    [pv[gather_pages].reshape(1, CTX, kvh, d),
-                     jnp.zeros((1, L, kvh, d), pv.dtype)], axis=1)
-                caches.append((ck, cv))
+            caches = [( _gather(pk, gather_pages), _gather(pv, gather_pages))
+                      for pk, pv in pools]
             (logits, caches), _ = functional_call(
                 model, state, ids, None, caches, start_pos,
                 training=False)
@@ -556,14 +603,19 @@ class ServingEngine:
                                greedy[None], seed[None],
                                jnp.zeros((1,), jnp.int32))[0]
             new_pools = []
+            qscale_max = jnp.float32(0.0)
             for (ck, cv), (pk, pv) in zip(caches, pools):
-                kvh, d = ck.shape[2], ck.shape[3]
-                pk = pk.at[scatter_pages].set(
-                    ck[0].reshape(n_buf_pages, ps, kvh, d))
-                pv = pv.at[scatter_pages].set(
-                    cv[0].reshape(n_buf_pages, ps, kvh, d))
-                new_pools.append((pk, pv))
-            return tok, ok, new_pools
+                new_pools.append((_scatter(pk, ck, scatter_pages),
+                                  _scatter(pv, cv, scatter_pages)))
+                if quant:
+                    # quant error-stat: the largest absmax scale over the
+                    # request's materialized context (per-element error
+                    # is bounded by scale/2 — metrics gauge + trace
+                    # instant in _run_prefill)
+                    qscale_max = jnp.maximum(
+                        qscale_max, jnp.maximum(jnp.max(ck.scale),
+                                                jnp.max(cv.scale)))
+            return tok, ok, qscale_max, new_pools
 
         self._prefill_progs[L] = prefill
         return prefill
@@ -606,13 +658,20 @@ class ServingEngine:
         sp = req.sampling
         with tr.span("prefill", track=req.rid, cached=cached,
                      suffix=n_sfx, bucket=L):
-            tok, ok, new_pools = self._prefill_prog(L)(
+            tok, ok, qs_max, new_pools = self._prefill_prog(L)(
                 self._state, jnp.asarray(ids), jnp.int32(n_sfx),
                 jnp.int32(cached), jnp.asarray(gather),
                 jnp.asarray(scatter), self.pool.pools,
                 jnp.float32(sp.temperature), jnp.float32(sp.top_p),
                 jnp.asarray(not sp.do_sample), jnp.int32(sp.seed))
         self.pool.pools = new_pools
+        if self.kv_quant:
+            # quantize-at-scatter observability: error-stat gauge (per-
+            # element error <= scale/2) + one trace instant per prefill
+            qs = float(qs_max)
+            self.metrics.on_kv_quant_scale(qs)
+            tr.instant("kv_quantize", track=req.rid,
+                       scale_max=round(qs, 6), suffix=n_sfx)
         if _fault.active_plan() is not None:
             try:
                 _fault.trip("serving.prefill", step=self._steps,

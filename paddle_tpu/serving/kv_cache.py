@@ -52,12 +52,38 @@ from dataclasses import dataclass, field
 import jax.numpy as jnp
 
 from ..observability.trace import NULL_TRACER
+from ..quantization.serving import QuantizedKV
 from .errors import ServingError
 
 __all__ = ["KVCachePool", "PoolExhaustedError", "PrefixMatch"]
 
-# chain root for the page-content hash (the "parent" of the first page)
+# chain root for the page-content hash (the "parent" of the first page).
+# A quantized pool chains from a DIFFERENT root (the mode tag hashed in),
+# so an fp-cache hash and an int8-cache hash of the same tokens can never
+# alias: the hash names the page *content* (KV bytes + scales), and the
+# same tokens produce different content under the two storage formats.
 _HASH_ROOT = b"\x00" * 16
+_HASH_ROOT_INT8 = hashlib.blake2b(b"paddle_tpu.kv.int8",
+                                  digest_size=16).digest()
+
+
+def _page_copy(arr, src: int, dst: int):
+    """Device-copy one page; a QuantizedKV page carries its scale row
+    along with the int8 codes (COW without the scales would dequantize
+    the copy with garbage)."""
+    if isinstance(arr, QuantizedKV):
+        return QuantizedKV(arr.q.at[dst].set(arr.q[src]),
+                           arr.scale.at[dst].set(arr.scale[src]))
+    return arr.at[dst].set(arr[src])
+
+
+def _page_zero(arr, idx):
+    """Zero pages; a QuantizedKV page zeroes codes AND scales — a scrub
+    that left a poisoned (NaN) scale row behind would re-poison the next
+    tenant on its first dequantized read."""
+    if isinstance(arr, QuantizedKV):
+        return QuantizedKV(arr.q.at[idx].set(0), arr.scale.at[idx].set(0))
+    return arr.at[idx].set(0)
 
 
 def _page_hash(parent: bytes, tokens) -> bytes:
@@ -94,7 +120,7 @@ class PrefixMatch:
 class KVCachePool:
     def __init__(self, num_layers: int, num_pages: int, page_size: int,
                  num_kv_heads: int, head_dim: int, dtype=jnp.bfloat16,
-                 cache_enabled: bool = True):
+                 cache_enabled: bool = True, quantized: bool = False):
         if num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is the "
                              "reserved scratch page)")
@@ -103,12 +129,25 @@ class KVCachePool:
         self.page_size = page_size
         self.num_kv_heads = num_kv_heads
         self.head_dim = head_dim
-        self.dtype = dtype
+        self.quantized = quantized
+        self.dtype = jnp.int8 if quantized else dtype
         shape = (num_pages, page_size, num_kv_heads, head_dim)
         # per-layer (pool_k, pool_v); functionally replaced by the compiled
-        # programs each step, so the handles here always name the latest
-        self.pools = [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
-                      for _ in range(num_layers)]
+        # programs each step, so the handles here always name the latest.
+        # Quantized mode stores int8 codes + one fp32 absmax scale per
+        # [page, slot, kv_head] row (see quantization/serving.py).
+        if quantized:
+            def _zeros():
+                return QuantizedKV(
+                    jnp.zeros(shape, jnp.int8),
+                    jnp.zeros(shape[:3], jnp.float32))
+            self.pools = [(_zeros(), _zeros()) for _ in range(num_layers)]
+        else:
+            self.pools = [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                          for _ in range(num_layers)]
+        # fp and int8 caches chain their content hashes from different
+        # roots — same tokens, different page content, never aliased
+        self._hash_root = _HASH_ROOT_INT8 if quantized else _HASH_ROOT
         # LIFO free list, page 0 reserved (scratch)
         self._free = list(range(num_pages - 1, 0, -1))
         self._peak_in_use = 0
@@ -137,13 +176,13 @@ class KVCachePool:
 
     @classmethod
     def from_config(cls, config, num_pages: int, page_size: int,
-                    dtype=jnp.bfloat16, cache_enabled: bool = True
-                    ) -> "KVCachePool":
+                    dtype=jnp.bfloat16, cache_enabled: bool = True,
+                    quantized: bool = False) -> "KVCachePool":
         """Build from a model config carrying num_hidden_layers /
         num_key_value_heads / head_dim (LlamaConfig shape)."""
         return cls(config.num_hidden_layers, num_pages, page_size,
                    config.num_key_value_heads, config.head_dim, dtype,
-                   cache_enabled=cache_enabled)
+                   cache_enabled=cache_enabled, quantized=quantized)
 
     # ---- accounting ----
 
@@ -179,6 +218,18 @@ class KVCachePool:
         """Pages needed to hold n_tokens cache positions."""
         return max(1, math.ceil(n_tokens / self.page_size))
 
+    def kv_bytes_per_token(self) -> int:
+        """HBM bytes ONE cached token position costs across all layers
+        (K+V): the per-token KV traffic unit the int8 bench configs score
+        MBU against. Quantized: 1 byte/element of codes plus the fp32
+        scale per kv-head row; fp: itemsize bytes/element."""
+        kvh, d = self.num_kv_heads, self.head_dim
+        if self.quantized:
+            per = kvh * d * 1 + kvh * 4   # int8 codes + fp32 scale row
+        else:
+            per = kvh * d * jnp.dtype(self.dtype).itemsize
+        return 2 * self.num_layers * per
+
     def stats(self) -> dict:
         return {"num_pages": self.num_pages, "page_size": self.page_size,
                 "capacity": self.capacity, "in_use": self.num_in_use,
@@ -186,6 +237,7 @@ class KVCachePool:
                 "free": self.num_free, "utilization": self.utilization(),
                 "peak_in_use": self._peak_in_use,
                 "indexed_pages": len(self._page_key),
+                "kv_quant": int(self.quantized),
                 **self.counters}
 
     # ---- alloc / free ----
@@ -332,7 +384,7 @@ class KVCachePool:
         if not self.cache_enabled or limit <= 0:
             return m
         ps = self.page_size
-        parent = _HASH_ROOT
+        parent = self._hash_root
         pos = 0
         while pos + ps <= limit:
             key = _page_hash(parent, tokens[pos:pos + ps])
@@ -376,7 +428,7 @@ class KVCachePool:
             return 0
         ps = self.page_size
         n_full = min(len(tokens) // ps, len(pages))
-        parent = _HASH_ROOT
+        parent = self._hash_root
         registered = 0
         for i in range(n_full):
             key = _page_hash(parent, tokens[i * ps:(i + 1) * ps])
@@ -416,7 +468,7 @@ class KVCachePool:
         """Copy-on-write materialization: device-copy page ``src`` into
         the freshly-allocated page ``dst``. The cached source is never
         written in place — the hitter extends its own copy."""
-        self.pools = [(pk.at[dst].set(pk[src]), pv.at[dst].set(pv[src]))
+        self.pools = [(_page_copy(pk, src, dst), _page_copy(pv, src, dst))
                       for pk, pv in self.pools]
         self.counters["prefix_cow_copies"] += 1
         self.tracer.instant("cow_copy", track="pool", src=src, dst=dst)
@@ -427,5 +479,5 @@ class KVCachePool:
         if not pages:
             return
         idx = jnp.asarray(sorted(set(pages)), jnp.int32)
-        self.pools = [(pk.at[idx].set(0), pv.at[idx].set(0))
+        self.pools = [(_page_zero(pk, idx), _page_zero(pv, idx))
                       for pk, pv in self.pools]

@@ -79,6 +79,12 @@ class ServingMetrics:
         self._prefill_tokens = 0
         self._prefill_cached_tokens = 0
         self._prefix_counters: dict[str, int] = {}
+        # int8 KV-cache quantization (SERVING.md "Quantized KV & weights"):
+        # the flag gauge plus a running max over per-prefill absmax scales —
+        # scale_max/2 bounds the worst-case dequant error of any cached
+        # element, the number an operator alerts on
+        self.kv_quant_enabled = 0
+        self.kv_quant_scale_max = 0.0
 
     def now(self) -> float:
         return self._clock()
@@ -189,6 +195,18 @@ class ServingMetrics:
             good += 1
         return good / wall
 
+    # ---- int8 KV quantization (SERVING.md "Quantized KV & weights") ----
+
+    def set_kv_quant(self, enabled: bool) -> None:
+        """Arm the kv_quant_enabled gauge (int, so Prometheus export —
+        which skips non-numeric values — renders it)."""
+        self.kv_quant_enabled = int(bool(enabled))
+
+    def on_kv_quant_scale(self, scale_max: float) -> None:
+        """Fold one prefill's max absmax scale into the running max."""
+        self.kv_quant_scale_max = max(self.kv_quant_scale_max,
+                                      float(scale_max))
+
     def cache_hit_rate(self) -> float:
         """Fraction of prefill context tokens served from cached pages."""
         if self._prefill_tokens == 0:
@@ -251,6 +269,12 @@ class ServingMetrics:
             "prefill_cached_tokens": self._prefill_cached_tokens,
             "goodput_at_slo": self.goodput_at_slo(self.slo_ttft_s,
                                                   self.slo_itl_s),
+            # always present (schema-stable for Prometheus scrapers);
+            # err_bound = scale_max/2 is the worst-case |dequant - true|
+            # of any element in the int8 cache
+            "kv_quant_enabled": self.kv_quant_enabled,
+            "kv_quant_scale_max": self.kv_quant_scale_max,
+            "kv_quant_err_bound": self.kv_quant_scale_max / 2.0,
             # pool counters live under prefix_* so they can never
             # shadow a summary key (the pool already uses that prefix
             # for most of them — normalise the stragglers)

@@ -14,7 +14,7 @@ _REPO = Path(__file__).resolve().parents[1]
 _DEFAULT_CONFIGS = {
     "llama_420m", "resnet50", "bert_base", "qwen2_moe", "lenet_mnist",
     "llama8b_shape", "llama_decode", "llama_longctx", "llama_serving",
-    "llama_serving_prefix",
+    "llama_serving_prefix", "llama_decode_int8", "llama_serving_int8",
 }
 
 
@@ -100,6 +100,25 @@ def test_dry_serving_prefix_cell_carries_cache_keys():
                          "prefix_evictions",
                          "goodput_at_slo", "retraces"}, cell
     assert all(v is None for v in cell.values()), cell
+
+
+def test_dry_int8_cells_carry_quant_keys():
+    # the quantized-serving arms (SERVING.md "Quantized KV & weights"):
+    # the decode cell reports the bytes ratio vs bf16, the serving cell
+    # additionally the quantization error bound gauge
+    out = _run_dry("llama_decode_int8", "llama_serving_int8")
+    assert out.returncode == 0, out.stderr
+    last = json.loads(out.stdout.splitlines()[-1])
+    dec = last["bench_summary"]["llama_decode_int8"]
+    assert set(dec) >= {"value", "mfu", "spread",
+                        "bytes_ratio_vs_bf16"}, dec
+    srv = last["bench_summary"]["llama_serving_int8"]
+    assert set(srv) >= {"value", "mfu", "spread",
+                        "ttft_p50", "ttft_p99", "tpot",
+                        "rejected", "timed_out", "quarantined",
+                        "goodput_at_slo", "retraces",
+                        "kv_quant_err_bound", "bytes_ratio_vs_bf16"}, srv
+    assert all(v is None for v in srv.values()), srv
 
 
 def test_dry_trace_flag_path_not_eaten_as_config_name():
