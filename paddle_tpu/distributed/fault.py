@@ -23,7 +23,15 @@ Named sites wired into the runtime (see RESILIENCE.md):
   ``serving.alloc`` — the serving engine's per-step, per-request and
   page-allocation sites (SERVING.md "Serving failure modes"); the
   per-request sites pass the request id as ``ctx['path']`` so ``match``
-  pins a fault to ONE request.
+  pins a fault to ONE request (``serving.alloc`` passes the fleet
+  replica index when a router owns the pool, so ``match`` can pin an
+  alloc storm to one replica).
+- ``fleet.dispatch`` / ``fleet.replica_kill`` / ``fleet.health`` — the
+  serving fleet router's placement, replica-life and health-probe sites
+  (SERVING.md "Engine fleet & failover"). ``ctx['path']`` is the request
+  id for ``fleet.dispatch`` and the replica index for the other two, so
+  ``match=r"^1$"`` chaos-kills exactly replica 1; ``step`` is the
+  router's step counter.
 
 Actions: ``hang`` (sleep ``arg`` seconds — trips the comm watchdog),
 ``kill`` (SIGKILL self: the un-catchable death), ``exit`` (``os._exit(arg)``),

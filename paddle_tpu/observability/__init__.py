@@ -24,10 +24,10 @@ engine (OBSERVABILITY.md):
 """
 
 from .export import (MetricsServer, goodput_at_slo, parse_prometheus,
-                     render_prometheus)
+                     render_fleet_prometheus, render_prometheus)
 from .recorder import FlightRecorder
 from .trace import NULL_TRACER, Tracer
 
 __all__ = ["Tracer", "NULL_TRACER", "FlightRecorder",
-           "render_prometheus", "parse_prometheus", "MetricsServer",
-           "goodput_at_slo"]
+           "render_prometheus", "render_fleet_prometheus",
+           "parse_prometheus", "MetricsServer", "goodput_at_slo"]

@@ -30,13 +30,16 @@ import json
 import re
 import threading
 
-__all__ = ["render_prometheus", "parse_prometheus", "MetricsServer",
-           "goodput_at_slo"]
+__all__ = ["render_prometheus", "render_fleet_prometheus",
+           "parse_prometheus", "MetricsServer", "goodput_at_slo"]
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
-# one sample line: metric_name value (no labels emitted by this renderer)
+# one sample line: metric_name[{label="value",...}] value — the optional
+# label block is what the fleet renderer uses for its ``replica`` label
 _SAMPLE_RE = re.compile(
     r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(?:\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"\\]*\""
+    r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"\\]*\")*\})?"
     r" (?:[+-]?(?:\d+\.?\d*(?:[eE][+-]?\d+)?|inf|nan))$")
 
 
@@ -77,17 +80,79 @@ def render_prometheus(summary: dict | None = None,
     return "\n".join(lines) + "\n"
 
 
+def render_fleet_prometheus(router) -> str:
+    """Prometheus text for a ``serving.fleet.FleetRouter``:
+
+    - fleet-wide gauges/counters — ``paddle_serving_fleet_<key>`` from
+      ``router.stats()`` (replicas_live/ejected, queue depth) and
+      ``paddle_serving_fleet_<key>_total`` from the
+      :class:`FleetMetrics` counter bag (failovers, replayed tokens,
+      shed, breaker opens);
+    - per-replica series carrying a ``replica`` label —
+      ``paddle_serving_fleet_replica_*{replica="i"}`` from each
+      replica's ``health()`` view (up/ready/live flags, queue depth,
+      pool utilization);
+    - the router's client-visible latency summary as plain
+      ``paddle_serving_*`` gauges (the fleet IS the serving endpoint —
+      scrapers keep their single-engine dashboards).
+
+    Everything here round-trips through :func:`parse_prometheus`, which
+    keeps the label block in the key."""
+    stats = router.stats()
+    lines: list[str] = []
+    typed: set[str] = set()   # one # TYPE line per metric NAME, not series
+
+    def emit(name: str, value, mtype: str = "gauge", labels: str = ""):
+        if isinstance(value, bool):
+            value = int(value)
+        if not isinstance(value, (int, float)):
+            return
+        if name not in typed:
+            typed.add(name)
+            lines.append(f"# TYPE {name} {mtype}")
+        lines.append(f"{name}{labels} {_fmt(value)}")
+
+    for key in ("replicas", "replicas_live", "replicas_ejected",
+                "queue_depth", "requests", "steps"):
+        emit(f"paddle_serving_fleet_{key}", stats[key])
+    for key, value in sorted(stats["fleet"].items()):
+        emit(f"paddle_serving_fleet_{_NAME_RE.sub('_', key)}_total",
+             value, "counter")
+    for health in stats["replica_health"]:
+        labels = '{replica="%d"}' % health["replica"]
+        emit("paddle_serving_fleet_replica_up",
+             health["state"] != "dead", labels=labels)
+        for key in ("ready", "live", "queue_depth", "running",
+                    "pool_utilization", "consecutive_failures",
+                    "breaker_opens", "backoff_remaining"):
+            emit(f"paddle_serving_fleet_replica_{key}", health[key],
+                 labels=labels)
+    # the client-visible stream summary, unlabeled — same names a
+    # single-engine scrape produces
+    for key in sorted(summary := router.metrics.summary()):
+        value = summary[key]
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            continue
+        name = _metric_name("paddle_serving_", key)
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {_fmt(value)}")
+    return "\n".join(lines) + "\n"
+
+
 def parse_prometheus(text: str) -> dict[str, float]:
     """Strict check of a text-format page (tests + the /metrics smoke):
     every non-comment line must be a well-formed sample. Returns
-    {metric_name: value}; raises ValueError on a malformed line."""
+    {metric_name: value}, where a labeled sample keeps its label block
+    in the key verbatim (``paddle_serving_fleet_replica_up{replica="0"}``)
+    so per-replica series stay distinct; raises ValueError on a
+    malformed line."""
     out: dict[str, float] = {}
     for ln in text.splitlines():
         if not ln.strip() or ln.startswith("#"):
             continue
         if not _SAMPLE_RE.match(ln):
             raise ValueError(f"malformed Prometheus sample: {ln!r}")
-        name, value = ln.split(" ", 1)
+        name, value = ln.rsplit(" ", 1)
         out[name] = float(value)
     return out
 

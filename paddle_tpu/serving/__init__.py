@@ -11,22 +11,30 @@ See SERVING.md for the design and the determinism contract.
     rid = eng.add_request(prompt_ids, max_new_tokens=32, eos_token_id=2)
     for ev in eng.stream():
         print(ev["rid"], ev["token"])
+
+For fault tolerance, N replicas go behind a :class:`FleetRouter`
+(fleet.py — SERVING.md "Engine fleet & failover"): health-checked
+least-loaded routing with prefix-cache affinity, circuit-broken
+placement, and deterministic failover replay with exactly-once client
+streams.
 """
 
 from .engine import ServingEngine
-from .errors import (EngineDrainingError, QueueFullError,
-                     RequestTooLargeError, SchedulerStalledError,
-                     ServingError)
+from .errors import (EngineDrainingError, FleetOverloadedError,
+                     QueueFullError, RequestTooLargeError,
+                     SchedulerStalledError, ServingError)
+from .fleet import FleetRequest, FleetRouter
 from .kv_cache import KVCachePool, PoolExhaustedError, PrefixMatch
-from .metrics import ServingMetrics, percentile
+from .metrics import FleetMetrics, ServingMetrics, percentile
 from .scheduler import (FINISHED, PREEMPTED, RUNNING, WAITING, Request,
                         SamplingParams, Scheduler)
 
 __all__ = [
     "ServingEngine", "KVCachePool", "PoolExhaustedError", "PrefixMatch",
-    "ServingMetrics",
+    "ServingMetrics", "FleetMetrics",
+    "FleetRouter", "FleetRequest",
     "percentile", "Request", "SamplingParams", "Scheduler",
     "WAITING", "RUNNING", "PREEMPTED", "FINISHED",
     "ServingError", "QueueFullError", "RequestTooLargeError",
-    "SchedulerStalledError", "EngineDrainingError",
+    "SchedulerStalledError", "EngineDrainingError", "FleetOverloadedError",
 ]

@@ -156,34 +156,28 @@ class ServingEngine:
         """Admission control happens HERE, not in the scheduler loop:
         a request that can never run raises RequestTooLargeError, a full
         bounded queue raises QueueFullError, a draining engine raises
-        EngineDrainingError — all typed (errors.py), all counted
-        (metrics.counters). ``deadline_s`` / ``max_queue_wait_s`` are
-        budgets from arrival on the metrics clock, enforced at step
-        boundaries with ``finish_reason="timeout"``."""
+        EngineDrainingError — all typed (errors.py, each carrying a
+        machine-readable ``retryable`` flag), all counted
+        (metrics.counters). Callers holding a retryable rejection don't
+        have to implement the retry themselves: a
+        ``serving.fleet.FleetRouter`` front-end routes around full and
+        draining replicas automatically (SERVING.md "Engine fleet &
+        failover"). ``deadline_s`` / ``max_queue_wait_s`` are budgets
+        from arrival on the metrics clock, enforced at step boundaries
+        with ``finish_reason="timeout"``."""
         if self._draining:
             raise EngineDrainingError(
-                "engine is draining (preempted or shut down); "
-                "retry on another replica")
+                "engine is draining (preempted or shut down); retry on "
+                "another replica (serving.fleet.FleetRouter skips "
+                "draining replicas at placement time)")
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("prompt must be non-empty")
-        total = len(prompt) + max_new_tokens
-        need = self.pool.pages_for(total)
-        if need > self.max_pages_per_slot:
+        try:
+            self.admission_check(len(prompt), max_new_tokens)
+        except RequestTooLargeError:
             self.metrics.on_reject("too_large")
-            raise RequestTooLargeError(
-                f"request needs {need} pages "
-                f"(max_pages_per_slot={self.max_pages_per_slot})")
-        # any (re-)admission prefill must fit the gather window: the
-        # longest possible recompute is prompt + max_new - 1 tokens
-        ctx = self._ctx_pages * self.page_size
-        if total - 1 > ctx:
-            self.metrics.on_reject("too_large")
-            raise RequestTooLargeError(
-                f"request context ({total} tokens) exceeds the prefill "
-                f"window of {ctx} tokens ({self._ctx_pages} pages; "
-                f"bounded by max_position_embeddings and "
-                f"max_pages_per_slot)")
+            raise
         rid = rid if rid is not None else f"req-{next(self._rid_counter)}"
         if rid in self._requests:
             raise ValueError(f"duplicate request id {rid!r}")
@@ -204,6 +198,30 @@ class ServingEngine:
         self._requests[rid] = req
         self.metrics.on_arrival(rid)
         return rid
+
+    def admission_check(self, prompt_len: int, max_new_tokens: int) -> None:
+        """Raise RequestTooLargeError if a request of this geometry can
+        NEVER run here, regardless of current load. Pure — no counters,
+        no state: ``add_request`` wraps it with the reject accounting,
+        and ``serving.fleet.FleetRouter`` calls it at submit time so an
+        impossible request is refused fleet-wide before it occupies
+        queue space anywhere (homogeneous replicas all reject it
+        identically, hence ``RequestTooLargeError.retryable = False``)."""
+        total = prompt_len + max_new_tokens
+        need = self.pool.pages_for(total)
+        if need > self.max_pages_per_slot:
+            raise RequestTooLargeError(
+                f"request needs {need} pages "
+                f"(max_pages_per_slot={self.max_pages_per_slot})")
+        # any (re-)admission prefill must fit the gather window: the
+        # longest possible recompute is prompt + max_new - 1 tokens
+        ctx = self._ctx_pages * self.page_size
+        if total - 1 > ctx:
+            raise RequestTooLargeError(
+                f"request context ({total} tokens) exceeds the prefill "
+                f"window of {ctx} tokens ({self._ctx_pages} pages; "
+                f"bounded by max_position_embeddings and "
+                f"max_pages_per_slot)")
 
     def step(self) -> list[dict]:
         """One scheduling iteration: expire deadlines, admit + prefill

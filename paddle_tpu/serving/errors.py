@@ -7,44 +7,83 @@ fails with a bare RuntimeError or, worse, a silent busy loop: callers
 can catch :class:`ServingError` and know they have seen every
 engine-originated failure.
 
+Every subclass carries a machine-readable ``retryable`` class attribute:
+``True`` means the same request, submitted unchanged to a *different*
+replica (or to the same engine later), can succeed — exactly the
+decision a router front-end has to make per error. The in-process
+router that acts on it is :class:`paddle_tpu.serving.fleet.FleetRouter`
+(SERVING.md "Engine fleet & failover").
+
 - :class:`QueueFullError` — backpressure: ``add_request`` refused
-  because the bounded waiting queue is at ``max_queue_depth``. The
-  caller should shed load or retry elsewhere.
+  because the bounded waiting queue is at ``max_queue_depth``.
+  ``retryable``: the request is fine, this replica is busy — the fleet
+  router retries it on a less-loaded replica (or sheds fleet-wide with
+  :class:`FleetOverloadedError` when every replica is saturated).
 - :class:`RequestTooLargeError` — the request could NEVER run: its
   prompt + decode budget needs more KV pages than the pool (or a slot)
   has. Rejected at add time — previously such a request silently spun
-  in ``admit()`` forever.
+  in ``admit()`` forever. NOT retryable: every homogeneous replica
+  would reject it identically.
 - :class:`SchedulerStalledError` — the engine detected a zero-progress
   step (nothing admitted, nothing decoded, work still pending) and
   refuses to busy-loop. Carries a ``snapshot`` dict of the queue/pool
-  state for the post-mortem.
+  state for the post-mortem. ``retryable`` — but only on ANOTHER
+  replica: this engine's state cannot change on its own, so the fleet
+  router ejects the replica and replays its in-flight requests
+  elsewhere (deterministic replay, SERVING.md).
 - :class:`EngineDrainingError` — ``add_request`` after ``drain()``
-  began: the engine is shutting down, retry on another replica.
+  began: the engine is shutting down; the fleet router routes around a
+  draining replica automatically.
+- :class:`FleetOverloadedError` — fleet-wide load shedding: the
+  router's global queue is at capacity, meaning EVERY replica is
+  saturated *and* the shared backlog is full. Retryable after backoff
+  (clients should retry with jitter), but there is no other replica to
+  try — this is the signal to scale out.
 """
 
 from __future__ import annotations
 
 __all__ = ["ServingError", "QueueFullError", "RequestTooLargeError",
-           "SchedulerStalledError", "EngineDrainingError"]
+           "SchedulerStalledError", "EngineDrainingError",
+           "FleetOverloadedError"]
 
 
 class ServingError(RuntimeError):
-    """Base of every typed serving failure."""
+    """Base of every typed serving failure.
+
+    ``retryable`` (class attribute, machine-readable): whether the SAME
+    request can succeed if resubmitted — to another replica for
+    engine-scoped failures, or after backoff for load shedding. The
+    conservative base default is False; each subclass states its own.
+    """
+
+    retryable: bool = False
 
 
 class QueueFullError(ServingError):
-    """Bounded-queue backpressure: the waiting queue is at capacity."""
+    """Bounded-queue backpressure: the waiting queue is at capacity.
+    Retryable on another replica — ``fleet.FleetRouter`` does exactly
+    that (least-loaded placement) instead of bouncing the client."""
+
+    retryable = True
 
 
 class RequestTooLargeError(ServingError, ValueError):
     """The request can never fit (prompt+decode pages exceed the pool
-    or the per-slot table) — rejected at ``add`` instead of spinning."""
+    or the per-slot table) — rejected at ``add`` instead of spinning.
+    Not retryable: homogeneous replicas all reject it identically."""
+
+    retryable = False
 
 
 class SchedulerStalledError(ServingError):
     """A zero-progress engine step: work is pending but nothing can be
     admitted or decoded, and the state cannot change on its own.
-    ``snapshot`` holds the queue/pool evidence."""
+    ``snapshot`` holds the queue/pool evidence. Retryable — on ANOTHER
+    replica: the fleet router ejects the stalled engine and replays its
+    in-flight requests deterministically elsewhere."""
+
+    retryable = True  # on another replica, never on this one
 
     def __init__(self, msg: str, snapshot: dict | None = None):
         super().__init__(msg)
@@ -52,4 +91,19 @@ class SchedulerStalledError(ServingError):
 
 
 class EngineDrainingError(ServingError):
-    """``add_request`` called after ``drain()``: admission is closed."""
+    """``add_request`` called after ``drain()``: admission is closed.
+    Retryable on another replica — the fleet router skips draining
+    replicas at placement time."""
+
+    retryable = True
+
+
+class FleetOverloadedError(ServingError):
+    """Fleet-wide load shedding (``fleet.FleetRouter.submit``): the
+    router's global bounded queue is full, i.e. every healthy replica
+    is saturated and the shared backlog on top of them is too. The
+    request was not accepted anywhere. Retryable after client-side
+    backoff; sustained occurrence means the fleet needs more replicas,
+    not more retries."""
+
+    retryable = True

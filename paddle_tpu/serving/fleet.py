@@ -1,0 +1,722 @@
+"""Engine fleet: replicated serving with health-checked routing and
+deterministic failover replay (SERVING.md "Engine fleet & failover").
+
+``FleetRouter`` fronts N in-process data-parallel :class:`ServingEngine`
+replicas (same model, same config — homogeneous) and owns the three
+things a single engine cannot:
+
+- **Admission.** One global bounded queue; when it is full ``submit``
+  sheds with :class:`FleetOverloadedError` (retryable after client
+  backoff). Requests the fleet could NEVER run are refused up front via
+  the engines' ``admission_check`` (homogeneous replicas all reject
+  identically, hence ``RequestTooLargeError.retryable = False``).
+  Placement is least-loaded with best-effort prefix-cache affinity: a
+  replica whose pool already holds the request's prompt prefix (the
+  content-hash index, ``pool.match_prefix``) wins over an idle cold one,
+  because the cached prefill is the cheaper admission.
+
+- **Health.** Per replica: *ready* = would accept a dispatch now (not
+  draining, queue below its bound, breaker not open); *live* = making
+  step progress. Transient dispatch/health failures feed a
+  consecutive-failure circuit breaker — at ``breaker_threshold`` the
+  replica goes OPEN and is skipped for placement for a bounded
+  exponential backoff (deterministic hash jitter, measured in router
+  steps — no wall-clock entropy), then HALF_OPEN where a single probe
+  dispatch decides: success closes the breaker, failure reopens it with
+  doubled backoff. The breaker gates NEW placements only; an OPEN
+  replica keeps stepping its in-flight work.
+
+- **Failover, exactly-once.** When a replica dies (chaos kill via the
+  ``fleet.replica_kill`` fault site, an unexpected exception), stalls
+  (:class:`SchedulerStalledError`) or drains, the router marks it DEAD,
+  dumps its flight recorder, and re-queues its in-flight requests for
+  placement on a healthy replica — same rid, same prompt, same seed.
+  Because the engine is bitwise deterministic (engine == ``generate()``
+  parity; per-slot sampling keyed ``fold_in(PRNGKey(seed), token_idx)``,
+  independent of slot placement and batch composition), the replay
+  reproduces the original token stream exactly. The router tracks per
+  request how many tokens the CLIENT has seen (``emitted``) versus how
+  many the current replica life has produced (``produced``, reset to 0
+  at each dispatch): replayed positions ``produced <= emitted`` are
+  verified bitwise against the delivered stream and suppressed, the
+  first fresh position is delivered — so every client sees each token
+  exactly once, and the whole stream equals a single-engine run
+  bit-for-bit. Replay is possible precisely because faults land at step
+  boundaries: a step either completes (its events were translated) or
+  raises (no events), so ``emitted`` can never include a half-delivered
+  step.
+
+The router never hangs: if every replica is DEAD (or zero placement
+progress persists past ``shed_patience`` router steps) the pending
+queue is shed with the classified terminal outcome
+``finish_reason="shed"`` rather than spinning. Fleet-wide SIGTERM drain
+composes with ``PreemptionGuard`` exactly like the single engine:
+``attach_preemption_guard`` + ``stream``/``run_to_completion`` notice
+the flag at a step boundary and ``drain()`` every replica, returning
+structured retry-elsewhere outcomes.
+
+Fault sites (RESILIENCE.md): ``fleet.dispatch`` (ctx path = rid),
+``fleet.replica_kill`` and ``fleet.health`` (ctx path = replica index,
+so ``match=r"^1$"`` chaos-kills exactly replica 1); the router also
+sets each pool's ``fault_path`` to the replica index so a
+``serving.alloc`` storm can be pinned to one replica.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from dataclasses import dataclass, field
+
+from ..distributed import fault as _fault
+from ..observability.trace import NULL_TRACER
+from .errors import (EngineDrainingError, FleetOverloadedError,
+                     RequestTooLargeError, SchedulerStalledError,
+                     ServingError)
+from .metrics import FleetMetrics, ServingMetrics
+from .scheduler import SamplingParams
+
+__all__ = ["FleetRouter", "FleetRequest",
+           "CLOSED", "OPEN", "HALF_OPEN", "DEAD"]
+
+# replica/breaker states
+CLOSED = "closed"          # healthy, accepts placements
+OPEN = "open"              # breaker open: no placements until backoff ends
+HALF_OPEN = "half_open"    # probing: one placement decides close/reopen
+DEAD = "dead"              # ejected (killed/stalled) — terminal
+
+_SHED_PATIENCE = 50        # zero-progress router steps before shedding
+
+
+@dataclass
+class FleetRequest:
+    """Router-side request record — the client's view of the stream.
+
+    ``tokens`` is the client-visible stream (exactly-once);
+    ``emitted`` == len(tokens) survives failover while ``produced``
+    counts the CURRENT replica life and resets to 0 at every dispatch,
+    which is what makes replay dedup a pair of integer compares."""
+    rid: str
+    prompt: list[int]
+    max_new_tokens: int
+    sampling: SamplingParams
+    eos_token_id: int | None
+    deadline_s: float | None
+    max_queue_wait_s: float | None
+    submit_seq: int
+    tokens: list[int] = field(default_factory=list)
+    emitted: int = 0           # tokens the client has seen (== len(tokens))
+    produced: int = 0          # tokens produced by the current replica life
+    finished: bool = False
+    finish_reason: str | None = None
+    replica: int | None = None  # current placement (None = router queue)
+    replays: int = 0            # failover re-dispatches
+
+
+@dataclass
+class _Replica:
+    idx: int
+    engine: object
+    state: str = CLOSED
+    consecutive_failures: int = 0
+    opens: int = 0              # times the breaker opened (backoff exponent)
+    backoff_until: int = 0      # router step when HALF_OPEN probing begins
+    last_progress_step: int = 0
+    dead_reason: str | None = None
+    dump_path: str | None = None
+
+
+class FleetRouter:
+    """Front-end over N homogeneous ``ServingEngine`` replicas.
+
+    The public surface mirrors the single engine on purpose —
+    ``submit`` (its ``add_request``), ``step``, ``stream``,
+    ``run_to_completion``, ``drain``, ``attach_preemption_guard``,
+    ``request``, ``stats`` — so a caller written against one engine
+    upgrades to a fleet by swapping the constructor. The router keeps
+    its OWN ``ServingMetrics`` fed only by client-delivered events, so
+    its TTFT/ITL/goodput are the honest client-visible numbers across
+    failovers (a replayed token that was suppressed never counts
+    twice); per-replica engine metrics stay on the engines.
+    """
+
+    def __init__(self, engines, max_queue_depth: int | None = None,
+                 breaker_threshold: int = 3,
+                 breaker_backoff_steps: int = 2,
+                 breaker_backoff_max: int = 16,
+                 shed_patience: int = _SHED_PATIENCE,
+                 clock=None, tracer=None):
+        if not engines:
+            raise ValueError("FleetRouter needs at least one engine")
+        self._replicas = [_Replica(i, e) for i, e in enumerate(engines)]
+        for rep in self._replicas:
+            pool = getattr(rep.engine, "pool", None)
+            if pool is not None:
+                # pin serving.alloc fault draws to this replica's index
+                pool.fault_path = str(rep.idx)
+        self.max_queue_depth = max_queue_depth
+        self.breaker_threshold = breaker_threshold
+        self.breaker_backoff_steps = breaker_backoff_steps
+        self.breaker_backoff_max = breaker_backoff_max
+        self.shed_patience = shed_patience
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.metrics = ServingMetrics(clock)     # client-visible stream
+        self.fleet_metrics = FleetMetrics()
+        self._records: dict[str, FleetRequest] = {}
+        self._pending: list[FleetRequest] = []   # router queue, submit order
+        self._submit_seq = 0
+        self._steps = 0
+        self._idle_steps = 0
+        self._draining = False
+        self._guard = None
+        self.last_drain_events: list[dict] = []
+
+    # ------------------------------------------------------------------
+    # admission
+    # ------------------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int,
+               sampling: SamplingParams | None = None,
+               eos_token_id: int | None = None,
+               rid: str | None = None,
+               deadline_s: float | None = None,
+               max_queue_wait_s: float | None = None) -> str:
+        """Fleet admission. A full global queue sheds with
+        :class:`FleetOverloadedError`; a request no replica could EVER
+        run raises :class:`RequestTooLargeError` here, before it
+        occupies queue space anywhere (homogeneous fleet — replica 0's
+        ``admission_check`` speaks for all). Placement happens at the
+        next ``step()``, not here: dispatch failures are the router's
+        to retry, never the client's."""
+        if self._draining:
+            raise EngineDrainingError(
+                "fleet is draining (preempted or shut down); "
+                "retry against another fleet")
+        if (self.max_queue_depth is not None
+                and len(self._pending) >= self.max_queue_depth):
+            self.fleet_metrics.bump("shed")
+            self.metrics.on_reject("queue_full")
+            raise FleetOverloadedError(
+                f"fleet queue at max_queue_depth={self.max_queue_depth}; "
+                f"request shed (every replica saturated — retry with "
+                f"backoff or scale out)")
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("prompt must be non-empty")
+        check = getattr(self._replicas[0].engine, "admission_check", None)
+        if check is not None:
+            try:
+                check(len(prompt), max_new_tokens)
+            except RequestTooLargeError:
+                self.metrics.on_reject("too_large")
+                raise
+        rid = rid if rid is not None else f"fleet-req-{self._submit_seq}"
+        if rid in self._records:
+            raise ValueError(f"duplicate request id {rid!r}")
+        rec = FleetRequest(rid=rid, prompt=prompt,
+                           max_new_tokens=max_new_tokens,
+                           sampling=sampling or SamplingParams(),
+                           eos_token_id=eos_token_id,
+                           deadline_s=deadline_s,
+                           max_queue_wait_s=max_queue_wait_s,
+                           submit_seq=self._submit_seq)
+        self._submit_seq += 1
+        self._records[rid] = rec
+        self._pending.append(rec)
+        self.metrics.on_arrival(rid)
+        self.tracer.instant("submit", track="fleet", rid=rid,
+                            queue=len(self._pending))
+        return rid
+
+    # ------------------------------------------------------------------
+    # stepping
+    # ------------------------------------------------------------------
+
+    def step(self) -> list[dict]:
+        """One router iteration: chaos/health sweep, placement of the
+        router queue, one engine step per live replica (ejecting and
+        failing over any that die or stall), and exactly-once
+        translation of their events into client events. Bounded work —
+        a replica that cannot accept work this step is retried next
+        step, never spun on."""
+        events: list[dict] = []
+        self._kill_sweep()
+        self._health_sweep()
+        self._dispatch(events)
+        progressed = bool(events)
+        for rep in list(self._replicas):
+            if rep.state == DEAD or not rep.engine.scheduler.has_work():
+                continue
+            try:
+                replica_events = rep.engine.step()
+            except SchedulerStalledError as e:
+                self._eject(rep, "stalled", snapshot=e.snapshot)
+                continue
+            except ServingError as e:
+                self._eject(rep, f"error:{type(e).__name__}")
+                continue
+            except _fault.FaultInjected:
+                self._eject(rep, "killed")
+                continue
+            if replica_events:
+                rep.last_progress_step = self._steps
+                progressed = True
+            self._translate(rep, replica_events, events)
+        self._steps += 1
+        if progressed or not self._pending:
+            self._idle_steps = 0
+        else:
+            self._idle_steps += 1
+        alive = [r for r in self._replicas if r.state != DEAD]
+        if self._pending and (not alive
+                              or self._idle_steps >= self.shed_patience):
+            # no-hang guarantee: nothing can place these — classify and
+            # finish them instead of spinning (terminal, retryable at
+            # the client since nothing was computed)
+            for rec in list(self._pending):
+                self._finish_record(rec, "shed", events)
+            self._pending.clear()
+        return events
+
+    def has_work(self) -> bool:
+        if self._pending:
+            return True
+        return any(rep.state != DEAD and rep.engine.scheduler.has_work()
+                   for rep in self._replicas)
+
+    def stream(self):
+        """Drive the fleet to completion, yielding client events —
+        ``{"rid", "token", "finished", "finish_reason", "replica"}`` —
+        exactly once each, in production order. On a tripped preemption
+        guard the fleet drains and the terminal events are yielded."""
+        while self.has_work():
+            if self._preemption_pending():
+                self.drain()
+                yield from self.last_drain_events
+                return
+            yield from self.step()
+
+    def run_to_completion(self, max_steps: int | None = None) -> dict:
+        """Drain the fleet; {rid: client-visible token list}. Raises
+        after ``max_steps`` router steps — the chaos suites' hang
+        tripwire."""
+        steps = 0
+        while self.has_work():
+            if self._preemption_pending():
+                self.drain()
+                break
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(
+                    f"fleet did not drain in {steps} router steps")
+        return {rid: list(r.tokens) for rid, r in self._records.items()}
+
+    # ------------------------------------------------------------------
+    # drain / preemption
+    # ------------------------------------------------------------------
+
+    def drain(self, timeout_s: float | None = None) -> dict:
+        """Fleet-wide graceful shutdown: shed the router queue as
+        retriable ``preempted`` outcomes (nothing was computed for
+        them), then drain every live replica — running requests decode
+        to their own finish within ``timeout_s`` (per replica, on its
+        metrics clock) and their events flow through the exactly-once
+        translation like any other step. Returns
+        {rid: {finish_reason, tokens, retriable}} over ALL fleet
+        requests; terminal events land in ``last_drain_events``."""
+        events: list[dict] = []
+        self._draining = True
+        for rec in list(self._pending):
+            self._finish_record(rec, "preempted", events)
+        self._pending.clear()
+        for rep in self._replicas:
+            if rep.state == DEAD or not rep.engine.scheduler.has_work():
+                continue
+            try:
+                rep.engine.drain(timeout_s=timeout_s)
+                self._translate(rep, rep.engine.last_drain_events, events)
+            except (ServingError, _fault.FaultInjected):
+                self._eject(rep, "died_in_drain")
+        # anything still unfinished (its replica died mid-drain and
+        # there is nowhere left to replay) is preempted: retryable,
+        # nothing the client saw is lost
+        for rec in self._records.values():
+            if not rec.finished:
+                self._finish_record(rec, "preempted", events)
+        self.last_drain_events = events
+        self.tracer.instant("fleet_drain", track="fleet",
+                            requests=len(self._records))
+        return {rid: {"finish_reason": rec.finish_reason,
+                      "tokens": list(rec.tokens),
+                      "retriable": rec.finish_reason in ("preempted",
+                                                         "shed")}
+                for rid, rec in self._records.items()}
+
+    def attach_preemption_guard(self, guard=None):
+        """Fleet-wide SIGTERM handling: one guard covers every replica —
+        ``stream``/``run_to_completion`` notice ``guard.preempted`` at a
+        router-step boundary and ``drain()`` the whole fleet (structured
+        retry-elsewhere outcomes, same contract as the single engine)."""
+        if guard is None:
+            from ..distributed import PreemptionGuard
+            guard = PreemptionGuard()
+        self._guard = guard
+        return guard
+
+    def _preemption_pending(self) -> bool:
+        return (self._guard is not None and self._guard.preempted
+                and not self._draining)
+
+    # ------------------------------------------------------------------
+    # health / breaker
+    # ------------------------------------------------------------------
+
+    def health(self, idx: int) -> dict:
+        """One replica's health view: *ready* (would accept a dispatch
+        now — queue/pool pressure + breaker), *live* (step progress;
+        vacuously true while it has nothing to do), and the breaker
+        bookkeeping an operator alerts on."""
+        rep = self._replicas[idx]
+        eng = rep.engine
+        sched = eng.scheduler
+        qd = sched.queue_depth
+        pool = getattr(eng, "pool", None)
+        has_work = sched.has_work()
+        return {
+            "replica": idx,
+            "state": rep.state,
+            "ready": self._ready(rep),
+            "live": (rep.state != DEAD
+                     and (not has_work
+                          or self._steps - rep.last_progress_step
+                          <= self.shed_patience)),
+            "queue_depth": qd,
+            "running": len(sched.running),
+            "pool_utilization": (pool.utilization()
+                                 if pool is not None else 0.0),
+            "consecutive_failures": rep.consecutive_failures,
+            "breaker_opens": rep.opens,
+            "backoff_remaining": max(0, rep.backoff_until - self._steps),
+            "dead_reason": rep.dead_reason,
+            "flight_recorder": rep.dump_path,
+        }
+
+    def _ready(self, rep: _Replica) -> bool:
+        if rep.state == DEAD or rep.state == OPEN:
+            return False
+        eng = rep.engine
+        if getattr(eng, "_draining", False):
+            return False
+        mqd = getattr(eng.scheduler, "max_queue_depth", None)
+        if mqd is not None and eng.scheduler.queue_depth >= mqd:
+            return False
+        return True
+
+    def _health_sweep(self) -> None:
+        """Advance breaker timers + fire the ``fleet.health`` site per
+        live replica (an injected health failure counts as a transient
+        breaker failure, exactly like a failed dispatch)."""
+        for rep in self._replicas:
+            if rep.state == DEAD:
+                continue
+            if rep.state == OPEN and self._steps >= rep.backoff_until:
+                rep.state = HALF_OPEN
+                self.fleet_metrics.bump("probes")
+                self.tracer.instant("breaker_half_open", track="fleet",
+                                    replica=rep.idx)
+            try:
+                _fault.trip("fleet.health", step=self._steps,
+                            path=str(rep.idx))
+            except _fault.FaultInjected:
+                self._breaker_failure(rep)
+
+    def _breaker_failure(self, rep: _Replica) -> None:
+        rep.consecutive_failures += 1
+        if rep.state == HALF_OPEN or (
+                rep.state == CLOSED
+                and rep.consecutive_failures >= self.breaker_threshold):
+            rep.opens += 1
+            rep.state = OPEN
+            backoff = min(
+                self.breaker_backoff_steps * (2 ** (rep.opens - 1)),
+                self.breaker_backoff_max)
+            rep.backoff_until = self._steps + backoff + self._jitter(
+                rep.idx, rep.opens, backoff)
+            self.fleet_metrics.bump("breaker_opens")
+            self.tracer.instant("breaker_open", track="fleet",
+                                replica=rep.idx, opens=rep.opens,
+                                until=rep.backoff_until)
+
+    def _breaker_success(self, rep: _Replica) -> None:
+        rep.consecutive_failures = 0
+        if rep.state == HALF_OPEN:
+            rep.state = CLOSED
+            self.tracer.instant("breaker_close", track="fleet",
+                                replica=rep.idx)
+
+    @staticmethod
+    def _jitter(idx: int, opens: int, backoff: int) -> int:
+        """Deterministic jitter in [0, backoff): a hash draw, never
+        wall-clock entropy, so chaos runs replay bit-identically."""
+        if backoff <= 1:
+            return 0
+        h = hashlib.sha256(f"fleet-jitter:{idx}:{opens}".encode()).digest()
+        return int.from_bytes(h[:4], "big") % backoff
+
+    # ------------------------------------------------------------------
+    # placement
+    # ------------------------------------------------------------------
+
+    def _dispatch(self, events: list[dict]) -> None:
+        """Place router-queued records onto ready replicas, FCFS by
+        submit order. Best-effort prefix-cache affinity first (largest
+        ``match_prefix`` hit), then least-loaded; every failure is a
+        breaker data point and the record simply stays queued for the
+        next step — bounded work, no spinning."""
+        if not self._pending:
+            return
+        placed: list[FleetRequest] = []
+        for rec in self._pending:
+            candidates = [rep for rep in self._replicas
+                          if self._ready(rep)]
+            if not candidates:
+                break  # nothing can take the head now — FCFS, try later
+            ordered = sorted(
+                candidates,
+                key=lambda rep: (-self._affinity(rep, rec),
+                                 self._load(rep), rep.idx))
+            ok = False
+            for rep in ordered:
+                if self._try_place(rec, rep, events):
+                    ok = True
+                    break
+                if rec.finished:   # non-retryable dispatch classification
+                    ok = True
+                    break
+            if ok:
+                placed.append(rec)
+        for rec in placed:
+            self._pending.remove(rec)
+
+    @staticmethod
+    def _load(rep: _Replica) -> int:
+        sched = rep.engine.scheduler
+        return sched.queue_depth + len(sched.running)
+
+    @staticmethod
+    def _affinity(rep: _Replica, rec: FleetRequest) -> int:
+        """Cached-prefix tokens this replica's pool already holds for
+        the prompt — pure lookup against the content-hash index."""
+        pool = getattr(rep.engine, "pool", None)
+        if pool is None or not getattr(pool, "cache_enabled", False):
+            return 0
+        try:
+            return int(pool.match_prefix(rec.prompt).cached_tokens)
+        except Exception:  # noqa: BLE001 — affinity is best-effort only
+            return 0
+
+    def _try_place(self, rec: FleetRequest, rep: _Replica,
+                   events: list[dict]) -> bool:
+        try:
+            _fault.trip("fleet.dispatch", step=self._steps, path=rec.rid)
+            rep.engine.add_request(
+                rec.prompt, rec.max_new_tokens, sampling=rec.sampling,
+                eos_token_id=rec.eos_token_id, rid=rec.rid,
+                deadline_s=rec.deadline_s,
+                max_queue_wait_s=rec.max_queue_wait_s)
+        except RequestTooLargeError:
+            # cannot happen after submit-time admission_check on a
+            # homogeneous fleet, but a duck-typed engine may disagree:
+            # classify, never retry (retryable=False)
+            self._finish_record(rec, "rejected_too_large", events)
+            return False
+        except (ServingError, _fault.FaultInjected):
+            # retryable=True territory (queue full / draining / injected
+            # dispatch fault): breaker data point, record stays queued
+            self._breaker_failure(rep)
+            return False
+        self._breaker_success(rep)
+        rec.replica = rep.idx
+        rec.produced = 0
+        self.metrics.on_admit(rec.rid)
+        self.fleet_metrics.bump("dispatched")
+        if rec.replays:
+            self.fleet_metrics.bump("replayed_requests")
+        self.tracer.instant("dispatch", track="fleet", rid=rec.rid,
+                            replica=rep.idx, replay=rec.replays)
+        return True
+
+    # ------------------------------------------------------------------
+    # failure handling
+    # ------------------------------------------------------------------
+
+    def _kill_sweep(self) -> None:
+        """The ``fleet.replica_kill`` chaos site: an armed ``raise``
+        matching a replica index kills that replica at this step
+        boundary (between engine steps — never mid-step, which is what
+        keeps replay exactly-once)."""
+        if _fault.active_plan() is None:
+            return
+        for rep in self._replicas:
+            if rep.state == DEAD:
+                continue
+            try:
+                _fault.trip("fleet.replica_kill", step=self._steps,
+                            path=str(rep.idx))
+            except _fault.FaultInjected:
+                self._eject(rep, "killed")
+
+    def kill_replica(self, idx: int, reason: str = "killed") -> None:
+        """Operational/chaos API: eject a replica NOW and fail its
+        in-flight requests over (equivalent to a replica_kill fault)."""
+        rep = self._replicas[idx]
+        if rep.state != DEAD:
+            self._eject(rep, reason)
+
+    def _eject(self, rep: _Replica, reason: str,
+               snapshot: dict | None = None) -> None:
+        """Replica death: flight-recorder dump, DEAD state, and failover
+        — every live request it held goes back to the router queue (in
+        submit order) for deterministic replay on a healthy replica."""
+        rep.state = DEAD
+        rep.dead_reason = reason
+        self.fleet_metrics.bump("ejections")
+        recorder = getattr(rep.engine, "flight_recorder", None)
+        if recorder is not None:
+            try:
+                rep.dump_path = recorder.dump(
+                    f"fleet_eject_{reason}",
+                    snapshot={"replica": rep.idx, "reason": reason,
+                              **(snapshot or {})})
+            except OSError:
+                rep.dump_path = None
+        self.tracer.instant("replica_eject", track="fleet",
+                            replica=rep.idx, reason=reason)
+        live = getattr(rep.engine.scheduler, "live_requests", None)
+        if live is not None:
+            survivors = live()
+        else:
+            survivors = (list(rep.engine.scheduler.waiting)
+                         + list(rep.engine.scheduler.running.values()))
+        for req in survivors:
+            rec = self._records.get(req.rid)
+            if rec is None or rec.finished:
+                continue
+            rec.replica = None
+            rec.produced = 0
+            rec.replays += 1
+            self.fleet_metrics.bump("failovers")
+            keys = [r.submit_seq for r in self._pending]
+            self._pending.insert(
+                bisect.bisect_left(keys, rec.submit_seq), rec)
+            self.tracer.instant("failover", track="fleet", rid=rec.rid,
+                                emitted=rec.emitted, replica=rep.idx)
+
+    # ------------------------------------------------------------------
+    # exactly-once translation
+    # ------------------------------------------------------------------
+
+    def _translate(self, rep: _Replica, replica_events: list[dict],
+                   out: list[dict]) -> None:
+        """Engine events -> client events, deduping replayed positions.
+
+        A token at position ``produced <= emitted`` is a replay of one
+        the client already has: it is verified bitwise against the
+        delivered stream (the determinism contract — a mismatch is a
+        hard error, not a silent corruption) and suppressed. The first
+        fresh position is delivered and ``emitted`` advances. Terminal
+        classification events (token None) always deliver — they can
+        never duplicate, because a finished record leaves the in-flight
+        set and is never replayed."""
+        for ev in replica_events:
+            rec = self._records.get(ev["rid"])
+            if rec is None or rec.finished:
+                continue  # not ours / already terminal (late drain echo)
+            token = ev.get("token")
+            if token is not None:
+                rec.produced += 1
+                if rec.produced <= rec.emitted:
+                    expected = rec.tokens[rec.produced - 1]
+                    if token != expected:
+                        raise RuntimeError(
+                            f"replay divergence for {rec.rid!r} at "
+                            f"position {rec.produced}: replica "
+                            f"{rep.idx} produced {token}, client was "
+                            f"delivered {expected} — the deterministic-"
+                            f"replay contract is broken")
+                    self.fleet_metrics.bump("replayed_tokens")
+                    if not ev.get("finished"):
+                        continue   # pure replay: suppress
+                    # a finish can only ride the LAST token; if that
+                    # position was already emitted the original replica
+                    # died after computing it but before the router saw
+                    # it — impossible by construction (step boundaries),
+                    # guarded anyway:
+                    token = None
+                else:
+                    rec.emitted += 1
+                    rec.tokens.append(token)
+                    self.metrics.on_token(rec.rid)
+            if ev.get("finished"):
+                reason = ev.get("finish_reason")
+                rec.finished = True
+                rec.finish_reason = reason
+                self.metrics.on_finish(rec.rid, reason)
+                if reason not in ("stop", "length"):
+                    self.metrics.on_outcome(reason)
+                self.tracer.instant("finish", track="fleet", rid=rec.rid,
+                                    reason=reason or "",
+                                    replica=rep.idx)
+            if token is not None or ev.get("finished"):
+                out.append({"rid": rec.rid, "token": token,
+                            "finished": bool(ev.get("finished")),
+                            "finish_reason": ev.get("finish_reason"),
+                            "replica": rep.idx})
+
+    def _finish_record(self, rec: FleetRequest, reason: str,
+                       events: list[dict]) -> None:
+        """Router-side terminal classification (shed / preempted /
+        rejected): the client gets a typed outcome, never silence."""
+        rec.finished = True
+        rec.finish_reason = reason
+        rec.replica = None
+        if reason == "shed":
+            self.fleet_metrics.bump("shed")
+        self.metrics.on_finish(rec.rid, reason)
+        self.metrics.on_outcome(reason)
+        events.append({"rid": rec.rid, "token": None, "finished": True,
+                       "finish_reason": reason, "replica": None})
+        self.tracer.instant("finish", track="fleet", rid=rec.rid,
+                            reason=reason)
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+
+    def request(self, rid: str) -> FleetRequest:
+        return self._records[rid]
+
+    def replicas_live(self) -> int:
+        return sum(1 for rep in self._replicas if rep.state != DEAD)
+
+    def stats(self) -> dict:
+        """Fleet-level stats: router counters + per-replica health (the
+        shape ``observability.render_fleet_prometheus`` exports)."""
+        return {
+            "steps": self._steps,
+            "replicas": len(self._replicas),
+            "replicas_live": self.replicas_live(),
+            "replicas_ejected": sum(1 for r in self._replicas
+                                    if r.state == DEAD),
+            "queue_depth": len(self._pending),
+            "requests": len(self._records),
+            "draining": self._draining,
+            "fleet": self.fleet_metrics.summary(),
+            "replica_health": [self.health(i)
+                               for i in range(len(self._replicas))],
+        }
+
+    @property
+    def engines(self):
+        return [rep.engine for rep in self._replicas]

@@ -156,6 +156,10 @@ class KVCachePool:
         # would fall back to the process-global training-step cursor and
         # draw ONE outcome for the engine's whole lifetime
         self.fault_step: int | None = None
+        # optional match-path for the serving.alloc site; the fleet
+        # router sets it to the replica index so a FaultSpec with
+        # ``match=r"^0$"`` pins an alloc storm to one replica
+        self.fault_path: str | None = None
 
         # ---- prefix cache state (all host-side integers) ----
         self.cache_enabled = cache_enabled
@@ -259,6 +263,7 @@ class KVCachePool:
         from ..distributed import fault as _fault
         try:
             _fault.trip("serving.alloc", step=self.fault_step,
+                        path=self.fault_path,
                         need=n, free=self.num_available)
         except _fault.FaultInjected as e:
             raise PoolExhaustedError(

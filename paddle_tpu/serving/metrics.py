@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import time
 
-__all__ = ["ServingMetrics", "percentile"]
+__all__ = ["ServingMetrics", "FleetMetrics", "percentile"]
 
 
 def percentile(values, p: float) -> float:
@@ -42,6 +42,40 @@ def percentile(values, p: float) -> float:
     hi = min(lo + 1, len(xs) - 1)
     frac = rank - lo
     return float(xs[lo] * (1.0 - frac) + xs[hi] * frac)
+
+
+class FleetMetrics:
+    """Counter bag for the fleet router (serving.fleet.FleetRouter) —
+    the numbers SERVING.md "Engine fleet & failover" defines and
+    ``observability.render_fleet_prometheus`` exports as
+    ``paddle_serving_fleet_*_total``:
+
+    - ``dispatched``        placements onto a replica (incl. replays)
+    - ``failovers``         in-flight requests re-queued off a dead replica
+    - ``replayed_requests`` re-dispatches that replay a prior stream
+    - ``replayed_tokens``   replayed positions verified + suppressed
+      (each one is a bitwise determinism check that passed)
+    - ``shed``              FleetOverloadedError rejects + terminal sheds
+    - ``ejections``         replicas marked DEAD
+    - ``breaker_opens``     circuit-breaker CLOSED/HALF_OPEN -> OPEN edges
+    - ``probes``            OPEN -> HALF_OPEN probe windows
+
+    Client-visible latency/goodput lives on the router's own
+    :class:`ServingMetrics`, not here — this bag is pure fleet-control
+    accounting."""
+
+    def __init__(self):
+        self.counters: dict[str, int] = {
+            "dispatched": 0, "failovers": 0, "replayed_requests": 0,
+            "replayed_tokens": 0, "shed": 0, "ejections": 0,
+            "breaker_opens": 0, "probes": 0,
+        }
+
+    def bump(self, key: str, n: int = 1) -> None:
+        self.counters[key] += n
+
+    def summary(self) -> dict:
+        return dict(self.counters)
 
 
 class ServingMetrics:

@@ -164,6 +164,14 @@ class Scheduler:
     def has_work(self) -> bool:
         return bool(self.waiting or self.running)
 
+    def live_requests(self) -> list[Request]:
+        """Every non-terminal request (waiting + running), arrival order.
+        This is what a fleet router fails over when it ejects the engine:
+        each entry's rid/prompt/sampling is enough to replay it bitwise
+        on another replica (SERVING.md "Engine fleet & failover")."""
+        live = list(self.waiting) + list(self.running.values())
+        return sorted(live, key=lambda r: r.arrival_seq)
+
     # ---- preemption ----
 
     def _preempt_youngest(self, pool: KVCachePool) -> Request:

@@ -15,6 +15,7 @@ _DEFAULT_CONFIGS = {
     "llama_420m", "resnet50", "bert_base", "qwen2_moe", "lenet_mnist",
     "llama8b_shape", "llama_decode", "llama_longctx", "llama_serving",
     "llama_serving_prefix", "llama_decode_int8", "llama_serving_int8",
+    "llama_serving_fleet",
 }
 
 
@@ -119,6 +120,23 @@ def test_dry_int8_cells_carry_quant_keys():
                         "goodput_at_slo", "retraces",
                         "kv_quant_err_bound", "bytes_ratio_vs_bf16"}, srv
     assert all(v is None for v in srv.values()), srv
+
+
+def test_dry_fleet_cell_carries_failover_keys():
+    # the fleet arm (SERVING.md "Engine fleet & failover"): the cell must
+    # surface the failover evidence — how many requests failed over, how
+    # many replayed tokens the exactly-once dedup suppressed, and whether
+    # anything was shed — next to the usual serving SLO keys
+    out = _run_dry("llama_serving_fleet")
+    assert out.returncode == 0, out.stderr
+    last = json.loads(out.stdout.splitlines()[-1])
+    cell = last["bench_summary"]["llama_serving_fleet"]
+    assert set(cell) >= {"value", "mfu", "spread",
+                         "ttft_p50", "ttft_p99", "tpot",
+                         "failovers", "replayed_tokens", "shed",
+                         "replicas_ejected",
+                         "goodput_at_slo", "retraces"}, cell
+    assert all(v is None for v in cell.values()), cell
 
 
 def test_dry_trace_flag_path_not_eaten_as_config_name():

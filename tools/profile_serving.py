@@ -48,6 +48,16 @@ Run: python tools/profile_serving.py            (real TPU)
                                                  line event histogram —
                                                  the post-mortem playbook,
                                                  OBSERVABILITY.md)
+     python tools/profile_serving.py --fleet-chaos
+                                                (3-replica FleetRouter under
+                                                 a fixed kill/stall/poison
+                                                 FaultPlan: per-replica
+                                                 outcome histogram, fleet
+                                                 failover/replay counters
+                                                 and each dead replica's
+                                                 flight-recorder dump path —
+                                                 SERVING.md "Engine fleet &
+                                                 failover")
 """
 import sys
 sys.path.insert(0, "/root/repo")
@@ -213,6 +223,125 @@ def flight_recorder():
         os.path.join(dump_dir, "chaos.trace.json"))
     print(f"Chrome trace (load at https://ui.perfetto.dev): {trace_path}")
     assert recorder.dumps > 0, "chaos replay produced no dumps"
+
+
+def fleet_chaos():
+    """Fleet chaos replay (SERVING.md "Engine fleet & failover"): a
+    3-replica FleetRouter on the tiny CPU model under the fixed
+    FaultPlan below — replica 2 is killed mid-run, replica 0 suffers a
+    permanent allocation storm until its scheduler stalls and the
+    router ejects it, and one request's decode activations are
+    NaN-poisoned wherever it lands. Everything fails over to replica 1.
+
+    Prints the per-replica outcome histogram (which replica delivered
+    each finish and why), the fleet's failover/replay/breaker counters,
+    each replica's terminal health row, and the flight-recorder dump
+    path the router wrote for every ejected replica — the operator's
+    post-mortem entry point. The invariants asserted at the end are the
+    fleet contract: every submitted request ends classified (exact
+    tokens or a typed finish_reason — never hung), the client stream
+    stays exactly-once across the failovers, and no surviving replica
+    ever retraced its decode program."""
+    import collections
+    import os
+    import tempfile
+
+    import paddle_tpu as pt
+    from paddle_tpu.distributed import fault
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.observability import FlightRecorder, Tracer
+    from paddle_tpu.serving import FleetRouter, ServingEngine
+
+    pt.seed(0)
+    model = LlamaForCausalLM(llama_tiny(mp_axis=None, fsdp_axis=None))
+    model.eval()
+
+    plan = fault.FaultPlan([
+        # hard replica loss: the router's kill sweep ejects replica 2 at
+        # its 4th step; in-flight requests fail over and REPLAY
+        fault.FaultSpec(site="fleet.replica_kill", action="raise",
+                        step=4, match=r"^2$"),
+        # permanent allocation storm pinned to replica 0's pool: its
+        # head request can never be admitted, the scheduler stalls, the
+        # router classifies the stall and ejects the replica
+        fault.FaultSpec(site="serving.alloc", action="raise",
+                        once=False, match=r"^0$"),
+        # NaN-poison one request's decode wherever it runs — it must end
+        # classified (nonfinite/injected), not take its replica down
+        fault.FaultSpec(site="serving.decode", action="poison",
+                        match=r"^fleet-req-5$"),
+    ], seed=11)
+
+    dump_dir = tempfile.mkdtemp(prefix="fleet_chaos_")
+    tracer = Tracer()
+    engines = []
+    for i in range(3):
+        rec = FlightRecorder(capacity=512, dump_dir=dump_dir)
+        engines.append(ServingEngine(model, num_pages=64, page_size=4,
+                                     max_slots=4, flight_recorder=rec))
+    router = FleetRouter(engines, tracer=tracer)
+
+    rng = np.random.default_rng(0)
+    n_requests, max_new = 12, 6
+    prompts = [rng.integers(0, model.config.vocab_size, 6).astype(np.int32)
+               for _ in range(n_requests)]
+    fault.activate(plan)
+    try:
+        submitted = [router.submit(p, max_new) for p in prompts[:4]]
+        steps = 0
+        while router.has_work() or len(submitted) < n_requests:
+            router.step()
+            steps += 1
+            if len(submitted) < n_requests and steps % 2 == 0:
+                submitted.append(
+                    router.submit(prompts[len(submitted)], max_new))
+            assert steps < 2000, "fleet hung under chaos"
+    finally:
+        fault.deactivate()
+
+    # per-replica outcome histogram: which replica delivered each finish
+    # ("-" = finished without a live placement, e.g. shed from the queue)
+    outcomes = collections.Counter()
+    unclassified = 0
+    for rid in submitted:
+        req = router.request(rid)
+        where = "-" if req.replica is None else f"replica {req.replica}"
+        outcomes[(where, req.finish_reason or "unfinished")] += 1
+        unclassified += req.finish_reason is None
+
+    fleet = router.fleet_metrics.summary()
+    st = router.stats()
+    print(f"\nfleet chaos replay: {n_requests} requests over 3 replicas, "
+          f"{steps} router steps, seed={plan.seed}")
+    print("per-replica outcome histogram:")
+    for (where, reason), n in sorted(outcomes.items()):
+        print(f"  {where:10s} {reason:20s} {n}")
+    print("fleet counters: "
+          + "  ".join(f"{k}={v}" for k, v in sorted(fleet.items())))
+    print("replica health:")
+    for h in st["replica_health"]:
+        line = (f"  replica {h['replica']}: state={h['state']:9s} "
+                f"breaker_opens={h['breaker_opens']}")
+        if h["dead_reason"]:
+            line += f" dead_reason={h['dead_reason']}"
+        if h["flight_recorder"]:
+            line += f"\n    flight-recorder dump: {h['flight_recorder']}"
+        print(line)
+    for f in sorted(os.listdir(dump_dir)):
+        print(f"  dump on disk: {os.path.join(dump_dir, f)}")
+
+    assert unclassified == 0, "a request ended without a finish_reason"
+    assert st["replicas_ejected"] == 2, "expected the kill + stall ejections"
+    assert fleet["failovers"] >= 1, "chaos produced no failovers"
+    dead = [h for h in st["replica_health"] if h["state"] == "dead"]
+    assert all(h["flight_recorder"] for h in dead), \
+        "an ejected replica left no flight-recorder dump"
+    for h in st["replica_health"]:
+        if h["state"] != "dead":
+            eng = router.engines[h["replica"]]
+            assert eng.decode_program_count() == 1, "decode retraced"
+    print("invariants held: all classified, 2 ejections dumped, "
+          "survivors never retraced")
 
 
 def prefix():
@@ -590,7 +719,9 @@ def main():
 
 
 if __name__ == "__main__":
-    if "--chaos" in sys.argv[1:]:
+    if "--fleet-chaos" in sys.argv[1:]:
+        fleet_chaos()
+    elif "--chaos" in sys.argv[1:]:
         chaos()
     elif "--flight-recorder" in sys.argv[1:]:
         flight_recorder()
