@@ -91,6 +91,10 @@ _SERVING_SLOS = {
     # pay re-prefill + replay inside one inter-token gap — the looser
     # ITL budget is the failover price the SLO explicitly allows
     "llama_serving_fleet": {"ttft_p99_s": 2.0, "itl_p99_s": 1.0},
+    # speculative arm: same workload/SLOs as llama_serving — drafting
+    # must not be allowed to trade latency SLOs for throughput. itl is
+    # per-EMITTED-token, so accepted multi-token steps help, not hurt
+    "llama_serving_spec": {"ttft_p99_s": 2.0, "itl_p99_s": 0.25},
 }
 
 
@@ -915,6 +919,149 @@ def bench_llama_serving_prefix(peak, peak_kind, n_requests=12,
     }
 
 
+def bench_llama_serving_spec(peak, peak_kind, n_requests=12,
+                             max_new_tokens=64, prefix_len=256,
+                             spec_k=4, trace_path=None):
+    """Speculative-decoding serving A/B (SERVING.md "Speculative
+    decoding"): the shared-system-prompt staggered trace run twice on
+    the same model — spec-off (plain decode) then spec-on (n-gram
+    prompt-lookup draft + one fixed-shape ``[max_slots, k]`` verify
+    program). Headline value is the spec-on tokens/s; the baseline
+    arm's tokens/s and the speedup land in extra alongside
+    ``accept_rate`` / ``draft_hit_rate`` (the knobs that explain the
+    speedup: every accepted draft token is one decode step's weight
+    stream the engine did not pay for). Greedy output is asserted
+    token-exact between the arms — speculation changes how many tokens
+    a step emits, never which — and both per-step-shape programs are
+    asserted compiled-once (the verify program is warmed by a
+    propose-always drafter so mid-trace compiles stay out of TTFT)."""
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import (ServingEngine, ServingMetrics,
+                                    SpeculativeConfig)
+
+    name = "llama_serving_spec"
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                      intermediate_size=5632, num_hidden_layers=8,
+                      num_attention_heads=16, num_key_value_heads=8,
+                      max_position_embeddings=4096, dtype="bfloat16",
+                      mp_axis=None, fsdp_axis=None)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    n_params = model.num_params()
+    rng = np.random.default_rng(0)
+    system = rng.integers(0, cfg.vocab_size, prefix_len).astype(np.int32)
+    sfx_lens = [int(x) for x in rng.integers(16, 64, n_requests)]
+    prompts = [np.concatenate(
+        [system, rng.integers(0, cfg.vocab_size, n).astype(np.int32)])
+        for n in sfx_lens]
+    lens = [len(p) for p in prompts]
+    warm = rng.integers(0, cfg.vocab_size, max(lens)).astype(np.int32)
+    tracer = _make_tracer(trace_path)
+
+    class _WarmDrafter:
+        # propose-always: guarantees the verify program traces during
+        # warmup even when the warm prompts have no n-gram repeats
+        def propose(self, req, k):
+            ctx = req.tokens or list(req.prompt)
+            return [int(ctx[-1])] * k
+
+        def observe(self, req, n_draft, n_accepted):
+            pass
+
+    def run_arm(spec_on):
+        eng = ServingEngine(model, num_pages=512, page_size=16,
+                            max_slots=8, max_pages_per_slot=48,
+                            tracer=tracer if spec_on else None,
+                            speculative=(SpeculativeConfig(k=spec_k)
+                                         if spec_on else None))
+        real_drafter = eng._drafter
+        if spec_on:
+            eng._drafter = _WarmDrafter()
+        # warm max_new must exceed 2: the draft cap is
+        # max_new - len(tokens) - 1, so a 2-token warm request never
+        # drafts and the verify program would compile mid-trace
+        for n in sorted({eng._bucket(s) for s in lens}
+                        | {eng._bucket(s) for s in sfx_lens}):
+            eng.add_request(warm[:n], 4 if spec_on else 2)
+        eng.run_to_completion(max_steps=300)
+        eng._drafter = real_drafter
+        eng.metrics = ServingMetrics()  # compile stays out of the trace
+        eng.metrics.set_spec(spec_on)   # re-arm after the reset
+        eng.metrics.set_slo(**_SERVING_SLOS[name])
+
+        added = 2
+        rids = [eng.add_request(p, max_new_tokens) for p in prompts[:2]]
+        steps = 0
+        while eng.scheduler.has_work() or added < n_requests:
+            eng.step()
+            steps += 1
+            if added < n_requests and steps % 4 == 0:
+                rids.append(eng.add_request(prompts[added],
+                                            max_new_tokens))
+                added += 1
+        outs = [list(eng.request(r).tokens) for r in rids]
+        m = eng.metrics.summary()
+        retraces = sum(n - 1 for n in eng.step_program_counts().values())
+        assert retraces == 0, "serving step program retraced"
+        return eng, m, steps, outs
+
+    _, m0, steps0, outs0 = run_arm(False)
+    eng, m, steps, outs = run_arm(True)
+    # the determinism contract, priced into the headline number: the
+    # speculative arm's greedy streams are token-exact vs plain decode
+    assert outs == outs0, "speculative arm diverged from plain decode"
+    hbm_bw = {"v4": 1.2e12,
+              "v5e": 0.82e12, "v5litepod": 0.82e12, "v5lite": 0.82e12,
+              "v5p": 2.77e12,
+              "v6e": 1.64e12, "trillium": 1.64e12,
+              }.get(peak_kind.split("(")[0], 0.82e12)
+    wall = max(m["wall_s"], 1e-9)
+    mbu = steps * 2.0 * n_params / wall / hbm_bw
+    trace_out = _dump_trace(tracer, trace_path, name)
+    return {
+        "metric": "llama_420m_serving_spec_tokens_per_sec",
+        "value": round(m["tokens_per_s"], 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(m["tokens_per_s"]
+                             / max(m0["tokens_per_s"], 1e-9), 4),
+        "extra": {"params": n_params, "n_requests": n_requests,
+                  "max_new_tokens": max_new_tokens,
+                  "prefix_len": prefix_len, "prompt_lens": lens,
+                  "spec_k": spec_k,
+                  "engine_steps": steps,
+                  "engine_steps_baseline": steps0,
+                  "tokens_per_s_baseline": round(m0["tokens_per_s"], 1),
+                  "speedup_vs_decode": round(
+                      m["tokens_per_s"] / max(m0["tokens_per_s"], 1e-9),
+                      4),
+                  "accept_rate": round(m["spec_accept_rate"], 4),
+                  "draft_hit_rate": round(m["spec_draft_hit_rate"], 4),
+                  "spec_draft_tokens": m["spec_draft_tokens_total"],
+                  "spec_accepted_tokens": m["spec_accepted_tokens_total"],
+                  "ttft_p50": round(m["ttft_p50_s"], 4),
+                  "ttft_p99": round(m["ttft_p99_s"], 4),
+                  "tpot": round(m["tpot_mean_s"], 5),
+                  "itl_p99": round(m["itl_p99_s"], 5),
+                  "preemptions": m["preemptions"],
+                  "rejected": m["rejected"],
+                  "timed_out": m["timed_out"],
+                  "quarantined": m["quarantined"],
+                  "kv_util_peak": round(m["kv_util_peak"], 4),
+                  "goodput_at_slo": round(m["goodput_at_slo"], 4),
+                  "slo": _SERVING_SLOS[name],
+                  "retraces": sum(
+                      n - 1
+                      for n in eng.step_program_counts().values()),
+                  "trace": trace_out,
+                  "mbu_weights_only": round(mbu, 4),
+                  "peak": peak_kind, "hbm_bw": hbm_bw,
+                  "pipeline": False, "runs": _RUNS,
+                  "spread": None},
+    }
+
+
 def bench_llama_serving_fleet(peak, peak_kind, n_requests=12,
                               max_new_tokens=64, kill_step=20,
                               trace_path=None):
@@ -1112,6 +1259,10 @@ _CONFIGS = {
     # "Engine fleet & failover"): client-visible tokens/s with the
     # failover replay priced in, plus failovers/replays/shed evidence
     "llama_serving_fleet": bench_llama_serving_fleet,
+    # speculative decoding A/B (SERVING.md "Speculative decoding"):
+    # n-gram draft + one [max_slots, k] verify program vs plain decode
+    # on the same shared-system-prompt trace; token-exact by assertion
+    "llama_serving_spec": bench_llama_serving_spec,
 }
 
 # configs whose bench_summary cell carries extra keys beyond
@@ -1134,6 +1285,10 @@ _SUMMARY_EXTRA_KEYS = {
                             "failovers", "replayed_tokens", "shed",
                             "replicas_ejected",
                             "goodput_at_slo", "retraces"),
+    "llama_serving_spec": ("ttft_p50", "ttft_p99", "tpot",
+                           "accept_rate", "draft_hit_rate",
+                           "speedup_vs_decode",
+                           "goodput_at_slo", "retraces"),
 }
 
 # opt-in configs (not in the default driver run — kept out to bound its

@@ -119,37 +119,45 @@ class LlamaAttention(Layer):
         v = self.v_proj(x).reshape(b, s, kvh, d)
         if paged is not None:
             # slot-indexed decode over a paged KV pool (the serving engine's
-            # one-compiled-program step): b is the fixed slot count, s == 1.
-            # ``paged`` = (block_tables [b, max_pages] int32, seq_lens [b]
-            # int32, active [b] bool); ``kv_cache`` is this layer's
-            # (pool_k, pool_v) [num_pages, page_size, kvh, d]. Inactive
-            # slots write to the reserved scratch page 0 (never allocated,
-            # never read unmasked) so joins/leaves never retrace.
-            if s != 1:
-                raise ValueError("paged decode takes one token per slot")
-            tables, seq_lens, active = paged
-            pos = jnp.broadcast_to(seq_lens[:, None], (b, s))
+            # one-compiled-program step): b is the fixed slot count. s == 1
+            # is the plain decode step; s > 1 is the speculative VERIFY
+            # step, where per-slot row j is written at pool position
+            # seq_lens + j and attends causally up to itself. ``paged`` =
+            # (block_tables [b, max_pages] int32, seq_lens [b] int32,
+            # active [b] bool[, n_live [b] int32]); the optional n_live
+            # masks per-slot live rows — rows j >= n_live (padding beyond
+            # a slot's draft count) write to the reserved scratch page 0
+            # like inactive slots do, so rejected/padded drafts never land
+            # in the pool and per-slot draft counts never retrace.
+            # ``kv_cache`` is this layer's (pool_k, pool_v)
+            # [num_pages, page_size, kvh, d].
+            tables, seq_lens, active = paged[:3]
+            n_live = paged[3] if len(paged) > 3 else None
+            pos = jnp.broadcast_to(seq_lens[:, None] + jnp.arange(s)[None, :],
+                                   (b, s))
             q = apply_rotary_pos_emb(q, cos, sin, pos)
             k = apply_rotary_pos_emb(k, cos, sin, pos)
             pk, pv = kv_cache
             ps = pk.shape[1]
-            page = jnp.take_along_axis(tables, (seq_lens // ps)[:, None],
-                                       axis=1)[:, 0]
-            page = jnp.where(active, page, 0)
-            off = jnp.where(active, seq_lens % ps, 0)
+            live = active[:, None] & (jnp.arange(s)[None, :]
+                                      < (n_live[:, None] if n_live is not None
+                                         else s))
+            page = jnp.take_along_axis(tables, pos // ps, axis=1)
+            page = jnp.where(live, page, 0)
+            off = jnp.where(live, pos % ps, 0)
             from ..quantization.serving import QuantizedKV, kv_quantize
             if isinstance(pk, QuantizedKV):
-                # int8 pool: quantize the step token at write time (codes
+                # int8 pool: quantize the step tokens at write time (codes
                 # + per-row absmax scale); the read side dequantizes
                 # inside the one shared decode core
-                kq, vq = kv_quantize(k[:, 0]), kv_quantize(v[:, 0])
+                kq, vq = kv_quantize(k), kv_quantize(v)
                 pk = QuantizedKV(pk.q.at[page, off].set(kq.q),
                                  pk.scale.at[page, off].set(kq.scale))
                 pv = QuantizedKV(pv.q.at[page, off].set(vq.q),
                                  pv.scale.at[page, off].set(vq.scale))
             else:
-                pk = pk.at[page, off].set(k[:, 0].astype(pk.dtype))
-                pv = pv.at[page, off].set(v[:, 0].astype(pv.dtype))
+                pk = pk.at[page, off].set(k.astype(pk.dtype))
+                pv = pv.at[page, off].set(v.astype(pv.dtype))
             out = F.paged_attention_decode(q, pk, pv, tables, seq_lens)
             return self.o_proj(out.reshape(b, s, h * d)), (pk, pv)
         # sequence parallelism: when tracing inside a manual-sep shard_map

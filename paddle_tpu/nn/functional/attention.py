@@ -384,18 +384,23 @@ def _grouped_decode_attn(q, kc, vc, seq_lens, scale):
     HBM copy of the cache. One implementation for both cache layouts so
     the paged engine's tokens stay bit-identical to contiguous decode.
 
-    q: [b, 1, h, d]; kc/vc: [b, S, kvh, d] — fp arrays, or QuantizedKV
-    (int8 codes + fp32 absmax scales, quantization/serving.py): quantized
-    caches dequantize to fp32 HERE, inside the one shared core, so the
-    int8 serving path changes storage bytes, never program count.
-    seq_lens: [b] — attends cache positions <= seq_lens (the just-written
-    step token included).
+    q: [b, t, h, d] — t == 1 is the engine's one-token decode step;
+    t > 1 is the speculative VERIFY step, where per-slot row j is the
+    query at cache position seq_lens + j and attends causally up to
+    itself (row limit seq_lens + j). The t rows share one cache read,
+    which is the whole speculative win: k scores per weight/KV stream.
+    kc/vc: [b, S, kvh, d] — fp arrays, or QuantizedKV (int8 codes + fp32
+    absmax scales, quantization/serving.py): quantized caches dequantize
+    to fp32 HERE, inside the one shared core, so the int8 serving path
+    changes storage bytes, never program count.
+    seq_lens: [b] — row j attends cache positions <= seq_lens + j (each
+    row's just-written token included).
     """
     from ...quantization.serving import QuantizedKV, kv_dequantize
     if isinstance(kc, QuantizedKV):
         kc = kv_dequantize(kc)          # fp32: int8*scale is exact in fp32
         vc = kv_dequantize(vc)
-    b, _, h, d = q.shape
+    b, t, h, d = q.shape
     kvh = kc.shape[2]
     S = kc.shape[1]
     g = h // kvh
@@ -407,37 +412,46 @@ def _grouped_decode_attn(q, kc, vc, seq_lens, scale):
     # mode and bf16 products are exact in fp32, so the scores are
     # unchanged; for fp32 caches every cast here is a no-op and the
     # math is bitwise identical to the upcast form.
-    qg = q[:, 0].reshape(b, kvh, g, d).astype(kc.dtype)
-    s = jnp.einsum("bngd,bsnd->bngs", qg, kc,
+    qg = q.reshape(b, t, kvh, g, d).astype(kc.dtype)
+    s = jnp.einsum("btngd,bsnd->btngs", qg, kc,
                    preferred_element_type=jnp.float32) * scale
-    mask = jnp.arange(S)[None, None, None, :] <= seq_lens[:, None, None, None]
+    limit = seq_lens[:, None] + jnp.arange(t)[None, :]        # [b, t]
+    mask = (jnp.arange(S)[None, None, None, None, :]
+            <= limit[:, :, None, None, None])
     s = jnp.where(mask, s, jnp.float32(-1e30))
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bngs,bsnd->bngd", p.astype(vc.dtype), vc,
+    out = jnp.einsum("btngs,bsnd->btngd", p.astype(vc.dtype), vc,
                      preferred_element_type=jnp.float32)
-    return out.reshape(b, 1, h, d).astype(q.dtype)
+    return out.reshape(b, t, h, d).astype(q.dtype)
 
 
 def paged_attention_decode(q, pool_k, pool_v, block_tables, seq_lens,
                            scale=None):
-    """Single-token decode attention over a PAGED KV pool (the serving
-    engine's attention; parity: vLLM PagedAttention / incubate
+    """Decode attention over a PAGED KV pool (the serving engine's
+    attention; parity: vLLM PagedAttention / incubate
     block_multihead_attention without the write step).
 
-    q:            [b, 1, h, d] — this step's query (h a multiple of kvh).
+    q:            [b, t, h, d] — this step's queries (h a multiple of
+                  kvh). t == 1 is the plain decode step; t > 1 is the
+                  speculative verify step, where row j sits at pool
+                  position seq_lens + j and attends causally up to
+                  itself.
     pool_k/v:     [num_pages, page_size, kvh, d] — the shared page pool.
     block_tables: [b, max_pages] int32 page ids per sequence (entries past
                   the live pages may point anywhere — typically the
                   reserved scratch page 0 — they are masked by seq_lens).
-    seq_lens:     [b] int32 — attends pool positions <= seq_lens (i.e.
-                  seq_lens + 1 tokens, the just-written one included).
+    seq_lens:     [b] int32 — row j attends pool positions <= seq_lens + j
+                  (i.e. seq_lens + j + 1 tokens, the just-written one
+                  included).
 
     Routing: on a real TPU with kernel-friendly shapes the Pallas
     block-table kernel (ops/pallas/paged_attention) gathers pages
     HBM→VMEM by table lookup; anywhere else (tier-1 CPU runs) an XLA
     gather materializes [b, max_pages*page_size, kvh, d] and reuses the
     same grouped-GQA core as the contiguous decode path, so both backends
-    and both cache layouts agree.
+    and both cache layouts agree. ``kernel_applicable`` gates on t == 1,
+    so the multi-row verify step takes the XLA gather path on every
+    backend — one code path to keep bit-identical to sequential decode.
     """
     from ...quantization.serving import QuantizedKV
     b, _, h, d = q.shape

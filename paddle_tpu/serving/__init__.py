@@ -28,6 +28,7 @@ from .kv_cache import KVCachePool, PoolExhaustedError, PrefixMatch
 from .metrics import FleetMetrics, ServingMetrics, percentile
 from .scheduler import (FINISHED, PREEMPTED, RUNNING, WAITING, Request,
                         SamplingParams, Scheduler)
+from .speculative import DraftProposer, NgramDrafter, SpeculativeConfig
 
 __all__ = [
     "ServingEngine", "KVCachePool", "PoolExhaustedError", "PrefixMatch",
@@ -35,6 +36,7 @@ __all__ = [
     "FleetRouter", "FleetRequest",
     "percentile", "Request", "SamplingParams", "Scheduler",
     "WAITING", "RUNNING", "PREEMPTED", "FINISHED",
+    "SpeculativeConfig", "DraftProposer", "NgramDrafter",
     "ServingError", "QueueFullError", "RequestTooLargeError",
     "SchedulerStalledError", "EngineDrainingError", "FleetOverloadedError",
 ]

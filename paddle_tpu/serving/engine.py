@@ -6,7 +6,17 @@ always runs over the fixed ``[max_slots]`` slot axis, with block tables
 mask, and every per-request sampling parameter passed as ARRAY inputs.
 Requests joining, finishing, or being preempted only change array
 *values*, never shapes or the jaxpr — ``decode_program_count()`` stays
-at 1 across arbitrary churn (asserted by tests/test_serving.py).
+at 1 across arbitrary churn (asserted by tests/test_serving.py). With
+speculative decoding enabled (``speculative=``, serving/speculative.py)
+the engine owns exactly ONE more fixed-shape program: the
+``[max_slots, k]`` verify step, which scores a slot's decode input plus
+up to k-1 drafted tokens in a single weight stream, samples every
+position under the engine's standard contract, accepts the longest
+draft prefix matching those samples, and zeroes rejected rows
+in-program. Per-slot draft counts ride as the ``n_live`` array lane, so
+accept patterns change array values, never shapes —
+``step_program_counts()`` reports every per-step-shape program and each
+stays pinned at 1 (O(1) programs, not O(accept-pattern)).
 
 Prefill runs one admitted request at a time through per-bucket compiled
 programs (UNCACHED-suffix lengths rounded up to power-of-two page
@@ -79,7 +89,7 @@ class ServingEngine:
                  drain_timeout_s: float | None = 30.0,
                  watchdog=None, prefix_cache: bool = True,
                  tracer=None, flight_recorder=None,
-                 kv_quant: bool = False):
+                 kv_quant: bool = False, speculative=None):
         cfg = model.config
         self.model = model
         self.page_size = page_size
@@ -112,8 +122,23 @@ class ServingEngine:
         self.scheduler = Scheduler(max_slots, prefill_token_budget,
                                    max_queue_depth=max_queue_depth,
                                    max_preemptions=max_preemptions)
+        # speculative decoding (serving/speculative.py; SERVING.md
+        # "Speculative decoding"): pass a SpeculativeConfig, an int k,
+        # or True for defaults. The verify row count k is a compile-time
+        # shape; the drafter runs host-side every step.
+        from .speculative import SpeculativeConfig
+        if speculative is True:
+            speculative = SpeculativeConfig()
+        elif speculative is False:
+            speculative = None
+        elif isinstance(speculative, int):
+            speculative = SpeculativeConfig(k=int(speculative))
+        self._spec: SpeculativeConfig | None = speculative
+        self._drafter = speculative.make_drafter() if speculative else None
+        self.scheduler.spec_k = speculative.k if speculative else 1
         self.metrics = ServingMetrics(clock)
         self.metrics.set_kv_quant(kv_quant)
+        self.metrics.set_spec(speculative is not None)
         # observability (OBSERVABILITY.md): the tracer is shared with
         # the scheduler (request-lifecycle spans) and the pool
         # (eviction/COW/quarantine events); construct it on the same
@@ -127,7 +152,10 @@ class ServingEngine:
         self.flight_recorder = flight_recorder
         if flight_recorder is not None:
             self.tracer.add_sink(flight_recorder.record)
-        self._decode_traces = 0       # retrace detection (tracing on)
+        # retrace detection (tracing on): last-seen compiled-program
+        # count PER STEP SHAPE ("decode", "verify") — every shape is a
+        # first-class program with its own sentinel
+        self._step_traces: dict[str, int] = {}
         self._wd_hooked: set[int] = set()
         self.step_timeout_s = step_timeout_s
         self.drain_timeout_s = drain_timeout_s
@@ -141,6 +169,8 @@ class ServingEngine:
         self._guard = None
         self.last_drain_events: list[dict] = []
         self._decode_step = self._build_decode_step()
+        self._verify_step = (self._build_verify_step()
+                             if speculative is not None else None)
         self._prefill_progs: dict[int, object] = {}
 
     # ------------------------------------------------------------------
@@ -248,7 +278,11 @@ class ServingEngine:
         # prefill just registered, so a same-step burst sharing a system
         # prompt prefills the common prefix exactly once
         if not self._draining:
-            budget = self.scheduler.prefill_token_budget
+            # the verify step scores up to spec_k tokens per running
+            # slot through the same weight stream as prefill — reserve
+            # those tokens out of the step's prefill budget up front
+            budget = (self.scheduler.prefill_token_budget
+                      - self.scheduler.verify_token_reserve())
             first = True
             while True:
                 with tr.span("admission"):
@@ -257,12 +291,17 @@ class ServingEngine:
                 if not batch:
                     break
                 req = batch[0]
-                budget -= req.context_len - req.cached_len
+                budget -= (req.context_len - req.cached_len
+                           + (self.scheduler.spec_k - 1))
                 first = False
                 self.metrics.on_admit(req.rid)
                 self.metrics.on_prefill(req.cached_len, req.context_len)
                 with tr.span("prefill_dispatch", rid=req.rid):
                     self._run_prefill(req, events)
+        # drafts are proposed BEFORE the page guarantee so
+        # ensure_decode_pages covers the speculative writes too
+        if self._spec is not None and self.scheduler.running:
+            self._propose_drafts()
         with tr.span("ensure_pages"):
             preempted = self.scheduler.ensure_decode_pages(self.pool)
         for victim in preempted:
@@ -392,9 +431,33 @@ class ServingEngine:
         return self._requests[rid]
 
     def decode_program_count(self) -> int:
-        """Compiled-program count of the decode step — the no-retrace
-        contract says this stays 1 no matter how requests churn."""
+        """Compiled-program count of the 1-token decode step — the
+        no-retrace contract says this stays 1 no matter how requests
+        churn. Speculative decoding adds exactly ONE more per-step-shape
+        program (the ``[max_slots, k]`` verify step), counted separately
+        by :meth:`verify_program_count`; ``step_program_counts`` reports
+        every step shape so none hides as an uncounted second program."""
         return int(self._decode_step._cache_size())
+
+    def verify_program_count(self) -> int:
+        """Compiled-program count of the speculative verify step: 0 with
+        speculation off, else pinned at 1 under churn — per-slot draft
+        counts and accept patterns are array values (``n_live`` lane and
+        in-program accept scan), never shapes."""
+        if self._verify_step is None:
+            return 0
+        return int(self._verify_step._cache_size())
+
+    def step_program_counts(self) -> dict[str, int]:
+        """Per-step-shape compiled-program counts. Every step shape the
+        engine can dispatch is first-class here, and the O(1)-programs
+        contract says each value stays exactly 1 no matter how requests
+        churn or accept patterns vary (asserted by the bench drivers and
+        tests/test_serving_spec.py over churn epochs)."""
+        counts = {"decode": int(self._decode_step._cache_size())}
+        if self._verify_step is not None:
+            counts["verify"] = int(self._verify_step._cache_size())
+        return counts
 
     def stats(self) -> dict:
         return {"steps": self._steps,
@@ -404,9 +467,11 @@ class ServingEngine:
                 "preemptions": self.scheduler.num_preemptions,
                 "draining": self._draining,
                 "decode_programs": self.decode_program_count(),
+                "step_programs": self.step_program_counts(),
                 "prefill_programs": len(self._prefill_progs),
                 "prefix_cache": self.prefix_cache,
                 "kv_quant": self.kv_quant,
+                "speculative": self._spec is not None,
                 "tracing": self.tracer.enabled}
 
     # ------------------------------------------------------------------
@@ -534,6 +599,75 @@ class ServingEngine:
             return nt, ok, pools
 
         return decode_step
+
+    def _build_verify_step(self):
+        """The speculative multi-token step: ONE fixed-shape
+        ``[max_slots, k]`` program for the engine's lifetime.
+
+        Per slot, row 0 is the ordinary decode input (the last generated
+        token) and rows 1..n_live-1 are the drafter's guesses; row j is
+        written at pool position seq_lens + j and attends causally up to
+        itself (rows >= n_live and inactive slots write scratch page 0).
+        Every row is sampled under the engine's standard contract —
+        ``fold_in(PRNGKey(seed), counts + j)``, the exact key the
+        non-speculative engine would use for that token index — and
+        draft row j is ACCEPTED iff it equals the row j-1 sample. The
+        emitted tokens are the samples themselves, so the output stream
+        is bitwise identical to sequential decode (greedy and sampled)
+        no matter what the drafter proposed; for a deterministic drafter
+        this is exactly the Leviathan accept/reject rule. Rejected live
+        rows are zeroed IN-PROGRAM (fixed-shape scatter: rejected rows
+        target their real (page, offset), everything else targets
+        scratch (0, 0)) so no garbage outlives the step — accept
+        patterns are data, never shapes."""
+        from ..nn.module import functional_call
+        model = self.model
+        ps = self.page_size
+
+        @jax.jit
+        def verify_step(state, pools, toks, tables, seq_lens, active,
+                        n_live, temps, top_ps, greedy, seeds, counts):
+            (logits, pools), _ = functional_call(
+                model, state, toks, None, pools, 0,
+                (tables, seq_lens, active, n_live), training=False)
+            S, K, V = logits.shape
+            rows = jnp.arange(K)
+            live = rows[None, :] < n_live[:, None]            # [S, K]
+            # per-slot poison sentinel over the LIVE rows only (padded
+            # rows read scratch and may be anything)
+            ok = jnp.all(jnp.where(live[..., None],
+                                   jnp.isfinite(logits.astype(jnp.float32)),
+                                   True), axis=(1, 2))
+            # sample all S*K rows with the row's own token index —
+            # logits stay in the model dtype so argmax/softmax see the
+            # same bits the 1-token decode step would
+            samp = _sample_rows(
+                logits.reshape(S * K, V),
+                jnp.repeat(temps, K), jnp.repeat(top_ps, K),
+                jnp.repeat(greedy, K), jnp.repeat(seeds, K),
+                (counts[:, None] + rows[None, :]).reshape(-1),
+            ).reshape(S, K)
+            # accepted draft count m: longest prefix of live draft rows
+            # matching the previous row's sample
+            match = (toks[:, 1:] == samp[:, :-1]) & live[:, 1:]
+            m = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                        axis=1)                               # [S]
+            # in-program rollback: zero the rejected live rows at their
+            # real (page, offset); all other rows target scratch (0, 0).
+            # Speculatively-written pages are always private to their
+            # request (shared full pages are immutable, COW copies
+            # partials), so the zeroing can never hit foreign KV.
+            pos = seq_lens[:, None] + rows[None, :]           # [S, K]
+            rej = live & (rows[None, :] > m[:, None]) & active[:, None]
+            page = jnp.take_along_axis(tables, pos // ps, axis=1)
+            page = jnp.where(rej, page, 0)
+            off = jnp.where(rej, pos % ps, 0)
+            pools = [(KVCachePool._pos_zero(pk, page, off),
+                      KVCachePool._pos_zero(pv, page, off))
+                     for pk, pv in pools]
+            return samp, m, ok, pools
+
+        return verify_step
 
     def _bucket(self, n_tokens: int) -> int:
         """Prompt-length bucket: the next power-of-two page count, in
@@ -726,6 +860,14 @@ class ServingEngine:
                     self._finish_abnormal(req, "injected", events)
             if not self.scheduler.running:
                 return
+        if self._spec is not None and any(
+                req.draft_tokens
+                for req in self.scheduler.running.values()):
+            # at least one slot drafted: dispatch the multi-token verify
+            # step. Draftless steps fall through to the plain decode
+            # program — same emitted tokens, fewer scored rows.
+            self._run_verify(events)
+            return
         tr = self.tracer
         S, M = self.max_slots, self.max_pages_per_slot
         with tr.span("decode_dispatch", slots=len(self.scheduler.running)):
@@ -755,39 +897,8 @@ class ServingEngine:
                 jnp.asarray(top_ps), jnp.asarray(greedy),
                 jnp.asarray(seeds), jnp.asarray(counts))
             self.pool.pools = new_pools
-        if tr.enabled:
-            # retrace sentinel: the no-retrace contract says this stays
-            # at 1; any growth lands a compile bar + counter bump in the
-            # trace right where the regression happened
-            n = self.decode_program_count()
-            if n != self._decode_traces:
-                tr.instant("compile", program="decode", programs=n)
-                tr.bump("compiles", n - self._decode_traces)
-                if self._decode_traces:
-                    tr.bump("decode_retraces", n - self._decode_traces)
-                self._decode_traces = n
-        from ..distributed.watchdog import default_watchdog
-        wd = self._watchdog if self._watchdog is not None \
-            else default_watchdog()
-        if self.flight_recorder is not None and id(wd) not in self._wd_hooked:
-            # one hook per watchdog instance: a hung device sync dumps
-            # the event ring before any kill action fires
-            self._wd_hooked.add(id(wd))
-            recorder = self.flight_recorder
-
-            def _post_mortem(task_rec, _fr=recorder):
-                _fr.dump("watchdog_timeout", snapshot={
-                    "task": task_rec.name,
-                    "meta": {k: repr(v) for k, v in task_rec.meta.items()}})
-
-            wd.post_mortem_hooks.append(_post_mortem)
-        with wd.task("serving.step", timeout=self.step_timeout_s,
-                     step=self._steps, slots=len(self.scheduler.running)):
-            # np.asarray is the engine's blocking device sync — a hung
-            # device shows up here, so this is where the watchdog looks
-            with tr.span("device_sync"):
-                nt = np.asarray(nt)
-                ok = np.asarray(ok)
+        self._note_retraces()
+        nt, ok = self._watched_sync(nt, ok)
         with tr.span("sample_emit"):
             for slot, req in list(self.scheduler.running.items()):
                 req.context_len += 1  # this step's KV write at old
@@ -799,6 +910,158 @@ class ServingEngine:
                     self._finish_abnormal(req, "nonfinite", events)
                     continue
                 self._emit(req, int(nt[slot]), events)
+
+    def _note_retraces(self) -> None:
+        """Retrace sentinel, one per step shape: the no-retrace contract
+        says every entry of ``step_program_counts()`` stays at 1; any
+        growth lands a compile bar + counter bump in the trace right
+        where the regression happened."""
+        tr = self.tracer
+        if not tr.enabled:
+            return
+        for name, n in self.step_program_counts().items():
+            seen = self._step_traces.get(name, 0)
+            if n != seen:
+                tr.instant("compile", program=name, programs=n)
+                tr.bump("compiles", n - seen)
+                if seen:
+                    tr.bump("decode_retraces", n - seen)
+                self._step_traces[name] = n
+
+    def _watched_sync(self, *arrays):
+        """The engine's blocking device sync (np.asarray) under the
+        watchdog — a hung device shows up here, so this is where the
+        watchdog looks (and where the flight recorder's post-mortem
+        hook dumps the event ring before any kill action fires)."""
+        from ..distributed.watchdog import default_watchdog
+        wd = self._watchdog if self._watchdog is not None \
+            else default_watchdog()
+        if self.flight_recorder is not None and id(wd) not in self._wd_hooked:
+            # one hook per watchdog instance
+            self._wd_hooked.add(id(wd))
+            recorder = self.flight_recorder
+
+            def _post_mortem(task_rec, _fr=recorder):
+                _fr.dump("watchdog_timeout", snapshot={
+                    "task": task_rec.name,
+                    "meta": {k: repr(v) for k, v in task_rec.meta.items()}})
+
+            wd.post_mortem_hooks.append(_post_mortem)
+        with wd.task("serving.step", timeout=self.step_timeout_s,
+                     step=self._steps, slots=len(self.scheduler.running)):
+            with self.tracer.span("device_sync"):
+                return tuple(np.asarray(a) for a in arrays)
+
+    # ------------------------------------------------------------------
+    # speculative decoding (serving/speculative.py)
+    # ------------------------------------------------------------------
+
+    def _propose_drafts(self) -> None:
+        """Host-side draft proposal for every running slot. The draft
+        count is capped so the verify step can never write beyond the
+        request's admission-checked page/position budget: at most k-1
+        rows, at most what the remaining token budget could accept
+        (m + 1 emits <= remaining), and never past the slot's page table
+        or the rope table."""
+        spec, drafter = self._spec, self._drafter
+        max_pos = min(self.max_pages_per_slot * self.page_size,
+                      self.model.config.max_position_embeddings)
+        with self.tracer.span("draft",
+                              slots=len(self.scheduler.running)):
+            for req in self.scheduler.running.values():
+                cap = min(spec.k - 1,
+                          req.max_new_tokens - len(req.tokens) - 1,
+                          max_pos - req.context_len - 1)
+                drafts = drafter.propose(req, cap) if cap > 0 else []
+                req.draft_tokens = [int(t) for t in drafts[:cap]]
+                self.metrics.on_spec_draft(len(req.draft_tokens))
+
+    def _run_verify(self, events: list[dict]) -> None:
+        """The speculative counterpart of ``_run_decode``: dispatch the
+        fixed-shape [max_slots, k] verify program, then emit each slot's
+        accepted sample prefix (plus the bonus correction sample) —
+        bitwise the tokens sequential decode would have produced."""
+        tr = self.tracer
+        S, M, K = self.max_slots, self.max_pages_per_slot, self._spec.k
+        n_drafted = {slot: len(req.draft_tokens)
+                     for slot, req in self.scheduler.running.items()}
+        with tr.span("verify", slots=len(self.scheduler.running),
+                     drafts=sum(n_drafted.values())):
+            toks = np.zeros((S, K), np.int32)
+            tables = np.zeros((S, M), np.int32)
+            seq_lens = np.zeros((S,), np.int32)
+            active = np.zeros((S,), bool)
+            n_live = np.zeros((S,), np.int32)
+            temps = np.ones((S,), np.float32)
+            top_ps = np.ones((S,), np.float32)
+            greedy = np.ones((S,), bool)
+            seeds = np.zeros((S,), np.int32)
+            counts = np.zeros((S,), np.int32)
+            for slot, req in self.scheduler.running.items():
+                d = req.draft_tokens
+                toks[slot, 0] = req.tokens[-1]
+                if d:
+                    toks[slot, 1:1 + len(d)] = d
+                n_live[slot] = 1 + len(d)
+                tables[slot, :len(req.pages)] = req.pages
+                seq_lens[slot] = req.context_len
+                active[slot] = True
+                temps[slot] = req.sampling.temperature
+                top_ps[slot] = req.sampling.top_p
+                greedy[slot] = not req.sampling.do_sample
+                seeds[slot] = req.sampling.seed
+                counts[slot] = len(req.tokens)
+            samp, acc, ok, new_pools = self._verify_step(
+                self._state, self.pool.pools, jnp.asarray(toks),
+                jnp.asarray(tables), jnp.asarray(seq_lens),
+                jnp.asarray(active), jnp.asarray(n_live),
+                jnp.asarray(temps), jnp.asarray(top_ps),
+                jnp.asarray(greedy), jnp.asarray(seeds),
+                jnp.asarray(counts))
+            self.pool.pools = new_pools
+        self._note_retraces()
+        samp, acc, ok = self._watched_sync(samp, acc, ok)
+        with tr.span("sample_emit"):
+            for slot, req in list(self.scheduler.running.items()):
+                n_draft = n_drafted[slot]
+                req.draft_tokens = []
+                C = req.context_len
+                if not ok[slot]:
+                    # poison quarantine, same as the decode path: only
+                    # this slot finishes (rows are per-slot independent)
+                    req.context_len += 1
+                    self._finish_abnormal(req, "nonfinite", events)
+                    continue
+                m = int(acc[slot])
+                if n_draft:
+                    self.metrics.on_spec_verify(n_draft, m)
+                    self._drafter.observe(req, n_draft, m)
+                # the emitted tokens are the engine's own samples for
+                # rows 0..m — exactly what m + 1 sequential decode steps
+                # would have drawn. A stop (eos) inside the accept
+                # window truncates the emission there.
+                emit: list[int] = []
+                for j in range(m + 1):
+                    t = int(samp[slot, j])
+                    emit.append(t)
+                    if ((req.eos_token_id is not None
+                         and t == req.eos_token_id)
+                            or len(req.tokens) + len(emit)
+                            >= req.max_new_tokens):
+                        break
+                req.context_len = C + len(emit)
+                if len(emit) < m + 1:
+                    # accepted-but-unused tail beyond an in-window stop:
+                    # rewind those positions to zero before the pages
+                    # can be released/registered (token-granular
+                    # masked-garbage-is-zero)
+                    self.pool.rewind(req.pages, C + len(emit), C + m + 1)
+                if tr.enabled and n_draft > m:
+                    tr.instant("rollback", track=req.rid,
+                               rejected=n_draft - m, accepted=m)
+                    tr.bump("spec_rejected_tokens", n_draft - m)
+                for t in emit:
+                    self._emit(req, t, events)
 
     def _emit(self, req: Request, token: int, events: list[dict]) -> None:
         req.tokens.append(token)

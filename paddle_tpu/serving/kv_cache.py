@@ -176,6 +176,7 @@ class KVCachePool:
             "prefix_lookups": 0, "prefix_hits": 0, "prefix_hit_pages": 0,
             "prefix_partial_hits": 0, "prefix_evictions": 0,
             "prefix_cow_copies": 0, "prefix_pages_registered": 0,
+            "rewound_tokens": 0,
         }
 
     @classmethod
@@ -486,3 +487,36 @@ class KVCachePool:
         idx = jnp.asarray(sorted(set(pages)), jnp.int32)
         self.pools = [(_page_zero(pk, idx), _page_zero(pv, idx))
                       for pk, pv in self.pools]
+
+    def rewind(self, pages: list[int], start: int, stop: int) -> None:
+        """Zero cache POSITIONS ``[start, stop)`` of a request's block
+        table (token-granular, unlike page-granular ``scrub``): the
+        speculative rollback primitive. The verify step writes draft KV
+        optimistically at positions ``context_len..context_len+n_draft``;
+        the compiled step zeroes rejected rows in-program, and the
+        engine calls this for the host-side cases (accepted-but-unused
+        tail when eos/length lands inside the accept window) so a
+        partial-page tail never leaves garbage beyond the request's
+        ``context_len`` — masked-garbage-is-zero, preserved at token
+        granularity. Pages written speculatively are always private to
+        the request (shared full pages are immutable; COW copies partial
+        heads), so zeroing here can never damage another request's KV."""
+        if stop <= start:
+            return
+        ps = self.page_size
+        pg = jnp.asarray([pages[p // ps] for p in range(start, stop)],
+                         jnp.int32)
+        off = jnp.asarray([p % ps for p in range(start, stop)], jnp.int32)
+        self.pools = [(self._pos_zero(pk, pg, off),
+                       self._pos_zero(pv, pg, off))
+                      for pk, pv in self.pools]
+        self.counters["rewound_tokens"] += stop - start
+
+    @staticmethod
+    def _pos_zero(arr, pages, offs):
+        """Zero individual (page, offset) rows; QuantizedKV zeroes codes
+        AND scales (same reasoning as ``_page_zero``)."""
+        if isinstance(arr, QuantizedKV):
+            return QuantizedKV(arr.q.at[pages, offs].set(0),
+                               arr.scale.at[pages, offs].set(0))
+        return arr.at[pages, offs].set(0)

@@ -119,6 +119,17 @@ class ServingMetrics:
         # element, the number an operator alerts on
         self.kv_quant_enabled = 0
         self.kv_quant_scale_max = 0.0
+        # speculative decoding (SERVING.md "Speculative decoding"):
+        # draft/accept token totals, drafter hit counts (calls that
+        # proposed >= 1 token), and a per-draft-length accept histogram
+        # {n_draft: [accepted_sum, verify_steps]} for the profiler's
+        # accept-rate-by-length report
+        self.spec_enabled = 0
+        self._spec_draft_tokens = 0
+        self._spec_accepted_tokens = 0
+        self._spec_draft_calls = 0
+        self._spec_draft_hits = 0
+        self._spec_hist: dict[int, list[int]] = {}
 
     def now(self) -> float:
         return self._clock()
@@ -241,6 +252,53 @@ class ServingMetrics:
         self.kv_quant_scale_max = max(self.kv_quant_scale_max,
                                       float(scale_max))
 
+    # ---- speculative decoding (SERVING.md "Speculative decoding") ----
+
+    def set_spec(self, enabled: bool) -> None:
+        """Arm the spec_enabled gauge (int, for Prometheus export)."""
+        self.spec_enabled = int(bool(enabled))
+
+    def on_spec_draft(self, proposed: int) -> None:
+        """One drafter call for one slot: ``proposed`` tokens offered
+        (0 = the drafter had nothing — the slot decodes normally)."""
+        self._spec_draft_calls += 1
+        if proposed > 0:
+            self._spec_draft_hits += 1
+
+    def on_spec_verify(self, drafted: int, accepted: int) -> None:
+        """One slot's verify outcome: ``accepted`` of ``drafted`` draft
+        tokens matched the engine's own samples (the step emitted
+        accepted + 1 tokens before any eos/length truncation)."""
+        self._spec_draft_tokens += drafted
+        self._spec_accepted_tokens += accepted
+        h = self._spec_hist.setdefault(drafted, [0, 0])
+        h[0] += accepted
+        h[1] += 1
+
+    def spec_accept_rate(self) -> float:
+        """Fraction of drafted tokens accepted by the verify step."""
+        if self._spec_draft_tokens == 0:
+            return 0.0
+        return self._spec_accepted_tokens / self._spec_draft_tokens
+
+    def spec_draft_hit_rate(self) -> float:
+        """Fraction of drafter calls that proposed at least one token."""
+        if self._spec_draft_calls == 0:
+            return 0.0
+        return self._spec_draft_hits / self._spec_draft_calls
+
+    def spec_accept_histogram(self) -> dict[int, dict]:
+        """Accept stats keyed by draft length: {n_draft: {"steps",
+        "accepted_mean", "accept_rate"}} — the profiler's per-length
+        report (tools/profile_serving.py --spec)."""
+        out = {}
+        for n, (acc, steps) in sorted(self._spec_hist.items()):
+            out[n] = {"steps": steps,
+                      "accepted_mean": acc / steps if steps else 0.0,
+                      "accept_rate": acc / (n * steps)
+                      if n and steps else 0.0}
+        return out
+
     def cache_hit_rate(self) -> float:
         """Fraction of prefill context tokens served from cached pages."""
         if self._prefill_tokens == 0:
@@ -309,6 +367,13 @@ class ServingMetrics:
             "kv_quant_enabled": self.kv_quant_enabled,
             "kv_quant_scale_max": self.kv_quant_scale_max,
             "kv_quant_err_bound": self.kv_quant_scale_max / 2.0,
+            # speculative decoding gauges/counters (schema-stable: zeros
+            # with speculation off)
+            "spec_enabled": self.spec_enabled,
+            "spec_draft_tokens_total": self._spec_draft_tokens,
+            "spec_accepted_tokens_total": self._spec_accepted_tokens,
+            "spec_accept_rate": self.spec_accept_rate(),
+            "spec_draft_hit_rate": self.spec_draft_hit_rate(),
             # pool counters live under prefix_* so they can never
             # shadow a summary key (the pool already uses that prefix
             # for most of them — normalise the stragglers)

@@ -74,6 +74,12 @@ class Request:
     # a copy-on-write partial hit
     cached_len: int = 0
     cached_partial: bool = False
+    # speculative decoding (serving/speculative.py): tokens the drafter
+    # proposed for the NEXT step; the verify program scores them at
+    # positions context_len..context_len+len-1 and the engine clears the
+    # list every step. Drafts never affect the emitted stream — only how
+    # many tokens a step emits — so this is working state, not history.
+    draft_tokens: list[int] = field(default_factory=list)
 
     @property
     def recompute_len(self) -> int:
@@ -100,6 +106,13 @@ class Scheduler:
         self._free_slots = list(range(max_slots - 1, -1, -1))
         self._arrival_counter = 0
         self.num_preemptions = 0
+        # speculative decoding: the engine sets spec_k to its verify
+        # step's row count (1 = plain decode). A verify step scores up
+        # to spec_k tokens per running slot through the same weight
+        # stream a prefill would use, so admission charges those extra
+        # verify tokens against the SAME per-step prefill token budget —
+        # one budget bounds the step's total token work.
+        self.spec_k = 1
         # injected by the engine when tracing is on. The scheduler owns
         # every queue/slot state transition, so it owns the request-track
         # lifecycle spans: "queued" opens at add/_requeue and closes at
@@ -211,6 +224,8 @@ class Scheduler:
         req.pages = []
         req.cached_len = 0
         req.cached_partial = False
+        req.draft_tokens = []   # drafts are per-step state; recompute
+                                # re-proposes from the same history
         self._free_slots.append(req.slot)
         del self.running[req.slot]
         req.slot = None
@@ -238,6 +253,14 @@ class Scheduler:
 
     # ---- the per-step scheduling decision ----
 
+    def verify_token_reserve(self) -> int:
+        """Verify tokens the next step may score beyond the plain
+        one-per-slot decode: (spec_k - 1) draft rows per running slot.
+        The engine subtracts this from the prefill budget it threads
+        through ``admit`` so speculation and prefill bursts share one
+        per-step token-work bound (0 when speculation is off)."""
+        return (self.spec_k - 1) * len(self.running)
+
     def ensure_decode_pages(self, pool: KVCachePool) -> list[Request]:
         """Before a decode step: every running request writes its next
         token at position context_len — make sure that page exists.
@@ -248,7 +271,13 @@ class Scheduler:
         for req in sorted(self.running.values(), key=lambda r: r.arrival_seq):
             if req.slot is None:  # lost its slot to an earlier preemption
                 continue
-            needed = pool.pages_for(req.context_len + 1) - len(req.pages)
+            # a speculative step writes the decode token AND the drafts
+            # optimistically, so the page guarantee covers all of them;
+            # rejected drafts just leave (zeroed) headroom the request
+            # would have grown into anyway
+            needed = (pool.pages_for(req.context_len + 1
+                                     + len(req.draft_tokens))
+                      - len(req.pages))
             while needed > 0:
                 try:
                     req.pages.extend(pool.alloc(needed))
@@ -345,5 +374,7 @@ class Scheduler:
                                     cached=cached, suffix=suffix)
                 self.tracer.begin("running", track=req.rid)
             admitted.append(req)
-            budget -= suffix
+            # an admitted slot also joins this step's verify fan-out
+            # (spec_k - 1 draft rows), charged like prefill tokens
+            budget -= suffix + (self.spec_k - 1)
         return admitted

@@ -1,0 +1,133 @@
+"""Speculative decoding: draft proposers for the paged serving engine.
+
+The engine's bandwidth wall is the weight stream: every decode step
+reads all weight bytes to produce ONE token per slot. Speculative
+decoding makes the same stream score k tokens per slot — a cheap
+*drafter* guesses the next few tokens from request history, and one
+fixed-shape ``[max_slots, k]`` *verify* program (built by the engine,
+see ``engine._build_verify_step``) scores all draft positions at once,
+accepting the longest prefix that matches what the engine would have
+sampled anyway.
+
+The acceptance rule is sample-and-compare: at draft position n the
+verify program draws token ``t_n`` under the engine's standard sampling
+contract (``fold_in(PRNGKey(seed), token_index)``, same temperature /
+top-p / greedy switch as the 1-token decode step) and accepts the draft
+iff it equals ``t_n``; the token actually emitted is ``t_n`` either
+way. For the deterministic drafters here this IS the exact Leviathan
+et al. accept/reject rule — a point-mass draft distribution accepts
+with probability ``p(draft)`` and otherwise resamples from the
+renormalized remainder, which is exactly what comparing against an
+independent draw from ``p`` does. Two consequences the engine's tests
+lean on:
+
+- the emitted stream is **bitwise identical** to the non-speculative
+  engine's (greedy and sampled) — drafts only change how many tokens a
+  step emits, never which tokens;
+- the stream is independent of the drafter entirely, so fleet failover
+  replay stays bitwise even if a future drafter is adaptive or
+  nondeterministic.
+
+Drafters are pluggable via :class:`DraftProposer`; the built-in
+:class:`NgramDrafter` is Saxena-style prompt lookup — no second model,
+wins on shared-system-prompt and self-repetitive traffic, loses
+(gracefully: zero drafts, plain 1-token steps) on text that never
+repeats its own n-grams. A small draft *model* sharing the paged pool
+can implement the same two-method interface later.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+__all__ = ["DraftProposer", "NgramDrafter", "SpeculativeConfig"]
+
+
+class DraftProposer:
+    """Interface a drafter implements.
+
+    ``propose(req, k)`` returns up to ``k`` guessed continuation tokens
+    for a running request (``req.prompt`` + ``req.tokens`` is the full
+    visible history; the last element of ``req.tokens`` is the decode
+    input the guesses extend). Returning ``[]`` is always legal and
+    means "this step decodes normally". ``observe`` is called after
+    every verify step with the proposal size and how many were
+    accepted — adaptive drafters (or a draft model tuning its depth)
+    hook here; the default is a no-op.
+
+    Proposals may be wrong, stale, or random without affecting output
+    correctness — the verify program emits the engine's own sampled
+    tokens regardless — so implementations only need to chase accept
+    rate, never exactness.
+    """
+
+    def propose(self, req, k: int) -> list[int]:
+        raise NotImplementedError
+
+    def observe(self, req, n_draft: int, n_accepted: int) -> None:
+        pass
+
+
+class NgramDrafter(DraftProposer):
+    """Prompt-lookup / n-gram drafter (Saxena 2023).
+
+    Matches the last ``n`` tokens of the visible history (prompt +
+    generated tokens) against every earlier position, longest ``n``
+    first, rightmost (most recent) occurrence first, and proposes the
+    tokens that followed that occurrence. Pure function of request
+    history — deterministic across preemption recompute and fleet
+    replay.
+    """
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        if not 1 <= min_ngram <= max_ngram:
+            raise ValueError("need 1 <= min_ngram <= max_ngram")
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+
+    def propose(self, req, k: int) -> list[int]:
+        if k <= 0:
+            return []
+        ctx = list(req.prompt) + list(req.tokens)
+        for n in range(self.max_ngram, self.min_ngram - 1, -1):
+            if len(ctx) <= n:
+                continue
+            pat = ctx[-n:]
+            # rightmost earlier occurrence of the trailing n-gram; the
+            # match may not include the trailing position itself
+            for i in range(len(ctx) - n - 1, -1, -1):
+                if ctx[i:i + n] == pat:
+                    cont = ctx[i + n:i + n + k]
+                    if cont:
+                        return [int(t) for t in cont]
+                    break  # pattern only recurs flush at the end
+        return []
+
+
+@dataclass
+class SpeculativeConfig:
+    """Engine-facing speculative decoding switch.
+
+    ``k`` is the verify step's row count per slot — 1 decode input plus
+    up to ``k - 1`` draft tokens — and is a COMPILE-TIME shape: the
+    engine builds exactly one ``[max_slots, k]`` verify program, and
+    per-step draft counts pad into it (``n_live`` masking), never
+    retrace it. ``drafter`` overrides the built-in
+    :class:`NgramDrafter` (constructed from ``max_ngram``/``min_ngram``
+    otherwise).
+    """
+
+    k: int = 4
+    max_ngram: int = 3
+    min_ngram: int = 1
+    drafter: DraftProposer | None = field(default=None, repr=False)
+
+    def __post_init__(self):
+        if self.k < 2:
+            raise ValueError("speculative k must be >= 2 "
+                             "(1 decode row + at least 1 draft row)")
+
+    def make_drafter(self) -> DraftProposer:
+        if self.drafter is not None:
+            return self.drafter
+        return NgramDrafter(self.max_ngram, self.min_ngram)

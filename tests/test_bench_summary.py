@@ -15,7 +15,7 @@ _DEFAULT_CONFIGS = {
     "llama_420m", "resnet50", "bert_base", "qwen2_moe", "lenet_mnist",
     "llama8b_shape", "llama_decode", "llama_longctx", "llama_serving",
     "llama_serving_prefix", "llama_decode_int8", "llama_serving_int8",
-    "llama_serving_fleet",
+    "llama_serving_fleet", "llama_serving_spec",
 }
 
 
@@ -135,6 +135,24 @@ def test_dry_fleet_cell_carries_failover_keys():
                          "ttft_p50", "ttft_p99", "tpot",
                          "failovers", "replayed_tokens", "shed",
                          "replicas_ejected",
+                         "goodput_at_slo", "retraces"}, cell
+    assert all(v is None for v in cell.values()), cell
+
+
+def test_dry_serving_spec_cell_carries_acceptance_keys():
+    # the speculative arm (SERVING.md "Speculative decoding"): the cell
+    # must surface the draft-economics evidence — accept rate, how often
+    # the n-gram drafter had anything to propose, and the measured
+    # speedup vs the plain-decode arm of the same run — next to the
+    # usual serving SLO keys
+    out = _run_dry("llama_serving_spec")
+    assert out.returncode == 0, out.stderr
+    last = json.loads(out.stdout.splitlines()[-1])
+    cell = last["bench_summary"]["llama_serving_spec"]
+    assert set(cell) >= {"value", "mfu", "spread",
+                         "ttft_p50", "ttft_p99", "tpot",
+                         "accept_rate", "draft_hit_rate",
+                         "speedup_vs_decode",
                          "goodput_at_slo", "retraces"}, cell
     assert all(v is None for v in cell.values()), cell
 

@@ -34,6 +34,17 @@ Run: python tools/profile_serving.py            (real TPU)
                                                  error + >=99% greedy
                                                  agreement asserted, int8
                                                  weight-stream bytes)
+     python tools/profile_serving.py --spec     (speculative-decoding
+                                                 A/B: the staggered
+                                                 shared-system-prompt
+                                                 trace with speculation
+                                                 OFF then ON — token-
+                                                 exact greedy parity
+                                                 asserted, steps-saved /
+                                                 throughput deltas and
+                                                 the accept-rate
+                                                 histogram by draft
+                                                 length printed)
      python tools/profile_serving.py --chaos    (replay the fixed
                                                  FaultPlan below and print
                                                  the outcome histogram —
@@ -467,6 +478,162 @@ def prefix():
               "on-chip for the PERF.md numbers)")
 
 
+def spec():
+    """Speculative-decoding A/B (SERVING.md "Speculative decoding"): one
+    staggered shared-system-prompt trace replayed on two identically-
+    configured engines — speculation OFF (plain 1-token decode) then ON
+    (n-gram prompt-lookup draft + the fixed-shape ``[max_slots, k]``
+    verify program). Both arms must produce bitwise-identical greedy
+    tokens (and match per-request ``generate()``) — the verify step
+    emits its own samples, drafts only decide how many land per step —
+    so the deltas printed at the end are pure mechanism: engine steps
+    saved, tokens/s ratio, and the accept-rate histogram by draft
+    length that explains both."""
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                         llama_tiny)
+    from paddle_tpu.serving import (ServingEngine, ServingMetrics,
+                                    SpeculativeConfig)
+
+    backend = jax.default_backend()
+    smoke = "--smoke" in sys.argv[1:] or backend != "tpu"
+    if backend != "tpu":
+        print(f"WARNING: backend={backend} — timings are meaningless "
+              f"off-chip, running the smoke shapes")
+
+    pt.seed(0)
+    if smoke:
+        cfg = llama_tiny(mp_axis=None, fsdp_axis=None)
+        n_requests, max_new = 6, 12
+        prefix_len, sfx_lohi = 24, (4, 16)
+        page_size, num_pages, max_slots = 4, 128, 4
+    else:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5632, num_hidden_layers=8,
+                          num_attention_heads=16, num_key_value_heads=8,
+                          max_position_embeddings=4096, dtype="bfloat16",
+                          mp_axis=None, fsdp_axis=None)
+        n_requests, max_new = 16, 64
+        prefix_len, sfx_lohi = 256, (16, 64)
+        page_size, num_pages, max_slots = 16, 1024, 8
+    spec_k = 4
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+
+    rng = np.random.default_rng(0)
+    system = rng.integers(0, cfg.vocab_size, prefix_len).astype(np.int32)
+    sfx_lens = [int(x) for x in rng.integers(*sfx_lohi, n_requests)]
+    prompts = [np.concatenate(
+        [system, rng.integers(0, cfg.vocab_size, n).astype(np.int32)])
+        for n in sfx_lens]
+    lens = [len(p) for p in prompts]
+    print(f"trace: {n_requests} requests sharing a {prefix_len}-token "
+          f"system prompt, suffixes {min(sfx_lens)}-{max(sfx_lens)} "
+          f"tokens, staggered arrivals, max_new={max_new}, greedy, "
+          f"k={spec_k}")
+
+    # cold reference: per-request contiguous generate — BOTH arms must
+    # match it bitwise (the determinism contract survives speculation)
+    refs = [np.asarray(model.generate(np.asarray([p]),
+                                      max_new_tokens=max_new)
+                       )[0, len(p):].tolist() for p in prompts]
+
+    mpps = max((n + max_new) // page_size + 2 for n in lens)
+
+    class _WarmDrafter:
+        # propose-always: traces the verify program during warmup even
+        # when the warm prompts have no n-gram repeats
+        def propose(self, req, k):
+            ctx = req.tokens or list(req.prompt)
+            return [int(ctx[-1])] * k
+
+        def observe(self, req, n_draft, n_accepted):
+            pass
+
+    def run_arm(spec_on):
+        eng = ServingEngine(model, num_pages=num_pages,
+                            page_size=page_size, max_slots=max_slots,
+                            max_pages_per_slot=mpps,
+                            speculative=(SpeculativeConfig(k=spec_k)
+                                         if spec_on else None))
+        real_drafter = eng._drafter
+        if spec_on:
+            eng._drafter = _WarmDrafter()
+        # warm every prefill bucket the trace will hit with an in-bucket
+        # length that fits the slot (a bucket-sized prompt can exceed
+        # max_pages_per_slot), plus decode + verify. Warm max_new must
+        # exceed 2: the draft cap is max_new - len(tokens) - 1, so a
+        # 2-token warm request never drafts and the verify program
+        # would compile inside the measured trace
+        warmed = set()
+        for n in sorted(set(lens) | set(sfx_lens)):
+            b = eng._bucket(n)
+            if b not in warmed:
+                warmed.add(b)
+                eng.add_request(
+                    rng.integers(0, cfg.vocab_size, n).astype(np.int32),
+                    4 if spec_on else 2)
+        eng.run_to_completion(max_steps=500)
+        eng._drafter = real_drafter
+        eng.metrics = ServingMetrics()
+        eng.metrics.set_spec(spec_on)
+
+        t0 = time.perf_counter()
+        rids = [eng.add_request(p, max_new) for p in prompts[:2]]
+        added, steps = 2, 0
+        while eng.scheduler.has_work() or added < n_requests:
+            eng.step()
+            steps += 1
+            if added < n_requests and steps % 2 == 0:
+                rids.append(eng.add_request(prompts[added], max_new))
+                added += 1
+        wall = time.perf_counter() - t0
+        counts = eng.step_program_counts()
+        assert all(n <= 1 for n in counts.values()), \
+            f"step program retraced: {counts}"
+        outs = [list(eng.request(r).tokens) for r in rids]
+        return outs, wall, steps, eng
+
+    out_off, t_off, steps_off, _ = run_arm(False)
+    out_on, t_on, steps_on, eng = run_arm(True)
+
+    for ref, a, b in zip(refs, out_off, out_on):
+        assert a == ref, "spec-OFF arm diverged from generate() — bug"
+        assert b == ref, ("spec-ON arm diverged — speculation changed "
+                          "WHICH tokens, not just how many per step")
+    print("parity: spec-ON == spec-OFF == generate(), token-exact, "
+          "all requests")
+
+    total = sum(len(r) for r in refs)
+    m = eng.metrics.summary()
+    print(f"\nspec OFF: {t_off:7.3f}s  {total / t_off:8.1f} tok/s  "
+          f"{steps_off} engine steps")
+    print(f"spec ON : {t_on:7.3f}s  {total / t_on:8.1f} tok/s  "
+          f"{steps_on} engine steps  "
+          f"accept_rate={m['spec_accept_rate']:.3f}  "
+          f"draft_hit_rate={m['spec_draft_hit_rate']:.3f}")
+    print(f"\ndeltas (ON vs OFF): throughput "
+          f"{(total / t_on) / (total / t_off):.2f}x  steps "
+          f"{steps_on}/{steps_off} "
+          f"({m['spec_accepted_tokens_total']} accepted draft tokens = "
+          f"decode steps not paid for)")
+    hist = eng.metrics.spec_accept_histogram()
+    print("accept-rate histogram by draft length:")
+    for n in sorted(hist):
+        h = hist[n]
+        bar = "#" * round(20 * h["accept_rate"])
+        print(f"  n_draft={n}: {h['steps']:4d} steps  "
+              f"mean accepted {h['accepted_mean']:.2f}  "
+              f"accept_rate {h['accept_rate']:.3f} {bar}")
+    if not hist:
+        print("  (no drafts proposed — trace had no n-gram repeats)")
+    if smoke:
+        print("(smoke mode: ratios are logic evidence only — rerun "
+              "on-chip for the PERF.md numbers)")
+
+
 def kv_int8():
     """Quantized-serving A/B (SERVING.md "Quantized KV & weights"): the
     SAME staggered ragged trace replayed on two identically-configured
@@ -729,5 +896,7 @@ if __name__ == "__main__":
         prefix()
     elif "--kv-int8" in sys.argv[1:]:
         kv_int8()
+    elif "--spec" in sys.argv[1:]:
+        spec()
     else:
         main()
