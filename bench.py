@@ -95,6 +95,9 @@ _SERVING_SLOS = {
     # must not be allowed to trade latency SLOs for throughput. itl is
     # per-EMITTED-token, so accepted multi-token steps help, not hurt
     "llama_serving_spec": {"ttft_p99_s": 2.0, "itl_p99_s": 0.25},
+    # tiered arm: prefix-cache SLOs — the host tier's job is to keep
+    # the hit path (and its TTFT) alive under pool pressure
+    "llama_serving_tiered": {"ttft_p99_s": 1.0, "itl_p99_s": 0.25},
 }
 
 
@@ -1182,6 +1185,108 @@ def bench_llama_serving_fleet(peak, peak_kind, n_requests=12,
     }
 
 
+def bench_llama_serving_tiered(peak, peak_kind, n_requests=12,
+                               max_new_tokens=48, trace_path=None):
+    """Tiered-KV serving A/B (SERVING.md "KV tiering & traffic
+    harness"): a seeded Poisson multi-tenant :class:`Workload` (Zipf
+    tenant popularity over 3 shared system prompts, mixed suffix
+    lengths) replayed on a pool deliberately sized to hold ~1.3 tenants'
+    pages, so returning tenants force LRU evictions. Arm A runs with no
+    host tier (evicted = recompute); arm B attaches a :class:`HostTier`
+    so evictions demote to host RAM and hits restore. Both arms see the
+    IDENTICAL trace (the workload is a value) and each arm replays it
+    twice on one engine — epoch 1 warms the compiled programs and the
+    prefix index, epoch 2 is measured — so the goodput_at_slo and
+    HBM/host/miss hit-rate deltas in the bench_summary cell are
+    attributable to the tier alone. Decode stays ONE compiled program
+    per arm: restores are admission-time ``device_put``s, never a new
+    step shape."""
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import (HostTier, ServingEngine,
+                                    ServingMetrics, make_workload)
+
+    name = "llama_serving_tiered"
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                      intermediate_size=5632, num_hidden_layers=8,
+                      num_attention_heads=16, num_key_value_heads=8,
+                      max_position_embeddings=4096, dtype="bfloat16",
+                      mp_axis=None, fsdp_axis=None)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    n_params = model.num_params()
+    wl = make_workload(seed=0, n_requests=n_requests, arrival="poisson",
+                       rate=0.5, tenants=3, zipf_alpha=1.2,
+                       system_len=(160, 224),
+                       prompt_mix=((0.7, 16, 48), (0.3, 48, 96)),
+                       max_new=(max_new_tokens, max_new_tokens),
+                       vocab_size=cfg.vocab_size)
+    tracer = _make_tracer(trace_path)
+    arms = {}
+    for arm, tier in (("notier", None), ("tiered", HostTier())):
+        eng = ServingEngine(model, num_pages=40, page_size=16,
+                            max_slots=4, tracer=tracer, host_tier=tier)
+        wl.replay(eng, max_steps=4000, rid_prefix="warm-")
+        eng.metrics = ServingMetrics()  # compile time stays off the clock
+        eng.metrics.set_slo(**_SERVING_SLOS[name])
+        eng.metrics.set_host_tier(tier is not None)
+        out = wl.replay(eng, max_steps=4000, rid_prefix="run-")
+        m = eng.metrics.summary()
+        assert eng.decode_program_count() == 1, "serving decode retraced"
+        arms[arm] = (eng, m, out)
+    eng, m, out = arms["tiered"]
+    assert eng.pool.host_tier.counters["restored_pages"] > 0, \
+        "tiered arm never restored — pool no longer under pressure"
+    m0 = arms["notier"][1]
+    hbm_bw = {"v4": 1.2e12,
+              "v5e": 0.82e12, "v5litepod": 0.82e12, "v5lite": 0.82e12,
+              "v5p": 2.77e12,
+              "v6e": 1.64e12, "trillium": 1.64e12,
+              }.get(peak_kind.split("(")[0], 0.82e12)
+    wall = max(m["wall_s"], 1e-9)
+    mbu = out["steps"] * 2.0 * n_params / wall / hbm_bw
+    trace_out = _dump_trace(tracer, trace_path, name)
+    wstats = wl.stats()
+    return {
+        "metric": "llama_420m_serving_tiered_tokens_per_sec",
+        "value": round(m["tokens_per_s"], 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mbu, 4),
+        "extra": {"params": n_params, "workload": wstats,
+                  "max_new_tokens": max_new_tokens,
+                  "engine_steps": out["steps"],
+                  "submitted": out["submitted"], "shed": out["shed"],
+                  "cache_hit_rate": round(m["cache_hit_rate"], 4),
+                  "cache_hit_rate_notier": round(m0["cache_hit_rate"], 4),
+                  "tier_hbm_hit_rate": round(m["tier_hbm_hit_rate"], 4),
+                  "tier_host_hit_rate": round(m["tier_host_hit_rate"], 4),
+                  "tier_miss_rate": round(m["tier_miss_rate"], 4),
+                  "spilled_pages": m["spilled_pages"],
+                  "restored_pages": m["restored_pages"],
+                  "spilled_bytes": m["spilled_bytes"],
+                  "restored_bytes": m["restored_bytes"],
+                  "host_pool_bytes": m["host_pool_bytes"],
+                  "prefill_restored_tokens": m["prefill_restored_tokens"],
+                  "ttft_p50": round(m["ttft_p50_s"], 4),
+                  "ttft_p99": round(m["ttft_p99_s"], 4),
+                  "tpot": round(m["tpot_mean_s"], 5),
+                  "itl_p99": round(m["itl_p99_s"], 5),
+                  "preemptions": m["preemptions"],
+                  "rejected": m["rejected"],
+                  "goodput_at_slo": round(m["goodput_at_slo"], 4),
+                  "goodput_at_slo_notier": round(m0["goodput_at_slo"], 4),
+                  "tokens_per_s_notier": round(m0["tokens_per_s"], 1),
+                  "slo": _SERVING_SLOS[name],
+                  "retraces": eng.decode_program_count() - 1,
+                  "trace": trace_out,
+                  "mbu_weights_only": round(mbu, 4),
+                  "peak": peak_kind, "hbm_bw": hbm_bw,
+                  "pipeline": False, "runs": _RUNS,
+                  "spread": None},
+    }
+
+
 def bench_llama8b_shape(peak, peak_kind, batch=1, seq=4096, layers=2):
     """North-star-SHAPE evidence (VERDICT r4 missing #1): ``layers``
     llama_3_8b-config decoder layers (hidden 4096, ffn 14336, GQA 32/8,
@@ -1263,6 +1368,10 @@ _CONFIGS = {
     # n-gram draft + one [max_slots, k] verify program vs plain decode
     # on the same shared-system-prompt trace; token-exact by assertion
     "llama_serving_spec": bench_llama_serving_spec,
+    # host-RAM KV tiering A/B on a Poisson multi-tenant Workload
+    # (SERVING.md "KV tiering & traffic harness"): spill-off vs spill-on
+    # under forced pool pressure; goodput_at_slo + tier hit rates
+    "llama_serving_tiered": bench_llama_serving_tiered,
 }
 
 # configs whose bench_summary cell carries extra keys beyond
@@ -1289,6 +1398,12 @@ _SUMMARY_EXTRA_KEYS = {
                            "accept_rate", "draft_hit_rate",
                            "speedup_vs_decode",
                            "goodput_at_slo", "retraces"),
+    "llama_serving_tiered": ("ttft_p50", "ttft_p99", "tpot",
+                             "cache_hit_rate", "tier_hbm_hit_rate",
+                             "tier_host_hit_rate", "tier_miss_rate",
+                             "spilled_pages", "restored_pages", "shed",
+                             "goodput_at_slo", "goodput_at_slo_notier",
+                             "retraces"),
 }
 
 # opt-in configs (not in the default driver run — kept out to bound its

@@ -140,6 +140,41 @@ def main():
           f"{qm['kv_quant_err_bound']:.4f}, weights {fp_b/1e6:.1f}MB -> "
           f"{q_b/1e6:.1f}MB")
 
+    # --- tiered KV cache: spill to host RAM, restore on hit -------------
+    # when the HBM pool LRU-evicts a cached page, host_tier=True demotes
+    # its bytes to a bounded host-RAM pool instead of losing them; a
+    # later request whose prefix walks into the tier restores the pages
+    # bit-exactly at admission time (SERVING.md "KV tiering & traffic
+    # harness"). Pool sized so two alternating tenants cannot coexist:
+    # every tenant switch evicts the other tenant's pages, every return
+    # restores them — and the tokens STILL match cold generate()
+    from paddle_tpu.serving import HostTier
+    eng4 = ServingEngine(model, num_pages=14, page_size=4, max_slots=1,
+                         host_tier=True)
+    systems = [list(rng.integers(0, cfg.vocab_size, 24)) for _ in range(2)]
+    for i in range(4):
+        p = systems[i % 2] + list(rng.integers(0, cfg.vocab_size, 6))
+        rid = eng4.add_request(p, max_new_tokens=6)
+        ref = np.asarray(model.generate(np.asarray([p]),
+                                        max_new_tokens=6))[0, len(p):]
+        assert eng4.run_to_completion()[rid] == ref.tolist()
+    assert eng4.decode_program_count() == 1  # restores are host-side
+    ps = eng4.pool.stats()       # host-tier breakdown rides pool.stats()
+    tm = eng4.metrics.summary()
+    assert ps["restored_pages"] > 0
+    print(f"tiered kv   : hit_rate={tm['cache_hit_rate']:.2f} "
+          f"(hbm={tm['tier_hbm_hit_rate']:.2f} "
+          f"host={tm['tier_host_hit_rate']:.2f}), spilled "
+          f"{ps['spilled_pages']} pages / restored {ps['restored_pages']} "
+          f"({ps['host_pool_bytes']}B in host pool), tokens bitwise "
+          f"identical through the host round-trip")
+
+    # HostTier(max_bytes=...) bounds the host pool; Workload/make_workload
+    # (paddle_tpu.serving.workload) builds the seeded Poisson multi-tenant
+    # traces the bench + profiler replay against it — see
+    # tools/profile_serving.py --tiered and bench.py llama_serving_tiered
+    _ = HostTier
+
 
 if __name__ == "__main__":
     main()

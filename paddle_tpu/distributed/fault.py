@@ -26,6 +26,13 @@ Named sites wired into the runtime (see RESILIENCE.md):
   pins a fault to ONE request (``serving.alloc`` passes the fleet
   replica index when a router owns the pool, so ``match`` can pin an
   alloc storm to one replica).
+- ``serving.spill`` / ``serving.restore`` — the KV host-tier demotion /
+  promotion sites (SERVING.md "KV tiering & traffic harness").
+  ``ctx['path']`` is the page's content-hash key (hex). ``raise`` drops
+  the spill (page lost, as without a tier) or fails the restore (those
+  tokens recompute); ``poison`` corrupts the stored host payload
+  WITHOUT updating its digest, so the restore-side blake2b re-verify
+  must detect it and fall back to recompute — wrong KV is never served.
 - ``fleet.dispatch`` / ``fleet.replica_kill`` / ``fleet.health`` — the
   serving fleet router's placement, replica-life and health-probe sites
   (SERVING.md "Engine fleet & failover"). ``ctx['path']`` is the request

@@ -29,6 +29,9 @@ from .metrics import FleetMetrics, ServingMetrics, percentile
 from .scheduler import (FINISHED, PREEMPTED, RUNNING, WAITING, Request,
                         SamplingParams, Scheduler)
 from .speculative import DraftProposer, NgramDrafter, SpeculativeConfig
+from .tiering import HostTier
+from .workload import (Workload, WorkloadRequest, WorkloadSpec,
+                       make_workload)
 
 __all__ = [
     "ServingEngine", "KVCachePool", "PoolExhaustedError", "PrefixMatch",
@@ -37,6 +40,8 @@ __all__ = [
     "percentile", "Request", "SamplingParams", "Scheduler",
     "WAITING", "RUNNING", "PREEMPTED", "FINISHED",
     "SpeculativeConfig", "DraftProposer", "NgramDrafter",
+    "HostTier",
+    "Workload", "WorkloadRequest", "WorkloadSpec", "make_workload",
     "ServingError", "QueueFullError", "RequestTooLargeError",
     "SchedulerStalledError", "EngineDrainingError", "FleetOverloadedError",
 ]

@@ -89,7 +89,8 @@ class ServingEngine:
                  drain_timeout_s: float | None = 30.0,
                  watchdog=None, prefix_cache: bool = True,
                  tracer=None, flight_recorder=None,
-                 kv_quant: bool = False, speculative=None):
+                 kv_quant: bool = False, speculative=None,
+                 host_tier=None):
         cfg = model.config
         self.model = model
         self.page_size = page_size
@@ -105,11 +106,18 @@ class ServingEngine:
         if kv_dtype is not None and jnp.dtype(kv_dtype) == jnp.int8:
             kv_quant = True
         self.kv_quant = kv_quant
+        # host-RAM spill tier (serving/tiering.py): True -> defaults, an
+        # int -> byte budget, or a ready HostTier instance — share ONE
+        # instance across homogeneous replicas and their spilled prefix
+        # pages become fleet-wide warm cache (identical weights produce
+        # bitwise-identical KV). Requires the prefix cache (spill keys
+        # are its content hashes).
         self.pool = KVCachePool.from_config(
             cfg, num_pages, page_size,
             dtype=(jnp.bfloat16 if kv_quant or kv_dtype is None
                    else kv_dtype),
-            cache_enabled=prefix_cache, quantized=kv_quant)
+            cache_enabled=prefix_cache, quantized=kv_quant,
+            host_tier=host_tier if prefix_cache else None)
         # the prefill gather window: every prefill program reads the
         # request's cached-prefix pages through a fixed-length gather of
         # _ctx_pages pages (unused entries point at scratch page 0, all
@@ -139,6 +147,7 @@ class ServingEngine:
         self.metrics = ServingMetrics(clock)
         self.metrics.set_kv_quant(kv_quant)
         self.metrics.set_spec(speculative is not None)
+        self.metrics.set_host_tier(self.pool.host_tier is not None)
         # observability (OBSERVABILITY.md): the tracer is shared with
         # the scheduler (request-lifecycle spans) and the pool
         # (eviction/COW/quarantine events); construct it on the same
@@ -292,10 +301,13 @@ class ServingEngine:
                     break
                 req = batch[0]
                 budget -= (req.context_len - req.cached_len
+                           + self.pool.restore_charge_tokens(
+                               req.restored_len)
                            + (self.scheduler.spec_k - 1))
                 first = False
                 self.metrics.on_admit(req.rid)
-                self.metrics.on_prefill(req.cached_len, req.context_len)
+                self.metrics.on_prefill(req.cached_len, req.context_len,
+                                        req.restored_len)
                 with tr.span("prefill_dispatch", rid=req.rid):
                     self._run_prefill(req, events)
         # drafts are proposed BEFORE the page guarantee so
@@ -316,6 +328,8 @@ class ServingEngine:
         if self.scheduler.running:
             self._run_decode(events)
         self.metrics.on_prefix_counters(self.pool.counters)
+        if self.pool.host_tier is not None:
+            self.metrics.on_tier_stats(self.pool.host_tier.stats())
         self.metrics.on_step(self.scheduler.queue_depth,
                              self.pool.utilization())
         self._steps += 1
@@ -471,6 +485,7 @@ class ServingEngine:
                 "prefill_programs": len(self._prefill_progs),
                 "prefix_cache": self.prefix_cache,
                 "kv_quant": self.kv_quant,
+                "host_tier": self.pool.host_tier is not None,
                 "speculative": self._spec is not None,
                 "tracing": self.tracer.enabled}
 

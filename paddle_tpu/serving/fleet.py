@@ -60,6 +60,14 @@ Fault sites (RESILIENCE.md): ``fleet.dispatch`` (ctx path = rid),
 so ``match=r"^1$"`` chaos-kills exactly replica 1); the router also
 sets each pool's ``fault_path`` to the replica index so a
 ``serving.alloc`` storm can be pinned to one replica.
+
+Homogeneous replicas may share ONE :class:`~.tiering.HostTier`
+(``ServingEngine(..., host_tier=tier)`` with the same instance): tier
+keys are chained content hashes namespaced per KV dtype, so a page
+spilled by replica A restores bit-exactly on replica B — after a
+failover the replacement replica warm-starts from the dead replica's
+spilled prefixes instead of recomputing them (chaos-tested in
+``tests/test_serving_tiering.py::TestTieredChaos``).
 """
 
 from __future__ import annotations

@@ -49,11 +49,14 @@ import struct
 from collections import OrderedDict
 from dataclasses import dataclass, field
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..observability.trace import NULL_TRACER
 from ..quantization.serving import QuantizedKV
 from .errors import ServingError
+from .tiering import HostTier
 
 __all__ = ["KVCachePool", "PoolExhaustedError", "PrefixMatch"]
 
@@ -106,21 +109,39 @@ class PrefixMatch:
     """Result of ``match_prefix``: the longest cached prefix of a token
     sequence, at page granularity. ``full_pages`` are immutable shared
     pages to map directly; ``partial_page`` (if any) must be reused via
-    ``cow_into`` a freshly-allocated page, never written in place."""
+    ``cow_into`` a freshly-allocated page, never written in place.
+
+    With a host tier attached the walk continues past the last
+    HBM-resident full page: ``chain`` holds the content-hash keys of
+    the continuation full pages, each resolvable in HBM OR the host
+    tier at match time (re-resolved HBM-first at restore time — a page
+    re-registered since its spill wins over the host copy), and
+    ``host_partial_key`` names a host-tier partial tail. ``host_tokens``
+    counts the tokens that would have to be RESTORED (host-resolved at
+    match time) — the scheduler's restore-budget charge is computed
+    from it. ``cached_tokens`` keeps its pre-tier meaning (the
+    HBM-contiguous prefix); ``total_cached`` is the full hierarchy
+    match the admission actually targets."""
     full_pages: list[int] = field(default_factory=list)
     partial_page: int | None = None
     partial_len: int = 0
     cached_tokens: int = 0
+    chain: list[bytes] = field(default_factory=list)
+    host_tokens: int = 0
+    host_partial_key: bytes | None = None
+    host_partial_len: int = 0
+    total_cached: int = 0
 
     @property
     def hit(self) -> bool:
-        return self.cached_tokens > 0
+        return self.cached_tokens > 0 or self.total_cached > 0
 
 
 class KVCachePool:
     def __init__(self, num_layers: int, num_pages: int, page_size: int,
                  num_kv_heads: int, head_dim: int, dtype=jnp.bfloat16,
-                 cache_enabled: bool = True, quantized: bool = False):
+                 cache_enabled: bool = True, quantized: bool = False,
+                 host_tier=None):
         if num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is the "
                              "reserved scratch page)")
@@ -148,6 +169,16 @@ class KVCachePool:
         # fp and int8 caches chain their content hashes from different
         # roots — same tokens, different page content, never aliased
         self._hash_root = _HASH_ROOT_INT8 if quantized else _HASH_ROOT
+        # host-RAM spill tier (serving/tiering.py): True -> defaults,
+        # an int -> byte budget, or a ready HostTier (shareable across
+        # homogeneous pools — identical weights produce identical KV
+        # bytes, and the dtype tag below keeps formats from aliasing)
+        if host_tier is True:
+            host_tier = HostTier()
+        elif isinstance(host_tier, int) and not isinstance(host_tier, bool):
+            host_tier = HostTier(max_bytes=host_tier)
+        self.host_tier: HostTier | None = host_tier
+        self._tier_tag = "int8" if quantized else str(jnp.dtype(self.dtype))
         # LIFO free list, page 0 reserved (scratch)
         self._free = list(range(num_pages - 1, 0, -1))
         self._peak_in_use = 0
@@ -182,12 +213,14 @@ class KVCachePool:
     @classmethod
     def from_config(cls, config, num_pages: int, page_size: int,
                     dtype=jnp.bfloat16, cache_enabled: bool = True,
-                    quantized: bool = False) -> "KVCachePool":
+                    quantized: bool = False,
+                    host_tier=None) -> "KVCachePool":
         """Build from a model config carrying num_hidden_layers /
         num_key_value_heads / head_dim (LlamaConfig shape)."""
         return cls(config.num_hidden_layers, num_pages, page_size,
                    config.num_key_value_heads, config.head_dim, dtype,
-                   cache_enabled=cache_enabled, quantized=quantized)
+                   cache_enabled=cache_enabled, quantized=quantized,
+                   host_tier=host_tier)
 
     # ---- accounting ----
 
@@ -236,6 +269,12 @@ class KVCachePool:
         return 2 * self.num_layers * per
 
     def stats(self) -> dict:
+        # host-tier breakdown rides along (schema-stable zeros when the
+        # tier is off) so dashboards reading pool stats don't need a
+        # second call — and observability.render_prometheus turns every
+        # numeric key here into a paddle_serving_pool_* gauge
+        tier = (self.host_tier.stats() if self.host_tier is not None
+                else HostTier.zero_stats())
         return {"num_pages": self.num_pages, "page_size": self.page_size,
                 "capacity": self.capacity, "in_use": self.num_in_use,
                 "pinned": self.num_in_use, "cached": self.num_cached,
@@ -243,6 +282,8 @@ class KVCachePool:
                 "peak_in_use": self._peak_in_use,
                 "indexed_pages": len(self._page_key),
                 "kv_quant": int(self.quantized),
+                "host_tier": int(self.host_tier is not None),
+                **tier,
                 **self.counters}
 
     # ---- alloc / free ----
@@ -276,6 +317,8 @@ class KVCachePool:
         evicted: list[int] = []
         while len(self._free) < n and self._lru:
             page, _ = self._lru.popitem(last=False)  # oldest first
+            self._spill(page)   # demote to the host tier (if attached)
+                                # BEFORE the index key is forgotten
             self._deregister(page)
             evicted.append(page)
             self._free.append(page)
@@ -359,9 +402,14 @@ class KVCachePool:
         scrub-on-zero. Pages still shared with live requests are NOT
         scrubbed here — zeroing under a reader would corrupt its
         stream; the scrub happens in ``release`` when the last
-        reference drops."""
+        reference drops. A quarantined page's host-tier entry is purged
+        too — poisoned content must not survive in ANY tier — and the
+        scrub-on-zero mark keeps the page from ever spilling later."""
         todo = []
         for p in set(pages):
+            kk = self._page_key.get(p)
+            if kk is not None and self.host_tier is not None:
+                self.host_tier.discard(self._tier_tag, *kk)
             self._deregister(p)
             if self._ref.get(p, 0) > 0:
                 self._scrub_on_zero.add(p)
@@ -390,6 +438,7 @@ class KVCachePool:
         if not self.cache_enabled or limit <= 0:
             return m
         ps = self.page_size
+        tier = self.host_tier
         parent = self._hash_root
         pos = 0
         while pos + ps <= limit:
@@ -400,13 +449,39 @@ class KVCachePool:
             m.full_pages.append(page)
             parent = key
             pos += ps
+        # host-tier continuation: keep walking the SAME content-hash
+        # chain past the HBM break, accepting a page wherever it is
+        # resolvable — HBM first (a mid-chain page can be HBM-resident
+        # while an earlier one was evicted: eviction drops only its own
+        # key), then the host tier. The keys are recorded, not pages:
+        # restore_chain re-resolves each one at commit time.
+        m.cached_tokens = pos
+        if tier is not None:
+            while pos + ps <= limit:
+                key = _page_hash(parent, tokens[pos:pos + ps])
+                if key in self._full_index:
+                    pass
+                elif tier.has(self._tier_tag, "full", key):
+                    m.host_tokens += ps
+                else:
+                    break
+                m.chain.append(key)
+                parent = key
+                pos += ps
         for q in range(min(limit - pos, ps - 1), 0, -1):
-            page = self._partial_index.get(
-                _page_hash(parent, tokens[pos:pos + q]))
+            key = _page_hash(parent, tokens[pos:pos + q])
+            page = self._partial_index.get(key)
             if page is not None:
                 m.partial_page, m.partial_len = page, q
                 break
-        m.cached_tokens = pos + m.partial_len
+            if tier is not None and tier.has(self._tier_tag, "partial",
+                                             key):
+                m.host_partial_key, m.host_partial_len = key, q
+                m.host_tokens += q
+                break
+        if not m.chain:
+            m.cached_tokens += m.partial_len
+        m.total_cached = pos + m.partial_len + m.host_partial_len
         if count:
             self.count_match(m)
         return m
@@ -414,10 +489,12 @@ class KVCachePool:
     def count_match(self, m: PrefixMatch) -> None:
         self.counters["prefix_lookups"] += 1
         if m.hit:
+            has_partial = (m.partial_page is not None
+                           or m.host_partial_key is not None)
             self.counters["prefix_hits"] += 1
             self.counters["prefix_hit_pages"] += (
-                len(m.full_pages) + (1 if m.partial_page is not None else 0))
-            if m.partial_page is not None:
+                len(m.full_pages) + len(m.chain) + (1 if has_partial else 0))
+            if has_partial:
                 self.counters["prefix_partial_hits"] += 1
 
     def register_prefix(self, tokens, pages: list[int],
@@ -467,6 +544,184 @@ class KVCachePool:
         index = self._full_index if kind == "full" else self._partial_index
         if index.get(key) == page:
             del index[key]
+
+    # ---- host tier: spill on evict, restore on hit ----
+    # (serving/tiering.py; SERVING.md "KV tiering & traffic harness").
+    # All transfers here are host-side device_get/device_put around
+    # functional .at[] updates — never inside a compiled program, so the
+    # engine's decode/verify program counts are untouched.
+
+    def _spill(self, page: int) -> None:
+        """Demote an LRU-evicted page's content to the host tier —
+        called from ``alloc`` BEFORE deregistration, while the page's
+        index key is still known. Quarantined content never spills:
+        quarantine pulls its pages off the LRU and purges their index
+        keys immediately, and the scrub-on-zero guard here covers any
+        remaining window. Fault site ``serving.spill``: ``raise`` drops
+        the spill (the page is simply lost, exactly as without a tier);
+        ``poison`` corrupts the stored payload after the fact, so the
+        restore-side digest re-verify MUST catch it."""
+        tier = self.host_tier
+        if tier is None:
+            return
+        kk = self._page_key.get(page)
+        if kk is None or page in self._scrub_on_zero:
+            return
+        kind, key = kk
+        if not tier.put(self._tier_tag, kind, key,
+                        self._page_payload(page)):
+            return
+        from ..distributed import fault as _fault
+        try:
+            _fault.trip("serving.spill", step=self.fault_step,
+                        path=key.hex(), page=page,
+                        poison=lambda: tier.corrupt(self._tier_tag,
+                                                    kind, key))
+        except _fault.FaultInjected:
+            tier.discard(self._tier_tag, kind, key)
+            tier.counters["spill_dropped"] += 1
+            return
+        self.tracer.instant("spill", track="pool", page=page, kind=kind)
+        self.tracer.bump("spills", 1, track="pool")
+
+    def _page_payload(self, page: int) -> list:
+        """One page's bytes as host numpy arrays, per layer in pool
+        order (k then v; a quantized pool interleaves codes and scales
+        — spilling codes without scales would dequantize the restore
+        with garbage). One batched device_get for the whole page."""
+        parts = []
+        for pk, pv in self.pools:
+            for arr in (pk, pv):
+                if isinstance(arr, QuantizedKV):
+                    parts.append(arr.q[page])
+                    parts.append(arr.scale[page])
+                else:
+                    parts.append(arr[page])
+        return [np.asarray(x) for x in jax.device_get(parts)]
+
+    def _write_host_page(self, page: int, arrays) -> None:
+        """device_put a host payload back into HBM page ``page`` (the
+        inverse of ``_page_payload``, bit-exact: get/put round-trips
+        bf16, fp32 and int8 bytes unchanged)."""
+        it = iter(arrays)
+        new_pools = []
+        for pk, pv in self.pools:
+            pair = []
+            for arr in (pk, pv):
+                if isinstance(arr, QuantizedKV):
+                    q = jnp.asarray(next(it), arr.q.dtype)
+                    s = jnp.asarray(next(it), arr.scale.dtype)
+                    pair.append(QuantizedKV(arr.q.at[page].set(q),
+                                            arr.scale.at[page].set(s)))
+                else:
+                    pair.append(arr.at[page].set(
+                        jnp.asarray(next(it), arr.dtype)))
+            new_pools.append(tuple(pair))
+        self.pools = new_pools
+
+    def restore_charge(self, m: PrefixMatch | None) -> int:
+        """Prefill-budget tokens the match's host-resolved tokens would
+        cost to restore (the admission-time optimistic charge)."""
+        if m is None or self.host_tier is None:
+            return 0
+        return self.host_tier.restore_charge(m.host_tokens)
+
+    def restore_charge_tokens(self, restored_tokens: int) -> int:
+        """Budget charge for tokens ACTUALLY restored (the post-commit
+        number the engine mirrors into its own budget bookkeeping)."""
+        if self.host_tier is None:
+            return 0
+        return self.host_tier.restore_charge(restored_tokens)
+
+    def restore_chain(self, m: PrefixMatch) -> tuple[list[int], int]:
+        """Map the continuation ``m.chain`` into HBM in chain order.
+        Each key is re-resolved HBM-first — a page (re-)registered since
+        the match, including by an earlier restore in this very loop,
+        wins and is simply acquired (the restore-racing-re-registration
+        rule) — else its payload is fetched from the host tier, written
+        into a freshly-allocated page and registered under the key.
+        Stops at the first failure (host miss, corrupt payload, injected
+        ``serving.restore`` fault, pool exhaustion): the chain beyond it
+        falls back to recompute. Returns ``(pages, restored_tokens)``;
+        every returned page carries one reference for the caller."""
+        pages: list[int] = []
+        restored_tok = 0
+        tier = self.host_tier
+        from ..distributed import fault as _fault
+        for key in m.chain:
+            page = self._full_index.get(key)
+            if page is not None:
+                self.acquire([page])
+                pages.append(page)
+                continue
+            if tier is None:
+                break
+            try:
+                _fault.trip("serving.restore", step=self.fault_step,
+                            path=key.hex(),
+                            poison=lambda k=key: tier.corrupt(
+                                self._tier_tag, "full", k))
+            except _fault.FaultInjected:
+                tier.counters["restore_failed"] += 1
+                break
+            arrays = tier.fetch(self._tier_tag, "full", key)
+            if arrays is None:
+                break
+            try:
+                page = self.alloc(1)[0]
+            except PoolExhaustedError:
+                break
+            self._write_host_page(page, arrays)
+            # first-writer-wins still holds: the key was absent from the
+            # index at the top of this iteration and nothing since could
+            # have inserted it (our own alloc only EVICTS entries)
+            self._full_index[key] = page
+            self._page_key[page] = ("full", key)
+            nbytes = sum(a.nbytes for a in arrays)
+            tier.on_restored(nbytes)
+            restored_tok += self.page_size
+            self.tracer.instant("restore", track="pool", page=page,
+                                bytes=nbytes)
+            self.tracer.bump("restores", 1, track="pool")
+            pages.append(page)
+        return pages, restored_tok
+
+    def fetch_host_partial(self, m: PrefixMatch):
+        """Fetch the match's host-tier partial payload (or None on
+        miss/corruption/injected fault). Separate from
+        ``restore_partial_into`` because the caller allocates the
+        destination page between the two."""
+        tier = self.host_tier
+        if tier is None or m.host_partial_key is None:
+            return None
+        from ..distributed import fault as _fault
+        key = m.host_partial_key
+        try:
+            _fault.trip("serving.restore", step=self.fault_step,
+                        path=key.hex(),
+                        poison=lambda: tier.corrupt(self._tier_tag,
+                                                    "partial", key))
+        except _fault.FaultInjected:
+            tier.counters["restore_failed"] += 1
+            return None
+        return tier.fetch(self._tier_tag, "partial", key)
+
+    def restore_partial_into(self, dst: int, arrays) -> None:
+        """Restore a host partial payload straight into the hitter's
+        first fresh suffix page: the copy-at-map COW rule with the copy
+        sourced from host RAM. ``dst`` is private to the hitter and is
+        NOT registered here — like a COW copy, it re-enters the index
+        at release under its own (longer) key. Positions beyond the
+        partial length were zero when the page spilled, so the
+        masked-garbage-is-zero invariant rides through the round
+        trip."""
+        self._write_host_page(dst, arrays)
+        nbytes = sum(np.asarray(a).nbytes for a in arrays)
+        if self.host_tier is not None:
+            self.host_tier.on_restored(nbytes)
+        self.tracer.instant("restore", track="pool", page=dst,
+                            bytes=nbytes, partial=True)
+        self.tracer.bump("restores", 1, track="pool")
 
     # ---- device-side page ops ----
 

@@ -113,6 +113,15 @@ class ServingMetrics:
         self._prefill_tokens = 0
         self._prefill_cached_tokens = 0
         self._prefix_counters: dict[str, int] = {}
+        # KV tiering (SERVING.md "KV tiering & traffic harness"):
+        # restored tokens are the host-tier slice of the cached tokens
+        # above (they skipped recompute but paid restore bytes); the
+        # tier's byte gauges are mirrored in from HostTier.stats() each
+        # step so summary()/render_prometheus carry spilled_bytes /
+        # restored_bytes / host_pool_bytes without a second scrape
+        self.host_tier_enabled = 0
+        self._prefill_restored_tokens = 0
+        self._tier_stats: dict[str, int] = {}
         # int8 KV-cache quantization (SERVING.md "Quantized KV & weights"):
         # the flag gauge plus a running max over per-prefill absmax scales —
         # scale_max/2 bounds the worst-case dequant error of any cached
@@ -186,17 +195,46 @@ class ServingMetrics:
         if key is not None:
             self.counters[key] += 1
 
-    def on_prefill(self, cached_tokens: int, total_tokens: int) -> None:
+    def on_prefill(self, cached_tokens: int, total_tokens: int,
+                   restored_tokens: int = 0) -> None:
         """One admission's prefill accounting: ``cached_tokens`` of the
         ``total_tokens`` context were served from the prefix cache (the
-        engine only ran the suffix). Feeds ``cache_hit_rate``."""
+        engine only ran the suffix), ``restored_tokens`` of THOSE came
+        back from the host spill tier. Feeds ``cache_hit_rate`` and the
+        tier hit-rate breakdown."""
         self._prefill_tokens += total_tokens
         self._prefill_cached_tokens += cached_tokens
+        self._prefill_restored_tokens += restored_tokens
 
     def on_prefix_counters(self, counters: dict) -> None:
         """Mirror the pool's prefix-cache page counters (lookups, hits,
         partial hits, evictions, COW copies) into the summary."""
         self._prefix_counters = dict(counters)
+
+    # ---- KV tiering (SERVING.md "KV tiering & traffic harness") ----
+
+    def set_host_tier(self, enabled: bool) -> None:
+        """Arm the host_tier_enabled gauge (int, for Prometheus)."""
+        self.host_tier_enabled = int(bool(enabled))
+
+    def on_tier_stats(self, stats: dict) -> None:
+        """Mirror the host tier's byte/page gauges (HostTier.stats())
+        into the summary — called by the engine once per step."""
+        self._tier_stats = dict(stats)
+
+    def tier_hit_rates(self) -> dict:
+        """Where prefill context tokens were served from: ``hbm``
+        (prefix-cache pages already resident), ``host`` (restored from
+        the spill tier), ``miss`` (recomputed). The three sum to 1 once
+        any prefill ran; restored tokens are cached tokens, so
+        hbm + host == cache_hit_rate."""
+        t = self._prefill_tokens
+        if t == 0:
+            return {"hbm": 0.0, "host": 0.0, "miss": 0.0}
+        host = self._prefill_restored_tokens / t
+        hbm = (self._prefill_cached_tokens
+               - self._prefill_restored_tokens) / t
+        return {"hbm": hbm, "host": host, "miss": 1.0 - hbm - host}
 
     # ---- SLO goodput (ROADMAP item 5) ----
 
@@ -330,8 +368,10 @@ class ServingMetrics:
         return sum(self._n_tokens.values())
 
     def summary(self) -> dict:
+        from .tiering import HostTier as _HostTier
         ttft = self.ttfts()
         tpot = self.tpots()
+        tier_rates = self.tier_hit_rates()
         wall = ((self._end - self._start)
                 if self._start is not None and self._end is not None else 0.0)
         return {
@@ -374,6 +414,16 @@ class ServingMetrics:
             "spec_accepted_tokens_total": self._spec_accepted_tokens,
             "spec_accept_rate": self.spec_accept_rate(),
             "spec_draft_hit_rate": self.spec_draft_hit_rate(),
+            # KV tiering (schema-stable: zeros with the tier off).
+            # tier_hit_rate == cache_hit_rate (restored tokens ARE
+            # cached tokens); the hbm/host/miss split is the breakdown.
+            "host_tier_enabled": self.host_tier_enabled,
+            "prefill_restored_tokens": self._prefill_restored_tokens,
+            "tier_hit_rate": self.cache_hit_rate(),
+            "tier_hbm_hit_rate": tier_rates["hbm"],
+            "tier_host_hit_rate": tier_rates["host"],
+            "tier_miss_rate": tier_rates["miss"],
+            **{**_HostTier.zero_stats(), **self._tier_stats},
             # pool counters live under prefix_* so they can never
             # shadow a summary key (the pool already uses that prefix
             # for most of them — normalise the stragglers)

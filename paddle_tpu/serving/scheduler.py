@@ -74,6 +74,12 @@ class Request:
     # a copy-on-write partial hit
     cached_len: int = 0
     cached_partial: bool = False
+    # host-tier accounting for the CURRENT admission: how many of
+    # cached_len tokens were restored from the host spill tier (they
+    # skip recompute FLOPs but paid restore bytes — the scheduler
+    # charged ceil(restored_len * restore_budget_frac) prefill-budget
+    # tokens for them; SERVING.md "KV tiering & traffic harness")
+    restored_len: int = 0
     # speculative decoding (serving/speculative.py): tokens the drafter
     # proposed for the NEXT step; the verify program scores them at
     # positions context_len..context_len+len-1 and the engine clears the
@@ -224,6 +230,7 @@ class Scheduler:
         req.pages = []
         req.cached_len = 0
         req.cached_partial = False
+        req.restored_len = 0
         req.draft_tokens = []   # drafts are per-step state; recompute
                                 # re-proposes from the same history
         self._free_slots.append(req.slot)
@@ -322,29 +329,68 @@ class Scheduler:
                 cap = n_valid if req.tokens else n_valid - 1
                 seq = req.prompt + req.tokens[:-1]
                 match = pool.match_prefix(seq, max_tokens=cap)
-                cached = match.cached_tokens
+                # the optimistic (pre-restore) view: the whole cache
+                # hierarchy hit, including host-tier tokens that still
+                # have to be restored at commit time
+                cached = match.total_cached
             suffix = n_valid - cached
             # only the UNCACHED suffix charges the prefill token budget
-            if (admitted or not first) and suffix > budget:
+            # — plus the restore toll on host-tier tokens: they skip
+            # recompute FLOPs but pay restore bytes, charged like a
+            # partial cache hit at restore_budget_frac per token
+            if ((admitted or not first)
+                    and suffix + pool.restore_charge(match) > budget):
                 break
             n_new = (pool.pages_for(n_valid)
                      - (len(match.full_pages) if match else 0))
             if n_new > pool.num_available:
                 break
             # commit order matters: pin the matched pages FIRST so this
-            # admission's own alloc cannot LRU-evict them, then allocate
-            # the suffix pages, then materialize the COW copy. Rollback
-            # on failure leaves the pool exactly as found.
+            # admission's own allocs (including restores) cannot
+            # LRU-evict them, then restore the host-tier chain, then
+            # allocate the suffix pages, then materialize the COW /
+            # host-partial copy. Rollback on failure leaves the pool as
+            # found — up to restored pages, which stay behind as
+            # refcount-0 CACHED pages (warm for the retry).
             pinned: list[int] = []
             if match is not None and match.hit:
                 pinned = list(match.full_pages)
                 if match.partial_page is not None:
                     pinned.append(match.partial_page)
                 pool.acquire(pinned)
+            chain_pages: list[int] = []
+            restored_tok = 0
+            if match is not None and match.chain:
+                chain_pages, restored_tok = pool.restore_chain(match)
+            chain_ok = (match is None
+                        or len(chain_pages) == len(match.chain))
+            # the partial tail applies only after a fully-restored
+            # chain (it continues the LAST chain page's content)
+            use_hbm_partial = bool(chain_ok and match is not None
+                                   and match.partial_page is not None)
+            host_partial = None
+            if (chain_ok and match is not None
+                    and match.host_partial_key is not None):
+                host_partial = pool.fetch_host_partial(match)
+            # re-derive the ACTUAL cached length from what committed
+            # (a failed restore shortens it; the difference recomputes)
+            partial_q = 0
+            if use_hbm_partial:
+                partial_q = match.partial_len
+            elif host_partial is not None:
+                partial_q = match.host_partial_len
+            if match is not None:
+                cached = ((len(match.full_pages) + len(chain_pages))
+                          * pool.page_size + partial_q)
+                suffix = n_valid - cached
+            n_new = (pool.pages_for(n_valid)
+                     - (len(match.full_pages) if match else 0)
+                     - len(chain_pages))
             try:
                 pages = pool.alloc(n_new)
             except PoolExhaustedError:
                 pool.release(pinned)
+                pool.release(chain_pages)
                 self.tracer.instant("admit_rollback", track=req.rid,
                                     need=n_new,
                                     available=pool.num_available)
@@ -352,18 +398,26 @@ class Scheduler:
                 break  # injected exhaustion (serving.alloc) — the head
                        # stays queued, never torn out of the FCFS order
             if match is not None and match.partial_page is not None:
-                # copy-at-map COW: the hitter gets a fresh page holding a
-                # copy of the cached partial page and extends THAT; the
-                # cached page itself is never written, then unpinned
-                pool.cow_into(match.partial_page, pages[0])
+                if use_hbm_partial:
+                    # copy-at-map COW: the hitter gets a fresh page
+                    # holding a copy of the cached partial page and
+                    # extends THAT; the cached page itself is never
+                    # written, then unpinned
+                    pool.cow_into(match.partial_page, pages[0])
                 pool.release([match.partial_page])
+            elif host_partial is not None:
+                # same COW rule, copy sourced from the host tier —
+                # restored straight into the hitter's first suffix page
+                pool.restore_partial_into(pages[0], host_partial)
+                restored_tok += match.host_partial_len
             if match is not None:
                 pool.count_match(match)
             self.waiting.pop(0)
-            req.pages = (list(match.full_pages) if match else []) + pages
+            req.pages = ((list(match.full_pages) if match else [])
+                         + chain_pages + pages)
             req.cached_len = cached
-            req.cached_partial = bool(match and match.partial_page
-                                      is not None)
+            req.restored_len = restored_tok
+            req.cached_partial = partial_q > 0
             req.slot = self._free_slots.pop()
             req.state = RUNNING
             req.context_len = n_valid
@@ -371,10 +425,13 @@ class Scheduler:
             if self.tracer.enabled:
                 self.tracer.end("queued", track=req.rid)
                 self.tracer.instant("admit", track=req.rid, slot=req.slot,
-                                    cached=cached, suffix=suffix)
+                                    cached=cached, suffix=suffix,
+                                    restored=restored_tok)
                 self.tracer.begin("running", track=req.rid)
             admitted.append(req)
             # an admitted slot also joins this step's verify fan-out
-            # (spec_k - 1 draft rows), charged like prefill tokens
-            budget -= suffix + (self.spec_k - 1)
+            # (spec_k - 1 draft rows), charged like prefill tokens —
+            # and restored tokens charge their restore toll
+            budget -= (suffix + pool.restore_charge_tokens(restored_tok)
+                       + (self.spec_k - 1))
         return admitted

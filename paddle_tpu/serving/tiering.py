@@ -1,0 +1,224 @@
+"""Host-RAM spill tier for the paged KV-cache pool (ROADMAP item 5).
+
+The HBM pool (kv_cache.py) LRU-evicts refcount-0 registered pages when
+``alloc`` runs dry — and without this module their content is lost, so
+prefix hit-rate collapses exactly when the pool is under pressure.
+:class:`HostTier` turns that eviction into a demotion: the evicted
+page's bytes (int8 codes AND fp32 scales in quantized mode) are copied
+to a bounded host-side pool keyed by the SAME chained content hash the
+prefix index uses, namespaced per KV storage format so an fp32, bf16
+and int8 cache can never serve each other's bytes. ``match_prefix``
+consults HBM first, then this tier; a hit is restored with a
+``device_put`` back into a freshly-allocated HBM page at admission time
+— on the host side of the step, never inside a compiled program, so the
+engine's ``decode_program_count() == 1`` contract is untouched.
+
+Integrity: every entry stores a blake2b-128 digest of its payload
+bytes, re-verified at fetch time. A corrupted entry (bit rot, or the
+``serving.restore`` fault site's ``poison`` action) is detected,
+dropped and counted — the scheduler falls back to recomputing those
+tokens, and wrong KV is never served. Spill and restore both honour
+the pool's quarantine rules: a quarantined page is never offered to
+``spill`` (the pool guards it), and quarantining a page purges its
+host-tier entry too.
+
+Accounting rule (SERVING.md "KV tiering & traffic harness"): restored
+tokens are cached tokens — they skip recompute FLOPs — but they pay
+restore BYTES, so the scheduler charges ``ceil(restored_tokens *
+restore_budget_frac)`` against the per-step prefill token budget, the
+same budget a partial cache hit's suffix would consume.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = ["HostTier", "HostPage"]
+
+
+def _payload_digest(arrays) -> bytes:
+    """blake2b-128 over the exact payload bytes, in array order. The
+    digest is the corruption detector, not the index key (the chained
+    token hash is) — so it covers the BYTES, including scales, not the
+    tokens."""
+    h = hashlib.blake2b(digest_size=16)
+    for a in arrays:
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.digest()
+
+
+@dataclass
+class HostPage:
+    """One demoted page: per-layer numpy arrays in pool order
+    (``[k0, v0, k1, v1, ...]``; quantized pools interleave codes and
+    scales as ``[kq0, ks0, vq0, vs0, ...]``), plus the integrity digest
+    computed at spill time."""
+    arrays: list = field(default_factory=list)
+    nbytes: int = 0
+    digest: bytes = b""
+
+
+class HostTier:
+    """Bounded host-RAM LRU of spilled KV pages.
+
+    Keys are ``(tag, kind, key)``: ``tag`` namespaces the KV storage
+    format ("int8" / "bfloat16" / "float32" — same-token pages have
+    different bytes under different formats and must never alias),
+    ``kind`` is "full" or "partial" (mirroring the pool's two indexes),
+    and ``key`` is the pool's chained blake2b-128 content hash. The
+    byte budget counts payload bytes only; an entry larger than the
+    whole budget is refused (counted as ``spill_dropped``) rather than
+    flushing the tier for one page.
+    """
+
+    def __init__(self, max_bytes: int = 256 * 1024 * 1024,
+                 restore_budget_frac: float = 0.25):
+        if max_bytes <= 0:
+            raise ValueError("max_bytes must be positive")
+        if restore_budget_frac < 0:
+            raise ValueError("restore_budget_frac must be >= 0")
+        self.max_bytes = int(max_bytes)
+        # fraction of a restored token charged against the scheduler's
+        # prefill token budget (restore pays bytes, not FLOPs)
+        self.restore_budget_frac = float(restore_budget_frac)
+        self._entries: "OrderedDict[tuple, HostPage]" = OrderedDict()
+        self._bytes = 0
+        self.counters: dict[str, int] = {
+            "spilled_pages": 0, "spilled_bytes": 0,
+            "restored_pages": 0, "restored_bytes": 0,
+            "host_evictions": 0, "spill_dropped": 0,
+            "restore_corrupt_detected": 0, "restore_failed": 0,
+            "host_hits": 0, "host_misses": 0,
+        }
+
+    # ---- accounting ----
+
+    @property
+    def pool_bytes(self) -> int:
+        """Payload bytes currently resident in the tier."""
+        return self._bytes
+
+    @property
+    def num_entries(self) -> int:
+        return len(self._entries)
+
+    def restore_charge(self, restored_tokens: int) -> int:
+        """Prefill-budget tokens a restore of ``restored_tokens`` costs
+        (the accounting rule in the module docstring)."""
+        if restored_tokens <= 0:
+            return 0
+        return int(math.ceil(restored_tokens * self.restore_budget_frac))
+
+    def stats(self) -> dict:
+        return {"host_pool_bytes": self._bytes,
+                "host_pool_pages": len(self._entries),
+                "host_capacity_bytes": self.max_bytes,
+                **self.counters}
+
+    @staticmethod
+    def zero_stats() -> dict:
+        """The ``stats()`` key set, all zero — what a pool WITHOUT a
+        tier reports, so the metrics/Prometheus schema never depends on
+        whether tiering is enabled."""
+        return {"host_pool_bytes": 0, "host_pool_pages": 0,
+                "host_capacity_bytes": 0,
+                "spilled_pages": 0, "spilled_bytes": 0,
+                "restored_pages": 0, "restored_bytes": 0,
+                "host_evictions": 0, "spill_dropped": 0,
+                "restore_corrupt_detected": 0, "restore_failed": 0,
+                "host_hits": 0, "host_misses": 0}
+
+    # ---- the spill / restore surface ----
+
+    def put(self, tag: str, kind: str, key: bytes, arrays) -> bool:
+        """Demote one page's payload into the tier. Evicts host-LRU
+        entries until the new payload fits; refuses (False) a payload
+        larger than the whole budget. Re-putting an existing key
+        refreshes its content and recency."""
+        arrays = [np.ascontiguousarray(np.asarray(a)) for a in arrays]
+        nbytes = sum(a.nbytes for a in arrays)
+        if nbytes > self.max_bytes:
+            self.counters["spill_dropped"] += 1
+            return False
+        k = (tag, kind, key)
+        old = self._entries.pop(k, None)
+        if old is not None:
+            self._bytes -= old.nbytes
+        while self._bytes + nbytes > self.max_bytes and self._entries:
+            _, victim = self._entries.popitem(last=False)  # host LRU
+            self._bytes -= victim.nbytes
+            self.counters["host_evictions"] += 1
+        self._entries[k] = HostPage(arrays=arrays, nbytes=nbytes,
+                                    digest=_payload_digest(arrays))
+        self._bytes += nbytes
+        self.counters["spilled_pages"] += 1
+        self.counters["spilled_bytes"] += nbytes
+        return True
+
+    def has(self, tag: str, kind: str, key: bytes) -> bool:
+        """Pure membership probe (no LRU touch) — what ``match_prefix``
+        uses to extend the chain walk into the tier."""
+        return (tag, kind, key) in self._entries
+
+    def fetch(self, tag: str, kind: str, key: bytes):
+        """Promote-read one page's payload, or None. The stored digest
+        is re-verified against the payload bytes first: a mismatch
+        means the entry was corrupted in host RAM — it is dropped and
+        counted, and the caller falls back to recompute (wrong KV is
+        never served). A verified hit touches the host LRU; restored-
+        bytes accounting happens pool-side where the restore actually
+        lands."""
+        k = (tag, kind, key)
+        entry = self._entries.get(k)
+        if entry is None:
+            self.counters["host_misses"] += 1
+            return None
+        if _payload_digest(entry.arrays) != entry.digest:
+            del self._entries[k]
+            self._bytes -= entry.nbytes
+            self.counters["restore_corrupt_detected"] += 1
+            return None
+        self._entries.move_to_end(k)
+        self.counters["host_hits"] += 1
+        return entry.arrays
+
+    def on_restored(self, nbytes: int) -> None:
+        """Pool callback: one page's payload actually landed back in
+        HBM (fetch alone is not a restore — the alloc can still fail)."""
+        self.counters["restored_pages"] += 1
+        self.counters["restored_bytes"] += int(nbytes)
+
+    def discard(self, tag: str, kind: str, key: bytes) -> bool:
+        """Drop an entry (quarantine purge: a poisoned page's content
+        must not survive in ANY tier)."""
+        entry = self._entries.pop((tag, kind, key), None)
+        if entry is None:
+            return False
+        self._bytes -= entry.nbytes
+        return True
+
+    def corrupt(self, tag: str, kind: str, key: bytes) -> None:
+        """Deterministic corruption hook for the ``serving.spill`` /
+        ``serving.restore`` fault sites' ``poison`` action: flip one
+        byte of the stored payload WITHOUT updating the digest, so the
+        next ``fetch`` must detect it. A no-op on a missing key (the
+        fault can race a host eviction)."""
+        entry = self._entries.get((tag, kind, key))
+        if entry is None or not entry.arrays:
+            return
+        a = entry.arrays[0]
+        flat = np.frombuffer(a.tobytes(), np.uint8).copy()
+        if flat.size == 0:
+            return
+        flat[0] ^= 0xFF
+        entry.arrays[0] = np.frombuffer(flat.tobytes(),
+                                        a.dtype).reshape(a.shape)
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self._bytes = 0

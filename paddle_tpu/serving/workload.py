@@ -1,0 +1,235 @@
+"""Deterministic serving traffic generator (ROADMAP item 5).
+
+The staggered synthetic traces the bench configs used until now ("2 at
+t=0, then 1 every 4 steps") cannot produce the regimes that actually
+rank schedulers, cache tiers and admission policies: arrival bursts
+that overflow the queue, Zipf-skewed tenant popularity that makes some
+prefixes hot and others cold, and mixed prompt lengths that fragment
+the pool. This module builds those traces as replayable data:
+
+- **Arrivals** are counted per engine step (not wall seconds — the
+  engine's only deterministic timebase) from a seeded generator:
+  ``poisson`` draws a constant-rate Poisson count per step; ``bursty``
+  modulates the rate with a deterministic on/off square wave (a
+  Markov-modulated Poisson process with fixed phase lengths), the
+  arrival shape that stresses queue depth and preemption.
+- **Prompts** are ``system prefix + user suffix``: each request picks a
+  tenant from a Zipf-popularity distribution over ``tenants`` distinct
+  system prompts (tenant 0 hottest), then appends a fresh random suffix
+  whose length is drawn from a weighted mixture of ranges. Shared
+  system prompts are exactly what the prefix cache and the host tier
+  monetize; the Zipf skew decides which of them stay warm.
+- **Replay** is a pure function of the built trace: ``replay(target)``
+  drives a :class:`~paddle_tpu.serving.engine.ServingEngine` or a
+  :class:`~paddle_tpu.serving.fleet.FleetRouter` (duck-typed on
+  ``submit``/``add_request``) step by step, submitting each request at
+  its arrival step. Same ``Workload`` + same engine seed => bitwise
+  identical streams, so A/B arms (tier off vs on) see IDENTICAL
+  traffic and their goodput_at_slo / hit-rate deltas are attributable
+  to the thing under test alone.
+
+Everything derives from ``numpy.random.default_rng(seed)`` — no global
+RNG state, no wall clock — so a Workload is a value: build it once,
+replay it on every arm, ship its ``stats()`` in the bench summary.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = ["Workload", "WorkloadRequest", "WorkloadSpec", "make_workload"]
+
+
+@dataclass
+class WorkloadRequest:
+    """One trace entry: submit ``prompt`` at engine step
+    ``arrival_step`` asking for ``max_new_tokens``."""
+    rid: str
+    arrival_step: int
+    prompt: list[int]
+    max_new_tokens: int
+    tenant: int
+
+
+@dataclass
+class WorkloadSpec:
+    """Knobs for :func:`make_workload` (SERVING.md "KV tiering &
+    traffic harness" documents each one).
+
+    ``arrival`` is "poisson" or "bursty"; ``rate`` is mean arrivals per
+    engine step. Bursty traffic alternates ``burst_on``-step windows at
+    ``rate * burst_factor`` with ``burst_off``-step windows at
+    ``rate * idle_factor``. ``prompt_mix`` is a weighted mixture of
+    inclusive user-suffix length ranges; ``system_len`` is the range of
+    per-tenant system-prompt lengths; ``zipf_alpha`` skews tenant
+    popularity (tenant 0 hottest; larger alpha = hotter head)."""
+    seed: int = 0
+    n_requests: int = 32
+    arrival: str = "poisson"
+    rate: float = 0.5
+    burst_on: int = 8
+    burst_off: int = 24
+    burst_factor: float = 4.0
+    idle_factor: float = 0.0
+    tenants: int = 4
+    zipf_alpha: float = 1.2
+    system_len: tuple[int, int] = (32, 64)
+    prompt_mix: tuple = ((0.6, 8, 24), (0.3, 24, 64), (0.1, 64, 128))
+    max_new: tuple[int, int] = (8, 32)
+    vocab_size: int = 256
+    eos_token_id: int | None = None
+
+
+class Workload:
+    """A built, replayable arrival trace (requests sorted by arrival
+    step, FCFS within a step)."""
+
+    def __init__(self, requests: list[WorkloadRequest],
+                 spec: WorkloadSpec | None = None,
+                 system_prompts: list[list[int]] | None = None):
+        self.requests = sorted(requests,
+                               key=lambda r: (r.arrival_step, r.rid))
+        self.spec = spec
+        self.system_prompts = system_prompts or []
+
+    def __len__(self) -> int:
+        return len(self.requests)
+
+    def __iter__(self):
+        return iter(self.requests)
+
+    @property
+    def horizon(self) -> int:
+        """Last arrival step (replay keeps stepping past it until the
+        target drains)."""
+        return self.requests[-1].arrival_step if self.requests else 0
+
+    def due(self, step: int) -> list[WorkloadRequest]:
+        """Requests arriving exactly at ``step`` (pure — no cursor, so
+        one Workload can drive any number of A/B arms)."""
+        return [r for r in self.requests if r.arrival_step == step]
+
+    def stats(self) -> dict:
+        """Shape summary for bench reports: determinism means these
+        describe every replay of this trace."""
+        if not self.requests:
+            return {"n_requests": 0}
+        plens = [len(r.prompt) for r in self.requests]
+        per_tenant: dict[int, int] = {}
+        for r in self.requests:
+            per_tenant[r.tenant] = per_tenant.get(r.tenant, 0) + 1
+        return {
+            "n_requests": len(self.requests),
+            "arrival_span_steps": self.horizon + 1,
+            "prompt_len_min": min(plens),
+            "prompt_len_mean": sum(plens) / len(plens),
+            "prompt_len_max": max(plens),
+            "tenants": len(self.system_prompts),
+            "tenant_counts": [per_tenant.get(t, 0)
+                              for t in range(len(self.system_prompts))],
+            "max_new_total": sum(r.max_new_tokens for r in self.requests),
+        }
+
+    def replay(self, target, max_steps: int | None = None,
+               rid_prefix: str = "") -> dict:
+        """Drive ``target`` (engine or fleet router) through the trace:
+        at each step, submit the requests due, then ``target.step()``;
+        keep stepping until the target drains. Backpressure rejections
+        (typed ServingError subclasses with ``retryable`` set) are
+        counted as shed, not raised — a traffic harness measures load
+        shedding, it doesn't crash on it. Returns
+        ``{"steps", "submitted", "shed", "rids"}``."""
+        from .errors import ServingError
+        submit = getattr(target, "submit", None) or target.add_request
+        has_work = (getattr(target, "has_work", None)
+                    or target.scheduler.has_work)
+        eos = self.spec.eos_token_id if self.spec is not None else None
+        i, step, shed = 0, 0, 0
+        rids: list[str] = []
+        n = len(self.requests)
+        while i < n or has_work():
+            while i < n and self.requests[i].arrival_step <= step:
+                r = self.requests[i]
+                i += 1
+                try:
+                    rids.append(submit(r.prompt, r.max_new_tokens,
+                                       eos_token_id=eos,
+                                       rid=rid_prefix + r.rid))
+                except ServingError:
+                    shed += 1
+            target.step()
+            step += 1
+            if max_steps is not None and step >= max_steps:
+                raise RuntimeError(
+                    f"workload replay did not drain in {step} steps "
+                    f"({n - i} unsubmitted, target still busy)")
+        return {"steps": step, "submitted": len(rids), "shed": shed,
+                "rids": rids}
+
+
+def _arrival_steps(spec: WorkloadSpec, rng) -> list[int]:
+    """Per-step Poisson arrival counts, optionally rate-modulated by
+    the deterministic on/off burst wave, until n_requests are placed."""
+    steps: list[int] = []
+    step = 0
+    period = spec.burst_on + spec.burst_off
+    while len(steps) < spec.n_requests:
+        rate = spec.rate
+        if spec.arrival == "bursty":
+            in_burst = (step % period) < spec.burst_on
+            rate = spec.rate * (spec.burst_factor if in_burst
+                                else spec.idle_factor)
+        k = int(rng.poisson(rate))
+        for _ in range(min(k, spec.n_requests - len(steps))):
+            steps.append(step)
+        step += 1
+        if step > 1000 * (spec.n_requests + 1):
+            raise ValueError(
+                f"arrival rate too low to place {spec.n_requests} "
+                f"requests (arrival={spec.arrival!r}, rate={spec.rate}, "
+                f"idle_factor={spec.idle_factor})")
+    return steps
+
+
+def make_workload(spec: WorkloadSpec | None = None, **kw) -> Workload:
+    """Build a :class:`Workload` from a spec (or spec fields as
+    kwargs). Fully deterministic in ``spec.seed``."""
+    if spec is None:
+        spec = WorkloadSpec(**kw)
+    elif kw:
+        raise TypeError("pass a WorkloadSpec OR field kwargs, not both")
+    if spec.arrival not in ("poisson", "bursty"):
+        raise ValueError(f"unknown arrival process {spec.arrival!r}")
+    if spec.tenants < 1:
+        raise ValueError("tenants must be >= 1")
+    rng = np.random.default_rng(spec.seed)
+    # per-tenant system prompts (the shared prefixes): lengths first,
+    # then token draws, all from the one seeded stream
+    system_prompts: list[list[int]] = []
+    for _ in range(spec.tenants):
+        n = int(rng.integers(spec.system_len[0], spec.system_len[1] + 1))
+        system_prompts.append(
+            [int(t) for t in rng.integers(0, spec.vocab_size, size=n)])
+    # Zipf tenant popularity: p(rank) ~ 1/(rank+1)^alpha, tenant 0 hottest
+    ranks = np.arange(1, spec.tenants + 1, dtype=np.float64)
+    probs = ranks ** -spec.zipf_alpha
+    probs /= probs.sum()
+    weights = np.asarray([w for w, _, _ in spec.prompt_mix], np.float64)
+    weights /= weights.sum()
+    arrivals = _arrival_steps(spec, rng)
+    requests: list[WorkloadRequest] = []
+    for i, arrival in enumerate(arrivals):
+        tenant = int(rng.choice(spec.tenants, p=probs))
+        bucket = int(rng.choice(len(weights), p=weights))
+        _, lo, hi = spec.prompt_mix[bucket]
+        sfx_len = int(rng.integers(lo, hi + 1))
+        suffix = [int(t) for t in rng.integers(0, spec.vocab_size,
+                                               size=sfx_len)]
+        max_new = int(rng.integers(spec.max_new[0], spec.max_new[1] + 1))
+        requests.append(WorkloadRequest(
+            rid=f"wl-{i:04d}", arrival_step=arrival,
+            prompt=system_prompts[tenant] + suffix,
+            max_new_tokens=max_new, tenant=tenant))
+    return Workload(requests, spec=spec, system_prompts=system_prompts)

@@ -15,7 +15,7 @@ _DEFAULT_CONFIGS = {
     "llama_420m", "resnet50", "bert_base", "qwen2_moe", "lenet_mnist",
     "llama8b_shape", "llama_decode", "llama_longctx", "llama_serving",
     "llama_serving_prefix", "llama_decode_int8", "llama_serving_int8",
-    "llama_serving_fleet", "llama_serving_spec",
+    "llama_serving_fleet", "llama_serving_spec", "llama_serving_tiered",
 }
 
 
@@ -154,6 +154,25 @@ def test_dry_serving_spec_cell_carries_acceptance_keys():
                          "accept_rate", "draft_hit_rate",
                          "speedup_vs_decode",
                          "goodput_at_slo", "retraces"}, cell
+    assert all(v is None for v in cell.values()), cell
+
+
+def test_dry_serving_tiered_cell_carries_tier_keys():
+    # the tiered arm (SERVING.md "KV tiering & traffic harness"): the
+    # cell must surface the A/B evidence — the HBM/host/miss hit-rate
+    # breakdown, spill/restore volume, what the traffic harness shed,
+    # and goodput_at_slo for BOTH arms — next to the usual serving keys
+    out = _run_dry("llama_serving_tiered")
+    assert out.returncode == 0, out.stderr
+    last = json.loads(out.stdout.splitlines()[-1])
+    cell = last["bench_summary"]["llama_serving_tiered"]
+    assert set(cell) >= {"value", "mfu", "spread",
+                         "ttft_p50", "ttft_p99", "tpot",
+                         "cache_hit_rate", "tier_hbm_hit_rate",
+                         "tier_host_hit_rate", "tier_miss_rate",
+                         "spilled_pages", "restored_pages", "shed",
+                         "goodput_at_slo", "goodput_at_slo_notier",
+                         "retraces"}, cell
     assert all(v is None for v in cell.values()), cell
 
 

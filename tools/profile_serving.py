@@ -45,6 +45,18 @@ Run: python tools/profile_serving.py            (real TPU)
                                                  the accept-rate
                                                  histogram by draft
                                                  length printed)
+     python tools/profile_serving.py --tiered   (KV-tiering A/B: the same
+                                                 seeded Poisson multi-
+                                                 tenant Workload replayed
+                                                 on a pool sized to hold
+                                                 ~1.3 tenants, host tier
+                                                 OFF then ON — bitwise
+                                                 parity vs generate()
+                                                 asserted on BOTH arms,
+                                                 hit-rate strictly higher
+                                                 with the tier, spill/
+                                                 restore + goodput deltas
+                                                 printed)
      python tools/profile_serving.py --chaos    (replay the fixed
                                                  FaultPlan below and print
                                                  the outcome histogram —
@@ -478,6 +490,131 @@ def prefix():
               "on-chip for the PERF.md numbers)")
 
 
+def tiered():
+    """Tiered-KV A/B (SERVING.md "KV tiering & traffic harness"): one
+    seeded Poisson multi-tenant :class:`Workload` — Zipf-popular shared
+    system prompts plus ragged user suffixes — replayed on two
+    identically-configured engines whose pool deliberately holds only
+    ~1.3 tenants' pages, host tier OFF then ON. Both arms must produce
+    bitwise-identical greedy tokens AND match per-request ``generate()``
+    (restored pages are bit-exact, so the determinism contract survives
+    the round trip through host RAM). The deltas printed at the end are
+    the tier's value proposition: under forced eviction the cache hit
+    rate is STRICTLY higher with the tier (asserted — evictions become
+    demotions instead of losses), TTFT/goodput follow, and the
+    spill/restore counters say what the host pool paid for it."""
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                         llama_tiny)
+    from paddle_tpu.serving import (HostTier, ServingEngine,
+                                    ServingMetrics, make_workload)
+
+    backend = jax.default_backend()
+    smoke = "--smoke" in sys.argv[1:] or backend != "tpu"
+    if backend != "tpu":
+        print(f"WARNING: backend={backend} — timings are meaningless "
+              f"off-chip, running the smoke shapes")
+
+    pt.seed(0)
+    if smoke:
+        cfg = llama_tiny(mp_axis=None, fsdp_axis=None)
+        n_requests, max_new = 8, 6
+        tenants, system_len, sfx = 2, (24, 24), ((1.0, 4, 8),)
+        page_size, num_pages, max_slots = 4, 14, 1
+    else:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5632, num_hidden_layers=8,
+                          num_attention_heads=16, num_key_value_heads=8,
+                          max_position_embeddings=4096, dtype="bfloat16",
+                          mp_axis=None, fsdp_axis=None)
+        n_requests, max_new = 16, 48
+        tenants, system_len = 3, (160, 224)
+        sfx = ((0.7, 16, 48), (0.3, 48, 96))
+        page_size, num_pages, max_slots = 16, 40, 4
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+
+    wl = make_workload(seed=0, n_requests=n_requests, arrival="poisson",
+                       rate=0.5, tenants=tenants, zipf_alpha=1.2,
+                       system_len=system_len, prompt_mix=sfx,
+                       max_new=(max_new, max_new),
+                       vocab_size=cfg.vocab_size)
+    ws = wl.stats()
+    print(f"trace: {ws['n_requests']} requests over {ws['tenants']} "
+          f"Zipf tenants (counts {ws['tenant_counts']}), prompt lens "
+          f"{ws['prompt_len_min']}-{ws['prompt_len_max']}, Poisson "
+          f"arrivals over {ws['arrival_span_steps']} steps, "
+          f"max_new={max_new}, greedy; pool holds ~1.3 tenants")
+
+    # cold reference: per-request contiguous generate — both arms must
+    # match it bitwise even when their pages round-trip through host RAM
+    refs = {r.rid: np.asarray(
+        model.generate(np.asarray([r.prompt]),
+                       max_new_tokens=r.max_new_tokens)
+        )[0, len(r.prompt):].tolist() for r in wl}
+
+    def run_arm(tier_on):
+        eng = ServingEngine(model, num_pages=num_pages,
+                            page_size=page_size, max_slots=max_slots,
+                            host_tier=HostTier() if tier_on else None)
+        # epoch 1 warms the compiled programs AND the prefix index /
+        # host tier into their steady state; epoch 2 is measured, so the
+        # arm deltas are steady-state, not cold-start
+        wl.replay(eng, max_steps=5000, rid_prefix="warm-")
+        eng.metrics = ServingMetrics()
+        eng.metrics.set_host_tier(tier_on)
+        t0 = time.perf_counter()
+        out = wl.replay(eng, max_steps=5000)
+        wall = time.perf_counter() - t0
+        assert eng.decode_program_count() == 1, "decode retraced"
+        toks = {rid: list(eng.request(rid).tokens) for rid in out["rids"]}
+        return toks, wall, eng.metrics.summary(), eng
+
+    out_off, t_off, m_off, _ = run_arm(False)
+    out_on, t_on, m_on, eng = run_arm(True)
+
+    for rid, ref in refs.items():
+        assert out_off.get(rid, ref) == ref, \
+            "tier-OFF arm diverged from generate() — bug"
+        assert out_on.get(rid, ref) == ref, \
+            "tier-ON arm diverged — a restored page was not bit-exact"
+    assert out_off == out_on
+    print("parity: tier-ON == tier-OFF == generate(), bitwise, "
+          "all requests")
+
+    total = sum(len(v) for v in out_on.values())
+    tier = eng.pool.host_tier
+    for label, t, m in (("tier OFF", t_off, m_off),
+                        ("tier ON ", t_on, m_on)):
+        print(f"{label}: {t:7.3f}s  {total / t:8.1f} tok/s  "
+              f"ttft p50/p99 = {m['ttft_p50_s'] * 1000:7.1f}/"
+              f"{m['ttft_p99_s'] * 1000:7.1f}ms  "
+              f"hit_rate = {m['cache_hit_rate']:.3f}  "
+              f"goodput@slo = {m['goodput_at_slo']:.1f} tok/s")
+    print(f"\ntier ON breakdown: hbm={m_on['tier_hbm_hit_rate']:.3f} "
+          f"host={m_on['tier_host_hit_rate']:.3f} "
+          f"miss={m_on['tier_miss_rate']:.3f}  "
+          f"(restored {m_on['prefill_restored_tokens']} prefill tokens)")
+    print(f"host tier totals: spilled {tier.counters['spilled_pages']}p/"
+          f"{tier.counters['spilled_bytes']}B, restored "
+          f"{tier.counters['restored_pages']}p/"
+          f"{tier.counters['restored_bytes']}B, "
+          f"host pool {tier.pool_bytes}B in {tier.num_entries} pages, "
+          f"host evictions {tier.counters['host_evictions']}")
+    assert tier.counters["restored_pages"] > 0, \
+        "no restores — the pool was not actually under pressure"
+    assert m_on["cache_hit_rate"] > m_off["cache_hit_rate"], (
+        f"tiering did not raise the hit rate under forced eviction "
+        f"({m_on['cache_hit_rate']:.3f} <= {m_off['cache_hit_rate']:.3f})")
+    print("invariants held: bitwise parity both arms, hit rate strictly "
+          "higher with the tier, one decode program")
+    if smoke:
+        print("(smoke mode: deltas are logic evidence only — rerun "
+              "on-chip for the PERF.md numbers)")
+
+
 def spec():
     """Speculative-decoding A/B (SERVING.md "Speculative decoding"): one
     staggered shared-system-prompt trace replayed on two identically-
@@ -896,6 +1033,8 @@ if __name__ == "__main__":
         prefix()
     elif "--kv-int8" in sys.argv[1:]:
         kv_int8()
+    elif "--tiered" in sys.argv[1:]:
+        tiered()
     elif "--spec" in sys.argv[1:]:
         spec()
     else:
