@@ -91,6 +91,10 @@ _SERVING_SLOS = {
     # pay re-prefill + replay inside one inter-token gap — the looser
     # ITL budget is the failover price the SLO explicitly allows
     "llama_serving_fleet": {"ttft_p99_s": 2.0, "itl_p99_s": 1.0},
+    # chunked-prefill A/B: long prompts land mid-decode, so the OFF
+    # arm's itl_p99 carries the head-of-line stall chunking removes; a
+    # tight ITL SLO makes goodput_at_slo sensitive to exactly that
+    "llama_serving_chunked": {"ttft_p99_s": 4.0, "itl_p99_s": 0.25},
     # speculative arm: same workload/SLOs as llama_serving — drafting
     # must not be allowed to trade latency SLOs for throughput. itl is
     # per-EMITTED-token, so accepted multi-token steps help, not hurt
@@ -739,12 +743,10 @@ def bench_llama_serving(peak, peak_kind, n_requests=12, max_new_tokens=64,
     eng = ServingEngine(model, num_pages=512, page_size=16, max_slots=8,
                         max_pages_per_slot=32, tracer=tracer,
                         kv_quant=quantized)
-    # warm every program the trace will hit: the decode step plus one
-    # prefill bucket per distinct prompt-length bucket
-    for n in sorted({eng._bucket(s) for s in lens}):
-        eng.add_request(prompts[0][:n] if n <= len(prompts[0])
-                        else rng.integers(0, cfg.vocab_size, n), 2)
-    eng.run_to_completion(max_steps=100)
+    # warm BOTH step-shape programs (decode + mixed) with one all-slots-
+    # inactive dispatch each — prompts of any length reuse them (chunks
+    # are array values, not shapes), so no per-length warm sweep remains
+    eng.warm_programs()
     eng.metrics = ServingMetrics()  # compile time stays out of the trace
     eng.metrics.set_kv_quant(quantized)  # re-arm after the reset
     eng.metrics.set_slo(**_SERVING_SLOS[name])
@@ -856,15 +858,10 @@ def bench_llama_serving_prefix(peak, peak_kind, n_requests=12,
     tracer = _make_tracer(trace_path)
     eng = ServingEngine(model, num_pages=512, page_size=16, max_slots=8,
                         max_pages_per_slot=48, tracer=tracer)
-    # warm the programs on a DISJOINT token range so the measured trace
-    # starts with a cold prefix index for its own system prompt: the
-    # full-prompt bucket (first arrival, cold) and the suffix buckets
-    # the cached followers will hit, plus decode
-    warm = rng.integers(0, cfg.vocab_size, max(lens)).astype(np.int32)
-    for n in sorted({eng._bucket(s) for s in lens}
-                    | {eng._bucket(s) for s in sfx_lens}):
-        eng.add_request(warm[:n], 2)
-    eng.run_to_completion(max_steps=200)
+    # warm both step-shape programs with scratch-page dispatches: writes
+    # nothing into the pool and registers nothing, so the measured trace
+    # starts with a cold prefix index for its own system prompt
+    eng.warm_programs()
     eng.metrics = ServingMetrics()  # compile time stays out of the trace
     eng.metrics.set_slo(**_SERVING_SLOS["llama_serving_prefix"])
 
@@ -922,6 +919,135 @@ def bench_llama_serving_prefix(peak, peak_kind, n_requests=12,
     }
 
 
+def bench_llama_serving_chunked(peak, peak_kind, n_short=10, n_long=2,
+                                max_new_tokens=48, long_len=768,
+                                budget=128, trace_path=None):
+    """Chunked-prefill serving A/B (SERVING.md "Chunked prefill & mixed
+    steps"): a decode-heavy short-request stream with LONG prompts
+    landing mid-trace, run twice on the same model — chunked OFF (the
+    legacy whole-prompt admission prefill: a long arrival stalls every
+    decoding slot for its entire prompt) and chunked ON (the prompt
+    streams through the mixed program in budget-sized chunks alongside
+    the decode rows, so decoders keep emitting every step). Headline
+    value is the chunked arm's tokens/s; the A/B evidence the driver
+    wants is ``itl_p99`` and ``goodput_at_slo`` for BOTH arms in the
+    bench_summary cell — head-of-line blocking shows up as the OFF
+    arm's inter-token p99, which is exactly what chunking removes.
+    Greedy streams are asserted token-exact between the arms (chunk
+    boundaries are scheduling, never semantics), and both arms assert
+    zero retraces across the decode + mixed program pair."""
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import ServingEngine, ServingMetrics
+
+    name = "llama_serving_chunked"
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                      intermediate_size=5632, num_hidden_layers=8,
+                      num_attention_heads=16, num_key_value_heads=8,
+                      max_position_embeddings=4096, dtype="bfloat16",
+                      mp_axis=None, fsdp_axis=None)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    n_params = model.num_params()
+    rng = np.random.default_rng(0)
+    short_lens = [int(x) for x in rng.integers(48, 96, n_short)]
+    shorts = [rng.integers(0, cfg.vocab_size, n).astype(np.int32)
+              for n in short_lens]
+    longs = [rng.integers(0, cfg.vocab_size, long_len).astype(np.int32)
+             for _ in range(n_long)]
+    long_steps = [6 + 10 * i for i in range(n_long)]  # land mid-decode
+    tracer = _make_tracer(trace_path)
+
+    def run_arm(chunked):
+        eng = ServingEngine(model, num_pages=512, page_size=16,
+                            max_slots=8, max_pages_per_slot=64,
+                            prefill_token_budget=budget,
+                            tracer=tracer if chunked else None,
+                            chunked=chunked, prefill_chunk=64)
+        eng.warm_programs()
+        eng.metrics = ServingMetrics()  # compile stays out of the trace
+        eng.metrics.set_chunked(chunked)  # re-arm after the reset
+        eng.metrics.set_slo(**_SERVING_SLOS[name])
+
+        added, added_long = 2, 0
+        rids = [eng.add_request(p, max_new_tokens) for p in shorts[:2]]
+        steps = 0
+        while (eng.scheduler.has_work() or added < n_short
+               or added_long < n_long):
+            eng.step()
+            steps += 1
+            if added < n_short and steps % 3 == 0:
+                rids.append(eng.add_request(shorts[added],
+                                            max_new_tokens))
+                added += 1
+            if added_long < n_long and steps >= long_steps[added_long]:
+                # a long prompt arrives while every slot is decoding
+                rids.append(eng.add_request(longs[added_long], 8))
+                added_long += 1
+        outs = [list(eng.request(r).tokens) for r in rids]
+        m = eng.metrics.summary()
+        retraces = sum(n - 1 for n in eng.step_program_counts().values())
+        assert retraces == 0, "serving step program retraced"
+        return eng, m, steps, outs
+
+    _, m0, steps0, outs0 = run_arm(False)
+    eng, m, steps, outs = run_arm(True)
+    # the tentpole's determinism contract, priced into the headline:
+    # chunked streams are token-exact vs whole-prompt prefill
+    assert outs == outs0, "chunked arm diverged from whole-prompt arm"
+    hbm_bw = {"v4": 1.2e12,
+              "v5e": 0.82e12, "v5litepod": 0.82e12, "v5lite": 0.82e12,
+              "v5p": 2.77e12,
+              "v6e": 1.64e12, "trillium": 1.64e12,
+              }.get(peak_kind.split("(")[0], 0.82e12)
+    wall = max(m["wall_s"], 1e-9)
+    mbu = steps * 2.0 * n_params / wall / hbm_bw
+    trace_out = _dump_trace(tracer, trace_path, name)
+    return {
+        "metric": "llama_420m_serving_chunked_tokens_per_sec",
+        "value": round(m["tokens_per_s"], 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(m["tokens_per_s"]
+                             / max(m0["tokens_per_s"], 1e-9), 4),
+        "extra": {"params": n_params,
+                  "n_short": n_short, "n_long": n_long,
+                  "short_lens": short_lens, "long_len": long_len,
+                  "prefill_chunk": 64, "prefill_token_budget": budget,
+                  "max_new_tokens": max_new_tokens,
+                  "engine_steps": steps,
+                  "engine_steps_baseline": steps0,
+                  "tokens_per_s_baseline": round(m0["tokens_per_s"], 1),
+                  "mixed_steps": m["mixed_steps"],
+                  "chunk_tokens_total": m["chunk_tokens_total"],
+                  "chunks_dispatched": m["chunks_dispatched_total"],
+                  "ttft_p50": round(m["ttft_p50_s"], 4),
+                  "ttft_p99": round(m["ttft_p99_s"], 4),
+                  "tpot": round(m["tpot_mean_s"], 5),
+                  "itl_p99": round(m["itl_p99_s"], 5),
+                  "itl_p99_baseline": round(m0["itl_p99_s"], 5),
+                  "itl_p99_ratio": round(
+                      m0["itl_p99_s"] / max(m["itl_p99_s"], 1e-9), 4),
+                  "preemptions": m["preemptions"],
+                  "rejected": m["rejected"],
+                  "timed_out": m["timed_out"],
+                  "quarantined": m["quarantined"],
+                  "kv_util_peak": round(m["kv_util_peak"], 4),
+                  "goodput_at_slo": round(m["goodput_at_slo"], 4),
+                  "goodput_at_slo_baseline": round(
+                      m0["goodput_at_slo"], 4),
+                  "slo": _SERVING_SLOS[name],
+                  "retraces": sum(
+                      n - 1
+                      for n in eng.step_program_counts().values()),
+                  "trace": trace_out,
+                  "mbu_weights_only": round(mbu, 4),
+                  "peak": peak_kind, "hbm_bw": hbm_bw,
+                  "pipeline": False, "runs": _RUNS,
+                  "spread": None},
+    }
+
+
 def bench_llama_serving_spec(peak, peak_kind, n_requests=12,
                              max_new_tokens=64, prefix_len=256,
                              spec_k=4, trace_path=None):
@@ -936,8 +1062,9 @@ def bench_llama_serving_spec(peak, peak_kind, n_requests=12,
     stream the engine did not pay for). Greedy output is asserted
     token-exact between the arms — speculation changes how many tokens
     a step emits, never which — and both per-step-shape programs are
-    asserted compiled-once (the verify program is warmed by a
-    propose-always drafter so mid-trace compiles stay out of TTFT)."""
+    asserted compiled-once (both programs are warmed by
+    ``warm_programs()`` — verify rows ride the mixed program — so
+    mid-trace compiles stay out of TTFT)."""
     import paddle_tpu as pt
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.serving import (ServingEngine, ServingMetrics,
@@ -960,18 +1087,7 @@ def bench_llama_serving_spec(peak, peak_kind, n_requests=12,
         [system, rng.integers(0, cfg.vocab_size, n).astype(np.int32)])
         for n in sfx_lens]
     lens = [len(p) for p in prompts]
-    warm = rng.integers(0, cfg.vocab_size, max(lens)).astype(np.int32)
     tracer = _make_tracer(trace_path)
-
-    class _WarmDrafter:
-        # propose-always: guarantees the verify program traces during
-        # warmup even when the warm prompts have no n-gram repeats
-        def propose(self, req, k):
-            ctx = req.tokens or list(req.prompt)
-            return [int(ctx[-1])] * k
-
-        def observe(self, req, n_draft, n_accepted):
-            pass
 
     def run_arm(spec_on):
         eng = ServingEngine(model, num_pages=512, page_size=16,
@@ -979,17 +1095,10 @@ def bench_llama_serving_spec(peak, peak_kind, n_requests=12,
                             tracer=tracer if spec_on else None,
                             speculative=(SpeculativeConfig(k=spec_k)
                                          if spec_on else None))
-        real_drafter = eng._drafter
-        if spec_on:
-            eng._drafter = _WarmDrafter()
-        # warm max_new must exceed 2: the draft cap is
-        # max_new - len(tokens) - 1, so a 2-token warm request never
-        # drafts and the verify program would compile mid-trace
-        for n in sorted({eng._bucket(s) for s in lens}
-                        | {eng._bucket(s) for s in sfx_lens}):
-            eng.add_request(warm[:n], 4 if spec_on else 2)
-        eng.run_to_completion(max_steps=300)
-        eng._drafter = real_drafter
+        # verify rows share the mixed program with prefill chunks, so
+        # one warm dispatch per step shape covers spec-on and -off alike
+        # (no propose-always warm drafter needed anymore)
+        eng.warm_programs()
         eng.metrics = ServingMetrics()  # compile stays out of the trace
         eng.metrics.set_spec(spec_on)   # re-arm after the reset
         eng.metrics.set_slo(**_SERVING_SLOS[name])
@@ -1103,13 +1212,10 @@ def bench_llama_serving_fleet(peak, peak_kind, n_requests=12,
                              max_slots=8, max_pages_per_slot=32,
                              tracer=tracer)
                for _ in range(2)]
-    # both replicas share the model, so the compiled decode/prefill
+    # both replicas share the model, so the compiled decode/mixed
     # programs are shared too — warm them once through replica 0, plus
     # one tiny run on replica 1 so its own step path is exercised
-    for n in sorted({engines[0]._bucket(s) for s in lens}):
-        engines[0].add_request(prompts[0][:n] if n <= len(prompts[0])
-                               else rng.integers(0, cfg.vocab_size, n), 2)
-    engines[0].run_to_completion(max_steps=100)
+    engines[0].warm_programs()
     engines[1].add_request(prompts[0], 2)
     engines[1].run_to_completion(max_steps=100)
     warm_steps = [e.stats()["steps"] for e in engines]
@@ -1364,8 +1470,12 @@ _CONFIGS = {
     # "Engine fleet & failover"): client-visible tokens/s with the
     # failover replay priced in, plus failovers/replays/shed evidence
     "llama_serving_fleet": bench_llama_serving_fleet,
+    # chunked-prefill A/B (SERVING.md "Chunked prefill & mixed steps"):
+    # whole-prompt vs chunk-streamed prefill on a long-prompt +
+    # decode-heavy trace; itl_p99/goodput for both arms, token-exact
+    "llama_serving_chunked": bench_llama_serving_chunked,
     # speculative decoding A/B (SERVING.md "Speculative decoding"):
-    # n-gram draft + one [max_slots, k] verify program vs plain decode
+    # n-gram draft verified through the mixed step vs plain decode
     # on the same shared-system-prompt trace; token-exact by assertion
     "llama_serving_spec": bench_llama_serving_spec,
     # host-RAM KV tiering A/B on a Poisson multi-tenant Workload
@@ -1394,6 +1504,12 @@ _SUMMARY_EXTRA_KEYS = {
                             "failovers", "replayed_tokens", "shed",
                             "replicas_ejected",
                             "goodput_at_slo", "retraces"),
+    "llama_serving_chunked": ("ttft_p50", "ttft_p99", "tpot",
+                              "itl_p99", "itl_p99_baseline",
+                              "itl_p99_ratio",
+                              "goodput_at_slo",
+                              "goodput_at_slo_baseline",
+                              "chunk_tokens_total", "retraces"),
     "llama_serving_spec": ("ttft_p50", "ttft_p99", "tpot",
                            "accept_rate", "draft_hit_rate",
                            "speedup_vs_decode",

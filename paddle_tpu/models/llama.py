@@ -211,9 +211,8 @@ class LlamaAttention(Layer):
                                                 kv_quantize)
             if isinstance(ck, QuantizedKV):
                 # int8 cache: quantize the written tokens (same per-row
-                # absmax codes a later decode append would produce), then
-                # attend over the fp32 dequantized view — the cache keeps
-                # int8 + scales, attention math runs in fp32
+                # absmax codes a later decode append would produce); the
+                # cache keeps int8 + scales, attention dequantizes to fp32
                 kq, vq = kv_quantize(k), kv_quantize(v)
                 ck = QuantizedKV(
                     jax.lax.dynamic_update_slice_in_dim(
@@ -233,6 +232,20 @@ class LlamaAttention(Layer):
                     cv, v.astype(cv.dtype), position_offset, axis=1)
                 k, v = ck, cv
             new_cache = (ck, cv)
+            if attn_mask is None:
+                # cached (pre)fill: row j sits at cache position
+                # position_offset + j. Routed through the SAME grouped
+                # GQA core as the paged decode/verify/chunk rows
+                # (cached_prefill_attention -> _grouped_decode_attn), so
+                # generate()'s prefill and the serving engine's chunked
+                # prefill are one numeric program — the bitwise
+                # engine==generate parity contract composes with chunk
+                # boundaries. QuantizedKV caches pass through undequantized;
+                # the core dequantizes them itself.
+                seq_lens = jnp.broadcast_to(jnp.asarray(position_offset), (b,))
+                out = F.cached_prefill_attention(q, new_cache[0],
+                                                 new_cache[1], seq_lens)
+                return self.o_proj(out.reshape(b, s, h * d)), new_cache
         if kvh != h:  # GQA: repeat kv heads
             rep = h // kvh
             k = jnp.repeat(k, rep, axis=2)

@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["scaled_dot_product_attention", "flash_attention", "sdp_kernel",
-           "paged_attention_decode"]
+           "paged_attention_decode", "cached_prefill_attention"]
 
 # sdp_kernel override; None -> read FLAGS_flash_min_seq (default 256). The
 # Pallas kernel's block logic covers seq >= 256 (blocks halve to divide the
@@ -423,6 +423,36 @@ def _grouped_decode_attn(q, kc, vc, seq_lens, scale):
     out = jnp.einsum("btngs,bsnd->btngd", p.astype(vc.dtype), vc,
                      preferred_element_type=jnp.float32)
     return out.reshape(b, t, h, d).astype(q.dtype)
+
+
+def cached_prefill_attention(q, kc, vc, seq_lens, scale=None):
+    """Causal attention of NEW rows against a contiguous KV cache that
+    already holds them: row j of ``q`` sits at cache position
+    ``seq_lens + j`` and attends positions ``<= seq_lens + j`` (itself
+    included; zeros beyond the written extent are masked).
+
+    This is the CONTIGUOUS-cache twin of ``paged_attention_decode``'s
+    gather path and shares ``_grouped_decode_attn`` with it, so
+    ``generate()``'s cached prefill, the engine's chunked-prefill rows
+    and the speculative verify rows are all the SAME numeric program —
+    q cast to the cache dtype, fp32-accumulated scores, probs in the
+    cache dtype. That unification is what keeps the serving engine's
+    mixed prefill/decode step bitwise-equal to ``generate()``: a chunk
+    boundary only changes WHERE the mask cuts, never the math. Accepts
+    fp caches or ``QuantizedKV`` (dequantized inside the core).
+
+    q: [b, t, h, d]; kc/vc: [b, S, kvh, d] (or QuantizedKV of the same
+    logical shape); seq_lens: [b] int32 — the per-row start offsets
+    (0 for a fresh prefill, the cached length for a suffix prefill).
+    Note: this path trades the flash kernel for core unification — the
+    masked columns cost O(S·t) flops, fine for chunk-sized t; long
+    *uncached* prompts still take the flash path (no cache to unify
+    against).
+    """
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    return _grouped_decode_attn(q, kc, vc, seq_lens, scale)
 
 
 def paged_attention_decode(q, pool_k, pool_v, block_tables, seq_lens,

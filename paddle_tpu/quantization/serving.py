@@ -9,7 +9,7 @@ Two halves, both aimed at the decode bandwidth wall PERF.md measured:
   ``[..., head_dim]`` row. Quantization happens exactly once, at
   cache-WRITE time (prefill scatter and decode append); every attention
   read dequantizes to fp32 inside the one shared GQA decode core, so the
-  compiled-program count and the pow2 prefill buckets are untouched.
+  engine's two-program contract (decode + mixed step) is untouched.
 
 * **Int8 weight streaming** — :func:`quantize_for_serving` converts a
   model's decode matmul weights (attention projections + MLP; the lm_head

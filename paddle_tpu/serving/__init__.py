@@ -31,7 +31,7 @@ from .scheduler import (FINISHED, PREEMPTED, RUNNING, WAITING, Request,
 from .speculative import DraftProposer, NgramDrafter, SpeculativeConfig
 from .tiering import HostTier
 from .workload import (Workload, WorkloadRequest, WorkloadSpec,
-                       make_workload)
+                       heavy_tail_workload, make_workload)
 
 __all__ = [
     "ServingEngine", "KVCachePool", "PoolExhaustedError", "PrefixMatch",
@@ -41,7 +41,8 @@ __all__ = [
     "WAITING", "RUNNING", "PREEMPTED", "FINISHED",
     "SpeculativeConfig", "DraftProposer", "NgramDrafter",
     "HostTier",
-    "Workload", "WorkloadRequest", "WorkloadSpec", "make_workload",
+    "Workload", "WorkloadRequest", "WorkloadSpec", "heavy_tail_workload",
+    "make_workload",
     "ServingError", "QueueFullError", "RequestTooLargeError",
     "SchedulerStalledError", "EngineDrainingError", "FleetOverloadedError",
 ]

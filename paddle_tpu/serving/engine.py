@@ -1,43 +1,50 @@
 """Continuous-batching serving engine over the paged KV-cache pool.
 
-The decode step is ONE compiled program for the engine's lifetime: it
-always runs over the fixed ``[max_slots]`` slot axis, with block tables
-``[max_slots, max_pages_per_slot]``, position offsets, the active-slot
-mask, and every per-request sampling parameter passed as ARRAY inputs.
-Requests joining, finishing, or being preempted only change array
-*values*, never shapes or the jaxpr — ``decode_program_count()`` stays
-at 1 across arbitrary churn (asserted by tests/test_serving.py). With
-speculative decoding enabled (``speculative=``, serving/speculative.py)
-the engine owns exactly ONE more fixed-shape program: the
-``[max_slots, k]`` verify step, which scores a slot's decode input plus
-up to k-1 drafted tokens in a single weight stream, samples every
-position under the engine's standard contract, accepts the longest
-draft prefix matching those samples, and zeroes rejected rows
-in-program. Per-slot draft counts ride as the ``n_live`` array lane, so
-accept patterns change array values, never shapes —
-``step_program_counts()`` reports every per-step-shape program and each
-stays pinned at 1 (O(1) programs, not O(accept-pattern)).
+The engine owns exactly TWO compiled programs for its lifetime:
 
-Prefill runs one admitted request at a time through per-bucket compiled
-programs (UNCACHED-suffix lengths rounded up to power-of-two page
-multiples, so the program count is O(log max_len)): the request's pages
-— including any prefix-cache hits mapped in by the scheduler — are
-gathered into a contiguous cache prefix, the model runs over the suffix
-ids only with a TRACED ``start_pos`` offset (never a bucket axis), and
-the buffer is scattered back page-by-page through the block table.
-Bucket-padding and already-cached positions land in the reserved
-scratch page 0. With ``prefix_cache=True`` (default) the pool indexes
-full pages by chained content hash, shares them across requests via
-refcounts, reuses partial pages copy-on-write, and LRU-evicts
-refcount-0 cached pages when allocation would otherwise fail — see
-SERVING.md "Prefix caching".
+- the 1-token decode step, always over the fixed ``[max_slots]`` slot
+  axis with block tables, position offsets, the active mask and every
+  per-request sampling parameter as ARRAY inputs — requests joining,
+  finishing or being preempted change array *values*, never shapes, so
+  ``decode_program_count()`` stays at 1 across arbitrary churn
+  (asserted by tests/test_serving.py);
+- the MIXED step, fixed shape ``[max_slots, chunk]``: each slot carries
+  ``(start_pos, n_new)`` as the ``seq_lens``/``n_live`` array lanes and
+  processes either a budget-sized PREFILL CHUNK (``forced`` lane set:
+  its rows are teacher-forced prompt tokens) or its decode input plus
+  up to k-1 speculative draft tokens — Orca's iteration-level batching
+  with Sarathi-Serve's chunked prefill. One program serves prefill,
+  decode+verify, and any mixture; the old O(log max_len) pow2
+  suffix-bucket prefill family is gone.
+
+Long prompts stream through the mixed step in chunks metered by the
+per-step prefill token budget, so decode slots never stall behind a
+prompt: a chunking slot occupies its lane with prompt rows while every
+other slot keeps decoding in the same dispatch. All rows share the one
+grouped GQA core and the paged scatter-at-write path (fp and int8 KV);
+within a chunk, row j sits at pool position ``start_pos + j`` and
+attends causally up to itself. Speculative verify is the degenerate
+mixed step whose new tokens are draft rows instead of prompt rows: row
+j is ACCEPTED iff it equals the row j-1 sample (Leviathan), rejected
+rows are zeroed in-program, and a ``forced`` slot accepts all its rows
+by construction. ``step_program_counts()`` reports both step shapes
+and each stays pinned at 1 (O(1) programs, not O(prompt-length) or
+O(accept-pattern)).
+
+With ``prefix_cache=True`` (default) the pool indexes full pages by
+chained content hash, shares them across requests via refcounts,
+reuses partial pages copy-on-write, and LRU-evicts refcount-0 cached
+pages when allocation would otherwise fail — see SERVING.md "Prefix
+caching". Prefix registration commits on the FINAL chunk: a request
+preempted mid-prompt registers nothing (and still drops its page
+refs), so partial prompts can never serve future hits.
 
 Determinism: greedy decode is argmax over logits that are bitwise equal
 to ``LlamaForCausalLM.generate()``'s (shared attention core, masked
 padding contributes exact zeros — see SERVING.md); sampled requests
 draw token *n* with ``fold_in(PRNGKey(seed), n)`` so a preempted and
 recomputed request reproduces its original stream regardless of slot
-placement or batch composition.
+placement, chunk boundaries, or batch composition.
 
 Robustness (SERVING.md "Serving failure modes"): every failure mode is
 a classified per-request outcome or a typed :mod:`.errors` exception,
@@ -47,11 +54,11 @@ at step boundaries on the injectable metrics clock, a per-request
 preemption cap, a non-finite logit sentinel that quarantines only the
 offending slot (its pages are scrubbed back to zero so the pool's
 masked-garbage-is-zero invariant survives reuse), zero-progress stall
-detection, and ``drain()`` for graceful (SIGTERM) shutdown. The
-blocking per-step device sync runs under ``watch("serving.step")`` and
-the fault sites ``serving.step`` / ``serving.prefill`` /
-``serving.decode`` / ``serving.alloc`` make all of it deterministically
-chaos-testable.
+detection (chunk progress counts as progress), and ``drain()`` for
+graceful (SIGTERM) shutdown. The blocking per-step device sync runs
+under ``watch("serving.step")`` and the fault sites ``serving.step`` /
+``serving.prefill`` / ``serving.decode`` / ``serving.alloc`` make all
+of it deterministically chaos-testable.
 """
 
 from __future__ import annotations
@@ -90,7 +97,8 @@ class ServingEngine:
                  watchdog=None, prefix_cache: bool = True,
                  tracer=None, flight_recorder=None,
                  kv_quant: bool = False, speculative=None,
-                 host_tier=None):
+                 host_tier=None, chunked: bool = True,
+                 prefill_chunk: int = 64):
         cfg = model.config
         self.model = model
         self.page_size = page_size
@@ -118,12 +126,8 @@ class ServingEngine:
                    else kv_dtype),
             cache_enabled=prefix_cache, quantized=kv_quant,
             host_tier=host_tier if prefix_cache else None)
-        # the prefill gather window: every prefill program reads the
-        # request's cached-prefix pages through a fixed-length gather of
-        # _ctx_pages pages (unused entries point at scratch page 0, all
-        # masked), so the CACHED length rides as a traced start_pos and
-        # the program count stays keyed by the suffix bucket alone —
-        # O(log max_len), not O(log^2)
+        # every (re-)admission must fit the slot's block table and the
+        # rope table — admission_check guards the window up front
         self._ctx_pages = min(self.max_pages_per_slot,
                               self.pool.pages_for(
                                   cfg.max_position_embeddings))
@@ -132,8 +136,8 @@ class ServingEngine:
                                    max_preemptions=max_preemptions)
         # speculative decoding (serving/speculative.py; SERVING.md
         # "Speculative decoding"): pass a SpeculativeConfig, an int k,
-        # or True for defaults. The verify row count k is a compile-time
-        # shape; the drafter runs host-side every step.
+        # or True for defaults. Draft rows ride the mixed step's row
+        # axis; the drafter runs host-side every step.
         from .speculative import SpeculativeConfig
         if speculative is True:
             speculative = SpeculativeConfig()
@@ -144,10 +148,25 @@ class ServingEngine:
         self._spec: SpeculativeConfig | None = speculative
         self._drafter = speculative.make_drafter() if speculative else None
         self.scheduler.spec_k = speculative.k if speculative else 1
+        # chunked prefill (SERVING.md "Chunked prefill & mixed steps"):
+        # chunked=True streams admitted prompts through the mixed step
+        # in prefill_chunk-sized bites interleaved with decode;
+        # chunked=False runs the whole suffix through the same program
+        # inside the admission loop (legacy whole-prompt pacing — the
+        # A/B baseline arm). Either way the mixed step's row count is
+        # ONE compile-time constant: max(prefill_chunk, spec_k).
+        if prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, "
+                             f"got {prefill_chunk}")
+        self.chunked = bool(chunked)
+        self.prefill_chunk = int(prefill_chunk)
+        self._chunk = max(self.prefill_chunk, self.scheduler.spec_k)
+        self.scheduler.chunked = self.chunked
         self.metrics = ServingMetrics(clock)
         self.metrics.set_kv_quant(kv_quant)
         self.metrics.set_spec(speculative is not None)
         self.metrics.set_host_tier(self.pool.host_tier is not None)
+        self.metrics.set_chunked(self.chunked)
         # observability (OBSERVABILITY.md): the tracer is shared with
         # the scheduler (request-lifecycle spans) and the pool
         # (eviction/COW/quarantine events); construct it on the same
@@ -162,7 +181,7 @@ class ServingEngine:
         if flight_recorder is not None:
             self.tracer.add_sink(flight_recorder.record)
         # retrace detection (tracing on): last-seen compiled-program
-        # count PER STEP SHAPE ("decode", "verify") — every shape is a
+        # count PER STEP SHAPE ("decode", "mixed") — every shape is a
         # first-class program with its own sentinel
         self._step_traces: dict[str, int] = {}
         self._wd_hooked: set[int] = set()
@@ -178,9 +197,7 @@ class ServingEngine:
         self._guard = None
         self.last_drain_events: list[dict] = []
         self._decode_step = self._build_decode_step()
-        self._verify_step = (self._build_verify_step()
-                             if speculative is not None else None)
-        self._prefill_progs: dict[int, object] = {}
+        self._mixed_step = self._build_mixed_step()
 
     # ------------------------------------------------------------------
     # public API
@@ -252,20 +269,23 @@ class ServingEngine:
             raise RequestTooLargeError(
                 f"request needs {need} pages "
                 f"(max_pages_per_slot={self.max_pages_per_slot})")
-        # any (re-)admission prefill must fit the gather window: the
-        # longest possible recompute is prompt + max_new - 1 tokens
+        # any (re-)admission must fit the context window: the longest
+        # possible recompute is prompt + max_new - 1 tokens
         ctx = self._ctx_pages * self.page_size
         if total - 1 > ctx:
             raise RequestTooLargeError(
-                f"request context ({total} tokens) exceeds the prefill "
+                f"request context ({total} tokens) exceeds the context "
                 f"window of {ctx} tokens ({self._ctx_pages} pages; "
                 f"bounded by max_position_embeddings and "
                 f"max_pages_per_slot)")
 
     def step(self) -> list[dict]:
-        """One scheduling iteration: expire deadlines, admit + prefill
-        newly runnable requests, guarantee decode pages (preempting if
-        needed), then one batched decode step over every running slot.
+        """One scheduling iteration: expire deadlines, admit newly
+        runnable requests (chunked: map pages only; unchunked: run the
+        whole prefill inline), guarantee decode pages (preempting if
+        needed), then ONE batched dispatch over the running slots —
+        prefill chunks and decode/verify rows share the mixed program;
+        a pure-decode step keeps the cheap ``[max_slots]`` program.
         Returns this step's token/finish events. A zero-progress step
         with work still pending raises SchedulerStalledError instead of
         letting ``run_to_completion`` busy-loop."""
@@ -282,16 +302,19 @@ class ServingEngine:
             self._expire_deadlines(events)
         if self._draining:
             self._flush_waiting(events)
-        # admit one request at a time and run its prefill immediately:
-        # the NEXT admission's prefix lookup then sees the pages this
-        # prefill just registered, so a same-step burst sharing a system
-        # prompt prefills the common prefix exactly once
+        # the verify/chunk rows and any admission prefill share ONE
+        # per-step token-work bound: the prefill budget, minus the
+        # (spec_k - 1) verify rows each decoding slot may score
+        budget = (self.scheduler.prefill_token_budget
+                  - self.scheduler.verify_token_reserve())
         if not self._draining:
-            # the verify step scores up to spec_k tokens per running
-            # slot through the same weight stream as prefill — reserve
-            # those tokens out of the step's prefill budget up front
-            budget = (self.scheduler.prefill_token_budget
-                      - self.scheduler.verify_token_reserve())
+            # admit one request at a time. Unchunked: run its prefill
+            # immediately so the NEXT admission's prefix lookup sees the
+            # pages this prefill just registered (a same-step burst
+            # sharing a system prompt prefills the common prefix once).
+            # Chunked: just map pages — the suffix streams through the
+            # mixed step below, and registration commits on the final
+            # chunk.
             first = True
             while True:
                 with tr.span("admission"):
@@ -300,16 +323,27 @@ class ServingEngine:
                 if not batch:
                     break
                 req = batch[0]
-                budget -= (req.context_len - req.cached_len
-                           + self.pool.restore_charge_tokens(
-                               req.restored_len)
-                           + (self.scheduler.spec_k - 1))
                 first = False
                 self.metrics.on_admit(req.rid)
-                self.metrics.on_prefill(req.cached_len, req.context_len,
+                self.metrics.on_prefill(req.cached_len, req.prefill_target,
                                         req.restored_len)
-                with tr.span("prefill_dispatch", rid=req.rid):
-                    self._run_prefill(req, events)
+                if self.chunked:
+                    budget -= self.pool.restore_charge_tokens(
+                        req.restored_len)
+                    if not req.prefilling:
+                        # recompute fully served from the prefix cache:
+                        # the pages already hold the context bit-for-bit
+                        # — no chunks owed, the stored last token drives
+                        # the next decode row
+                        tr.instant("prefill_cached", track=req.rid,
+                                   cached=req.cached_len)
+                else:
+                    budget -= (req.context_len - req.cached_len
+                               + self.pool.restore_charge_tokens(
+                                   req.restored_len)
+                               + (self.scheduler.spec_k - 1))
+                    with tr.span("prefill_dispatch", rid=req.rid):
+                        self._run_prefill(req, events)
         # drafts are proposed BEFORE the page guarantee so
         # ensure_decode_pages covers the speculative writes too
         if self._spec is not None and self.scheduler.running:
@@ -325,15 +359,18 @@ class ServingEngine:
                 events.append({"rid": victim.rid, "token": None,
                                "finished": True,
                                "finish_reason": "preempted_limit"})
+        chunk_tokens = 0
         if self.scheduler.running:
-            self._run_decode(events)
+            chunk_tokens = self._run_batch(events, max(budget, 0))
         self.metrics.on_prefix_counters(self.pool.counters)
         if self.pool.host_tier is not None:
             self.metrics.on_tier_stats(self.pool.host_tier.stats())
         self.metrics.on_step(self.scheduler.queue_depth,
                              self.pool.utilization())
         self._steps += 1
-        if events or not self.scheduler.waiting:
+        if events or chunk_tokens or not self.scheduler.waiting:
+            # chunk tokens are progress even before any emission: a
+            # long prompt legitimately spends several steps mid-prefill
             self._idle_steps = 0
         else:
             # work is pending but nothing was admitted, decoded or
@@ -418,6 +455,9 @@ class ServingEngine:
                     self._finish_abnormal(req, "preempted", events)
                 break
             events.extend(self.step())
+        # the last step may have preempted a straggler back to waiting
+        # AFTER that step's own flush — classify it before reporting
+        self._flush_waiting(events)
         self.last_drain_events = events
         report = {rid: {"finish_reason": r.finish_reason,
                         "tokens": list(r.tokens),
@@ -447,31 +487,58 @@ class ServingEngine:
     def decode_program_count(self) -> int:
         """Compiled-program count of the 1-token decode step — the
         no-retrace contract says this stays 1 no matter how requests
-        churn. Speculative decoding adds exactly ONE more per-step-shape
-        program (the ``[max_slots, k]`` verify step), counted separately
-        by :meth:`verify_program_count`; ``step_program_counts`` reports
-        every step shape so none hides as an uncounted second program."""
+        churn. The only other program is the ``[max_slots, chunk]``
+        mixed step (prefill chunks + speculative verify), counted by
+        :meth:`mixed_program_count`; ``step_program_counts`` reports
+        every step shape so none hides as an uncounted extra program."""
         return int(self._decode_step._cache_size())
 
+    def mixed_program_count(self) -> int:
+        """Compiled-program count of the mixed step: pinned at 1 under
+        churn once any prefill chunk or verify has dispatched — chunk
+        sizes, accept patterns and prefill/decode composition are array
+        values (``n_live``/``forced`` lanes), never shapes."""
+        return int(self._mixed_step._cache_size())
+
     def verify_program_count(self) -> int:
-        """Compiled-program count of the speculative verify step: 0 with
-        speculation off, else pinned at 1 under churn — per-slot draft
-        counts and accept patterns are array values (``n_live`` lane and
-        in-program accept scan), never shapes."""
-        if self._verify_step is None:
+        """Speculative verify rides the mixed program (verify is the
+        mixed step with draft rows instead of prompt rows): 0 with
+        speculation off, else the mixed-step program count."""
+        if self._spec is None:
             return 0
-        return int(self._verify_step._cache_size())
+        return self.mixed_program_count()
 
     def step_program_counts(self) -> dict[str, int]:
         """Per-step-shape compiled-program counts. Every step shape the
         engine can dispatch is first-class here, and the O(1)-programs
-        contract says each value stays exactly 1 no matter how requests
-        churn or accept patterns vary (asserted by the bench drivers and
-        tests/test_serving_spec.py over churn epochs)."""
-        counts = {"decode": int(self._decode_step._cache_size())}
-        if self._verify_step is not None:
-            counts["verify"] = int(self._verify_step._cache_size())
-        return counts
+        contract says each value stays at most 1 no matter how requests
+        churn, prompts chunk, or accept patterns vary (asserted by the
+        bench drivers and tests/test_serving_spec.py over churn
+        epochs)."""
+        return {"decode": int(self._decode_step._cache_size()),
+                "mixed": int(self._mixed_step._cache_size())}
+
+    def warm_programs(self) -> None:
+        """Compile both step programs with an all-inactive dispatch
+        (every row targets the reserved scratch page 0) so benches and
+        profilers can separate compile time from steady-state latency
+        without fabricating requests. Idempotent — reuses the jit
+        caches; ``step_program_counts()`` reads 1/1 afterwards."""
+        S, M, K = self.max_slots, self.max_pages_per_slot, self._chunk
+        zi = jnp.zeros((S,), jnp.int32)
+        zb = jnp.zeros((S,), bool)
+        ones = jnp.ones((S,), jnp.float32)
+        gt = jnp.ones((S,), bool)
+        tables = jnp.zeros((S, M), jnp.int32)
+        _, _, pools = self._decode_step(
+            self._state, self.pool.pools, zi, tables, zi, zb,
+            ones, ones, gt, zi, zi)
+        self.pool.pools = pools
+        _, _, _, pools = self._mixed_step(
+            self._state, self.pool.pools, jnp.zeros((S, K), jnp.int32),
+            tables, zi, zb, zi, zb, ones, ones, gt, zi, zi)
+        self.pool.pools = pools
+        self._note_retraces()
 
     def stats(self) -> dict:
         return {"steps": self._steps,
@@ -482,11 +549,15 @@ class ServingEngine:
                 "draining": self._draining,
                 "decode_programs": self.decode_program_count(),
                 "step_programs": self.step_program_counts(),
-                "prefill_programs": len(self._prefill_progs),
+                # the pow2 bucket family is gone: every prefill token
+                # flows through the ONE mixed program
+                "prefill_programs": self.mixed_program_count(),
                 "prefix_cache": self.prefix_cache,
                 "kv_quant": self.kv_quant,
                 "host_tier": self.pool.host_tier is not None,
                 "speculative": self._spec is not None,
+                "chunked": self.chunked,
+                "prefill_chunk": self.prefill_chunk,
                 "tracing": self.tracer.enabled}
 
     # ------------------------------------------------------------------
@@ -574,8 +645,9 @@ class ServingEngine:
         prefix caching the leading pages may be SHARED cached pages,
         and poisoning one would blast every request mapping it. The
         trailing page is never in the prefix index while its owner
-        runs (only full prompt pages are registered at prefill; the
-        partial tail waits for release), so it is always private."""
+        runs (only full prompt pages are registered at the final
+        chunk; the partial tail waits for release), so it is always
+        private."""
         if not req.pages:
             return
         page = req.pages[-1]
@@ -615,33 +687,44 @@ class ServingEngine:
 
         return decode_step
 
-    def _build_verify_step(self):
-        """The speculative multi-token step: ONE fixed-shape
-        ``[max_slots, k]`` program for the engine's lifetime.
+    def _build_mixed_step(self):
+        """THE mixed step: ONE fixed-shape ``[max_slots, chunk]``
+        program for the engine's lifetime, serving prefill chunks,
+        decode, speculative verify, and any per-slot mixture.
 
-        Per slot, row 0 is the ordinary decode input (the last generated
-        token) and rows 1..n_live-1 are the drafter's guesses; row j is
-        written at pool position seq_lens + j and attends causally up to
-        itself (rows >= n_live and inactive slots write scratch page 0).
+        Per slot, ``n_live`` new tokens start at pool position
+        ``seq_lens``: row j is written at ``seq_lens + j`` and attends
+        causally up to itself (rows >= n_live and inactive slots write
+        scratch page 0). Two slot flavors share the shape:
+
+        - ``forced`` (a prefill chunk): the rows are the next n_live
+          prompt tokens, teacher-forced — every row is accepted by
+          construction (``m = n_live - 1``) and only the LAST row's
+          sample can matter (the first token of a fresh request's
+          stream, on its final chunk);
+        - verify/decode (not forced): row 0 is the ordinary decode
+          input (the last generated token) and rows 1..n_live-1 are
+          the drafter's guesses; draft row j is ACCEPTED iff it equals
+          the row j-1 sample, the Leviathan accept/reject rule.
+
         Every row is sampled under the engine's standard contract —
         ``fold_in(PRNGKey(seed), counts + j)``, the exact key the
-        non-speculative engine would use for that token index — and
-        draft row j is ACCEPTED iff it equals the row j-1 sample. The
-        emitted tokens are the samples themselves, so the output stream
-        is bitwise identical to sequential decode (greedy and sampled)
-        no matter what the drafter proposed; for a deterministic drafter
-        this is exactly the Leviathan accept/reject rule. Rejected live
-        rows are zeroed IN-PROGRAM (fixed-shape scatter: rejected rows
-        target their real (page, offset), everything else targets
-        scratch (0, 0)) so no garbage outlives the step — accept
-        patterns are data, never shapes."""
+        sequential engine would use for that token index — so emitted
+        streams are bitwise identical to sequential decode (greedy and
+        sampled) no matter how prompts chunk or what the drafter
+        proposed. Rejected live rows are zeroed IN-PROGRAM (fixed-shape
+        scatter: rejected rows target their real (page, offset),
+        everything else targets scratch (0, 0)) so no garbage outlives
+        the step — chunk sizes and accept patterns are data, never
+        shapes."""
         from ..nn.module import functional_call
         model = self.model
         ps = self.page_size
 
         @jax.jit
-        def verify_step(state, pools, toks, tables, seq_lens, active,
-                        n_live, temps, top_ps, greedy, seeds, counts):
+        def mixed_step(state, pools, toks, tables, seq_lens, active,
+                       n_live, forced, temps, top_ps, greedy, seeds,
+                       counts):
             (logits, pools), _ = functional_call(
                 model, state, toks, None, pools, 0,
                 (tables, seq_lens, active, n_live), training=False)
@@ -662,16 +745,21 @@ class ServingEngine:
                 jnp.repeat(greedy, K), jnp.repeat(seeds, K),
                 (counts[:, None] + rows[None, :]).reshape(-1),
             ).reshape(S, K)
-            # accepted draft count m: longest prefix of live draft rows
+            # accepted count m: a forced (chunk) slot accepts all its
+            # rows — its tokens are the prompt, not guesses; a verify
+            # slot accepts the longest prefix of live draft rows
             # matching the previous row's sample
             match = (toks[:, 1:] == samp[:, :-1]) & live[:, 1:]
             m = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
                         axis=1)                               # [S]
+            m = jnp.where(forced, n_live - 1, m)
             # in-program rollback: zero the rejected live rows at their
             # real (page, offset); all other rows target scratch (0, 0).
             # Speculatively-written pages are always private to their
             # request (shared full pages are immutable, COW copies
-            # partials), so the zeroing can never hit foreign KV.
+            # partials), so the zeroing can never hit foreign KV. A
+            # forced slot has no rejected rows (rows > n_live - 1 are
+            # not live), so chunk writes always survive.
             pos = seq_lens[:, None] + rows[None, :]           # [S, K]
             rej = live & (rows[None, :] > m[:, None]) & active[:, None]
             page = jnp.take_along_axis(tables, pos // ps, axis=1)
@@ -682,119 +770,26 @@ class ServingEngine:
                      for pk, pv in pools]
             return samp, m, ok, pools
 
-        return verify_step
-
-    def _bucket(self, n_tokens: int) -> int:
-        """Prompt-length bucket: the next power-of-two page count, in
-        tokens. Bounds the prefill program count at O(log max_len)."""
-        pages = self.pool.pages_for(n_tokens)
-        p2 = 1
-        while p2 < pages:
-            p2 *= 2
-        return p2 * self.page_size
-
-    def _prefill_prog(self, L: int):
-        """Suffix prefill program for suffix bucket L (tokens). ONE
-        program family serves both cold prefills (start_pos = 0, no
-        cached pages) and prefix-cache hits: the request's pages are
-        gathered into a contiguous ``[1, CTX]`` cache prefix (unused
-        gather entries read scratch page 0 — masked), a ``[1, L]``
-        zero tail is appended, and the model runs over the suffix ids
-        with a TRACED ``start_pos`` offset (rope positions and the
-        cache mask honor it inside LlamaAttention), so the cached
-        length never becomes a bucket axis — program count stays
-        O(log max_len). The whole buffer is scattered back page-by-page;
-        prefix pages scatter into scratch (their pool content is
-        already identical), suffix pages land in the request's pages."""
-        if L in self._prefill_progs:
-            return self._prefill_progs[L]
-        # a new suffix bucket means a new XLA trace — make it visible as
-        # a compile event + counter so retrace regressions jump out of
-        # the timeline instead of hiding as latency spikes
-        self.tracer.instant("compile", program=f"prefill[{L}]",
-                            bucket=L)
-        self.tracer.bump("compiles")
-        self.tracer.bump("prefill_programs")
-        from ..nn.module import functional_call
-        from ..quantization.serving import QuantizedKV
-        model = self.model
-        ps = self.page_size
-        CTX = self._ctx_pages * ps
-        n_buf_pages = self._ctx_pages + L // ps
-        quant = self.kv_quant
-
-        def _gather(arr, gather_pages):
-            """Pool pages -> contiguous [1, CTX(+L)] cache prefix; a
-            quantized pool gathers codes AND scales (the temp cache stays
-            int8 — the model's prefill branch writes quantized tokens
-            into it and the scatter moves raw codes+scales back, so the
-            pool bytes match what a decode append would have written)."""
-            if quant:
-                kvh, d = arr.q.shape[2], arr.q.shape[3]
-                return QuantizedKV(
-                    jnp.concatenate(
-                        [arr.q[gather_pages].reshape(1, CTX, kvh, d),
-                         jnp.zeros((1, L, kvh, d), jnp.int8)], axis=1),
-                    jnp.concatenate(
-                        [arr.scale[gather_pages].reshape(1, CTX, kvh),
-                         jnp.zeros((1, L, kvh), jnp.float32)], axis=1))
-            kvh, d = arr.shape[2], arr.shape[3]
-            return jnp.concatenate(
-                [arr[gather_pages].reshape(1, CTX, kvh, d),
-                 jnp.zeros((1, L, kvh, d), arr.dtype)], axis=1)
-
-        def _scatter(pool_arr, cache_arr, scatter_pages):
-            if quant:
-                kvh, d = cache_arr.q.shape[2], cache_arr.q.shape[3]
-                return QuantizedKV(
-                    pool_arr.q.at[scatter_pages].set(
-                        cache_arr.q[0].reshape(n_buf_pages, ps, kvh, d)),
-                    pool_arr.scale.at[scatter_pages].set(
-                        cache_arr.scale[0].reshape(n_buf_pages, ps, kvh)))
-            kvh, d = cache_arr.shape[2], cache_arr.shape[3]
-            return pool_arr.at[scatter_pages].set(
-                cache_arr[0].reshape(n_buf_pages, ps, kvh, d))
-
-        @jax.jit
-        def prefill(state, ids, n_sfx, start_pos, gather_pages,
-                    scatter_pages, pools, temp, top_p, greedy, seed):
-            caches = [( _gather(pk, gather_pages), _gather(pv, gather_pages))
-                      for pk, pv in pools]
-            (logits, caches), _ = functional_call(
-                model, state, ids, None, caches, start_pos,
-                training=False)
-            lg = jax.lax.dynamic_index_in_dim(logits[0], n_sfx - 1,
-                                              axis=0, keepdims=False)
-            ok = jnp.all(jnp.isfinite(lg.astype(jnp.float32)))
-            tok = _sample_rows(lg[None], temp[None], top_p[None],
-                               greedy[None], seed[None],
-                               jnp.zeros((1,), jnp.int32))[0]
-            new_pools = []
-            qscale_max = jnp.float32(0.0)
-            for (ck, cv), (pk, pv) in zip(caches, pools):
-                new_pools.append((_scatter(pk, ck, scatter_pages),
-                                  _scatter(pv, cv, scatter_pages)))
-                if quant:
-                    # quant error-stat: the largest absmax scale over the
-                    # request's materialized context (per-element error
-                    # is bounded by scale/2 — metrics gauge + trace
-                    # instant in _run_prefill)
-                    qscale_max = jnp.maximum(
-                        qscale_max, jnp.maximum(jnp.max(ck.scale),
-                                                jnp.max(cv.scale)))
-            return tok, ok, qscale_max, new_pools
-
-        self._prefill_progs[L] = prefill
-        return prefill
+        return mixed_step
 
     # ------------------------------------------------------------------
     # per-step work
     # ------------------------------------------------------------------
 
     def _run_prefill(self, req: Request, events: list[dict]) -> None:
+        """Unchunked (``chunked=False``) admission prefill: run the
+        whole uncached suffix through the mixed program NOW, inside the
+        admission loop, as forced single-slot passes of up to ``chunk``
+        rows each. This is the legacy whole-prompt pacing (the A/B
+        baseline arm): registration and first-token emission complete
+        before the next admission's prefix lookup, so a same-step burst
+        sharing a system prompt still prefills the common prefix
+        exactly once — but the step's decode slots wait for the whole
+        prompt, which is exactly the head-of-line blocking chunked mode
+        removes."""
         tr = self.tracer
-        n_valid = req.context_len   # == max(recompute_len, 1), from admit()
-        cached = req.cached_len     # prefix tokens served from cached pages
+        n_valid = req.prefill_target
+        cached = req.cached_len
         n_sfx = n_valid - cached
         seq = req.prompt + req.tokens[:-1]
         if n_sfx == 0:
@@ -806,36 +801,60 @@ class ServingEngine:
             # decode step. (Only reachable for req.tokens non-empty:
             # fresh admissions cap the match at n_valid - 1.)
             return
-        ps = self.page_size
-        L = self._bucket(n_sfx)
-        n_buf_pages = self._ctx_pages + L // ps
-        ids = np.zeros((1, L), np.int32)
-        ids[0, :n_sfx] = seq[cached:]
-        gather = np.zeros((self._ctx_pages,), np.int32)
-        gather[:len(req.pages)] = req.pages
-        # scatter only from the first suffix page on: the cached full
-        # pages (indices < cached // ps) are immutable and already hold
-        # these exact bits — their buffer rows scatter into scratch.
-        # The COW page (partial hit) IS scattered: rows below the hit
-        # length come back from the gather bit-identical, rows above it
-        # carry the freshly-computed suffix KV.
-        first_sfx_page = cached // ps
-        scatter = np.zeros((n_buf_pages,), np.int32)
-        scatter[first_sfx_page:len(req.pages)] = req.pages[first_sfx_page:]
+        S, M, K = self.max_slots, self.max_pages_per_slot, self._chunk
+        slot = req.slot
         sp = req.sampling
+        tok = 0
+        ok_all = True
         with tr.span("prefill", track=req.rid, cached=cached,
-                     suffix=n_sfx, bucket=L):
-            tok, ok, qs_max, new_pools = self._prefill_prog(L)(
-                self._state, jnp.asarray(ids), jnp.int32(n_sfx),
-                jnp.int32(cached), jnp.asarray(gather),
-                jnp.asarray(scatter), self.pool.pools,
-                jnp.float32(sp.temperature), jnp.float32(sp.top_p),
-                jnp.asarray(not sp.do_sample), jnp.int32(sp.seed))
-        self.pool.pools = new_pools
+                     suffix=n_sfx, chunks=-(-n_sfx // K)):
+            start = cached
+            while start < n_valid:
+                n = min(K, n_valid - start)
+                toks = np.zeros((S, K), np.int32)
+                toks[slot, :n] = seq[start:start + n]
+                tables = np.zeros((S, M), np.int32)
+                tables[slot, :len(req.pages)] = req.pages
+                seq_lens = np.zeros((S,), np.int32)
+                seq_lens[slot] = start
+                active = np.zeros((S,), bool)
+                active[slot] = True
+                n_live = np.zeros((S,), np.int32)
+                n_live[slot] = n
+                forced = np.zeros((S,), bool)
+                forced[slot] = True
+                temps = np.ones((S,), np.float32)
+                temps[slot] = sp.temperature
+                top_ps = np.ones((S,), np.float32)
+                top_ps[slot] = sp.top_p
+                greedy = np.ones((S,), bool)
+                greedy[slot] = not sp.do_sample
+                seeds = np.zeros((S,), np.int32)
+                seeds[slot] = sp.seed
+                counts = np.zeros((S,), np.int32)
+                # row j samples with counts + j: anchor the LAST row of
+                # the pass on this request's next token index (earlier
+                # rows sample at stale indices and are discarded)
+                counts[slot] = len(req.tokens) - (n - 1)
+                samp, _, ok, new_pools = self._mixed_step(
+                    self._state, self.pool.pools, jnp.asarray(toks),
+                    jnp.asarray(tables), jnp.asarray(seq_lens),
+                    jnp.asarray(active), jnp.asarray(n_live),
+                    jnp.asarray(forced), jnp.asarray(temps),
+                    jnp.asarray(top_ps), jnp.asarray(greedy),
+                    jnp.asarray(seeds), jnp.asarray(counts))
+                self.pool.pools = new_pools
+                samp, ok = self._watched_sync(samp, ok)
+                start += n
+                tok = int(samp[slot, n - 1])
+                if not bool(ok[slot]):
+                    ok_all = False
+                    break  # NaN cache rows only propagate — stop early
+        self._note_retraces()
         if self.kv_quant:
             # quantize-at-scatter observability: error-stat gauge (per-
             # element error <= scale/2) + one trace instant per prefill
-            qs = float(qs_max)
+            qs = self._qscale_max(req.pages)
             self.metrics.on_kv_quant_scale(qs)
             tr.instant("kv_quantize", track=req.rid,
                        scale_max=round(qs, 6), suffix=n_sfx)
@@ -847,7 +866,7 @@ class ServingEngine:
             except _fault.FaultInjected:
                 self._finish_abnormal(req, "injected", events)
                 return
-        if not bool(ok):
+        if not ok_all:
             # the prompt itself produced non-finite logits — quarantine
             # at admission, before it ever joins the decode batch
             self._finish_abnormal(req, "nonfinite", events)
@@ -862,11 +881,57 @@ class ServingEngine:
         if req.tokens:
             return  # recompute after preemption: cache rebuilt, the stored
                     # last token is the next decode input — no new emission
-        self._emit(req, int(tok), events)
+        self._emit(req, tok, events)
 
-    def _run_decode(self, events: list[dict]) -> None:
+    def _qscale_max(self, pages: list[int]) -> float:
+        """Max absmax scale over the request's pages across all layers
+        — the bounded-dequant-error stat (per-element error <= scale/2)
+        the metrics gauge and ``kv_quantize`` trace instants report."""
+        idx = jnp.asarray(pages, jnp.int32)
+        qs = 0.0
+        for pk, pv in self.pool.pools:
+            qs = max(qs, float(jnp.max(pk.scale[idx])),
+                     float(jnp.max(pv.scale[idx])))
+        return qs
+
+    def _plan_chunks(self, budget: int) -> dict[int, int]:
+        """slot -> n_new: this step's prefill chunks, FCFS by arrival
+        over the partially-prefilled slots under the remaining prefill
+        token budget. The OLDEST prefilling slot always advances at
+        least one token even with the budget exhausted (chunked
+        admission charges no suffix, so this is the no-starvation
+        guarantee that keeps stall detection honest); younger slots
+        never jump the budget queue."""
+        plan: dict[int, int] = {}
+        if not self.chunked:
+            return plan
+        C = self._chunk
+        prefilling = sorted(
+            ((slot, req) for slot, req in self.scheduler.running.items()
+             if req.prefilling),
+            key=lambda sr: sr[1].arrival_seq)
+        for slot, req in prefilling:
+            need = req.prefill_target - req.context_len
+            cap = budget if plan else max(budget, 1)
+            n = min(C, need, cap)
+            if n <= 0:
+                break
+            plan[slot] = n
+            budget -= n
+        return plan
+
+    def _run_batch(self, events: list[dict], budget: int) -> int:
+        """Dispatch this step's model work: plan prefill chunks under
+        the remaining token budget, then route — any chunk or draft
+        rows go through the ONE mixed program (decode slots ride along
+        in the same dispatch); a pure-decode step keeps the cheap
+        ``[max_slots]`` decode program. Returns the number of prefill
+        chunk tokens dispatched (progress accounting for the stall
+        detector)."""
         if _fault.active_plan() is not None:
             for req in list(self.scheduler.running.values()):
+                if req.prefilling:
+                    continue  # serving.prefill trips at chunk dispatch
                 try:
                     _fault.trip("serving.decode", step=self._steps,
                                 path=req.rid,
@@ -874,15 +939,16 @@ class ServingEngine:
                 except _fault.FaultInjected:
                     self._finish_abnormal(req, "injected", events)
             if not self.scheduler.running:
-                return
-        if self._spec is not None and any(
-                req.draft_tokens
-                for req in self.scheduler.running.values()):
-            # at least one slot drafted: dispatch the multi-token verify
-            # step. Draftless steps fall through to the plain decode
-            # program — same emitted tokens, fewer scored rows.
-            self._run_verify(events)
-            return
+                return 0
+        plan = self._plan_chunks(budget)
+        has_drafts = self._spec is not None and any(
+            req.draft_tokens for req in self.scheduler.running.values())
+        if plan or has_drafts:
+            return self._run_mixed(events, plan)
+        self._run_decode(events)
+        return 0
+
+    def _run_decode(self, events: list[dict]) -> None:
         tr = self.tracer
         S, M = self.max_slots, self.max_pages_per_slot
         with tr.span("decode_dispatch", slots=len(self.scheduler.running)):
@@ -926,11 +992,188 @@ class ServingEngine:
                     continue
                 self._emit(req, int(nt[slot]), events)
 
+    def _run_mixed(self, events: list[dict], plan: dict[int, int]) -> int:
+        """One mixed dispatch: the planned prefill chunks (teacher-
+        forced prompt rows) and every decoding slot (decode input +
+        drafts) share the fixed-shape ``[max_slots, chunk]`` program.
+        Chunk slots advance ``context_len`` and emit only on their
+        FINAL chunk — which is also when the prompt's full pages commit
+        to the prefix index (first-writer-wins; a request preempted
+        mid-prompt registers nothing). Decode slots emit their accepted
+        sample prefix plus the bonus correction sample — bitwise the
+        tokens sequential decode would have produced."""
+        tr = self.tracer
+        sched = self.scheduler
+        S, M, K = self.max_slots, self.max_pages_per_slot, self._chunk
+        # the plan may be stale by one preemption (ensure_decode_pages
+        # ran in between) — keep only slots that still owe chunks
+        plan = {slot: n for slot, n in plan.items()
+                if slot in sched.running and sched.running[slot].prefilling}
+        toks = np.zeros((S, K), np.int32)
+        tables = np.zeros((S, M), np.int32)
+        seq_lens = np.zeros((S,), np.int32)
+        active = np.zeros((S,), bool)
+        n_live = np.zeros((S,), np.int32)
+        forced = np.zeros((S,), bool)
+        temps = np.ones((S,), np.float32)
+        top_ps = np.ones((S,), np.float32)
+        greedy = np.ones((S,), bool)
+        seeds = np.zeros((S,), np.int32)
+        counts = np.zeros((S,), np.int32)
+        n_drafted: dict[int, int] = {}
+        chunk_tokens = 0
+        for slot, req in sched.running.items():
+            if req.prefilling and slot not in plan:
+                continue  # out of budget this step: the slot sits out
+            sp = req.sampling
+            tables[slot, :len(req.pages)] = req.pages
+            seq_lens[slot] = req.context_len
+            active[slot] = True
+            temps[slot] = sp.temperature
+            top_ps[slot] = sp.top_p
+            greedy[slot] = not sp.do_sample
+            seeds[slot] = sp.seed
+            if slot in plan:
+                n = plan[slot]
+                seq = req.prompt + req.tokens[:-1]
+                toks[slot, :n] = seq[req.context_len:req.context_len + n]
+                n_live[slot] = n
+                forced[slot] = True
+                # row j samples with counts + j: anchor the LAST chunk
+                # row on this request's next token index (mid-chunk
+                # rows sample at stale indices and are discarded)
+                counts[slot] = len(req.tokens) - (n - 1)
+                chunk_tokens += n
+                if tr.enabled:
+                    tr.instant("chunk", track=req.rid,
+                               start=int(req.context_len), n=n)
+                    tr.bump("chunks")
+            else:
+                d = req.draft_tokens
+                toks[slot, 0] = req.tokens[-1]
+                if d:
+                    toks[slot, 1:1 + len(d)] = d
+                n_live[slot] = 1 + len(d)
+                n_drafted[slot] = len(d)
+                counts[slot] = len(req.tokens)
+        self.metrics.on_mixed_step(
+            chunk_tokens, len(n_drafted), len(plan),
+            sum(1 for r in sched.running.values() if r.prefilling))
+        with tr.span("mixed_dispatch", slots=len(plan) + len(n_drafted),
+                     chunk_tokens=chunk_tokens,
+                     drafts=sum(n_drafted.values())):
+            samp, acc, ok, new_pools = self._mixed_step(
+                self._state, self.pool.pools, jnp.asarray(toks),
+                jnp.asarray(tables), jnp.asarray(seq_lens),
+                jnp.asarray(active), jnp.asarray(n_live),
+                jnp.asarray(forced), jnp.asarray(temps),
+                jnp.asarray(top_ps), jnp.asarray(greedy),
+                jnp.asarray(seeds), jnp.asarray(counts))
+            self.pool.pools = new_pools
+        self._note_retraces()
+        samp, acc, ok = self._watched_sync(samp, acc, ok)
+        # serving.prefill fault trips for the chunk slots, mirroring
+        # the legacy prefill site: after the write, before the ok check
+        # and before any registration — an injected chunk failure can
+        # never index its pages
+        if _fault.active_plan() is not None:
+            for slot in list(plan):
+                req = sched.running.get(slot)
+                if req is None:
+                    continue
+                try:
+                    _fault.trip("serving.prefill", step=self._steps,
+                                path=req.rid,
+                                poison=lambda r=req: self._poison_pages(r))
+                except _fault.FaultInjected:
+                    req.context_len += plan.pop(slot)
+                    self._finish_abnormal(req, "injected", events)
+        with tr.span("sample_emit"):
+            participants = ([s for s in plan if s in sched.running]
+                            + [s for s in n_drafted if s in sched.running])
+            for slot in participants:
+                req = sched.running.get(slot)
+                if req is None:
+                    continue
+                if slot in plan:
+                    n = plan[slot]
+                    req.context_len += n
+                    if not ok[slot]:
+                        # the prompt chunk produced non-finite logits —
+                        # quarantine before it ever joins the decode
+                        # batch (and before any registration)
+                        self._finish_abnormal(req, "nonfinite", events)
+                        continue
+                    if req.prefilling:
+                        continue  # mid-prompt: more chunks owed
+                    # FINAL chunk: commit the prompt's full pages to
+                    # the prefix index now (first-writer-wins in the
+                    # pool; the trailing partial page keeps filling
+                    # during decode and is registered at release)
+                    seq = req.prompt + req.tokens[:-1]
+                    self.pool.register_prefix(seq[:req.prefill_target],
+                                              req.pages,
+                                              include_partial=False)
+                    if self.kv_quant:
+                        qs = self._qscale_max(req.pages)
+                        self.metrics.on_kv_quant_scale(qs)
+                        tr.instant("kv_quantize", track=req.rid,
+                                   scale_max=round(qs, 6), suffix=n)
+                    if req.tokens:
+                        continue  # recompute after preemption: cache
+                                  # rebuilt, the stored last token is
+                                  # the next decode input
+                    self._emit(req, int(samp[slot, n - 1]), events)
+                else:
+                    n_draft = n_drafted[slot]
+                    req.draft_tokens = []
+                    C0 = req.context_len
+                    if not ok[slot]:
+                        # poison quarantine, same as the decode path:
+                        # only this slot finishes (rows are per-slot
+                        # independent)
+                        req.context_len += 1
+                        self._finish_abnormal(req, "nonfinite", events)
+                        continue
+                    m = int(acc[slot])
+                    if n_draft:
+                        self.metrics.on_spec_verify(n_draft, m)
+                        self._drafter.observe(req, n_draft, m)
+                    # the emitted tokens are the engine's own samples
+                    # for rows 0..m — exactly what m + 1 sequential
+                    # decode steps would have drawn. A stop (eos)
+                    # inside the accept window truncates the emission.
+                    emit: list[int] = []
+                    for j in range(m + 1):
+                        t = int(samp[slot, j])
+                        emit.append(t)
+                        if ((req.eos_token_id is not None
+                             and t == req.eos_token_id)
+                                or len(req.tokens) + len(emit)
+                                >= req.max_new_tokens):
+                            break
+                    req.context_len = C0 + len(emit)
+                    if len(emit) < m + 1:
+                        # accepted-but-unused tail beyond an in-window
+                        # stop: rewind those positions to zero before
+                        # the pages can be released/registered (token-
+                        # granular masked-garbage-is-zero)
+                        self.pool.rewind(req.pages, C0 + len(emit),
+                                         C0 + m + 1)
+                    if tr.enabled and n_draft > m:
+                        tr.instant("rollback", track=req.rid,
+                                   rejected=n_draft - m, accepted=m)
+                        tr.bump("spec_rejected_tokens", n_draft - m)
+                    for t in emit:
+                        self._emit(req, t, events)
+        return chunk_tokens
+
     def _note_retraces(self) -> None:
-        """Retrace sentinel, one per step shape: the no-retrace contract
-        says every entry of ``step_program_counts()`` stays at 1; any
-        growth lands a compile bar + counter bump in the trace right
-        where the regression happened."""
+        """Retrace sentinel, one per step shape ("decode", "mixed"):
+        the no-retrace contract says every entry of
+        ``step_program_counts()`` stays at 1; any growth lands a
+        compile bar + counter bump in the trace right where the
+        regression happened."""
         tr = self.tracer
         if not tr.enabled:
             return
@@ -972,111 +1215,28 @@ class ServingEngine:
     # ------------------------------------------------------------------
 
     def _propose_drafts(self) -> None:
-        """Host-side draft proposal for every running slot. The draft
-        count is capped so the verify step can never write beyond the
+        """Host-side draft proposal for every decoding slot (a slot
+        still mid-prefill neither decodes nor drafts). The draft count
+        is capped so the mixed step can never write beyond the
         request's admission-checked page/position budget: at most k-1
         rows, at most what the remaining token budget could accept
-        (m + 1 emits <= remaining), and never past the slot's page table
-        or the rope table."""
+        (m + 1 emits <= remaining), and never past the slot's page
+        table or the rope table."""
         spec, drafter = self._spec, self._drafter
         max_pos = min(self.max_pages_per_slot * self.page_size,
                       self.model.config.max_position_embeddings)
         with self.tracer.span("draft",
                               slots=len(self.scheduler.running)):
             for req in self.scheduler.running.values():
+                if req.prefilling or not req.tokens:
+                    req.draft_tokens = []
+                    continue
                 cap = min(spec.k - 1,
                           req.max_new_tokens - len(req.tokens) - 1,
                           max_pos - req.context_len - 1)
                 drafts = drafter.propose(req, cap) if cap > 0 else []
                 req.draft_tokens = [int(t) for t in drafts[:cap]]
                 self.metrics.on_spec_draft(len(req.draft_tokens))
-
-    def _run_verify(self, events: list[dict]) -> None:
-        """The speculative counterpart of ``_run_decode``: dispatch the
-        fixed-shape [max_slots, k] verify program, then emit each slot's
-        accepted sample prefix (plus the bonus correction sample) —
-        bitwise the tokens sequential decode would have produced."""
-        tr = self.tracer
-        S, M, K = self.max_slots, self.max_pages_per_slot, self._spec.k
-        n_drafted = {slot: len(req.draft_tokens)
-                     for slot, req in self.scheduler.running.items()}
-        with tr.span("verify", slots=len(self.scheduler.running),
-                     drafts=sum(n_drafted.values())):
-            toks = np.zeros((S, K), np.int32)
-            tables = np.zeros((S, M), np.int32)
-            seq_lens = np.zeros((S,), np.int32)
-            active = np.zeros((S,), bool)
-            n_live = np.zeros((S,), np.int32)
-            temps = np.ones((S,), np.float32)
-            top_ps = np.ones((S,), np.float32)
-            greedy = np.ones((S,), bool)
-            seeds = np.zeros((S,), np.int32)
-            counts = np.zeros((S,), np.int32)
-            for slot, req in self.scheduler.running.items():
-                d = req.draft_tokens
-                toks[slot, 0] = req.tokens[-1]
-                if d:
-                    toks[slot, 1:1 + len(d)] = d
-                n_live[slot] = 1 + len(d)
-                tables[slot, :len(req.pages)] = req.pages
-                seq_lens[slot] = req.context_len
-                active[slot] = True
-                temps[slot] = req.sampling.temperature
-                top_ps[slot] = req.sampling.top_p
-                greedy[slot] = not req.sampling.do_sample
-                seeds[slot] = req.sampling.seed
-                counts[slot] = len(req.tokens)
-            samp, acc, ok, new_pools = self._verify_step(
-                self._state, self.pool.pools, jnp.asarray(toks),
-                jnp.asarray(tables), jnp.asarray(seq_lens),
-                jnp.asarray(active), jnp.asarray(n_live),
-                jnp.asarray(temps), jnp.asarray(top_ps),
-                jnp.asarray(greedy), jnp.asarray(seeds),
-                jnp.asarray(counts))
-            self.pool.pools = new_pools
-        self._note_retraces()
-        samp, acc, ok = self._watched_sync(samp, acc, ok)
-        with tr.span("sample_emit"):
-            for slot, req in list(self.scheduler.running.items()):
-                n_draft = n_drafted[slot]
-                req.draft_tokens = []
-                C = req.context_len
-                if not ok[slot]:
-                    # poison quarantine, same as the decode path: only
-                    # this slot finishes (rows are per-slot independent)
-                    req.context_len += 1
-                    self._finish_abnormal(req, "nonfinite", events)
-                    continue
-                m = int(acc[slot])
-                if n_draft:
-                    self.metrics.on_spec_verify(n_draft, m)
-                    self._drafter.observe(req, n_draft, m)
-                # the emitted tokens are the engine's own samples for
-                # rows 0..m — exactly what m + 1 sequential decode steps
-                # would have drawn. A stop (eos) inside the accept
-                # window truncates the emission there.
-                emit: list[int] = []
-                for j in range(m + 1):
-                    t = int(samp[slot, j])
-                    emit.append(t)
-                    if ((req.eos_token_id is not None
-                         and t == req.eos_token_id)
-                            or len(req.tokens) + len(emit)
-                            >= req.max_new_tokens):
-                        break
-                req.context_len = C + len(emit)
-                if len(emit) < m + 1:
-                    # accepted-but-unused tail beyond an in-window stop:
-                    # rewind those positions to zero before the pages
-                    # can be released/registered (token-granular
-                    # masked-garbage-is-zero)
-                    self.pool.rewind(req.pages, C + len(emit), C + m + 1)
-                if tr.enabled and n_draft > m:
-                    tr.instant("rollback", track=req.rid,
-                               rejected=n_draft - m, accepted=m)
-                    tr.bump("spec_rejected_tokens", n_draft - m)
-                for t in emit:
-                    self._emit(req, t, events)
 
     def _emit(self, req: Request, token: int, events: list[dict]) -> None:
         req.tokens.append(token)

@@ -503,10 +503,14 @@ class KVCachePool:
         holds ``tokens[i*ps:(i+1)*ps]``. Full pages are registered under
         the chained hash; the trailing partial page (content frozen —
         callers register it only once no further writes can land, i.e.
-        at release) under the partial index. First writer wins: an
-        existing index entry for the same content keeps its page. Pages
-        must be held by the caller (refcount > 0); returns how many
-        pages were newly registered."""
+        at release) under the partial index. The chunked engine calls
+        this only when the FINAL prefill chunk lands (never for a
+        prompt still streaming in chunks — a mid-prompt preemption must
+        leave nothing indexed); the unchunked arm registers inside the
+        admission loop right after the whole-suffix prefill. First
+        writer wins: an existing index entry for the same content keeps
+        its page. Pages must be held by the caller (refcount > 0);
+        returns how many pages were newly registered."""
         if not self.cache_enabled:
             return 0
         ps = self.page_size
@@ -549,7 +553,7 @@ class KVCachePool:
     # (serving/tiering.py; SERVING.md "KV tiering & traffic harness").
     # All transfers here are host-side device_get/device_put around
     # functional .at[] updates — never inside a compiled program, so the
-    # engine's decode/verify program counts are untouched.
+    # engine's decode/mixed program counts are untouched.
 
     def _spill(self, page: int) -> None:
         """Demote an LRU-evicted page's content to the host tier —

@@ -139,6 +139,19 @@ class ServingMetrics:
         self._spec_draft_calls = 0
         self._spec_draft_hits = 0
         self._spec_hist: dict[int, list[int]] = {}
+        # chunked prefill / mixed steps (SERVING.md "Chunked prefill &
+        # mixed steps"): per-step mixed-batch composition — how many
+        # prefill-chunk tokens and decode slots shared each mixed
+        # dispatch, how many chunks were cut in total, and how many
+        # partially-prefilled requests were in flight at the last step.
+        # Schema-stable zeros with chunking off.
+        self.chunked_enabled = 0
+        self._mixed_steps = 0
+        self._chunk_tokens = 0
+        self._chunks_dispatched = 0
+        self._chunk_prefill_tokens_last = 0
+        self._chunk_decode_slots_last = 0
+        self._chunks_in_flight_last = 0
 
     def now(self) -> float:
         return self._clock()
@@ -313,6 +326,26 @@ class ServingMetrics:
         h[0] += accepted
         h[1] += 1
 
+    # ---- chunked prefill (SERVING.md "Chunked prefill & mixed steps") --
+
+    def set_chunked(self, enabled: bool) -> None:
+        """Arm the chunked_enabled gauge (int, for Prometheus export)."""
+        self.chunked_enabled = int(bool(enabled))
+
+    def on_mixed_step(self, prefill_tokens: int, decode_slots: int,
+                      chunk_slots: int, in_flight: int) -> None:
+        """One mixed-step dispatch: ``prefill_tokens`` prompt-chunk
+        tokens across ``chunk_slots`` slots shared the program with
+        ``decode_slots`` decoding/verifying slots; ``in_flight`` is the
+        number of partially-prefilled requests resident after planning
+        (slots mid-prompt, whether or not they got a chunk this step)."""
+        self._mixed_steps += 1
+        self._chunk_tokens += prefill_tokens
+        self._chunks_dispatched += chunk_slots
+        self._chunk_prefill_tokens_last = prefill_tokens
+        self._chunk_decode_slots_last = decode_slots
+        self._chunks_in_flight_last = in_flight
+
     def spec_accept_rate(self) -> float:
         """Fraction of drafted tokens accepted by the verify step."""
         if self._spec_draft_tokens == 0:
@@ -414,6 +447,15 @@ class ServingMetrics:
             "spec_accepted_tokens_total": self._spec_accepted_tokens,
             "spec_accept_rate": self.spec_accept_rate(),
             "spec_draft_hit_rate": self.spec_draft_hit_rate(),
+            # chunked prefill / mixed-step composition (schema-stable:
+            # zeros with chunking off)
+            "chunked_enabled": self.chunked_enabled,
+            "mixed_steps": self._mixed_steps,
+            "chunk_tokens_total": self._chunk_tokens,
+            "chunks_dispatched_total": self._chunks_dispatched,
+            "chunk_prefill_tokens_last": self._chunk_prefill_tokens_last,
+            "chunk_decode_slots_last": self._chunk_decode_slots_last,
+            "chunks_in_flight": self._chunks_in_flight_last,
             # KV tiering (schema-stable: zeros with the tier off).
             # tier_hit_rate == cache_hit_rate (restored tokens ARE
             # cached tokens); the hbm/host/miss split is the breakdown.

@@ -68,6 +68,13 @@ class Request:
     slot: int | None = None
     pages: list[int] = field(default_factory=list)
     context_len: int = 0          # tokens currently materialized in cache
+    # chunked prefill (SERVING.md "Chunked prefill & mixed steps"): the
+    # materialization target of the CURRENT admission. A chunked admit
+    # leaves context_len at the cached length and the engine streams the
+    # suffix through the mixed step in budget-sized chunks, advancing
+    # context_len until it reaches prefill_target; an unchunked admit
+    # sets context_len = prefill_target in one shot (legacy behavior).
+    prefill_target: int = 0
     # prefix-cache bookkeeping for the CURRENT admission: how many
     # leading tokens were served from cached pages (the engine prefills
     # only the suffix beyond them), and whether the last cached page was
@@ -81,7 +88,7 @@ class Request:
     # tokens for them; SERVING.md "KV tiering & traffic harness")
     restored_len: int = 0
     # speculative decoding (serving/speculative.py): tokens the drafter
-    # proposed for the NEXT step; the verify program scores them at
+    # proposed for the NEXT step; the mixed program scores them at
     # positions context_len..context_len+len-1 and the engine clears the
     # list every step. Drafts never affect the emitted stream — only how
     # many tokens a step emits — so this is working state, not history.
@@ -93,6 +100,14 @@ class Request:
         tokens except the last, which is the decode input (after a
         preemption the cache is rebuilt exactly to where it was)."""
         return len(self.prompt) + max(0, len(self.tokens) - 1)
+
+    @property
+    def prefilling(self) -> bool:
+        """True while a RUNNING request still owes prefill chunks: its
+        cache holds fewer tokens than this admission's target. A
+        prefilling slot neither decodes nor drafts — it rides the mixed
+        step's prefill lanes until context_len reaches the target."""
+        return self.state == RUNNING and self.context_len < self.prefill_target
 
     @property
     def done(self) -> bool:
@@ -119,6 +134,13 @@ class Scheduler:
         # verify tokens against the SAME per-step prefill token budget —
         # one budget bounds the step's total token work.
         self.spec_k = 1
+        # chunked prefill: when True (set by the engine), ``admit`` maps
+        # pages and pins the cached prefix but leaves context_len at the
+        # cached length — the engine streams the uncached suffix through
+        # its mixed step in budget-metered chunks. The suffix then
+        # charges the budget chunk by chunk AT DISPATCH, not at
+        # admission, so admission only pays the host-tier restore toll.
+        self.chunked = False
         # injected by the engine when tracing is on. The scheduler owns
         # every queue/slot state transition, so it owns the request-track
         # lifecycle spans: "queued" opens at add/_requeue and closes at
@@ -220,10 +242,18 @@ class Scheduler:
         (every release except poison quarantine), its materialized
         prefix — full pages plus the frozen partial tail — is indexed
         first, so a preempted request's recompute, or a later request
-        sharing the prompt, can map these pages instead of re-prefilling."""
+        sharing the prompt, can map these pages instead of re-prefilling.
+
+        A request released MID-PREFILL (context_len < prefill_target —
+        a chunked prefill preempted between chunks) registers NOTHING:
+        its later pages hold partially-written or zero content, and even
+        the completed leading chunks are an unfinished admission —
+        registration commits only on the final chunk (engine) or at a
+        post-prefill release here. The page references are still
+        dropped, so a mid-chunk preemption can never leak COW refs."""
         self.tracer.end("running", track=req.rid,
                         context_len=req.context_len)
-        if register and req.pages:
+        if register and req.pages and not req.prefilling:
             seq = (req.prompt + req.tokens)[:req.context_len]
             pool.register_prefix(seq, req.pages, include_partial=True)
         pool.release(req.pages)
@@ -265,8 +295,11 @@ class Scheduler:
         one-per-slot decode: (spec_k - 1) draft rows per running slot.
         The engine subtracts this from the prefill budget it threads
         through ``admit`` so speculation and prefill bursts share one
-        per-step token-work bound (0 when speculation is off)."""
-        return (self.spec_k - 1) * len(self.running)
+        per-step token-work bound (0 when speculation is off). Slots
+        still mid-prefill don't verify (they neither decode nor draft),
+        so they don't reserve."""
+        return (self.spec_k - 1) * sum(1 for r in self.running.values()
+                                       if not r.prefilling)
 
     def ensure_decode_pages(self, pool: KVCachePool) -> list[Request]:
         """Before a decode step: every running request writes its next
@@ -305,13 +338,16 @@ class Scheduler:
         admitted requests with slot + prompt pages assigned; the engine
         runs their prefills.
 
-        The engine calls this with ``limit=1`` in a loop, running each
-        prefill before the next admission, so a same-step burst sharing
-        a prompt prefix hits the pages the previous prefill just
-        registered; ``budget`` carries the remaining step budget across
-        those calls and ``first=False`` says an admission already
-        happened this step (the first admission of a step ignores the
-        budget so an oversized prompt cannot deadlock)."""
+        The engine calls this with ``limit=1`` in a loop; ``budget``
+        carries the remaining step budget across those calls and
+        ``first=False`` says an admission already happened this step
+        (the first admission of a step ignores the budget so an
+        oversized prompt cannot deadlock). With ``chunked`` set the
+        uncached suffix does NOT gate or charge admission — the engine
+        meters it chunk by chunk at dispatch — so only the host-tier
+        restore toll counts here, and the admitted request starts with
+        ``context_len`` at its cached length and ``prefill_target`` at
+        the full materialization goal."""
         admitted: list[Request] = []
         budget = self.prefill_token_budget if budget is None else budget
         while (self.waiting and self._free_slots
@@ -337,9 +373,11 @@ class Scheduler:
             # only the UNCACHED suffix charges the prefill token budget
             # — plus the restore toll on host-tier tokens: they skip
             # recompute FLOPs but pay restore bytes, charged like a
-            # partial cache hit at restore_budget_frac per token
-            if ((admitted or not first)
-                    and suffix + pool.restore_charge(match) > budget):
+            # partial cache hit at restore_budget_frac per token.
+            # Chunked mode defers the suffix charge to chunk dispatch,
+            # so only the restore toll gates admission here.
+            charge = (0 if self.chunked else suffix) + pool.restore_charge(match)
+            if (admitted or not first) and charge > budget:
                 break
             n_new = (pool.pages_for(n_valid)
                      - (len(match.full_pages) if match else 0))
@@ -420,7 +458,10 @@ class Scheduler:
             req.cached_partial = partial_q > 0
             req.slot = self._free_slots.pop()
             req.state = RUNNING
-            req.context_len = n_valid
+            req.prefill_target = n_valid
+            # chunked: start at the cached length; the engine's mixed
+            # step advances context_len chunk by chunk up to the target
+            req.context_len = cached if self.chunked else n_valid
             self.running[req.slot] = req
             if self.tracer.enabled:
                 self.tracer.end("queued", track=req.rid)
@@ -429,9 +470,15 @@ class Scheduler:
                                     restored=restored_tok)
                 self.tracer.begin("running", track=req.rid)
             admitted.append(req)
-            # an admitted slot also joins this step's verify fan-out
-            # (spec_k - 1 draft rows), charged like prefill tokens —
-            # and restored tokens charge their restore toll
-            budget -= (suffix + pool.restore_charge_tokens(restored_tok)
-                       + (self.spec_k - 1))
+            if self.chunked:
+                # the suffix charges at chunk dispatch; a prefilling slot
+                # doesn't verify, so no (spec_k - 1) reserve either —
+                # admission pays only the restore toll
+                budget -= pool.restore_charge_tokens(restored_tok)
+            else:
+                # an admitted slot also joins this step's verify fan-out
+                # (spec_k - 1 draft rows), charged like prefill tokens —
+                # and restored tokens charge their restore toll
+                budget -= (suffix + pool.restore_charge_tokens(restored_tok)
+                           + (self.spec_k - 1))
         return admitted

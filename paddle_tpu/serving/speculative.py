@@ -3,14 +3,14 @@
 The engine's bandwidth wall is the weight stream: every decode step
 reads all weight bytes to produce ONE token per slot. Speculative
 decoding makes the same stream score k tokens per slot — a cheap
-*drafter* guesses the next few tokens from request history, and one
-fixed-shape ``[max_slots, k]`` *verify* program (built by the engine,
-see ``engine._build_verify_step``) scores all draft positions at once,
-accepting the longest prefix that matches what the engine would have
-sampled anyway.
+*drafter* guesses the next few tokens from request history, and the
+engine's fixed-shape ``[max_slots, chunk]`` MIXED program (see
+``engine._build_mixed_step``; verify rows share it with prefill
+chunks) scores all draft positions at once, accepting the longest
+prefix that matches what the engine would have sampled anyway.
 
 The acceptance rule is sample-and-compare: at draft position n the
-verify program draws token ``t_n`` under the engine's standard sampling
+verify pass draws token ``t_n`` under the engine's standard sampling
 contract (``fold_in(PRNGKey(seed), token_index)``, same temperature /
 top-p / greedy switch as the 1-token decode step) and accepts the draft
 iff it equals ``t_n``; the token actually emitted is ``t_n`` either
@@ -56,7 +56,7 @@ class DraftProposer:
     hook here; the default is a no-op.
 
     Proposals may be wrong, stale, or random without affecting output
-    correctness — the verify program emits the engine's own sampled
+    correctness — the verify pass emits the engine's own sampled
     tokens regardless — so implementations only need to chase accept
     rate, never exactness.
     """
@@ -109,10 +109,11 @@ class SpeculativeConfig:
     """Engine-facing speculative decoding switch.
 
     ``k`` is the verify step's row count per slot — 1 decode input plus
-    up to ``k - 1`` draft tokens — and is a COMPILE-TIME shape: the
-    engine builds exactly one ``[max_slots, k]`` verify program, and
-    per-step draft counts pad into it (``n_live`` masking), never
-    retrace it. ``drafter`` overrides the built-in
+    up to ``k - 1`` draft tokens — and is a COMPILE-TIME shape: verify
+    rows ride the engine's one ``[max_slots, chunk]`` mixed program
+    (``chunk = max(prefill_chunk, k)``; SERVING.md "Chunked prefill &
+    mixed steps"), and per-step draft counts pad into it (``n_live``
+    masking), never retrace it. ``drafter`` overrides the built-in
     :class:`NgramDrafter` (constructed from ``max_ngram``/``min_ngram``
     otherwise).
     """

@@ -39,7 +39,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-__all__ = ["Workload", "WorkloadRequest", "WorkloadSpec", "make_workload"]
+__all__ = ["Workload", "WorkloadRequest", "WorkloadSpec",
+           "heavy_tail_workload", "make_workload"]
 
 
 @dataclass
@@ -80,6 +81,20 @@ class WorkloadSpec:
     max_new: tuple[int, int] = (8, 32)
     vocab_size: int = 256
     eos_token_id: int | None = None
+    # heavy-tailed suffix lengths (the chunked-prefill regime): with
+    # ``suffix_dist="lognormal"``, a ``heavy_frac`` coin decides per
+    # request between a LONG prompt — suffix length drawn from
+    # lognormal(mu, sigma), clipped to ``suffix_clip`` — and the short
+    # ``prompt_mix`` draw. Short requests optionally get their own
+    # decode-heavy ``light_max_new`` range, so the trace interleaves
+    # rare huge prefills with a steady stream of decode traffic —
+    # exactly the mix where whole-prompt prefill stalls decode ITL.
+    suffix_dist: str = "mixture"
+    heavy_frac: float = 0.3
+    lognormal_mu: float = 4.2
+    lognormal_sigma: float = 0.8
+    suffix_clip: tuple[int, int] = (48, 320)
+    light_max_new: tuple[int, int] | None = None
 
 
 class Workload:
@@ -202,6 +217,8 @@ def make_workload(spec: WorkloadSpec | None = None, **kw) -> Workload:
         raise TypeError("pass a WorkloadSpec OR field kwargs, not both")
     if spec.arrival not in ("poisson", "bursty"):
         raise ValueError(f"unknown arrival process {spec.arrival!r}")
+    if spec.suffix_dist not in ("mixture", "lognormal"):
+        raise ValueError(f"unknown suffix_dist {spec.suffix_dist!r}")
     if spec.tenants < 1:
         raise ValueError("tenants must be >= 1")
     rng = np.random.default_rng(spec.seed)
@@ -222,14 +239,48 @@ def make_workload(spec: WorkloadSpec | None = None, **kw) -> Workload:
     requests: list[WorkloadRequest] = []
     for i, arrival in enumerate(arrivals):
         tenant = int(rng.choice(spec.tenants, p=probs))
-        bucket = int(rng.choice(len(weights), p=weights))
-        _, lo, hi = spec.prompt_mix[bucket]
-        sfx_len = int(rng.integers(lo, hi + 1))
+        heavy = (spec.suffix_dist == "lognormal"
+                 and bool(rng.random() < spec.heavy_frac))
+        if heavy:
+            lo, hi = spec.suffix_clip
+            sfx_len = int(np.clip(
+                round(rng.lognormal(spec.lognormal_mu,
+                                    spec.lognormal_sigma)), lo, hi))
+        else:
+            bucket = int(rng.choice(len(weights), p=weights))
+            _, lo, hi = spec.prompt_mix[bucket]
+            sfx_len = int(rng.integers(lo, hi + 1))
         suffix = [int(t) for t in rng.integers(0, spec.vocab_size,
                                                size=sfx_len)]
-        max_new = int(rng.integers(spec.max_new[0], spec.max_new[1] + 1))
+        mn = (spec.light_max_new
+              if not heavy and spec.light_max_new is not None
+              else spec.max_new)
+        max_new = int(rng.integers(mn[0], mn[1] + 1))
         requests.append(WorkloadRequest(
             rid=f"wl-{i:04d}", arrival_step=arrival,
             prompt=system_prompts[tenant] + suffix,
             max_new_tokens=max_new, tenant=tenant))
     return Workload(requests, spec=spec, system_prompts=system_prompts)
+
+
+def heavy_tail_workload(seed: int = 0, n_requests: int = 24,
+                        **overrides) -> Workload:
+    """The chunked-prefill stress preset: lognormal long prompts
+    (~30% of requests, suffixes up to a few hundred tokens) interleaved
+    with short decode-heavy traffic on small shared system prompts.
+    Without chunking, each long prompt monopolizes an entire step and
+    every decoding slot's inter-token latency eats the full prefill;
+    with chunking the prompt streams through in budget-sized bites —
+    this trace is what ``bench.py llama_serving_chunked`` and
+    ``tools/profile_serving.py --chunked`` A/B over. Deterministic in
+    ``seed``; any :class:`WorkloadSpec` field can be overridden."""
+    kw: dict = dict(seed=seed, n_requests=n_requests,
+                    arrival="poisson", rate=0.75,
+                    tenants=2, zipf_alpha=1.2, system_len=(8, 16),
+                    suffix_dist="lognormal", heavy_frac=0.3,
+                    lognormal_mu=4.2, lognormal_sigma=0.8,
+                    suffix_clip=(48, 320),
+                    prompt_mix=((1.0, 4, 12),),
+                    max_new=(4, 8), light_max_new=(16, 48))
+    kw.update(overrides)
+    return make_workload(WorkloadSpec(**kw))

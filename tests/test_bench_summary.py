@@ -16,6 +16,7 @@ _DEFAULT_CONFIGS = {
     "llama8b_shape", "llama_decode", "llama_longctx", "llama_serving",
     "llama_serving_prefix", "llama_decode_int8", "llama_serving_int8",
     "llama_serving_fleet", "llama_serving_spec", "llama_serving_tiered",
+    "llama_serving_chunked",
 }
 
 
@@ -136,6 +137,24 @@ def test_dry_fleet_cell_carries_failover_keys():
                          "failovers", "replayed_tokens", "shed",
                          "replicas_ejected",
                          "goodput_at_slo", "retraces"}, cell
+    assert all(v is None for v in cell.values()), cell
+
+
+def test_dry_serving_chunked_cell_carries_ab_keys():
+    # the chunked-prefill arm (SERVING.md "Chunked prefill & mixed
+    # steps"): the cell must surface the A/B evidence — itl_p99 and
+    # goodput_at_slo for BOTH arms (head-of-line blocking shows up as
+    # the OFF arm's inter-token p99) plus the chunk volume — next to
+    # the usual serving SLO keys
+    out = _run_dry("llama_serving_chunked")
+    assert out.returncode == 0, out.stderr
+    last = json.loads(out.stdout.splitlines()[-1])
+    cell = last["bench_summary"]["llama_serving_chunked"]
+    assert set(cell) >= {"value", "mfu", "spread",
+                         "ttft_p50", "ttft_p99", "tpot",
+                         "itl_p99", "itl_p99_baseline", "itl_p99_ratio",
+                         "goodput_at_slo", "goodput_at_slo_baseline",
+                         "chunk_tokens_total", "retraces"}, cell
     assert all(v is None for v in cell.values()), cell
 
 

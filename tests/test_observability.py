@@ -245,15 +245,17 @@ class TestEngineTracing:
             assert res[rid] == ref  # bitwise: tracing observes, not alters
         assert eng.decode_program_count() == 1
         assert "decode_retraces" not in tr.counters
-        # the step phases, lifecycle events and compile markers all landed
+        # the step phases, lifecycle events and compile markers all
+        # landed (chunked default: prompts stream through the mixed
+        # program, so chunk instants replace prefill_dispatch spans)
         names = {e["name"] for e in tr.events}
-        assert {"deadline_sweep", "admission", "prefill_dispatch",
-                "prefill", "decode_dispatch", "device_sync", "sample_emit",
+        assert {"deadline_sweep", "admission", "mixed_dispatch",
+                "chunk", "decode_dispatch", "device_sync", "sample_emit",
                 "queued", "running", "admit", "finish",
                 "compile"} <= names, names
         assert tr.counters["tokens"] == sum(len(r) for r in refs)
         assert tr.counters["finishes"] == 3
-        assert tr.counters["compiles"] >= 2  # prefill program + decode
+        assert tr.counters["compiles"] >= 2  # mixed program + decode
         # every request track's B/E durations are balanced — the Chrome
         # B/E stack per tid corrupts if the scheduler mislays one side
         for rid in rids:

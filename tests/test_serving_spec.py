@@ -10,8 +10,9 @@ The speculative contracts (SERVING.md "Speculative decoding"):
    samples — drafts only decide how many tokens a step emits, never
    which. Holds across churn, preemption, prefix-cache hits and int8 KV.
 2. O(1) PROGRAMS — the engine owns exactly two per-step-shape programs
-   (``[max_slots]`` decode + ``[max_slots, k]`` verify), each pinned at
-   1 compiled instance under churn and arbitrary accept patterns
+   (``[max_slots]`` decode + the ``[max_slots, chunk]`` MIXED step that
+   carries prefill chunks and verify rows alike), each pinned at 1
+   compiled instance under churn and arbitrary accept patterns
    (``step_program_counts()``; asserted over 3 churn epochs).
 3. EXACT ROLLBACK — rejected draft rows are zeroed in-program and an
    in-window stop rewinds the accepted-but-unused tail, so no
@@ -24,10 +25,10 @@ The speculative contracts (SERVING.md "Speculative decoding"):
 Most engine tests share ONE module-scoped speculative engine (``eng4``)
 and swap the drafter per test (drafters are stateless host objects, and
 the parity contract makes the emitted stream drafter-independent) — a
-fresh ServingEngine means recompiling prefill/decode/verify, which is
-the dominant cost of this file. The shared engine doubles as a
-cross-test churn assertion: ``step_program_counts()`` must still be
-exactly ``{"decode": 1, "verify": 1}`` after EVERY workload below.
+fresh ServingEngine means recompiling decode + mixed, which is the
+dominant cost of this file. The shared engine doubles as a cross-test
+churn assertion: ``step_program_counts()`` must still be exactly
+``{"decode": 1, "mixed": 1}`` after EVERY workload below.
 """
 
 import numpy as np
@@ -113,7 +114,7 @@ class OracleDrafter(DraftProposer):
 class RepeatDrafter(DraftProposer):
     """Proposes the last context token k times — the cheapest real
     drafter (great on repetitive text). Here it guarantees every decode
-    step goes through the verify program regardless of prompt content,
+    step goes through the mixed program regardless of prompt content,
     which pins the program-count assertions; parity is unaffected
     because the emitted stream never depends on the drafter."""
 
@@ -258,8 +259,9 @@ class TestSpecScheduler:
 class TestSpecParity:
     def test_greedy_equivalence_staggered_arrivals(self, eng4, refs):
         # First use of the shared engine: a drafter that never proposes
-        # keeps the engine on the 1-token decode program — the verify
-        # program must not be traced until real drafts arrive below.
+        # keeps every DECODE step on the 1-token program — the mixed
+        # program compiles once for the prefill chunk and must not
+        # retrace when real drafts arrive below.
         class NoDrafter(DraftProposer):
             def propose(self, req, k):
                 return []
@@ -267,7 +269,7 @@ class TestSpecParity:
         eng = _arm(eng4, NoDrafter())
         rid0 = eng.add_request(P5, 4)
         assert eng.run_to_completion(max_steps=50)[rid0] == refs[5][:4]
-        assert eng.step_program_counts() == {"decode": 1, "verify": 0}
+        assert eng.step_program_counts() == {"decode": 1, "mixed": 1}
 
         eng = _arm(eng4)
         rids = [eng.add_request(P5, MAX_NEW), eng.add_request(P9, MAX_NEW)]
@@ -276,7 +278,7 @@ class TestSpecParity:
         res = eng.run_to_completion(max_steps=200)
         for rid, ref in zip(rids, (refs[5], refs[9], refs[12])):
             assert res[rid] == ref
-        assert eng.step_program_counts() == {"decode": 1, "verify": 1}
+        assert eng.step_program_counts() == {"decode": 1, "mixed": 1}
 
     def test_greedy_equivalence_through_preemption(self, model, refs):
         """Preemption parity — and, on the same fresh engine, the full
@@ -292,18 +294,19 @@ class TestSpecParity:
         assert eng.scheduler.num_preemptions > 0
         for rid, ref in zip(rids, (refs[9], refs[12])):
             assert res[rid] == ref
-        assert eng.step_program_counts() == {"decode": 1, "verify": 1}
+        assert eng.step_program_counts() == {"decode": 1, "mixed": 1}
         names = {e["name"] for e in tr.events}
-        assert {"draft", "verify", "rollback"} <= names
-        # the verify program announces its compile exactly once
+        assert {"draft", "mixed_dispatch", "rollback"} <= names
+        # the mixed program announces its compile exactly once (at the
+        # first prefill chunk; verify rides the same program)
         compiles = [e for e in tr.events if e["name"] == "compile"
-                    and e["args"].get("program") == "verify"]
+                    and e["args"].get("program") == "mixed"]
         assert len(compiles) == 1
         assert "decode_retraces" not in tr.counters
         # chrome export round-trips the new events
         doc = tr.chrome_trace()
         chrome_names = {e.get("name") for e in doc["traceEvents"]}
-        assert {"draft", "verify", "rollback"} <= chrome_names
+        assert {"draft", "mixed_dispatch", "rollback"} <= chrome_names
         # the spec counters survive the Prometheus render/parse roundtrip
         page = render_prometheus(eng.metrics.summary(), eng.pool.stats(),
                                  eng.tracer.counters)
@@ -359,9 +362,9 @@ class TestSpecParity:
             for rid, ref in zip(rids, refs):
                 assert res[rid] == ref, f"epoch {epoch}"
             assert eng.step_program_counts() == \
-                {"decode": 1, "verify": 1}, f"retraced in epoch {epoch}"
+                {"decode": 1, "mixed": 1}, f"retraced in epoch {epoch}"
         assert eng.metrics.summary()["cache_hit_rate"] > 0
-        assert eng.stats()["step_programs"] == {"decode": 1, "verify": 1}
+        assert eng.stats()["step_programs"] == {"decode": 1, "mixed": 1}
 
     def test_ngram_drafter_end_to_end(self, model, eng4):
         """Default n-gram drafter on a repetitive prompt: the trailing
@@ -512,4 +515,4 @@ class TestSpecFleet:
         for h in st["replica_health"]:
             if h["state"] != "dead":
                 e = router.engines[h["replica"]]
-                assert e.step_program_counts() == {"decode": 1, "verify": 1}
+                assert e.step_program_counts() == {"decode": 1, "mixed": 1}

@@ -34,6 +34,19 @@ Run: python tools/profile_serving.py            (real TPU)
                                                  error + >=99% greedy
                                                  agreement asserted, int8
                                                  weight-stream bytes)
+     python tools/profile_serving.py --chunked  (chunked-prefill A/B:
+                                                 long prompts landing in
+                                                 a decode-heavy stream,
+                                                 whole-prompt vs chunk-
+                                                 streamed prefill —
+                                                 bitwise parity vs
+                                                 generate() asserted on
+                                                 BOTH arms, inter-token-
+                                                 latency p50/p99 deltas
+                                                 printed: the OFF arm's
+                                                 p99 carries the head-of-
+                                                 line stall chunking
+                                                 removes)
      python tools/profile_serving.py --spec     (speculative-decoding
                                                  A/B: the staggered
                                                  shared-system-prompt
@@ -432,17 +445,11 @@ def prefix():
                             page_size=page_size, max_slots=max_slots,
                             max_pages_per_slot=mpps,
                             prefix_cache=cache_on)
-        # warm on a DISJOINT trace (fresh random tokens) so arm timings
-        # exclude compile AND the measured trace starts with a cold
-        # prefix index for its own system prompt. EVERY prefill bucket
-        # up to the longest prompt gets warmed — a follower's
-        # suffix-only prefill lands in whatever small bucket its
-        # uncached tail rounds up to (O(log max_len) programs total)
-        for b in sorted({eng._bucket(n)
-                         for n in range(1, max(lens) + 1)}):
-            eng.add_request(
-                rng.integers(0, cfg.vocab_size, b).astype(np.int32), 2)
-        eng.run_to_completion(max_steps=500)
+        # warm both step-shape programs (decode + mixed) with scratch-
+        # page dispatches: arm timings exclude compile AND the measured
+        # trace starts with a cold prefix index for its own system
+        # prompt (warm_programs writes nothing and registers nothing)
+        eng.warm_programs()
         eng.metrics = ServingMetrics()
 
         t0 = time.perf_counter()
@@ -619,8 +626,8 @@ def spec():
     """Speculative-decoding A/B (SERVING.md "Speculative decoding"): one
     staggered shared-system-prompt trace replayed on two identically-
     configured engines — speculation OFF (plain 1-token decode) then ON
-    (n-gram prompt-lookup draft + the fixed-shape ``[max_slots, k]``
-    verify program). Both arms must produce bitwise-identical greedy
+    (n-gram prompt-lookup draft verified through the fixed-shape
+    mixed step). Both arms must produce bitwise-identical greedy
     tokens (and match per-request ``generate()``) — the verify step
     emits its own samples, drafts only decide how many land per step —
     so the deltas printed at the end are pure mechanism: engine steps
@@ -679,41 +686,16 @@ def spec():
 
     mpps = max((n + max_new) // page_size + 2 for n in lens)
 
-    class _WarmDrafter:
-        # propose-always: traces the verify program during warmup even
-        # when the warm prompts have no n-gram repeats
-        def propose(self, req, k):
-            ctx = req.tokens or list(req.prompt)
-            return [int(ctx[-1])] * k
-
-        def observe(self, req, n_draft, n_accepted):
-            pass
-
     def run_arm(spec_on):
         eng = ServingEngine(model, num_pages=num_pages,
                             page_size=page_size, max_slots=max_slots,
                             max_pages_per_slot=mpps,
                             speculative=(SpeculativeConfig(k=spec_k)
                                          if spec_on else None))
-        real_drafter = eng._drafter
-        if spec_on:
-            eng._drafter = _WarmDrafter()
-        # warm every prefill bucket the trace will hit with an in-bucket
-        # length that fits the slot (a bucket-sized prompt can exceed
-        # max_pages_per_slot), plus decode + verify. Warm max_new must
-        # exceed 2: the draft cap is max_new - len(tokens) - 1, so a
-        # 2-token warm request never drafts and the verify program
-        # would compile inside the measured trace
-        warmed = set()
-        for n in sorted(set(lens) | set(sfx_lens)):
-            b = eng._bucket(n)
-            if b not in warmed:
-                warmed.add(b)
-                eng.add_request(
-                    rng.integers(0, cfg.vocab_size, n).astype(np.int32),
-                    4 if spec_on else 2)
-        eng.run_to_completion(max_steps=500)
-        eng._drafter = real_drafter
+        # verify rows share the mixed program with prefill chunks, so
+        # one warm dispatch per step shape covers spec-on and -off
+        # alike (no propose-always warm drafter needed anymore)
+        eng.warm_programs()
         eng.metrics = ServingMetrics()
         eng.metrics.set_spec(spec_on)
 
@@ -768,6 +750,137 @@ def spec():
         print("  (no drafts proposed — trace had no n-gram repeats)")
     if smoke:
         print("(smoke mode: ratios are logic evidence only — rerun "
+              "on-chip for the PERF.md numbers)")
+
+
+def chunked():
+    """Chunked-prefill A/B (SERVING.md "Chunked prefill & mixed
+    steps"): a decode-heavy short-request stream with LONG prompts
+    landing mid-trace, replayed on two identically-configured engines —
+    chunked OFF (whole-prompt admission prefill: a long arrival stalls
+    every decoding slot for its entire prompt) then chunked ON (the
+    prompt streams through the mixed program in budget-sized chunks
+    alongside the decode rows). Both arms must produce bitwise-
+    identical greedy tokens AND match per-request ``generate()`` —
+    chunk boundaries are scheduling, never semantics. The deltas
+    printed at the end are the inter-token-latency percentiles: the
+    OFF arm's itl_p99 carries the head-of-line stall that chunking
+    removes."""
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                         llama_tiny)
+    from paddle_tpu.serving import ServingEngine, ServingMetrics
+
+    backend = jax.default_backend()
+    smoke = "--smoke" in sys.argv[1:] or backend != "tpu"
+    if backend != "tpu":
+        print(f"WARNING: backend={backend} — timings are meaningless "
+              f"off-chip, running the smoke shapes")
+
+    pt.seed(0)
+    if smoke:
+        cfg = llama_tiny(mp_axis=None, fsdp_axis=None)
+        n_short, max_new, short_lohi = 6, 16, (8, 24)
+        n_long, long_len, long_new = 2, 96, 4
+        chunk, budget = 8, 8
+        page_size, num_pages, max_slots = 4, 128, 4
+    else:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5632, num_hidden_layers=8,
+                          num_attention_heads=16, num_key_value_heads=8,
+                          max_position_embeddings=4096, dtype="bfloat16",
+                          mp_axis=None, fsdp_axis=None)
+        n_short, max_new, short_lohi = 12, 64, (48, 96)
+        n_long, long_len, long_new = 2, 1024, 8
+        chunk, budget = 64, 128
+        page_size, num_pages, max_slots = 16, 1024, 8
+
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    short_lens = [int(x) for x in rng.integers(*short_lohi, n_short)]
+    shorts = [rng.integers(0, cfg.vocab_size, n).astype(np.int32)
+              for n in short_lens]
+    longs = [rng.integers(0, cfg.vocab_size, long_len).astype(np.int32)
+             for _ in range(n_long)]
+    long_steps = [6 + 10 * i for i in range(n_long)]
+    print(f"trace: {n_short} short requests ({min(short_lens)}-"
+          f"{max(short_lens)} tokens, max_new={max_new}) + {n_long} "
+          f"long prompts ({long_len} tokens) landing mid-decode; "
+          f"chunk={chunk}, prefill budget={budget}/step, greedy")
+
+    refs = [np.asarray(model.generate(np.asarray([p]),
+                                      max_new_tokens=n)
+                       )[0, len(p):].tolist()
+            for p, n in ([(p, max_new) for p in shorts]
+                         + [(p, long_new) for p in longs])]
+
+    mpps = max((long_len + long_new) // page_size + 2,
+               max((n + max_new) // page_size + 2 for n in short_lens))
+
+    def run_arm(chunk_on):
+        eng = ServingEngine(model, num_pages=num_pages,
+                            page_size=page_size, max_slots=max_slots,
+                            max_pages_per_slot=mpps,
+                            prefill_token_budget=budget,
+                            chunked=chunk_on, prefill_chunk=chunk)
+        eng.warm_programs()
+        eng.metrics = ServingMetrics()
+        eng.metrics.set_chunked(chunk_on)
+
+        t0 = time.perf_counter()
+        added, added_long = 2, 0
+        rids = [eng.add_request(p, max_new) for p in shorts[:2]]
+        long_rids = []
+        steps = 0
+        while (eng.scheduler.has_work() or added < n_short
+               or added_long < n_long):
+            eng.step()
+            steps += 1
+            if added < n_short and steps % 3 == 0:
+                rids.append(eng.add_request(shorts[added], max_new))
+                added += 1
+            if added_long < n_long and steps >= long_steps[added_long]:
+                long_rids.append(eng.add_request(longs[added_long],
+                                                 long_new))
+                added_long += 1
+        wall = time.perf_counter() - t0
+        counts = eng.step_program_counts()
+        assert all(n <= 1 for n in counts.values()), \
+            f"step program retraced: {counts}"
+        outs = [list(eng.request(r).tokens) for r in rids + long_rids]
+        return outs, wall, steps, eng.metrics.summary()
+
+    out_off, t_off, steps_off, m_off = run_arm(False)
+    out_on, t_on, steps_on, m_on = run_arm(True)
+
+    for ref, a, b in zip(refs, out_off, out_on):
+        assert a == ref, "chunked-OFF arm diverged from generate() — bug"
+        assert b == ref, ("chunked-ON arm diverged — chunk boundaries "
+                          "changed WHICH tokens, not just when")
+    print("parity: chunked-ON == chunked-OFF == generate(), bitwise, "
+          "all requests")
+
+    total = sum(len(r) for r in refs)
+    for label, t, steps, m in (("chunked OFF", t_off, steps_off, m_off),
+                               ("chunked ON ", t_on, steps_on, m_on)):
+        print(f"{label}: {t:7.3f}s  {total / t:8.1f} tok/s  "
+              f"{steps} engine steps  "
+              f"itl p50/p99 = {m['itl_p50_s'] * 1000:7.1f}/"
+              f"{m['itl_p99_s'] * 1000:7.1f}ms  "
+              f"ttft p99 = {m['ttft_p99_s'] * 1000:7.1f}ms")
+    print(f"\ndeltas (ON vs OFF): "
+          f"itl_p99 {m_off['itl_p99_s'] / max(m_on['itl_p99_s'], 1e-9):.2f}x "
+          f"lower  "
+          f"itl_p50 {m_off['itl_p50_s'] / max(m_on['itl_p50_s'], 1e-9):.2f}x  "
+          f"throughput {(total / t_on) / (total / t_off):.2f}x  "
+          f"mixed_steps={m_on['mixed_steps']} "
+          f"chunks={m_on['chunks_dispatched_total']} "
+          f"chunk_tokens={m_on['chunk_tokens_total']}")
+    if smoke:
+        print("(smoke mode: deltas are logic evidence only — rerun "
               "on-chip for the PERF.md numbers)")
 
 
@@ -833,10 +946,7 @@ def kv_int8():
         eng = ServingEngine(model, num_pages=num_pages,
                             page_size=page_size, max_slots=max_slots,
                             max_pages_per_slot=mpps, kv_quant=kv_quant)
-        for b in sorted({eng._bucket(n) for n in lens}):
-            eng.add_request(
-                rng.integers(0, cfg.vocab_size, b).astype(np.int32), 2)
-        eng.run_to_completion(max_steps=500)
+        eng.warm_programs()
         eng.metrics = ServingMetrics()
         eng.metrics.set_kv_quant(kv_quant)
 
@@ -1033,6 +1143,8 @@ if __name__ == "__main__":
         prefix()
     elif "--kv-int8" in sys.argv[1:]:
         kv_int8()
+    elif "--chunked" in sys.argv[1:]:
+        chunked()
     elif "--tiered" in sys.argv[1:]:
         tiered()
     elif "--spec" in sys.argv[1:]:
