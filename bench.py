@@ -91,6 +91,9 @@ _SERVING_SLOS = {
     # pay re-prefill + replay inside one inter-token gap — the looser
     # ITL budget is the failover price the SLO explicitly allows
     "llama_serving_fleet": {"ttft_p99_s": 2.0, "itl_p99_s": 1.0},
+    # failover A/B (full vs bounded replay): same kill, same budgets as
+    # the fleet arm — snapshots must win on replay work, not on SLOs
+    "llama_serving_failover": {"ttft_p99_s": 2.0, "itl_p99_s": 1.0},
     # chunked-prefill A/B: long prompts land mid-decode, so the OFF
     # arm's itl_p99 carries the head-of-line stall chunking removes; a
     # tight ITL SLO makes goodput_at_slo sensitive to exactly that
@@ -1291,6 +1294,153 @@ def bench_llama_serving_fleet(peak, peak_kind, n_requests=12,
     }
 
 
+def bench_llama_serving_failover(peak, peak_kind, n_requests=12,
+                                 max_new_tokens=64, kill_step=20,
+                                 snapshot_interval=4, trace_path=None):
+    """Bounded-replay failover A/B (RESILIENCE.md "Serving recovery
+    playbook"): the same 420M model, staggered trace and mid-run replica
+    kill as bench_llama_serving_fleet, run twice. Arm A has no snapshot
+    store, so every failed-over request replays its FULL already-emitted
+    stream on the survivor; arm B's replicas share a ``SnapshotStore``
+    (capture every ``snapshot_interval`` engine steps), so failover
+    restores each request's KV from its latest verified snapshot and
+    replays only the tokens emitted since. Both arms see the identical
+    trace and must produce bitwise-identical client streams (asserted) —
+    the cell's evidence is the replay-work delta:
+    ``replayed_tokens_full`` vs ``recovery_replayed_tokens`` +
+    ``recovery_restored_tokens``, with ``goodput_at_slo`` for both arms
+    so the saved recompute is priced against the same SLOs."""
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import (FleetMetrics, FleetRouter,
+                                    ServingEngine, ServingMetrics,
+                                    SnapshotStore)
+
+    name = "llama_serving_failover"
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                      intermediate_size=5632, num_hidden_layers=8,
+                      num_attention_heads=16, num_key_value_heads=8,
+                      max_position_embeddings=4096, dtype="bfloat16",
+                      mp_axis=None, fsdp_axis=None)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    n_params = model.num_params()
+    weight_bytes = 2.0 * n_params
+    rng = np.random.default_rng(0)
+    lens = [int(x) for x in rng.integers(64, 256, n_requests)]
+    prompts = [rng.integers(0, cfg.vocab_size, n).astype(np.int32)
+               for n in lens]
+    tracer = _make_tracer(trace_path)
+
+    def _arm(bounded):
+        # replicas share the model, so compiled programs are shared
+        # across arms too — arm A pays the compiles, arm B reuses them
+        store = SnapshotStore() if bounded else None
+        kw = ({"snapshot_store": store,
+               "snapshot_interval": snapshot_interval} if bounded else {})
+        arm_tracer = tracer if bounded else None
+        engines = [ServingEngine(model, num_pages=256, page_size=16,
+                                 max_slots=8, max_pages_per_slot=32,
+                                 tracer=arm_tracer, **kw)
+                   for _ in range(2)]
+        engines[0].warm_programs()
+        engines[1].add_request(prompts[0], 2)
+        engines[1].run_to_completion(max_steps=100)
+        warm_steps = [e.stats()["steps"] for e in engines]
+        router = FleetRouter(engines, tracer=arm_tracer)
+        router.metrics = ServingMetrics()  # compile time stays out
+        router.metrics.set_slo(**_SERVING_SLOS[name])
+        router.fleet_metrics = FleetMetrics()
+        added = 2
+        for p in prompts[:2]:
+            router.submit(p, max_new_tokens)
+        steps = 0
+        killed = False
+        out = {}
+        while router.has_work() or added < n_requests:
+            for ev in router.step():
+                if ev.get("token") is not None:
+                    out.setdefault(ev["rid"], []).append(ev["token"])
+            steps += 1
+            if not killed and steps == kill_step:
+                router.kill_replica(1)  # the same chaos in both arms
+                killed = True
+            if added < n_requests and steps % 4 == 0:
+                router.submit(prompts[added], max_new_tokens)
+                added += 1
+        survivors = [e for e, rep in zip(engines, router._replicas)
+                     if rep.state != "dead"]
+        for e in survivors:
+            assert e.decode_program_count() == 1, "serving decode retraced"
+            e.audit_pool()
+        engine_steps = sum(e.stats()["steps"] - w
+                           for e, w in zip(engines, warm_steps))
+        return {"m": router.metrics.summary(),
+                "fleet": router.fleet_metrics.summary(),
+                "out": out, "steps": steps, "engine_steps": engine_steps,
+                "retraces": sum(e.decode_program_count() - 1
+                                for e in survivors),
+                "ejected": 2 - router.replicas_live()}
+
+    full = _arm(bounded=False)
+    bnd = _arm(bounded=True)
+    # the whole point of bounded replay: the client streams are the SAME
+    assert bnd["out"] == full["out"], \
+        "bounded-replay arm diverged from full-replay arm"
+    m, fleet = bnd["m"], bnd["fleet"]
+    m0, fleet0 = full["m"], full["fleet"]
+    hbm_bw = {"v4": 1.2e12,
+              "v5e": 0.82e12, "v5litepod": 0.82e12, "v5lite": 0.82e12,
+              "v5p": 2.77e12,
+              "v6e": 1.64e12, "trillium": 1.64e12,
+              }.get(peak_kind.split("(")[0], 0.82e12)
+    wall = max(m["wall_s"], 1e-9)
+    mbu = bnd["engine_steps"] * weight_bytes / wall / hbm_bw
+    trace_out = _dump_trace(tracer, trace_path, name)
+    return {
+        "metric": "llama_420m_serving_failover_tokens_per_sec",
+        "value": round(m["tokens_per_s"], 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(m["tokens_per_s"]
+                             / max(m0["tokens_per_s"], 1e-9), 4),
+        "extra": {"params": n_params, "n_requests": n_requests,
+                  "max_new_tokens": max_new_tokens,
+                  "prompt_lens": lens,
+                  "replicas": 2, "kill_step": kill_step,
+                  "snapshot_interval": snapshot_interval,
+                  "replicas_ejected": bnd["ejected"],
+                  "router_steps": bnd["steps"],
+                  "engine_steps": bnd["engine_steps"],
+                  "failovers": fleet["failovers"],
+                  # the A/B evidence: replay work in each arm
+                  "replayed_tokens": fleet["replayed_tokens"],
+                  "replayed_tokens_full": fleet0["replayed_tokens"],
+                  "snapshot_restores": fleet["snapshot_restores"],
+                  "snapshot_fallbacks": fleet["snapshot_fallbacks"],
+                  "recovery_restored_tokens":
+                      fleet["recovery_restored_tokens"],
+                  "recovery_replayed_tokens":
+                      fleet["recovery_replayed_tokens"],
+                  "token_exact": True,
+                  "shed": fleet["shed"],
+                  "ttft_p50": round(m["ttft_p50_s"], 4),
+                  "ttft_p99": round(m["ttft_p99_s"], 4),
+                  "tpot": round(m["tpot_mean_s"], 5),
+                  "itl_p99": round(m["itl_p99_s"], 5),
+                  "goodput_at_slo": round(m["goodput_at_slo"], 4),
+                  "goodput_at_slo_full": round(m0["goodput_at_slo"], 4),
+                  "tokens_per_s_full": round(m0["tokens_per_s"], 1),
+                  "slo": _SERVING_SLOS[name],
+                  "retraces": bnd["retraces"] + full["retraces"],
+                  "trace": trace_out,
+                  "mbu_weights_only": round(mbu, 4),
+                  "peak": peak_kind, "hbm_bw": hbm_bw,
+                  "pipeline": False, "runs": _RUNS,
+                  "spread": None},
+    }
+
+
 def bench_llama_serving_tiered(peak, peak_kind, n_requests=12,
                                max_new_tokens=48, trace_path=None):
     """Tiered-KV serving A/B (SERVING.md "KV tiering & traffic
@@ -1470,6 +1620,12 @@ _CONFIGS = {
     # "Engine fleet & failover"): client-visible tokens/s with the
     # failover replay priced in, plus failovers/replays/shed evidence
     "llama_serving_fleet": bench_llama_serving_fleet,
+    # bounded-replay failover A/B (RESILIENCE.md "Serving recovery
+    # playbook"): the fleet kill run twice — no snapshots (full replay)
+    # vs a shared SnapshotStore (restore KV, replay only the delta);
+    # bitwise-identical client streams by assertion, replay-work +
+    # goodput_at_slo evidence for both arms
+    "llama_serving_failover": bench_llama_serving_failover,
     # chunked-prefill A/B (SERVING.md "Chunked prefill & mixed steps"):
     # whole-prompt vs chunk-streamed prefill on a long-prompt +
     # decode-heavy trace; itl_p99/goodput for both arms, token-exact
@@ -1504,6 +1660,14 @@ _SUMMARY_EXTRA_KEYS = {
                             "failovers", "replayed_tokens", "shed",
                             "replicas_ejected",
                             "goodput_at_slo", "retraces"),
+    "llama_serving_failover": ("ttft_p50", "ttft_p99", "tpot",
+                               "failovers",
+                               "replayed_tokens", "replayed_tokens_full",
+                               "snapshot_restores", "snapshot_fallbacks",
+                               "recovery_restored_tokens",
+                               "recovery_replayed_tokens",
+                               "goodput_at_slo", "goodput_at_slo_full",
+                               "retraces"),
     "llama_serving_chunked": ("ttft_p50", "ttft_p99", "tpot",
                               "itl_p99", "itl_p99_baseline",
                               "itl_p99_ratio",

@@ -33,6 +33,15 @@ Named sites wired into the runtime (see RESILIENCE.md):
   tokens recompute); ``poison`` corrupts the stored host payload
   WITHOUT updating its digest, so the restore-side blake2b re-verify
   must detect it and fall back to recompute — wrong KV is never served.
+- ``serving.snapshot`` / ``serving.snapshot_restore`` — the crash-
+  consistent snapshot capture / restore sites (serving/snapshot.py;
+  RESILIENCE.md "Serving recovery playbook"). ``ctx['path']`` is the
+  request id. ``raise`` at capture drops that request's snapshot (the
+  previous capture, or full replay, covers it); ``raise`` at restore
+  falls the failover back to full replay. ``poison`` corrupts the
+  stored / about-to-be-injected payload WITHOUT updating its blake2b
+  digests, so the restore-side re-verify must catch it and recompute —
+  a poisoned snapshot can cost time, never correctness.
 - ``fleet.dispatch`` / ``fleet.replica_kill`` / ``fleet.health`` — the
   serving fleet router's placement, replica-life and health-probe sites
   (SERVING.md "Engine fleet & failover"). ``ctx['path']`` is the request

@@ -61,6 +61,7 @@ class LLMPredictor:
         "RequestTooLargeError": "too_large",
         "EngineDrainingError": "draining",
         "SchedulerStalledError": "scheduler_stalled",
+        "FleetOverloadedError": "overloaded",
     }
 
     def generate(self, prompts, max_new_tokens: int = 32,
@@ -99,7 +100,7 @@ class LLMPredictor:
 
         ``{"tokens": [...], "finish_reason": str | None, "error":
         None | "queue_full" | "too_large" | "draining" |
-        "scheduler_stalled"}``
+        "scheduler_stalled" | "overloaded", "retryable": bool}``
 
         Rejected prompts carry ``finish_reason="rejected"`` and empty
         tokens; accepted prompts carry the engine's classified
@@ -107,7 +108,12 @@ class LLMPredictor:
         ``nonfinite`` / ``preempted`` / ``preempted_limit`` /
         ``injected`` — SERVING.md). A scheduler stall marks every
         still-unfinished prompt ``scheduler_stalled`` rather than
-        raising."""
+        raising. ``retryable`` surfaces the typed error's own
+        ``ServingError.retryable`` flag, so a transient shed
+        (queue_full / draining / overloaded — back off and resubmit,
+        possibly elsewhere) is machine-distinguishable from a terminal
+        rejection (too_large: every homogeneous replica refuses it
+        identically, retrying is futile)."""
         from ..serving import SchedulerStalledError, ServingError
         if sampling is not None and isinstance(sampling, (list, tuple)):
             per = list(sampling)
@@ -125,7 +131,8 @@ class LLMPredictor:
             except ServingError as e:
                 outcomes[i] = {"tokens": [], "finish_reason": "rejected",
                                "error": self.FAILURE_CODES.get(
-                                   type(e).__name__, "serving_error")}
+                                   type(e).__name__, "serving_error"),
+                               "retryable": bool(e.retryable)}
         stalled = False
         try:
             self.engine.run_to_completion(max_steps=max_steps)
@@ -134,15 +141,23 @@ class LLMPredictor:
         for rid, i in rids.items():
             req = self.engine.request(rid)
             if req.finish_reason is None:
+                # SchedulerStalledError.retryable is True: a stall is
+                # an engine-side livelock, not the request's fault
                 outcomes[i] = {"tokens": list(req.tokens),
                                "finish_reason": "stalled" if stalled
                                else None,
                                "error": "scheduler_stalled" if stalled
-                               else None}
+                               else None,
+                               "retryable": stalled}
             else:
                 outcomes[i] = {"tokens": list(req.tokens),
                                "finish_reason": req.finish_reason,
-                               "error": None}
+                               "error": None,
+                               # matches drain()'s retriable contract:
+                               # only a preempted eviction computed
+                               # nothing the client is owed elsewhere
+                               "retryable": req.finish_reason
+                               == "preempted"}
         return outcomes
 
     def drain(self, timeout_s: float | None = None) -> dict:

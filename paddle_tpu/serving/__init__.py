@@ -28,6 +28,8 @@ from .kv_cache import KVCachePool, PoolExhaustedError, PrefixMatch
 from .metrics import FleetMetrics, ServingMetrics, percentile
 from .scheduler import (FINISHED, PREEMPTED, RUNNING, WAITING, Request,
                         SamplingParams, Scheduler)
+from .snapshot import (RequestSnapshot, SnapshotStore,
+                       load_engine_snapshot, save_engine_snapshot)
 from .speculative import DraftProposer, NgramDrafter, SpeculativeConfig
 from .tiering import HostTier
 from .workload import (Workload, WorkloadRequest, WorkloadSpec,
@@ -41,6 +43,8 @@ __all__ = [
     "WAITING", "RUNNING", "PREEMPTED", "FINISHED",
     "SpeculativeConfig", "DraftProposer", "NgramDrafter",
     "HostTier",
+    "SnapshotStore", "RequestSnapshot",
+    "save_engine_snapshot", "load_engine_snapshot",
     "Workload", "WorkloadRequest", "WorkloadSpec", "heavy_tail_workload",
     "make_workload",
     "ServingError", "QueueFullError", "RequestTooLargeError",

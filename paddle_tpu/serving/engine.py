@@ -76,6 +76,8 @@ from .errors import (EngineDrainingError, QueueFullError,
 from .kv_cache import KVCachePool
 from .metrics import ServingMetrics
 from .scheduler import FINISHED, Request, SamplingParams, Scheduler
+from .snapshot import (RequestSnapshot, load_engine_snapshot,
+                       save_engine_snapshot)
 
 __all__ = ["ServingEngine"]
 
@@ -98,7 +100,8 @@ class ServingEngine:
                  tracer=None, flight_recorder=None,
                  kv_quant: bool = False, speculative=None,
                  host_tier=None, chunked: bool = True,
-                 prefill_chunk: int = 64):
+                 prefill_chunk: int = 64, snapshot_store=None,
+                 snapshot_interval: int = 16):
         cfg = model.config
         self.model = model
         self.page_size = page_size
@@ -162,11 +165,27 @@ class ServingEngine:
         self.prefill_chunk = int(prefill_chunk)
         self._chunk = max(self.prefill_chunk, self.scheduler.spec_k)
         self.scheduler.chunked = self.chunked
+        # crash-consistent snapshots (serving/snapshot.py; RESILIENCE.md
+        # "Serving recovery playbook"): with a SnapshotStore attached,
+        # every snapshot_interval steps the engine captures each live
+        # request's resumable state — tokens so far plus its KV pages,
+        # exported host-side with ONE batched device_get — so a fleet
+        # router can bound failover replay to the tokens since the last
+        # capture, and save_snapshot/restore give warm process restart.
+        if snapshot_interval < 1:
+            raise ValueError(f"snapshot_interval must be >= 1, "
+                             f"got {snapshot_interval}")
+        self.snapshot_store = snapshot_store
+        self.snapshot_interval = int(snapshot_interval)
+        # set this (or pass drain(snapshot_path=...)) to make SIGTERM
+        # drains persist in-flight state instead of finishing it
+        self.drain_snapshot_path: str | None = None
         self.metrics = ServingMetrics(clock)
         self.metrics.set_kv_quant(kv_quant)
         self.metrics.set_spec(speculative is not None)
         self.metrics.set_host_tier(self.pool.host_tier is not None)
         self.metrics.set_chunked(self.chunked)
+        self.metrics.set_snapshots(snapshot_store is not None)
         # observability (OBSERVABILITY.md): the tracer is shared with
         # the scheduler (request-lifecycle spans) and the pool
         # (eviction/COW/quarantine events); construct it on the same
@@ -368,6 +387,14 @@ class ServingEngine:
         self.metrics.on_step(self.scheduler.queue_depth,
                              self.pool.utilization())
         self._steps += 1
+        if (self.snapshot_store is not None
+                and self._steps % self.snapshot_interval == 0):
+            # capture at the step boundary: pages hold exactly
+            # context_len tokens, positions beyond are zeros (rejected
+            # rows were zeroed in-program) or unreached stale content —
+            # the tail page is sanitized host-side at export
+            with tr.span("snapshot_capture"):
+                self._capture_snapshots()
         if events or chunk_tokens or not self.scheduler.waiting:
             # chunk tokens are progress even before any emission: a
             # long prompt legitimately spends several steps mid-prefill
@@ -434,7 +461,8 @@ class ServingEngine:
                 raise RuntimeError(f"engine did not drain in {steps} steps")
         return {rid: list(r.tokens) for rid, r in self._requests.items()}
 
-    def drain(self, timeout_s: float | None = None) -> dict:
+    def drain(self, timeout_s: float | None = None,
+              snapshot_path: str | None = None) -> dict:
         """Graceful shutdown: stop admission, evict the waiting queue as
         ``finish_reason="preempted"`` ("retry elsewhere" — nothing was
         computed for them), let the running slots decode to their own
@@ -443,8 +471,35 @@ class ServingEngine:
         report {rid: {finish_reason, tokens, retriable}}; the terminal
         events produced during the drain are kept in
         ``last_drain_events``. Idempotent; after a drain,
-        ``add_request`` raises EngineDrainingError."""
+        ``add_request`` raises EngineDrainingError.
+
+        With ``snapshot_path`` (or ``drain_snapshot_path`` set), the
+        drain takes the FAST path instead of decoding stragglers to
+        completion: persist every in-flight request's resumable state
+        with :meth:`save_snapshot`, then evict them all as retriable
+        ``preempted`` outcomes. A warm restart
+        (``ServingEngine.restore(path)``) continues every stream
+        bitwise — the SIGTERM alternative when finishing all requests
+        would blow the termination grace period."""
         events: list[dict] = []
+        if snapshot_path is None:
+            snapshot_path = self.drain_snapshot_path
+        if snapshot_path is not None and not self._draining:
+            self.save_snapshot(snapshot_path)
+            self._draining = True
+            self._flush_waiting(events)
+            for req in list(self.scheduler.running.values()):
+                self._finish_abnormal(req, "preempted", events)
+            self.last_drain_events = events
+            report = {rid: {"finish_reason": r.finish_reason,
+                            "tokens": list(r.tokens),
+                            "retriable": r.finish_reason == "preempted"}
+                      for rid, r in self._requests.items()}
+            self._dump_flight("drain", {
+                "snapshot_path": snapshot_path,
+                "outcomes": {rid: o["finish_reason"]
+                             for rid, o in report.items()}})
+            return report
         self._draining = True
         t0 = self.metrics.now()
         self._flush_waiting(events)
@@ -467,6 +522,179 @@ class ServingEngine:
             "outcomes": {rid: o["finish_reason"]
                          for rid, o in report.items()}})
         return report
+
+    # ------------------------------------------------------------------
+    # crash-consistent snapshots (serving/snapshot.py)
+    # ------------------------------------------------------------------
+
+    def save_snapshot(self, path: str) -> str:
+        """Durable warm-restart snapshot: capture every live request's
+        resumable state NOW and persist it through the checkpoint
+        commit protocol (stage into ``<path>.tmp``, ``COMMIT`` marker,
+        rename — RESILIENCE.md). A crash mid-save leaves a torn staging
+        dir that :meth:`restore` rejects; the previous committed
+        snapshot at ``path`` is replaced only by the atomic rename."""
+        snaps = self._capture_requests()
+        save_engine_snapshot(path, snaps, meta={
+            "steps": self._steps, "kv_quant": self.kv_quant,
+            "page_size": self.page_size})
+        self.metrics.counters["snapshot_saves"] += 1
+        self.tracer.instant("snapshot_save", requests=len(snaps),
+                            step=self._steps)
+        return path
+
+    def restore(self, path: str) -> list[str]:
+        """Warm restart: load a committed on-disk snapshot into this
+        (fresh) engine and re-admit every request in its original
+        arrival order, seeded with the tokens it had already generated
+        — the streams continue bitwise from where the dead process
+        stopped (determinism: seed + token index reproduce every
+        sample; the injected KV only saves recompute). Raises
+        :class:`CheckpointCorruptionError` on a torn or unverifiable
+        snapshot dir. Returns the restored rids."""
+        snaps, _meta = load_engine_snapshot(path)
+        return [self.restore_request(s) for s in snaps]
+
+    def restore_request(self, snap: RequestSnapshot) -> str:
+        """Re-admit one snapshotted request (fleet failover and warm
+        restart both land here). The snapshot's KV payloads — if any,
+        and if their digests still verify — are injected into the pool
+        as refcount-0 cached pages, so the ordinary admission prefix
+        match maps them and the request resumes with zero (or near-
+        zero) recompute; any verification failure just downgrades to
+        the full-recompute path, which is bitwise-identical anyway."""
+        if self._draining:
+            raise EngineDrainingError(
+                "engine is draining; restore on another replica")
+        rid = snap.rid
+        if rid in self._requests:
+            raise ValueError(f"duplicate request id {rid!r}")
+        self.admission_check(len(snap.prompt), snap.max_new_tokens)
+        # the payload is usable only in the pool's own storage format
+        # (int8 codes+scales vs fp pages have different bytes) and page
+        # geometry — a mismatch is a recompute, never a reinterpret
+        inject = bool(snap.payloads) and (
+            snap.kv_tag == self.pool._tier_tag
+            and snap.page_size == self.page_size)
+        if inject:
+            try:
+                _fault.trip("serving.snapshot_restore", step=self._steps,
+                            path=rid, poison=snap.corrupt)
+            except _fault.FaultInjected:
+                inject = False
+                self.metrics.counters["snapshot_restore_failed"] += 1
+            if inject and not snap.verify():
+                # bit rot (or the poison action above) since capture:
+                # the digest re-verify catches it HERE, before any byte
+                # reaches the pool — fall back to recompute
+                inject = False
+                self.metrics.counters["snapshot_restore_corrupt"] += 1
+        if inject:
+            self.pool.inject_prefix(snap.seq(), snap.payloads)
+        req = Request(rid=rid, prompt=list(snap.prompt),
+                      max_new_tokens=snap.max_new_tokens,
+                      sampling=SamplingParams(
+                          temperature=snap.temperature, top_p=snap.top_p,
+                          do_sample=snap.do_sample, seed=snap.seed),
+                      eos_token_id=snap.eos_token_id,
+                      arrival_t=self.metrics.now())
+        req.tokens = list(snap.tokens)
+        try:
+            self.scheduler.add(req, self.pool)
+        except QueueFullError:
+            self.metrics.on_reject("queue_full")
+            raise
+        self._requests[rid] = req
+        self.metrics.on_arrival(rid)
+        self.metrics.counters["snapshot_restores"] += 1
+        self.metrics.counters["snapshot_restored_tokens"] += len(snap.tokens)
+        self.tracer.instant("snapshot_restore", track=rid,
+                            tokens=len(snap.tokens), injected=int(inject))
+        return rid
+
+    def audit_pool(self, check_device: bool = True) -> dict:
+        """Run the pool's invariant audit (``KVCachePool.audit``)
+        against the scheduler's live block tables — the test-teardown /
+        chaos-suite hook proving the engine left the pool consistent."""
+        tables = [list(r.pages)
+                  for r in self.scheduler.running.values() if r.pages]
+        return self.pool.audit(block_tables=tables,
+                               check_device=check_device)
+
+    def _capture_requests(self) -> list[RequestSnapshot]:
+        """Sealed snapshot of every live request, via ONE batched
+        ``export_pages`` device_get across all their pages — host-side,
+        outside every compiled program, so ``step_program_counts()``
+        never moves. A request whose cache holds nothing yet (still
+        queued, or admitted at context 0) gets a meta-only snapshot:
+        replay still skips re-emitting its already-delivered tokens."""
+        ps = self.page_size
+        spans: list[tuple[Request, int]] = []
+        flat: list[int] = []
+        for r in self.scheduler.live_requests():
+            n = 0
+            if r.pages and r.context_len > 0:
+                n = min(self.pool.pages_for(r.context_len), len(r.pages))
+            spans.append((r, n))
+            flat.extend(r.pages[:n])
+        exported = self.pool.export_pages(flat)
+        snaps: list[RequestSnapshot] = []
+        i = 0
+        for r, n in spans:
+            payloads = exported[i:i + n]
+            i += n
+            q = r.context_len % ps
+            if n and q and n == self.pool.pages_for(r.context_len):
+                # the tail page holds q valid rows; rows beyond may be
+                # stale from allocation — zero them host-side so the
+                # payload matches the spill invariant (zeros beyond the
+                # partial length) and the digest is deterministic
+                tail = payloads[-1]
+                for k, a in enumerate(tail):
+                    a = np.array(a)
+                    a[q:] = 0
+                    tail[k] = a
+            snaps.append(RequestSnapshot(
+                rid=r.rid, prompt=list(r.prompt),
+                max_new_tokens=r.max_new_tokens,
+                eos_token_id=r.eos_token_id,
+                temperature=r.sampling.temperature,
+                top_p=r.sampling.top_p,
+                do_sample=r.sampling.do_sample,
+                seed=r.sampling.seed, arrival_seq=r.arrival_seq,
+                tokens=list(r.tokens), context_len=int(r.context_len),
+                step=self._steps, kv_tag=self.pool._tier_tag,
+                page_size=ps, payloads=payloads).seal())
+        return snaps
+
+    def _capture_snapshots(self) -> None:
+        """Periodic in-memory capture into the attached SnapshotStore
+        (the fleet's bounded-replay source). Put-then-trip: the
+        ``serving.snapshot`` fault site's ``poison`` action corrupts
+        the JUST-stored snapshot (the restore-side digest re-verify
+        must catch it); ``raise`` drops the capture — the previous
+        snapshot, or full replay, covers the request."""
+        store = self.snapshot_store
+        snaps = self._capture_requests()
+        if not snaps:
+            return
+        tr = self.tracer
+        for snap in snaps:
+            store.put(snap.rid, snap)
+            try:
+                _fault.trip("serving.snapshot", step=self._steps,
+                            path=snap.rid,
+                            poison=lambda rid=snap.rid: store.corrupt(rid))
+            except _fault.FaultInjected:
+                store.drop(snap.rid)
+                store.counters["snapshot_failed"] += 1
+                continue
+            if tr.enabled:
+                tr.instant("snapshot", track=snap.rid,
+                           tokens=len(snap.tokens),
+                           pages=len(snap.payloads))
+        store.counters["snapshots_captured"] += 1
+        self.metrics.on_snapshot_stats(store.stats())
 
     def attach_preemption_guard(self, guard=None):
         """Wire SIGTERM to a graceful drain: with a guard attached,
@@ -558,6 +786,8 @@ class ServingEngine:
                 "speculative": self._spec is not None,
                 "chunked": self.chunked,
                 "prefill_chunk": self.prefill_chunk,
+                "snapshots": self.snapshot_store is not None,
+                "snapshot_interval": self.snapshot_interval,
                 "tracing": self.tracer.enabled}
 
     # ------------------------------------------------------------------
@@ -630,6 +860,11 @@ class ServingEngine:
         self.metrics.on_outcome(reason)
         self.metrics.on_finish(req.rid, reason)
         self._trace_finish(req, reason)
+        if self.snapshot_store is not None and reason != "preempted":
+            # terminal here AND fleet-wide — but a "preempted" eviction
+            # is retry-elsewhere, and its snapshot is exactly what lets
+            # the retry be a bounded replay instead of a full one
+            self.snapshot_store.drop(req.rid)
         events.append({"rid": req.rid, "token": None, "finished": True,
                        "finish_reason": reason})
 
@@ -1251,6 +1486,9 @@ class ServingEngine:
             self.scheduler.finish(req, self.pool, reason)
             self.metrics.on_finish(req.rid, reason)
             self._trace_finish(req, reason)
+            if self.snapshot_store is not None:
+                # terminal: the store is bounded by LIVE requests
+                self.snapshot_store.drop(req.rid)
         events.append({"rid": req.rid, "token": token,
                        "finished": reason is not None,
                        "finish_reason": reason})

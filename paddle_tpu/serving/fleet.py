@@ -44,7 +44,13 @@ things a single engine cannot:
   bit-for-bit. Replay is possible precisely because faults land at step
   boundaries: a step either completes (its events were translated) or
   raises (no events), so ``emitted`` can never include a half-delivered
-  step.
+  step. With a shared :class:`~.snapshot.SnapshotStore` (the engines'
+  periodic captures), the replay is BOUNDED: the replacement replica
+  restores the request's KV and already-generated tokens from its
+  latest digest-verified snapshot and re-produces only the delta since
+  capture — a missing or corrupt snapshot silently degrades to the
+  full replay above (slower, never wrong; RESILIENCE.md "Serving
+  recovery playbook").
 
 The router never hangs: if every replica is DEAD (or zero placement
 progress persists past ``shed_patience`` router steps) the pending
@@ -153,7 +159,7 @@ class FleetRouter:
                  breaker_backoff_steps: int = 2,
                  breaker_backoff_max: int = 16,
                  shed_patience: int = _SHED_PATIENCE,
-                 clock=None, tracer=None):
+                 clock=None, tracer=None, snapshot_store=None):
         if not engines:
             raise ValueError("FleetRouter needs at least one engine")
         self._replicas = [_Replica(i, e) for i, e in enumerate(engines)]
@@ -172,6 +178,22 @@ class FleetRouter:
         self.fleet_metrics = FleetMetrics()
         self._records: dict[str, FleetRequest] = {}
         self._pending: list[FleetRequest] = []   # router queue, submit order
+        # bounded-replay failover (serving/snapshot.py): the shared
+        # store the replicas capture into — it models the off-replica
+        # durable medium, so a replica's death never takes its
+        # requests' snapshots with it. Auto-discovered from the engines
+        # when not passed explicitly; None -> every failover is a full
+        # replay from token 0 (the pre-snapshot behaviour).
+        self._snapshot_store = snapshot_store
+        if self._snapshot_store is None:
+            for e in engines:
+                store = getattr(e, "snapshot_store", None)
+                if store is not None:
+                    self._snapshot_store = store
+                    break
+        # rid -> ejection time: open recovery windows, closed by the
+        # first FRESH post-recovery token (time-to-first-recovered-token)
+        self._recovering: dict[str, float] = {}
         self._submit_seq = 0
         self._steps = 0
         self._idle_steps = 0
@@ -524,15 +546,47 @@ class FleetRouter:
         except Exception:  # noqa: BLE001 — affinity is best-effort only
             return 0
 
+    def _usable_snapshot(self, rec: FleetRequest):
+        """The record's latest VERIFIED snapshot, iff seeding from it
+        is provably safe: its token prefix must already be in the
+        client-delivered stream (len <= emitted, bitwise equal) —
+        seeded tokens are never re-emitted by the engine, so a token
+        beyond the delivered stream would silently vanish. Anything
+        else (missing, digest-corrupt, ahead of the client) returns
+        None and the failover degrades to full replay from token 0 —
+        slower, never wrong."""
+        store = self._snapshot_store
+        if store is None:
+            return None
+        snap = store.get(rec.rid)   # digest-re-verified; corrupt -> None
+        if snap is None:
+            return None
+        n = len(snap.tokens)
+        if n > rec.emitted or list(snap.tokens) != rec.tokens[:n]:
+            return None
+        return snap
+
     def _try_place(self, rec: FleetRequest, rep: _Replica,
                    events: list[dict]) -> bool:
+        # bounded-replay failover: a replayed record with a usable
+        # snapshot restores from it (KV injected, tokens seeded) and
+        # replays only the delta since capture; the seeded tokens flow
+        # through the SAME emitted-vs-produced dedup via the produced
+        # counter, so client streams stay exactly-once and bitwise
+        snap = self._usable_snapshot(rec) if rec.replays else None
+        restore = getattr(rep.engine, "restore_request", None)
+        if restore is None:
+            snap = None
         try:
             _fault.trip("fleet.dispatch", step=self._steps, path=rec.rid)
-            rep.engine.add_request(
-                rec.prompt, rec.max_new_tokens, sampling=rec.sampling,
-                eos_token_id=rec.eos_token_id, rid=rec.rid,
-                deadline_s=rec.deadline_s,
-                max_queue_wait_s=rec.max_queue_wait_s)
+            if snap is not None:
+                restore(snap)
+            else:
+                rep.engine.add_request(
+                    rec.prompt, rec.max_new_tokens, sampling=rec.sampling,
+                    eos_token_id=rec.eos_token_id, rid=rec.rid,
+                    deadline_s=rec.deadline_s,
+                    max_queue_wait_s=rec.max_queue_wait_s)
         except RequestTooLargeError:
             # cannot happen after submit-time admission_check on a
             # homogeneous fleet, but a duck-typed engine may disagree:
@@ -546,13 +600,26 @@ class FleetRouter:
             return False
         self._breaker_success(rep)
         rec.replica = rep.idx
-        rec.produced = 0
+        # the replica's first emission is token index len(snap.tokens):
+        # seeding produced keeps the dedup's position arithmetic exact
+        rec.produced = len(snap.tokens) if snap is not None else 0
+        if rec.replays:
+            fm = self.fleet_metrics
+            # THE bounded-vs-full A/B number: tokens this failover still
+            # re-produces (full replay pays the whole emitted count)
+            fm.bump("recovery_replayed_tokens", rec.emitted - rec.produced)
+            if snap is not None:
+                fm.bump("snapshot_restores")
+                fm.bump("recovery_restored_tokens", rec.produced)
+            elif self._snapshot_store is not None:
+                fm.bump("snapshot_fallbacks")
         self.metrics.on_admit(rec.rid)
         self.fleet_metrics.bump("dispatched")
         if rec.replays:
             self.fleet_metrics.bump("replayed_requests")
         self.tracer.instant("dispatch", track="fleet", rid=rec.rid,
-                            replica=rep.idx, replay=rec.replays)
+                            replica=rep.idx, replay=rec.replays,
+                            restored=rec.produced)
         return True
 
     # ------------------------------------------------------------------
@@ -614,6 +681,10 @@ class FleetRouter:
             rec.replica = None
             rec.produced = 0
             rec.replays += 1
+            # open the recovery window (closed by the first fresh
+            # token); a second ejection mid-recovery keeps the original
+            # start so TTFRT measures the whole client-visible gap
+            self._recovering.setdefault(rec.rid, self.metrics.now())
             self.fleet_metrics.bump("failovers")
             keys = [r.submit_seq for r in self._pending]
             self._pending.insert(
@@ -666,10 +737,17 @@ class FleetRouter:
                     rec.emitted += 1
                     rec.tokens.append(token)
                     self.metrics.on_token(rec.rid)
+                    t0 = self._recovering.pop(rec.rid, None)
+                    if t0 is not None:
+                        # first FRESH token after a failover: close the
+                        # time-to-first-recovered-token window
+                        self.fleet_metrics.observe_recovery(
+                            self.metrics.now() - t0)
             if ev.get("finished"):
                 reason = ev.get("finish_reason")
                 rec.finished = True
                 rec.finish_reason = reason
+                self._recovering.pop(rec.rid, None)
                 self.metrics.on_finish(rec.rid, reason)
                 if reason not in ("stop", "length"):
                     self.metrics.on_outcome(reason)
@@ -728,3 +806,9 @@ class FleetRouter:
     @property
     def engines(self):
         return [rep.engine for rep in self._replicas]
+
+    @property
+    def snapshot_store(self):
+        """The shared bounded-replay snapshot store (None = every
+        failover is a full replay)."""
+        return self._snapshot_store

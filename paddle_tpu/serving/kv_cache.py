@@ -181,6 +181,11 @@ class KVCachePool:
         self._tier_tag = "int8" if quantized else str(jnp.dtype(self.dtype))
         # LIFO free list, page 0 reserved (scratch)
         self._free = list(range(num_pages - 1, 0, -1))
+        # pages known to hold all-zero content: everything at
+        # construction, re-added by scrub(), dropped at handout or any
+        # host-payload write. audit()'s scrubbed-means-zero check reads
+        # the device content of (free ∩ scrubbed) pages against this.
+        self._scrubbed: set[int] = set(range(1, num_pages))
         self._peak_in_use = 0
         # fault-draw step context for the serving.alloc site, advanced by
         # the engine once per step — without it, probabilistic specs
@@ -332,6 +337,7 @@ class KVCachePool:
         pages = [self._free.pop() for _ in range(n)]
         for p in pages:
             self._ref[p] = 1
+            self._scrubbed.discard(p)
         self._peak_in_use = max(self._peak_in_use, self.num_in_use)
         return pages
 
@@ -603,10 +609,32 @@ class KVCachePool:
                     parts.append(arr[page])
         return [np.asarray(x) for x in jax.device_get(parts)]
 
+    def export_pages(self, pages: list[int]) -> list[list[np.ndarray]]:
+        """Export many pages' payloads with ONE batched device_get:
+        returns one ``_page_payload``-format array list per page, in
+        input order. This is the snapshot capture primitive
+        (serving/snapshot.py) — a host-side transfer outside every
+        compiled program, so ``step_program_counts()`` is untouched."""
+        if not pages:
+            return []
+        parts = []
+        for page in pages:
+            for pk, pv in self.pools:
+                for arr in (pk, pv):
+                    if isinstance(arr, QuantizedKV):
+                        parts.append(arr.q[page])
+                        parts.append(arr.scale[page])
+                    else:
+                        parts.append(arr[page])
+        flat = [np.asarray(x) for x in jax.device_get(parts)]
+        k = len(flat) // len(pages)
+        return [flat[i * k:(i + 1) * k] for i in range(len(pages))]
+
     def _write_host_page(self, page: int, arrays) -> None:
         """device_put a host payload back into HBM page ``page`` (the
         inverse of ``_page_payload``, bit-exact: get/put round-trips
         bf16, fp32 and int8 bytes unchanged)."""
+        self._scrubbed.discard(page)
         it = iter(arrays)
         new_pools = []
         for pk, pv in self.pools:
@@ -727,6 +755,57 @@ class KVCachePool:
                             bytes=nbytes, partial=True)
         self.tracer.bump("restores", 1, track="pool")
 
+    def inject_prefix(self, tokens, payloads) -> int:
+        """Write externally-held page payloads (a request snapshot —
+        serving/snapshot.py) into the pool and register them under the
+        chained content hash as refcount-0 CACHED pages, exactly as if
+        a request with this prefix had just released them. Page i of
+        ``payloads`` holds ``tokens[i*ps:(i+1)*ps]`` in
+        ``_page_payload`` format; a trailing partial page (0 < q < ps
+        tokens, zeros beyond) lands in the partial index. The ordinary
+        admission path (``match_prefix`` + ``acquire`` + COW) then maps
+        them — restore needs no new engine machinery, and an injected
+        page LRU-evicted before its request re-admits degrades to a
+        plain recompute, never a wrong token. First writer wins:
+        content already indexed keeps its resident page (those tokens
+        still count as injected — they are matchable). Stops early on
+        pool exhaustion. Returns the matchable token count."""
+        if not self.cache_enabled:
+            return 0
+        ps = self.page_size
+        n_full = len(tokens) // ps
+        parent = self._hash_root
+        injected = 0
+        for i in range(min(n_full, len(payloads))):
+            key = _page_hash(parent, tokens[i * ps:(i + 1) * ps])
+            if key not in self._full_index:
+                try:
+                    page = self.alloc(1)[0]
+                except PoolExhaustedError:
+                    return injected
+                self._write_host_page(page, payloads[i])
+                self._full_index[key] = page
+                self._page_key[page] = ("full", key)
+                self.counters["prefix_pages_registered"] += 1
+                self.release([page])   # registered + refcount 0 -> LRU
+            parent = key
+            injected += ps
+        q = len(tokens) - n_full * ps
+        if 0 < q < ps and n_full < len(payloads):
+            key = _page_hash(parent, tokens[n_full * ps:])
+            if key not in self._partial_index:
+                try:
+                    page = self.alloc(1)[0]
+                except PoolExhaustedError:
+                    return injected
+                self._write_host_page(page, payloads[n_full])
+                self._partial_index[key] = page
+                self._page_key[page] = ("partial", key)
+                self.counters["prefix_pages_registered"] += 1
+                self.release([page])
+            injected += q
+        return injected
+
     # ---- device-side page ops ----
 
     def cow_into(self, src: int, dst: int) -> None:
@@ -746,6 +825,7 @@ class KVCachePool:
         idx = jnp.asarray(sorted(set(pages)), jnp.int32)
         self.pools = [(_page_zero(pk, idx), _page_zero(pv, idx))
                       for pk, pv in self.pools]
+        self._scrubbed.update(int(p) for p in pages)
 
     def rewind(self, pages: list[int], start: int, stop: int) -> None:
         """Zero cache POSITIONS ``[start, stop)`` of a request's block
@@ -779,3 +859,119 @@ class KVCachePool:
             return QuantizedKV(arr.q.at[pages, offs].set(0),
                                arr.scale.at[pages, offs].set(0))
         return arr.at[pages, offs].set(0)
+
+    # ---- invariant audit ----
+
+    def audit(self, block_tables=None, check_device: bool = True) -> dict:
+        """Invariant checker for the pool's host-side accounting —
+        called from serving test teardowns and the faults-marked chaos
+        suites, so every chaos scenario proves it left the pool
+        consistent, not just that the streams came out right. Raises
+        AssertionError listing every violated invariant:
+
+        - free-list hygiene: no duplicates, never the scratch page,
+          disjoint from held (refcount > 0) and cached (LRU) pages;
+        - conservation: free ∪ cached ∪ held covers every allocatable
+          page exactly once;
+        - refcounts: strictly positive, and — given ``block_tables``
+          (one page list per live request) — equal to the number of
+          holders per page, with no held page missing a holder;
+        - index agreement: ``_page_key`` and the full/partial indexes
+          are exact inverses, an indexed page is never free, an LRU
+          page is always registered, and a quarantined (scrub-on-zero)
+          page is held and never indexed;
+        - scrubbed-means-zero (``check_device``): every free page the
+          pool believes it scrubbed reads back all-zero on device —
+          codes AND scales in int8 mode (a NaN can't hide: NaN != 0).
+
+        Returns a small accounting dict when everything holds."""
+        problems: list[str] = []
+        free_list = self._free
+        free = set(free_list)
+        cached = set(self._lru)
+        held = set(self._ref)
+        all_pages = set(range(1, self.num_pages))
+        if len(free) != len(free_list):
+            problems.append("duplicate pages on the free list")
+        if 0 in free or 0 in cached or 0 in held:
+            problems.append("scratch page 0 entered the accounting")
+        for a, b, name in ((free, cached, "free∩cached"),
+                           (free, held, "free∩held"),
+                           (cached, held, "cached∩held")):
+            both = a & b
+            if both:
+                problems.append(f"{name} not disjoint: {sorted(both)}")
+        union = free | cached | held
+        if union != all_pages:
+            missing = sorted(all_pages - union)
+            extra = sorted(union - all_pages)
+            problems.append(f"page conservation broken: leaked={missing} "
+                            f"phantom={extra}")
+        for p, r in self._ref.items():
+            if r <= 0:
+                problems.append(f"page {p} held with refcount {r} <= 0")
+        if block_tables is not None:
+            holders: dict[int, int] = {}
+            for table in block_tables:
+                for p in table:
+                    holders[p] = holders.get(p, 0) + 1
+            for p, r in self._ref.items():
+                if holders.get(p, 0) != r:
+                    problems.append(
+                        f"page {p} refcount {r} != {holders.get(p, 0)} "
+                        f"block-table holders")
+            for p in holders:
+                if p not in self._ref:
+                    problems.append(
+                        f"page {p} appears in a block table but holds "
+                        f"no reference")
+        for page, (kind, key) in self._page_key.items():
+            index = (self._full_index if kind == "full"
+                     else self._partial_index)
+            if index.get(key) != page:
+                problems.append(
+                    f"page {page} claims {kind} key {key.hex()[:8]} but "
+                    f"the index maps it to {index.get(key)}")
+            if page in free:
+                problems.append(f"registered page {page} is on the "
+                                f"free list")
+        for kind, index in (("full", self._full_index),
+                            ("partial", self._partial_index)):
+            for key, page in index.items():
+                if self._page_key.get(page) != (kind, key):
+                    problems.append(
+                        f"{kind} index entry {key.hex()[:8]} -> {page} "
+                        f"has no matching _page_key back-pointer")
+        for p in cached:
+            if p not in self._page_key:
+                problems.append(f"cached (LRU) page {p} is not "
+                                f"registered in any index")
+        for p in self._scrub_on_zero:
+            if p not in held:
+                problems.append(f"scrub-on-zero page {p} has no holder "
+                                f"(should have been scrubbed+freed)")
+            if p in self._page_key:
+                problems.append(f"quarantined page {p} is still in the "
+                                f"prefix index")
+        if check_device:
+            zeroed = sorted(free & self._scrubbed)
+            if zeroed:
+                idx = jnp.asarray(zeroed, jnp.int32)
+                for li, (pk, pv) in enumerate(self.pools):
+                    for name, arr in (("k", pk), ("v", pv)):
+                        if isinstance(arr, QuantizedKV):
+                            ok = (bool(jnp.all(arr.q[idx] == 0))
+                                  and bool(jnp.all(arr.scale[idx] == 0)))
+                        else:
+                            ok = bool(jnp.all(arr[idx] == 0))
+                        if not ok:
+                            problems.append(
+                                f"scrubbed free page holds nonzero "
+                                f"{name} content in layer {li}")
+                    if problems and problems[-1].startswith("scrubbed"):
+                        break   # one layer's evidence is enough
+        if problems:
+            raise AssertionError(
+                "KV pool audit failed:\n- " + "\n- ".join(problems))
+        return {"pages": self.num_pages - 1, "free": len(free),
+                "cached": len(cached), "held": len(held)}

@@ -60,6 +60,20 @@ class FleetMetrics:
     - ``breaker_opens``     circuit-breaker CLOSED/HALF_OPEN -> OPEN edges
     - ``probes``            OPEN -> HALF_OPEN probe windows
 
+    Bounded-replay failover (serving/snapshot.py; RESILIENCE.md
+    "Serving recovery playbook") adds:
+
+    - ``snapshot_restores``        failover placements seeded from a
+      verified snapshot (bounded replay) instead of token 0
+    - ``snapshot_fallbacks``       failover placements that wanted a
+      snapshot but fell back to full replay (missing/corrupt/unusable)
+    - ``recovery_restored_tokens`` tokens skipped by snapshot seeding
+    - ``recovery_replayed_tokens`` delta tokens each failover still has
+      to re-produce (emitted - seeded; the full-replay arm pays the
+      whole emitted count here) — THE bounded-vs-full A/B number
+    - ``recovery_ttfrt_p50_s`` / ``_p99_s`` (summary only): ejection ->
+      first FRESH post-recovery token, via :meth:`observe_recovery`
+
     Client-visible latency/goodput lives on the router's own
     :class:`ServingMetrics`, not here — this bag is pure fleet-control
     accounting."""
@@ -69,13 +83,25 @@ class FleetMetrics:
             "dispatched": 0, "failovers": 0, "replayed_requests": 0,
             "replayed_tokens": 0, "shed": 0, "ejections": 0,
             "breaker_opens": 0, "probes": 0,
+            "snapshot_restores": 0, "snapshot_fallbacks": 0,
+            "recovery_restored_tokens": 0, "recovery_replayed_tokens": 0,
         }
+        # time-to-first-recovered-token samples: ejection -> the first
+        # token beyond the request's pre-failover stream
+        self.recovery_latency_s: list[float] = []
 
     def bump(self, key: str, n: int = 1) -> None:
         self.counters[key] += n
 
+    def observe_recovery(self, dt: float) -> None:
+        self.recovery_latency_s.append(float(dt))
+
     def summary(self) -> dict:
-        return dict(self.counters)
+        return {**self.counters,
+                "recovery_ttfrt_p50_s": percentile(
+                    self.recovery_latency_s, 50),
+                "recovery_ttfrt_p99_s": percentile(
+                    self.recovery_latency_s, 99)}
 
 
 class ServingMetrics:
@@ -105,6 +131,12 @@ class ServingMetrics:
             "rejected_queue_full": 0, "rejected_too_large": 0,
             "timed_out": 0, "quarantined": 0, "preempted_limit": 0,
             "drained": 0, "injected": 0,
+            # crash-consistent snapshots (serving/snapshot.py):
+            # engine-side restore/save outcomes; the store's own
+            # capture counters are mirrored in via on_snapshot_stats
+            "snapshot_restores": 0, "snapshot_restored_tokens": 0,
+            "snapshot_restore_failed": 0, "snapshot_restore_corrupt": 0,
+            "snapshot_saves": 0,
         }
         # prefix-cache accounting (SERVING.md "Prefix caching"):
         # per-admission token totals accumulate here; the pool's page
@@ -146,6 +178,11 @@ class ServingMetrics:
         # partially-prefilled requests were in flight at the last step.
         # Schema-stable zeros with chunking off.
         self.chunked_enabled = 0
+        # crash-consistent snapshots (serving/snapshot.py): the flag
+        # gauge plus a mirror of SnapshotStore.stats() refreshed at
+        # each capture — schema-stable zeros with snapshots off
+        self.snapshots_enabled = 0
+        self._snapshot_stats: dict[str, int] = {}
         self._mixed_steps = 0
         self._chunk_tokens = 0
         self._chunks_dispatched = 0
@@ -332,6 +369,18 @@ class ServingMetrics:
         """Arm the chunked_enabled gauge (int, for Prometheus export)."""
         self.chunked_enabled = int(bool(enabled))
 
+    # ---- crash-consistent snapshots (serving/snapshot.py) ----
+
+    def set_snapshots(self, enabled: bool) -> None:
+        """Arm the snapshots_enabled gauge (int, for Prometheus)."""
+        self.snapshots_enabled = int(bool(enabled))
+
+    def on_snapshot_stats(self, stats: dict) -> None:
+        """Mirror the snapshot store's capture gauges
+        (SnapshotStore.stats()) into the summary — called by the
+        engine after each periodic capture."""
+        self._snapshot_stats = dict(stats)
+
     def on_mixed_step(self, prefill_tokens: int, decode_slots: int,
                       chunk_slots: int, in_flight: int) -> None:
         """One mixed-step dispatch: ``prefill_tokens`` prompt-chunk
@@ -401,6 +450,7 @@ class ServingMetrics:
         return sum(self._n_tokens.values())
 
     def summary(self) -> dict:
+        from .snapshot import SnapshotStore as _SnapshotStore
         from .tiering import HostTier as _HostTier
         ttft = self.ttfts()
         tpot = self.tpots()
@@ -466,6 +516,10 @@ class ServingMetrics:
             "tier_host_hit_rate": tier_rates["host"],
             "tier_miss_rate": tier_rates["miss"],
             **{**_HostTier.zero_stats(), **self._tier_stats},
+            # crash-consistent snapshots (schema-stable: zeros with
+            # snapshotting off; the store's keys are snapshot_-prefixed)
+            "snapshots_enabled": self.snapshots_enabled,
+            **{**_SnapshotStore.zero_stats(), **self._snapshot_stats},
             # pool counters live under prefix_* so they can never
             # shadow a summary key (the pool already uses that prefix
             # for most of them — normalise the stragglers)

@@ -1,0 +1,359 @@
+"""Crash-consistent snapshots of live serving-engine state.
+
+The fleet (fleet.py) recovers a dead replica by deterministically
+replaying every live request from token 0 — bitwise-correct, but the
+recovery cost grows with context length, which is exactly wrong for
+heavy-tailed long-prompt traffic. This module bounds it: every
+``snapshot_interval`` engine steps the engine captures, per live
+request, the minimal state that makes the request resumable —
+
+- the request identity and sampling recipe (rid, prompt, seed,
+  temperature/top_p/do_sample, max_new_tokens, eos, arrival order),
+- the tokens generated so far and the materialized ``context_len``,
+- the request's KV pages exported in the HostTier payload format
+  (``[k0, v0, k1, v1, ...]``; int8 pools interleave codes and scales),
+
+each payload guarded by a blake2b-128 digest and the metadata by its
+own digest. Capture happens on the HOST side of the step via one
+batched ``device_get`` — never inside a compiled program — so the
+engine's no-retrace contract (``step_program_counts() == {"decode": 1,
+"mixed": 1}``) is untouched.
+
+Two consumers:
+
+1. **Bounded-replay failover** — on replica ejection the router asks
+   the :class:`SnapshotStore` (shared across the fleet) for each live
+   request's latest snapshot, restores the KV via
+   ``KVCachePool.inject_prefix`` on the surviving replica and replays
+   only the delta tokens since capture. The existing emitted-vs-
+   produced dedup keeps client streams bitwise equal to a single-
+   engine run; a corrupt or missing snapshot is digest-detected and
+   falls back to full replay — never wrong tokens.
+
+2. **Warm engine restart** — ``save_engine_snapshot`` /
+   ``load_engine_snapshot`` persist the same records to disk through
+   the PR 1 checkpoint commit protocol (stage into ``<path>.tmp``,
+   write ``COMMIT``, rename), so a SIGKILLed process can come back
+   with ``ServingEngine.restore(path)`` and continue every in-flight
+   stream bitwise. A torn (uncommitted) directory is rejected with
+   :class:`CheckpointCorruptionError`; a corrupted page payload is
+   detected per-digest and only costs that request its zero-recompute
+   restore, not its correctness.
+
+Determinism makes the whole scheme cheap: a snapshot does NOT need the
+RNG state or the decode logits — ``seed`` + token index reproduce every
+sample, so the only expensive thing worth saving is the KV, and even
+that is an optimisation (losing it costs recompute, never wrongness).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..distributed.checkpoint.save_load import (COMMIT_MARKER,
+                                                CheckpointCorruptionError,
+                                                _staging, is_committed)
+from .tiering import _payload_digest
+
+__all__ = ["RequestSnapshot", "SnapshotStore",
+           "save_engine_snapshot", "load_engine_snapshot"]
+
+_STATE_FILE = "state.json"
+_PAGES_FILE = "pages.npz"
+
+
+@dataclass
+class RequestSnapshot:
+    """Everything needed to resume one request bitwise, captured at a
+    step boundary (so ``context_len`` tokens are materialized in the
+    payload pages and position ``context_len`` onward is zeros)."""
+
+    rid: object
+    prompt: list
+    max_new_tokens: int
+    eos_token_id: object
+    temperature: float
+    top_p: float
+    do_sample: bool
+    seed: int
+    arrival_seq: int
+    tokens: list = field(default_factory=list)   # generated so far
+    context_len: int = 0
+    step: int = 0                                # engine step at capture
+    kv_tag: str = ""                             # pool storage format
+    page_size: int = 0
+    payloads: list = field(default_factory=list)  # per-page HostTier format
+    page_digests: list = field(default_factory=list)
+    meta_digest: bytes = b""
+
+    # ---- integrity ----
+
+    def _meta_bytes(self) -> bytes:
+        rec = [str(self.rid), list(self.prompt), list(self.tokens),
+               int(self.max_new_tokens),
+               None if self.eos_token_id is None else int(self.eos_token_id),
+               float(self.temperature), float(self.top_p),
+               bool(self.do_sample), int(self.seed), int(self.arrival_seq),
+               int(self.context_len), int(self.step), self.kv_tag,
+               int(self.page_size)]
+        return json.dumps(rec).encode()
+
+    def seal(self) -> "RequestSnapshot":
+        """Compute the digests over the current content. Call once,
+        right after capture — everything after that is verification."""
+        self.page_digests = [_payload_digest(p) for p in self.payloads]
+        self.meta_digest = _payload_digest([np.frombuffer(
+            self._meta_bytes(), np.uint8)])
+        return self
+
+    def verify_meta(self) -> bool:
+        return self.meta_digest == _payload_digest(
+            [np.frombuffer(self._meta_bytes(), np.uint8)])
+
+    def verify_payloads(self) -> bool:
+        if len(self.page_digests) != len(self.payloads):
+            return False
+        return all(_payload_digest(p) == d
+                   for p, d in zip(self.payloads, self.page_digests))
+
+    def verify(self) -> bool:
+        return self.verify_meta() and self.verify_payloads()
+
+    # ---- derived ----
+
+    def seq(self) -> list:
+        """The materialized token sequence the payload pages hold —
+        exactly ``context_len`` tokens of ``prompt + tokens`` (a
+        decoding request's last generated token is sampled but not yet
+        attended, hence the truncation)."""
+        return (list(self.prompt) + list(self.tokens))[:self.context_len]
+
+    @property
+    def nbytes(self) -> int:
+        return sum(a.nbytes for p in self.payloads for a in p)
+
+    def corrupt(self) -> None:
+        """Deterministic corruption hook for the ``serving.snapshot`` /
+        ``serving.snapshot_restore`` fault sites' ``poison`` action:
+        flip one byte WITHOUT updating the digests, so the next verify
+        must detect it. Prefers the first payload array (exercising the
+        page-digest ladder); a payload-less snapshot gets a flipped
+        token so the meta digest trips instead."""
+        if self.payloads and self.payloads[0]:
+            a = self.payloads[0][0]
+            flat = np.frombuffer(np.ascontiguousarray(a).tobytes(),
+                                 np.uint8).copy()
+            if flat.size == 0:
+                return
+            flat[0] ^= 0xFF
+            self.payloads[0][0] = np.frombuffer(
+                flat.tobytes(), a.dtype).reshape(a.shape)
+        elif self.tokens:
+            self.tokens[0] = int(self.tokens[0]) ^ 1
+        else:
+            self.prompt[0] = int(self.prompt[0]) ^ 1
+
+
+class SnapshotStore:
+    """In-memory latest-snapshot-per-request store, shared by every
+    replica in a fleet (it models the off-replica durable medium — a
+    replica's death must not take its requests' snapshots with it).
+    ``get`` re-verifies digests so a snapshot corrupted after capture
+    (bit rot, or the poison fault action) is dropped and counted, and
+    the caller falls back to full replay."""
+
+    def __init__(self):
+        self._snaps: dict = {}
+        self.counters: dict[str, int] = {
+            "snapshots_captured": 0,     # capture rounds completed
+            "snapshot_requests": 0,      # per-request snapshots stored
+            "snapshot_pages": 0,         # pages exported, cumulative
+            "snapshot_bytes": 0,         # payload bytes, cumulative
+            "snapshot_failed": 0,        # captures dropped by a fault
+            "snapshot_corrupt_detected": 0,
+            "snapshot_hits": 0,
+            "snapshot_misses": 0,
+        }
+
+    # ---- accounting ----
+
+    @property
+    def num_snapshots(self) -> int:
+        return len(self._snaps)
+
+    def stats(self) -> dict:
+        return {"snapshot_live": len(self._snaps), **self.counters}
+
+    @staticmethod
+    def zero_stats() -> dict:
+        """The ``stats()`` key set, all zero — what an engine WITHOUT
+        snapshots reports, so the metrics schema never depends on
+        whether snapshotting is enabled."""
+        return {"snapshot_live": 0,
+                "snapshots_captured": 0, "snapshot_requests": 0,
+                "snapshot_pages": 0, "snapshot_bytes": 0,
+                "snapshot_failed": 0, "snapshot_corrupt_detected": 0,
+                "snapshot_hits": 0, "snapshot_misses": 0}
+
+    # ---- the capture / restore surface ----
+
+    def put(self, rid, snap: RequestSnapshot) -> None:
+        """Store a request's latest snapshot (replacing any older one —
+        failover only ever wants the newest verified state)."""
+        self._snaps[rid] = snap
+        self.counters["snapshot_requests"] += 1
+        self.counters["snapshot_pages"] += len(snap.payloads)
+        self.counters["snapshot_bytes"] += snap.nbytes
+
+    def get(self, rid):
+        """The request's latest snapshot, digest-re-verified, or None.
+        A corrupt snapshot is dropped and counted — the caller falls
+        back to full replay (wrong tokens are never worth a shortcut)."""
+        snap = self._snaps.get(rid)
+        if snap is None:
+            self.counters["snapshot_misses"] += 1
+            return None
+        if not snap.verify():
+            del self._snaps[rid]
+            self.counters["snapshot_corrupt_detected"] += 1
+            return None
+        self.counters["snapshot_hits"] += 1
+        return snap
+
+    def drop(self, rid) -> None:
+        """Forget a request (called when it finishes — the store is
+        bounded by live requests, not by history)."""
+        self._snaps.pop(rid, None)
+
+    def corrupt(self, rid) -> None:
+        """Poison hook for the fault sites: corrupt the stored snapshot
+        in place (no-op on a missing rid — the fault can race a
+        finish)."""
+        snap = self._snaps.get(rid)
+        if snap is not None:
+            snap.corrupt()
+
+    def clear(self) -> None:
+        self._snaps.clear()
+
+
+# ---- durable (warm-restart) persistence ----
+
+
+def save_engine_snapshot(path: str, snaps: list, meta: dict | None = None
+                         ) -> str:
+    """Persist request snapshots through the checkpoint commit protocol
+    (RESILIENCE.md): stage into ``<path>.tmp``, write ``state.json``
+    (metadata + digests) and ``pages.npz`` (every payload array), then
+    the ``COMMIT`` marker, then rename. A crash at any earlier point
+    leaves a staging dir that ``load_engine_snapshot`` rejects."""
+    stage = _staging(path)
+    if os.path.exists(stage):
+        shutil.rmtree(stage)
+    os.makedirs(stage)
+    records = []
+    arrays = {}
+    for i, s in enumerate(snaps):
+        records.append({
+            "rid": s.rid, "prompt": list(map(int, s.prompt)),
+            "tokens": list(map(int, s.tokens)),
+            "max_new_tokens": int(s.max_new_tokens),
+            "eos_token_id": (None if s.eos_token_id is None
+                             else int(s.eos_token_id)),
+            "temperature": float(s.temperature), "top_p": float(s.top_p),
+            "do_sample": bool(s.do_sample), "seed": int(s.seed),
+            "arrival_seq": int(s.arrival_seq),
+            "context_len": int(s.context_len), "step": int(s.step),
+            "kv_tag": s.kv_tag, "page_size": int(s.page_size),
+            "pages": [len(p) for p in s.payloads],
+            # npz cannot round-trip extension dtypes (bfloat16): store
+            # each array as a raw uint8 view plus its dtype name, and
+            # re-view on load — same bytes, so digests are unaffected
+            "dtypes": [[str(np.asarray(a).dtype) for a in p]
+                       for p in s.payloads],
+            "page_digests": [d.hex() for d in s.page_digests],
+            "meta_digest": s.meta_digest.hex(),
+        })
+        for j, payload in enumerate(s.payloads):
+            for k, a in enumerate(payload):
+                arrays[f"r{i}_p{j}_a{k}"] = \
+                    np.ascontiguousarray(a).view(np.uint8)
+    state = {"version": 1, "meta": meta or {}, "requests": records}
+    with open(os.path.join(stage, _STATE_FILE), "w") as f:
+        json.dump(state, f)
+    np.savez(os.path.join(stage, _PAGES_FILE), **arrays)
+    with open(os.path.join(stage, COMMIT_MARKER), "w") as f:
+        f.write("ok\n")
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    os.replace(stage, path)
+    return path
+
+
+def load_engine_snapshot(path: str):
+    """Load a committed snapshot dir. Returns ``(snaps, meta)`` with
+    snapshots ordered by arrival_seq (so re-admission preserves the
+    original arrival order and therefore the scheduler's FCFS choices).
+
+    The fallback ladder (RESILIENCE.md "Serving recovery playbook"):
+    a torn / uncommitted / unreadable dir raises
+    :class:`CheckpointCorruptionError` (there is nothing safe to
+    resume); a request whose META digest fails also raises (identity
+    bytes are unverifiable, resuming could emit wrong tokens); a
+    request whose PAGE digest fails only loses its payloads — the
+    snapshot degrades to meta-only and the engine recomputes that KV,
+    still bitwise."""
+    if not is_committed(path):
+        raise CheckpointCorruptionError(
+            f"serving snapshot at {path!r} is torn or uncommitted")
+    try:
+        with open(os.path.join(path, _STATE_FILE)) as f:
+            state = json.load(f)
+        npz = np.load(os.path.join(path, _PAGES_FILE))
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        raise CheckpointCorruptionError(
+            f"serving snapshot at {path!r} is unreadable: {e}") from e
+    snaps = []
+    dropped_payloads = 0
+    for i, rec in enumerate(state["requests"]):
+        try:
+            # NpzFile reads lazily — a bad CRC / short member surfaces
+            # HERE, not at np.load; treat it like a failed page digest
+            payloads = [[np.asarray(npz[f"r{i}_p{j}_a{k}"])
+                         .view(np.dtype(rec["dtypes"][j][k]))
+                         for k in range(n)]
+                        for j, n in enumerate(rec["pages"])]
+        except Exception:
+            payloads = None
+        s = RequestSnapshot(
+            rid=rec["rid"], prompt=list(rec["prompt"]),
+            max_new_tokens=rec["max_new_tokens"],
+            eos_token_id=rec["eos_token_id"],
+            temperature=rec["temperature"], top_p=rec["top_p"],
+            do_sample=rec["do_sample"], seed=rec["seed"],
+            arrival_seq=rec["arrival_seq"],
+            tokens=list(rec["tokens"]), context_len=rec["context_len"],
+            step=rec["step"], kv_tag=rec["kv_tag"],
+            page_size=rec["page_size"], payloads=payloads or [],
+            page_digests=[bytes.fromhex(d) for d in rec["page_digests"]],
+            meta_digest=bytes.fromhex(rec["meta_digest"]))
+        if not s.verify_meta():
+            raise CheckpointCorruptionError(
+                f"serving snapshot request {s.rid!r} failed metadata "
+                f"digest verification")
+        if payloads is None or not s.verify_payloads():
+            # page bytes are damaged but the identity is intact: degrade
+            # to meta-only (recompute path) rather than refusing resume
+            s.payloads = []
+            s.page_digests = []
+            dropped_payloads += 1
+        snaps.append(s)
+    snaps.sort(key=lambda s: s.arrival_seq)
+    meta = dict(state.get("meta") or {})
+    meta["corrupt_payloads_dropped"] = dropped_payloads
+    return snaps, meta

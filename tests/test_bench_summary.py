@@ -16,7 +16,7 @@ _DEFAULT_CONFIGS = {
     "llama8b_shape", "llama_decode", "llama_longctx", "llama_serving",
     "llama_serving_prefix", "llama_decode_int8", "llama_serving_int8",
     "llama_serving_fleet", "llama_serving_spec", "llama_serving_tiered",
-    "llama_serving_chunked",
+    "llama_serving_chunked", "llama_serving_failover",
 }
 
 
@@ -137,6 +137,28 @@ def test_dry_fleet_cell_carries_failover_keys():
                          "failovers", "replayed_tokens", "shed",
                          "replicas_ejected",
                          "goodput_at_slo", "retraces"}, cell
+    assert all(v is None for v in cell.values()), cell
+
+
+def test_dry_failover_cell_carries_replay_ab_keys():
+    # the bounded-replay A/B (RESILIENCE.md "Serving recovery
+    # playbook"): the cell must surface the replay-work evidence for
+    # BOTH arms — the full-replay arm's replayed_tokens vs the snapshot
+    # arm's restored/replayed split and its restore/fallback counts —
+    # plus goodput_at_slo for both arms, next to the usual serving keys
+    out = _run_dry("llama_serving_failover")
+    assert out.returncode == 0, out.stderr
+    last = json.loads(out.stdout.splitlines()[-1])
+    cell = last["bench_summary"]["llama_serving_failover"]
+    assert set(cell) >= {"value", "mfu", "spread",
+                         "ttft_p50", "ttft_p99", "tpot",
+                         "failovers",
+                         "replayed_tokens", "replayed_tokens_full",
+                         "snapshot_restores", "snapshot_fallbacks",
+                         "recovery_restored_tokens",
+                         "recovery_replayed_tokens",
+                         "goodput_at_slo", "goodput_at_slo_full",
+                         "retraces"}, cell
     assert all(v is None for v in cell.values()), cell
 
 

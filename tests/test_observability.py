@@ -291,6 +291,7 @@ class TestEngineTracing:
         assert payload["reason"] == "scheduler_stalled"
         assert payload["histogram"]["admit_rollback"] >= 1
         assert payload["snapshot"]["idle_steps"] >= 1
+        eng.audit_pool()
 
     def test_drain_dumps_outcomes(self, model, tmp_path):
         tr = Tracer()

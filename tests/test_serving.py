@@ -489,10 +489,12 @@ class TestServingRobustness:
         assert out[0]["finish_reason"] == "length"
         assert out[0]["tokens"] == _reference(model, prompts[0], 4)
         assert out[1] == {"tokens": [], "finish_reason": "rejected",
-                          "error": "too_large"}
+                          "error": "too_large", "retryable": False}
         assert out[2]["error"] is None
+        # queue_full is the retryable outcome: nothing was computed, the
+        # same prompt succeeds once the queue drains (SERVING.md)
         assert out[3] == {"tokens": [], "finish_reason": "rejected",
-                          "error": "queue_full"}
+                          "error": "queue_full", "retryable": True}
 
 
 @pytest.mark.faults
@@ -527,6 +529,7 @@ class TestServingChaos:
         for pk, pv in eng.pool.pools:
             assert bool(jnp.all(jnp.isfinite(pk.astype(jnp.float32))))
             assert bool(jnp.all(jnp.isfinite(pv.astype(jnp.float32))))
+        eng.audit_pool()
 
     def test_injected_prefill_failure_is_classified(self, model, fault_free):
         fault.activate(fault.FaultPlan([
@@ -542,6 +545,7 @@ class TestServingChaos:
         assert len(res[ok]) == 4
         assert eng.metrics.summary()["injected"] == 1
         assert eng.pool.num_in_use == 0
+        eng.audit_pool()
 
     def test_alloc_storm_preempts_but_stays_deterministic(self, model,
                                                           fault_free):
@@ -563,6 +567,7 @@ class TestServingChaos:
         for rid, ref in zip(rids, refs):
             assert res[rid] == ref
         assert eng.decode_program_count() == 1
+        eng.audit_pool()
 
     def test_acceptance_chaos_storm(self, model, fault_free):
         """ISSUE.md acceptance: NaN poison + pool-exhaustion storm +
@@ -612,6 +617,7 @@ class TestServingChaos:
         assert eng.pool.num_in_use == 0
         m = eng.metrics.summary()
         assert m["quarantined"] == 1 and m["drained"] >= 1
+        eng.audit_pool()
 
 
 # ---------------------------------------------------------------------------
@@ -975,6 +981,7 @@ class TestPrefixCacheChaos:
             assert bool(jnp.all(jnp.isfinite(pk.astype(jnp.float32))))
             assert bool(jnp.all(jnp.isfinite(pv.astype(jnp.float32))))
         assert eng.decode_program_count() == 1
+        eng.audit_pool()
 
 
 # ---------------------------------------------------------------------------

@@ -397,3 +397,72 @@ class TestHeavyTailWorkload:
         out = wl.replay(eng, max_steps=400)
         assert out["submitted"] + out["shed"] == 6
         assert eng.step_program_counts() == {"decode": 1, "mixed": 1}
+
+
+# ---------------------------------------------------------------------------
+# drain / failover arriving MID-CHUNK on a prefilling slot
+# ---------------------------------------------------------------------------
+
+class TestMidChunkDrain:
+    def _mid_chunk(self, eng, rid):
+        """Step until ``rid`` is mid-prompt: some chunks consumed, the
+        final chunk not yet dispatched."""
+        guard = 0
+        while True:
+            req = eng.request(rid)
+            if req.prefilling and req.context_len > 0:
+                return req
+            eng.step()
+            guard += 1
+            assert guard < 50, "never observed a mid-chunk slot"
+
+    def test_drain_mid_chunk_stops_at_boundary_registers_nothing(
+            self, model, fault_free):
+        """SIGTERM between chunk steps: the drain preempts the slot at
+        the chunk boundary — zero tokens emitted for the partial
+        prompt, NOTHING registered in the prefix index (final-chunk
+        registration), and the outcome is retriable."""
+        eng = _engine(model, chunked=True, prefill_chunk=8)
+        rid = eng.add_request(P_LONG, MAX_NEW)
+        eng.step()
+        req = self._mid_chunk(eng, rid)
+        assert 0 < req.context_len < len(P_LONG)
+        report = eng.drain(timeout_s=0.0)
+        assert report[rid]["finish_reason"] == "preempted"
+        assert report[rid]["retriable"] is True
+        assert report[rid]["tokens"] == []      # prefill never finished
+        assert eng.pool.counters["prefix_pages_registered"] == 0
+        assert eng.pool.num_in_use == 0         # partial pages released
+        eng.audit_pool()
+
+    def test_failover_mid_chunk_replays_bitwise_on_survivor(
+            self, model, fault_free):
+        """Replica killed while its slot is mid-chunk: the surviving
+        replica replays from scratch and the client stream is bitwise
+        the single-engine run — a half-prefilled prompt contributes
+        nothing (no tokens, no registered pages) to the replay."""
+        from paddle_tpu.serving import FleetRouter
+        ref = _reference(model, P_LONG, MAX_NEW)
+        engines = [_engine(model, chunked=True, prefill_chunk=8)
+                   for _ in range(2)]
+        router = FleetRouter(engines)
+        rid = router.submit(P_LONG, MAX_NEW)
+        guard = 0
+        while router.request(rid).replica is None:
+            router.step()
+            guard += 1
+            assert guard < 50
+        victim = router.request(rid).replica
+        veng = engines[victim]
+        req = self._mid_chunk(veng, rid)
+        assert 0 < req.context_len < len(P_LONG)
+        router.kill_replica(victim)
+        out = router.run_to_completion(max_steps=400)
+        assert out[rid] == ref                  # bitwise, exactly-once
+        assert router.request(rid).emitted == len(ref)
+        # the victim registered nothing for its partial prompt
+        assert veng.pool.counters["prefix_pages_registered"] == 0
+        survivor = engines[1 - victim]
+        assert all(v <= 1
+                   for v in survivor.step_program_counts().values())
+        survivor.audit_pool()

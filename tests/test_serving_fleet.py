@@ -678,3 +678,5 @@ class TestFleetRealModel:
             if h["state"] != DEAD:
                 assert router.engines[h["replica"]] \
                     .decode_program_count() == 1
+                # chaos left the pool's bookkeeping invariants intact
+                router.engines[h["replica"]].audit_pool()

@@ -386,6 +386,7 @@ class TestInt8Chaos:
         for pk, pv in eng.pool.pools:
             assert np.isfinite(np.asarray(pk.scale)).all()
             assert np.isfinite(np.asarray(pv.scale)).all()
+        eng.audit_pool()
 
     @pytest.mark.slow
     def test_alloc_storm_preempts_int8_deterministic(self, model,
@@ -404,6 +405,7 @@ class TestInt8Chaos:
         for rid, ref in zip(rids, refs):
             assert res[rid] == ref
         assert eng.decode_program_count() == 1
+        eng.audit_pool()
 
 
 # ---------------------------------------------------------------------------

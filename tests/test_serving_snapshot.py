@@ -1,0 +1,502 @@
+"""paddle_tpu.serving.snapshot — crash-consistent serving snapshots.
+
+The snapshot contracts (RESILIENCE.md "Serving recovery playbook"):
+
+1. BOUNDED REPLAY — on replica ejection the router restores each live
+   request's KV from its latest verified snapshot and replays only the
+   delta since capture; client streams stay bitwise identical to a
+   single-engine run and exactly-once, with ``recovery_replayed_tokens``
+   strictly below the full-replay cost whenever a snapshot exists.
+2. WARM RESTART — ``save_snapshot``/``restore`` persist through the
+   stage -> COMMIT -> rename protocol; a SIGKILLed process restores and
+   continues every in-flight stream bitwise. A torn (uncommitted) dir
+   is never loaded.
+3. NEVER WRONG TOKENS — a corrupt snapshot (bit rot, or the
+   ``serving.snapshot``/``serving.snapshot_restore`` ``poison`` fault)
+   is caught by the blake2b re-verify and falls back to full replay /
+   recompute. Corruption can cost time, never correctness.
+4. NO NEW PROGRAMS — capture is batched ``device_get``s outside every
+   compiled program; ``step_program_counts()`` stays
+   ``{"decode": 1, "mixed": 1}`` with snapshots on.
+
+Chaos tests (deterministic FaultPlan replays) carry the ``faults``
+marker, same as the serving/fleet suites. Every test audits the pool's
+bookkeeping invariants on the way out (``KVCachePool.audit``).
+"""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import fault
+from paddle_tpu.distributed.checkpoint.save_load import (
+    COMMIT_MARKER, CheckpointCorruptionError)
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import (FleetRouter, RequestSnapshot, ServingEngine,
+                                SnapshotStore, load_engine_snapshot,
+                                save_engine_snapshot)
+
+RNG = np.random.default_rng(31)
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(123)
+    m = LlamaForCausalLM(llama_tiny(dtype="float32",
+                                    mp_axis=None, fsdp_axis=None))
+    m.eval()
+    return m
+
+
+@pytest.fixture
+def fault_free(monkeypatch):
+    """No FaultPlan leaks out of a chaos test; no rank env leaks in."""
+    fault.deactivate()
+    monkeypatch.delenv("PADDLE_TRAINER_ID", raising=False)
+    monkeypatch.delenv("PROCESS_ID", raising=False)
+    monkeypatch.delenv("PADDLE_RESTART_EPOCH", raising=False)
+    yield
+    fault.deactivate()
+
+
+def _reference(model, prompt, max_new, **kw):
+    out = model.generate(jnp.asarray([prompt]), max_new_tokens=max_new, **kw)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def _mk(model, **kw):
+    cfg = dict(num_pages=64, page_size=4, max_slots=2,
+               prefill_token_budget=64)
+    cfg.update(kw)
+    return ServingEngine(model, **cfg)
+
+
+def _snap(rid="r0", tokens=(7, 8), payloads=None):
+    s = RequestSnapshot(rid=rid, prompt=[1, 2, 3], max_new_tokens=8,
+                        eos_token_id=None, temperature=1.0, top_p=1.0,
+                        do_sample=False, seed=0, arrival_seq=0,
+                        tokens=list(tokens),
+                        context_len=3 + max(0, len(tokens) - 1),
+                        step=4, kv_tag="kv", page_size=4,
+                        payloads=[list(p) for p in (payloads or [])])
+    return s.seal()
+
+
+# ---------------------------------------------------------------------------
+# snapshot value objects + store (no model)
+# ---------------------------------------------------------------------------
+
+class TestRequestSnapshot:
+    def test_seal_verify_roundtrip(self):
+        pay = [[np.arange(8, dtype=np.float32).reshape(4, 2)],
+               [np.ones((4, 2), np.float32)]]
+        s = _snap(payloads=pay)
+        assert s.verify_meta() and s.verify_payloads() and s.verify()
+        assert len(s.page_digests) == 2
+
+    def test_meta_tamper_detected(self):
+        s = _snap()
+        s.tokens.append(9)
+        assert not s.verify_meta() and not s.verify()
+
+    def test_payload_tamper_detected(self):
+        s = _snap(payloads=[[np.zeros((4, 2), np.float32)]])
+        s.corrupt()
+        assert s.verify_meta()          # identity bytes untouched
+        assert not s.verify_payloads()
+
+    def test_seq_materializes_prompt_plus_tokens(self):
+        s = _snap(tokens=[7, 8, 9])
+        # context_len = len(prompt) + len(tokens) - 1: the newest token
+        # has not been written into KV yet
+        assert s.seq() == [1, 2, 3, 7, 8]
+
+
+class TestSnapshotStore:
+    def test_put_get_drop_and_counters(self):
+        st = SnapshotStore()
+        s = _snap()
+        st.put("r0", s)
+        assert st.num_snapshots == 1
+        assert st.get("r0") is s
+        assert st.get("nope") is None
+        st.drop("r0")
+        st.drop("r0")                   # idempotent
+        assert st.get("r0") is None
+        c = st.stats()
+        assert c["snapshot_requests"] == 1
+        assert c["snapshot_hits"] == 1 and c["snapshot_misses"] == 2
+        assert c["snapshot_live"] == 0
+
+    def test_get_reverifies_and_evicts_corrupt(self):
+        st = SnapshotStore()
+        st.put("r0", _snap(payloads=[[np.zeros((4, 2), np.float32)]]))
+        st.corrupt("r0")
+        assert st.get("r0") is None     # digest re-verify caught it
+        assert st.stats()["snapshot_corrupt_detected"] == 1
+        assert st.num_snapshots == 0    # evicted, later gets are misses
+
+    def test_zero_stats_matches_stats_keys(self):
+        st = SnapshotStore()
+        assert set(SnapshotStore.zero_stats()) == set(st.stats())
+        assert all(v == 0 for v in SnapshotStore.zero_stats().values())
+
+
+# ---------------------------------------------------------------------------
+# pool audit (satellite): the invariant checker itself
+# ---------------------------------------------------------------------------
+
+class TestPoolAudit:
+    def test_clean_engine_passes_and_reports(self, model, fault_free):
+        eng = _mk(model)
+        eng.add_request([1, 2, 3, 4, 5], 6, eos_token_id=None)
+        eng.run_to_completion(max_steps=100)
+        rep = eng.audit_pool()
+        assert rep["pages"] == rep["free"] + rep["cached"] + rep["held"]
+
+    def test_detects_refcount_leak(self, model, fault_free):
+        eng = _mk(model)
+        eng.add_request([1, 2, 3, 4, 5], 6, eos_token_id=None)
+        eng.run_to_completion(max_steps=100)
+        page = eng.pool._free[0]
+        eng.pool._ref[page] = 1         # held AND free: conservation broken
+        with pytest.raises(AssertionError, match="audit failed"):
+            eng.audit_pool(check_device=False)
+
+    def test_detects_index_registration_drift(self, model, fault_free):
+        eng = _mk(model)
+        eng.add_request([1, 2, 3, 4, 5, 6, 7, 8], 4, eos_token_id=None)
+        eng.run_to_completion(max_steps=100)
+        assert eng.pool._page_key, "expected cached registered pages"
+        page = next(iter(eng.pool._page_key))
+        del eng.pool._page_key[page]    # index still points at the page
+        with pytest.raises(AssertionError, match="audit failed"):
+            eng.audit_pool(check_device=False)
+
+
+# ---------------------------------------------------------------------------
+# periodic capture
+# ---------------------------------------------------------------------------
+
+class TestPeriodicCapture:
+    def test_capture_counters_programs_and_metrics(self, model, fault_free):
+        st = SnapshotStore()
+        eng = _mk(model, snapshot_store=st, snapshot_interval=2)
+        prompts = [list(RNG.integers(1, 500, 6)), list(RNG.integers(1, 500, 9))]
+        refs = [_reference(model, p, 8) for p in prompts]
+        rids = [eng.add_request(p, 8, eos_token_id=None) for p in prompts]
+        out = eng.run_to_completion(max_steps=100)
+        assert [out[r] for r in rids] == refs
+        assert eng.step_program_counts() == {"decode": 1, "mixed": 1}
+        stats = st.stats()
+        assert stats["snapshots_captured"] >= 2
+        assert stats["snapshot_requests"] >= 2
+        assert stats["snapshot_live"] == 0      # finish drops snapshots
+        summ = eng.metrics.summary()
+        assert summ["snapshots_enabled"] == 1
+        assert summ["snapshots_captured"] == stats["snapshots_captured"]
+        eng.audit_pool()
+
+    def test_interval_validation(self, model):
+        with pytest.raises(ValueError):
+            _mk(model, snapshot_store=SnapshotStore(), snapshot_interval=0)
+
+
+# ---------------------------------------------------------------------------
+# warm restart (save/restore through stage -> COMMIT -> rename)
+# ---------------------------------------------------------------------------
+
+class TestWarmRestart:
+    def _run_partial(self, model, tmp_path, steps=6, **kw):
+        prompts = [list(RNG.integers(1, 500, 7)),
+                   list(RNG.integers(1, 500, 5))]
+        eng = _mk(model, **kw)
+        rids = [eng.add_request(p, 10, eos_token_id=None) for p in prompts]
+        for _ in range(steps):
+            eng.step()
+        path = str(tmp_path / "snap")
+        eng.save_snapshot(path)
+        return eng, rids, path
+
+    def test_save_restore_continues_bitwise(self, model, tmp_path,
+                                            fault_free):
+        eng, rids, path = self._run_partial(model, tmp_path)
+        warm = _mk(model)
+        assert warm.restore(path) == rids       # arrival order preserved
+        out = warm.run_to_completion(max_steps=100)
+        cont = eng.run_to_completion(max_steps=100)
+        for r in rids:
+            assert out[r] == cont[r]            # bitwise vs uninterrupted
+        assert warm.metrics.counters["snapshot_restores"] == len(rids)
+        assert warm.metrics.counters["snapshot_restore_corrupt"] == 0
+        assert eng.metrics.counters["snapshot_saves"] == 1
+        warm.audit_pool()
+        eng.audit_pool()
+
+    def test_save_restore_bitwise_int8(self, model, tmp_path, fault_free):
+        eng, rids, path = self._run_partial(model, tmp_path, kv_quant=True)
+        warm = _mk(model, kv_quant=True)
+        warm.restore(path)
+        out = warm.run_to_completion(max_steps=100)
+        cont = eng.run_to_completion(max_steps=100)
+        for r in rids:
+            assert out[r] == cont[r]
+        warm.audit_pool()
+
+    def test_torn_dir_never_loaded(self, model, tmp_path, fault_free):
+        _, _, path = self._run_partial(model, tmp_path)
+        torn = str(tmp_path / "torn.tmp")
+        shutil.copytree(path, torn)
+        os.remove(os.path.join(torn, COMMIT_MARKER))
+        with pytest.raises(CheckpointCorruptionError, match="uncommitted"):
+            load_engine_snapshot(torn)
+        with pytest.raises(CheckpointCorruptionError):
+            _mk(model).restore(torn)
+
+    def test_corrupt_payload_degrades_to_recompute(self, model, tmp_path,
+                                                   fault_free):
+        eng, rids, path = self._run_partial(model, tmp_path)
+        pages = os.path.join(path, "pages.npz")
+        data = bytearray(open(pages, "rb").read())
+        # flip a byte inside the first member's array data (the member
+        # name in the local header + ~70B npy header precede it)
+        data[data.find(b"r0_p0_a0") + 200] ^= 0xFF
+        open(pages, "wb").write(bytes(data))
+        snaps, meta = load_engine_snapshot(path)
+        assert meta["corrupt_payloads_dropped"] >= 1
+        warm = _mk(model)
+        warm.restore(path)
+        out = warm.run_to_completion(max_steps=100)
+        cont = eng.run_to_completion(max_steps=100)
+        for r in rids:
+            assert out[r] == cont[r]            # recompute path, bitwise
+        warm.audit_pool()
+
+    def test_save_roundtrip_preserves_dtypes_and_digests(self, tmp_path):
+        # bfloat16 does not survive a naive np.savez round-trip — the
+        # format stores raw uint8 views + dtype names instead
+        pay = [[np.asarray(RNG.standard_normal((4, 2)),
+                           jnp.bfloat16.dtype)],
+               [np.asarray(RNG.integers(-127, 128, (4, 2)), np.int8),
+                np.ones((4, 1), np.float32)]]
+        s = _snap(payloads=pay)
+        path = str(tmp_path / "s")
+        save_engine_snapshot(path, [s], meta={"k": 1})
+        loaded, meta = load_engine_snapshot(path)
+        assert meta["k"] == 1 and meta["corrupt_payloads_dropped"] == 0
+        l = loaded[0]
+        assert l.verify()
+        for p0, p1 in zip(pay, l.payloads):
+            for a0, a1 in zip(p0, p1):
+                assert a1.dtype == a0.dtype and a1.shape == a0.shape
+                assert np.array_equal(np.asarray(a0), np.asarray(a1))
+
+    def test_drain_snapshot_fast_path(self, model, tmp_path, fault_free):
+        eng, rids, _ = self._run_partial(model, tmp_path, steps=4)
+        path = str(tmp_path / "drain_snap")
+        partial = {r: list(eng.request(r).tokens) for r in rids}
+        report = eng.drain(snapshot_path=path)
+        # fast path: no decode-to-finish — everything preempted at once
+        assert report and all(o["finish_reason"] == "preempted"
+                              and o["retriable"]
+                              for o in report.values())
+        warm = _mk(model)
+        warm.restore(path)
+        out = warm.run_to_completion(max_steps=100)
+        ref_eng = _mk(model)
+        prompts = {r: list(eng.request(r).prompt) for r in rids}
+        refs = {}
+        for r in rids:
+            rr = ref_eng.add_request(prompts[r], 10, eos_token_id=None)
+            refs[r] = rr
+        full = ref_eng.run_to_completion(max_steps=100)
+        for r in rids:
+            assert out[r] == full[refs[r]]      # continuation == one life
+            assert out[r][: len(partial[r])] == partial[r]
+        warm.audit_pool()
+
+
+# ---------------------------------------------------------------------------
+# bounded-replay failover
+# ---------------------------------------------------------------------------
+
+def _fleet(model, store, n=2, **kw):
+    return FleetRouter([_mk(model, snapshot_store=store,
+                            snapshot_interval=2, **kw) for _ in range(n)])
+
+
+class TestBoundedReplayFailover:
+    def _sweep(self, model, ks, max_new=8):
+        prompt = list(RNG.integers(1, 500, 6))
+        ref = _reference(model, prompt, max_new)
+        for k in ks:
+            store = SnapshotStore()
+            router = _fleet(model, store)
+            rid = router.submit(prompt, max_new)
+            guard = 0
+            while router.request(rid).emitted < k:
+                router.step()
+                guard += 1
+                assert guard < 100
+            at_kill = router.request(rid).emitted
+            victim = router.request(rid).replica
+            router.kill_replica(0 if victim is None else victim)
+            out = router.run_to_completion(max_steps=300)
+            assert out[rid] == ref, f"k={k}"    # bitwise + exactly-once
+            fm = router.fleet_metrics.counters
+            if fm["snapshot_restores"]:
+                # bounded: strictly cheaper than replaying the full stream
+                assert fm["recovery_replayed_tokens"] < at_kill
+                assert (fm["recovery_restored_tokens"]
+                        + fm["recovery_replayed_tokens"]) == at_kill
+            else:
+                assert fm["snapshot_fallbacks"] == 1
+                assert fm["recovery_replayed_tokens"] == at_kill
+            for eng in router.engines:
+                if eng.stats()["steps"]:
+                    # the ejected replica may have died before its first
+                    # decode-only step — the contract is "never >1"
+                    assert all(v <= 1 for v in
+                               eng.step_program_counts().values())
+                    eng.audit_pool()
+
+    def test_kill_after_snapshot_is_bounded_and_bitwise(self, model,
+                                                        fault_free):
+        self._sweep(model, ks=(3, 4))
+
+    @pytest.mark.slow
+    def test_kill_at_every_emitted_count_sweep(self, model, fault_free):
+        self._sweep(model, ks=range(1, 8))
+
+    def test_recovery_latency_observed(self, model, fault_free):
+        store = SnapshotStore()
+        router = _fleet(model, store)
+        rid = router.submit(list(RNG.integers(1, 500, 6)), 8)
+        while router.request(rid).emitted < 3:
+            router.step()
+        router.kill_replica(router.request(rid).replica)
+        router.run_to_completion(max_steps=300)
+        fs = router.fleet_metrics.summary()
+        assert fs["recovery_ttfrt_p50_s"] >= 0.0
+        assert fs["snapshot_restores"] + fs["snapshot_fallbacks"] >= 1
+
+    def test_snapshot_ahead_of_emitted_is_unusable(self, model, fault_free):
+        """A snapshot holding tokens the client has not been shown yet
+        must not seed the replay — those tokens would never be emitted."""
+        store = SnapshotStore()
+        router = _fleet(model, store)
+
+        class Rec:
+            rid = "r0"
+            emitted = 1
+            tokens = [5, 6, 7]
+        store.put("r0", _snap(rid="r0", tokens=[5, 6]))     # 2 > emitted
+        assert router._usable_snapshot(Rec()) is None
+        store.put("r0", _snap(rid="r0", tokens=[9]))        # diverged
+        assert router._usable_snapshot(Rec()) is None
+        store.put("r0", _snap(rid="r0", tokens=[5]))        # usable prefix
+        assert router._usable_snapshot(Rec()) is not None
+
+
+# ---------------------------------------------------------------------------
+# chaos: the serving.snapshot / serving.snapshot_restore fault sites
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faults
+class TestSnapshotChaos:
+    def _kill_run(self, model, store, prompt, max_new, k=3):
+        router = _fleet(model, store)
+        rid = router.submit(prompt, max_new)
+        guard = 0
+        while router.request(rid).emitted < k:
+            router.step()
+            guard += 1
+            assert guard < 100
+        router.kill_replica(router.request(rid).replica)
+        out = router.run_to_completion(max_steps=300)
+        return router, rid, out
+
+    def test_capture_raise_drops_that_snapshot(self, model, fault_free):
+        prompt = list(RNG.integers(1, 500, 6))
+        ref = _reference(model, prompt, 8)
+        fault.activate(fault.FaultPlan([
+            fault.FaultSpec(site="serving.snapshot", action="raise",
+                            once=False),
+        ]))
+        store = SnapshotStore()
+        router, rid, out = self._kill_run(model, store, prompt, 8)
+        assert out[rid] == ref                  # full replay, bitwise
+        assert store.counters["snapshot_failed"] >= 1
+        fm = router.fleet_metrics.counters
+        assert fm["snapshot_restores"] == 0
+        assert fm["snapshot_fallbacks"] == 1
+        for eng in router.engines:
+            if eng.stats()["steps"]:
+                eng.audit_pool()
+
+    def test_capture_poison_caught_by_reverify(self, model, fault_free):
+        """Poisoned at capture (digest NOT updated) — the failover-side
+        ``get`` re-verifies, evicts, and falls back to full replay:
+        zero wrong tokens."""
+        prompt = list(RNG.integers(1, 500, 6))
+        ref = _reference(model, prompt, 8)
+        fault.activate(fault.FaultPlan([
+            fault.FaultSpec(site="serving.snapshot", action="poison",
+                            once=False),
+        ]))
+        store = SnapshotStore()
+        router, rid, out = self._kill_run(model, store, prompt, 8)
+        assert out[rid] == ref
+        assert store.counters["snapshot_corrupt_detected"] >= 1
+        fm = router.fleet_metrics.counters
+        assert fm["snapshot_restores"] == 0
+        assert fm["snapshot_fallbacks"] == 1
+        for eng in router.engines:
+            if eng.stats()["steps"]:
+                assert eng.step_program_counts()["decode"] == 1
+                eng.audit_pool()
+
+    def test_restore_raise_recomputes_kv_still_bounded(self, model,
+                                                       fault_free):
+        """The restore site failing skips KV injection only — the replay
+        is still seeded from snapshot tokens (bounded), KV recomputes."""
+        prompt = list(RNG.integers(1, 500, 6))
+        ref = _reference(model, prompt, 8)
+        fault.activate(fault.FaultPlan([
+            fault.FaultSpec(site="serving.snapshot_restore",
+                            action="raise"),
+        ]))
+        store = SnapshotStore()
+        router, rid, out = self._kill_run(model, store, prompt, 8)
+        assert out[rid] == ref
+        failed = sum(e.metrics.counters["snapshot_restore_failed"]
+                     for e in router.engines)
+        assert failed == 1
+        assert router.fleet_metrics.counters["snapshot_restores"] == 1
+        for eng in router.engines:
+            if eng.stats()["steps"]:
+                eng.audit_pool()
+
+    def test_restore_poison_caught_zero_wrong_tokens(self, model,
+                                                     fault_free):
+        prompt = list(RNG.integers(1, 500, 6))
+        ref = _reference(model, prompt, 8)
+        fault.activate(fault.FaultPlan([
+            fault.FaultSpec(site="serving.snapshot_restore",
+                            action="poison"),
+        ]))
+        store = SnapshotStore()
+        router, rid, out = self._kill_run(model, store, prompt, 8)
+        assert out[rid] == ref
+        corrupt = sum(e.metrics.counters["snapshot_restore_corrupt"]
+                      for e in router.engines)
+        assert corrupt == 1
+        for eng in router.engines:
+            if eng.stats()["steps"]:
+                assert eng.step_program_counts()["decode"] == 1
+                eng.audit_pool()

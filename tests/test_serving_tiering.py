@@ -577,6 +577,7 @@ class TestTieredChaos:
         assert tier.counters["spill_dropped"] > 0
         assert tier.counters["restored_pages"] == 0
         assert eng.decode_program_count() == 1
+        eng.audit_pool()
 
     def test_restore_poison_detected_and_recomputed(self, model,
                                                     fault_free):
@@ -598,6 +599,7 @@ class TestTieredChaos:
         assert tier.counters["restore_corrupt_detected"] > 0
         assert tier.counters["restored_pages"] == 0
         assert eng.decode_program_count() == 1
+        eng.audit_pool()
 
     def test_restore_fault_raise_falls_back(self, model, fault_free):
         """An injected restore failure (raise) on one chain key: those
@@ -615,6 +617,7 @@ class TestTieredChaos:
             assert eng.run_to_completion(max_steps=100)[rid] == ref
         assert eng.pool.host_tier.counters["restore_failed"] > 0
         assert eng.pool.host_tier.counters["restored_pages"] == 0
+        eng.audit_pool()
 
     def test_fleet_shared_tier_replica_kill_exact_or_classified(
             self, model, fault_free):
@@ -644,3 +647,6 @@ class TestTieredChaos:
                 classified += 1
         assert classified == 0                  # failover replays exactly
         assert tier.counters["spilled_pages"] > 0
+        for eng in engines:
+            if eng.stats()["steps"]:
+                eng.audit_pool()
