@@ -105,6 +105,10 @@ _SERVING_SLOS = {
     # tiered arm: prefix-cache SLOs — the host tier's job is to keep
     # the hit path (and its TTFT) alive under pool pressure
     "llama_serving_tiered": {"ttft_p99_s": 1.0, "itl_p99_s": 0.25},
+    # tensor-parallel A/B: same workload and SLOs as llama_serving —
+    # the mesh must not hide behind looser targets; both arms report
+    # goodput against the identical budget
+    "llama_serving_tp": {"ttft_p99_s": 2.0, "itl_p99_s": 0.25},
 }
 
 
@@ -1543,6 +1547,134 @@ def bench_llama_serving_tiered(peak, peak_kind, n_requests=12,
     }
 
 
+class _StreamRecorder:
+    """Replay target that wraps an engine and keeps each request's
+    emitted tokens — the tensor-parallel A/B asserts the two arms'
+    streams bitwise identical, which ``Workload.replay``'s summary dict
+    alone cannot show."""
+
+    def __init__(self, eng):
+        self.eng = eng
+        self.scheduler = eng.scheduler     # replay's has_work probe
+        self.tokens = {}
+
+    def add_request(self, *args, **kw):
+        return self.eng.add_request(*args, **kw)
+
+    def step(self):
+        events = self.eng.step()
+        for ev in events:
+            if ev.get("token") is not None:
+                self.tokens.setdefault(ev["rid"], []).append(ev["token"])
+        return events
+
+
+def bench_llama_serving_tp(peak, peak_kind, n_requests=12,
+                           max_new_tokens=48, trace_path=None):
+    """Tensor-parallel serving A/B (SERVING.md "Tensor-parallel
+    serving"): ONE seeded staggered Workload trace served by a tp=1
+    engine and by a tp=2 engine whose two step programs each run as one
+    shard_map over the mp mesh (KV pool sharded on the kv-head dim,
+    Megatron column/row weight layout, one psum per block). The arms'
+    per-request token streams are asserted BITWISE IDENTICAL — sharding
+    relocates math, it never changes it — so every delta in the summary
+    (tokens/s, goodput_at_slo, per-shard KV bytes) is attributable to
+    the mesh alone. Each arm replays the trace twice on one engine:
+    epoch 1 warms the two compiled programs, epoch 2 is measured.
+    Needs >= 2 devices (TPU slice, or CPU with
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` exported
+    before the first jax import)."""
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import (ServingEngine, ServingMetrics,
+                                    make_workload)
+
+    name = "llama_serving_tp"
+    if jax.device_count() < 2:
+        raise RuntimeError(
+            "llama_serving_tp needs >= 2 devices for the tp=2 arm; on "
+            "CPU export XLA_FLAGS=--xla_force_host_platform_device_count"
+            "=8 before running bench.py (jax is already initialized by "
+            "the time this config runs, so the flag cannot be set here)")
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                      intermediate_size=5632, num_hidden_layers=8,
+                      num_attention_heads=16, num_key_value_heads=8,
+                      max_position_embeddings=4096, dtype="bfloat16",
+                      mp_axis="mp", fsdp_axis=None)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    n_params = model.num_params()
+    wl = make_workload(seed=0, n_requests=n_requests, arrival="poisson",
+                       rate=0.5, tenants=3, zipf_alpha=1.2,
+                       system_len=(96, 160),
+                       prompt_mix=((0.7, 16, 48), (0.3, 48, 96)),
+                       max_new=(max_new_tokens, max_new_tokens),
+                       vocab_size=cfg.vocab_size)
+    tracer = _make_tracer(trace_path)
+    arms = {}
+    for arm, deg in (("tp1", 1), ("tp2", 2)):
+        eng = ServingEngine(model, num_pages=64, page_size=16,
+                            max_slots=4, tracer=tracer, tp=deg)
+        rec = _StreamRecorder(eng)
+        wl.replay(rec, max_steps=4000, rid_prefix="warm-")
+        eng.metrics = ServingMetrics()  # compile time stays off the clock
+        eng.metrics.set_slo(**_SERVING_SLOS[name])
+        eng.metrics.set_tp(deg, eng.pool.kv_bytes_per_token_shard())
+        out = wl.replay(rec, max_steps=4000, rid_prefix="run-")
+        m = eng.metrics.summary()
+        assert eng.step_program_counts() == {"decode": 1, "mixed": 1}, \
+            f"tp={deg} step retraced"
+        streams = {r: t for r, t in rec.tokens.items()
+                   if r.startswith("run-")}
+        arms[arm] = (eng, m, out, streams)
+    assert arms["tp1"][3] == arms["tp2"][3], \
+        "tp=2 streams diverged from tp=1 — TP must be bitwise"
+    eng, m, out, _ = arms["tp2"]
+    m0 = arms["tp1"][1]
+    hbm_bw = {"v4": 1.2e12,
+              "v5e": 0.82e12, "v5litepod": 0.82e12, "v5lite": 0.82e12,
+              "v5p": 2.77e12,
+              "v6e": 1.64e12, "trillium": 1.64e12,
+              }.get(peak_kind.split("(")[0], 0.82e12)
+    wall = max(m["wall_s"], 1e-9)
+    mbu = out["steps"] * 2.0 * n_params / wall / hbm_bw
+    trace_out = _dump_trace(tracer, trace_path, name)
+    return {
+        "metric": "llama_420m_serving_tp_tokens_per_sec",
+        "value": round(m["tokens_per_s"], 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mbu, 4),
+        "extra": {"params": n_params, "workload": wl.stats(),
+                  "max_new_tokens": max_new_tokens,
+                  "engine_steps": out["steps"],
+                  "submitted": out["submitted"], "shed": out["shed"],
+                  "tp_degree": 2,
+                  "tp_shard_kv_bytes_per_token":
+                      eng.pool.kv_bytes_per_token_shard(),
+                  "kv_bytes_per_token": eng.pool.kv_bytes_per_token(),
+                  "ttft_p50": round(m["ttft_p50_s"], 4),
+                  "ttft_p99": round(m["ttft_p99_s"], 4),
+                  "tpot": round(m["tpot_mean_s"], 5),
+                  "itl_p99": round(m["itl_p99_s"], 5),
+                  "preemptions": m["preemptions"],
+                  "rejected": m["rejected"],
+                  "goodput_at_slo": round(m["goodput_at_slo"], 4),
+                  "goodput_at_slo_tp1": round(m0["goodput_at_slo"], 4),
+                  "tokens_per_s_tp1": round(m0["tokens_per_s"], 1),
+                  "bitwise_parity": True,
+                  "slo": _SERVING_SLOS[name],
+                  "retraces": eng.decode_program_count() - 1,
+                  "trace": trace_out,
+                  "mbu_weights_only": round(mbu, 4),
+                  "peak": peak_kind, "hbm_bw": hbm_bw,
+                  "pipeline": False, "runs": _RUNS,
+                  "spread": None},
+    }
+
+
 def bench_llama8b_shape(peak, peak_kind, batch=1, seq=4096, layers=2):
     """North-star-SHAPE evidence (VERDICT r4 missing #1): ``layers``
     llama_3_8b-config decoder layers (hidden 4096, ffn 14336, GQA 32/8,
@@ -1638,6 +1770,12 @@ _CONFIGS = {
     # (SERVING.md "KV tiering & traffic harness"): spill-off vs spill-on
     # under forced pool pressure; goodput_at_slo + tier hit rates
     "llama_serving_tiered": bench_llama_serving_tiered,
+    # tensor-parallel serving A/B (SERVING.md "Tensor-parallel
+    # serving"): tp=1 vs tp=2 on one seeded trace, streams asserted
+    # bitwise identical; per-shard KV bytes + goodput for both arms.
+    # Needs >= 2 devices (CPU: XLA_FLAGS=--xla_force_host_platform_
+    # device_count=8 exported before launch)
+    "llama_serving_tp": bench_llama_serving_tp,
 }
 
 # configs whose bench_summary cell carries extra keys beyond
@@ -1684,6 +1822,12 @@ _SUMMARY_EXTRA_KEYS = {
                              "spilled_pages", "restored_pages", "shed",
                              "goodput_at_slo", "goodput_at_slo_notier",
                              "retraces"),
+    "llama_serving_tp": ("ttft_p50", "ttft_p99", "tpot",
+                         "tp_degree", "tp_shard_kv_bytes_per_token",
+                         "kv_bytes_per_token",
+                         "tokens_per_s_tp1",
+                         "goodput_at_slo", "goodput_at_slo_tp1",
+                         "retraces"),
 }
 
 # opt-in configs (not in the default driver run — kept out to bound its

@@ -12,6 +12,8 @@ distributed.collective for hand-scheduled code.
 
 from __future__ import annotations
 
+import contextlib
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -22,7 +24,36 @@ from ...nn import initializer as I
 from ...nn.module import Layer, Parameter
 
 __all__ = ["VocabParallelEmbedding", "ColumnParallelLinear", "RowParallelLinear",
-           "ParallelCrossEntropy", "mark_sharding"]
+           "ParallelCrossEntropy", "mark_sharding", "manual_mp_region",
+           "current_manual_mp"]
+
+# Manual-mp trace flag (the mp twin of sequence_parallel's manual-sep
+# region): inside a shard_map over the mp axis GSPMD is out of the
+# picture, so model code must issue its own collectives — one psum after
+# each row-parallel matmul, a masked lookup + psum for the vocab-parallel
+# embedding, one all_gather on the vocab-sharded logits. Layers check
+# ``current_manual_mp() == cfg.mp_axis`` to switch from sharding hints to
+# those explicit collectives (serving/parallel.py wraps the engine's two
+# step programs in this region).
+_MANUAL_MP: list[str | None] = [None]
+
+
+@contextlib.contextmanager
+def manual_mp_region(axis: str | None):
+    """Mark the current trace as running INSIDE a shard_map over ``axis``
+    (manual mode): per-shard shapes, explicit collectives."""
+    prev = _MANUAL_MP[0]
+    _MANUAL_MP[0] = axis
+    try:
+        yield
+    finally:
+        _MANUAL_MP[0] = prev
+
+
+def current_manual_mp() -> str | None:
+    """The manual-mp axis name when tracing inside a shard_map region
+    entered via :func:`manual_mp_region`, else None."""
+    return _MANUAL_MP[0]
 
 
 def mark_sharding(x, *spec):

@@ -75,6 +75,31 @@ def _rope_cache(config: LlamaConfig):
     return jnp.cos(freqs), jnp.sin(freqs)
 
 
+def _mp_psum(x, axis):
+    """One explicit allreduce after a row-parallel matmul (o_proj /
+    down_proj) when tracing inside a manual-mp shard_map region — the
+    serving engine's TP step programs (serving/parallel.py). In the
+    hint-based GSPMD path (training, generate) the region is inactive and
+    GSPMD inserts the same collective from the weight specs."""
+    if axis is not None:
+        from ..distributed.fleet.mp_layers import current_manual_mp
+        if current_manual_mp() == axis:
+            return jax.lax.psum(x, axis)
+    return x
+
+
+def _mp_gather_logits(logits, axis):
+    """all_gather of the vocab-sharded logits inside a manual-mp region
+    (both the untied lm_head and the tied embed.T shard vocab on mp) —
+    the ONE gather per TP step; sampling then sees replicated values on
+    every shard, keeping the fold_in(key, token_index) contract."""
+    if axis is not None:
+        from ..distributed.fleet.mp_layers import current_manual_mp
+        if current_manual_mp() == axis:
+            return jax.lax.all_gather(logits, axis, axis=-1, tiled=True)
+    return logits
+
+
 def apply_rotary_pos_emb(x, cos, sin, position_ids=None):
     """x: [b, s, h, d]; cos/sin: [S, d/2] (parity:
     incubate fused_rotary_position_embedding — here one fused XLA graph)."""
@@ -113,10 +138,17 @@ class LlamaAttention(Layer):
                 paged=None):
         b, s, _ = x.shape
         cfg = self.config
-        h, kvh, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
-        q = self.q_proj(x).reshape(b, s, h, d)
-        k = self.k_proj(x).reshape(b, s, kvh, d)
-        v = self.v_proj(x).reshape(b, s, kvh, d)
+        d = cfg.head_dim
+        # head counts come from the projection widths, not the config:
+        # inside a manual-mp shard_map region (ServingEngine(tp=N)) the
+        # weights are the per-shard columns — h/tp and kvh/tp heads — and
+        # every branch below is head-local (the GQA ratio h/kvh survives
+        # because both divide by tp). Unsharded, local == global.
+        q, k, v = self.q_proj(x), self.k_proj(x), self.v_proj(x)
+        h, kvh = q.shape[-1] // d, k.shape[-1] // d
+        q = q.reshape(b, s, h, d)
+        k = k.reshape(b, s, kvh, d)
+        v = v.reshape(b, s, kvh, d)
         if paged is not None:
             # slot-indexed decode over a paged KV pool (the serving engine's
             # one-compiled-program step): b is the fixed slot count. s == 1
@@ -159,7 +191,8 @@ class LlamaAttention(Layer):
                 pk = pk.at[page, off].set(k.astype(pk.dtype))
                 pv = pv.at[page, off].set(v.astype(pv.dtype))
             out = F.paged_attention_decode(q, pk, pv, tables, seq_lens)
-            return self.o_proj(out.reshape(b, s, h * d)), (pk, pv)
+            out = _mp_psum(self.o_proj(out.reshape(b, s, h * d)), cfg.mp_axis)
+            return out, (pk, pv)
         # sequence parallelism: when tracing inside a manual-sep shard_map
         # region (the pipelined train step), x is the LOCAL seq shard —
         # rope positions are offset by the shard start and attention runs
@@ -179,7 +212,8 @@ class LlamaAttention(Layer):
             # GQA k/v stay at kvh heads — ring_attention_manual repeats
             # per-step so rotating buffers are h/kvh smaller
             out = _sp.ring_attention_manual(q, k, v, axis=sep, causal=True)
-            return self.o_proj(out.reshape(b, s, h * d))
+            return _mp_psum(self.o_proj(out.reshape(b, s, h * d)),
+                            cfg.mp_axis)
         static_zero = not isinstance(position_offset, jax.Array) and position_offset == 0
         if static_zero:
             q = apply_rotary_pos_emb(q, cos, sin)
@@ -203,7 +237,7 @@ class LlamaAttention(Layer):
             seq_lens = jnp.broadcast_to(jnp.asarray(position_offset), (b,))
             out, ck, cv = FF.masked_multihead_attention(
                 q, k, v, kv_cache[0], kv_cache[1], seq_lens)
-            out = self.o_proj(out.reshape(b, s, h * d))
+            out = _mp_psum(self.o_proj(out.reshape(b, s, h * d)), cfg.mp_axis)
             return out, (ck, cv)
         if kv_cache is not None:
             ck, cv = kv_cache
@@ -245,7 +279,9 @@ class LlamaAttention(Layer):
                 seq_lens = jnp.broadcast_to(jnp.asarray(position_offset), (b,))
                 out = F.cached_prefill_attention(q, new_cache[0],
                                                  new_cache[1], seq_lens)
-                return self.o_proj(out.reshape(b, s, h * d)), new_cache
+                out = _mp_psum(self.o_proj(out.reshape(b, s, h * d)),
+                               cfg.mp_axis)
+                return out, new_cache
         if kvh != h:  # GQA: repeat kv heads
             rep = h // kvh
             k = jnp.repeat(k, rep, axis=2)
@@ -263,13 +299,14 @@ class LlamaAttention(Layer):
         out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
                                              is_causal=causal,
                                              training=self.training)
-        out = self.o_proj(out.reshape(b, s, h * d))
+        out = _mp_psum(self.o_proj(out.reshape(b, s, h * d)), cfg.mp_axis)
         return (out, new_cache) if kv_cache is not None else out
 
 
 class LlamaMLP(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__(dtype=config.dtype)
+        self.config = config
         mp = config.mp_axis
         self.gate_proj = nn.Linear(config.hidden_size, config.intermediate_size,
                                    bias_attr=False, weight_spec=(None, mp))
@@ -280,7 +317,8 @@ class LlamaMLP(Layer):
 
     def forward(self, x):
         # SwiGLU (parity: incubate swiglu fused op — XLA fuses this chain)
-        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+        y = self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+        return _mp_psum(y, self.config.mp_axis)
 
 
 class LlamaDecoderLayer(Layer):
@@ -324,9 +362,30 @@ class LlamaModel(Layer):
         self.register_buffer("rope_cos", cos, persistable=False)
         self.register_buffer("rope_sin", sin, persistable=False)
 
+    def _embed(self, input_ids):
+        """Vocab-parallel embedding. In the hint-based path the plain
+        gather + the (mp, None) weight spec let GSPMD insert the
+        collective; inside a manual-mp shard_map region (the TP serving
+        steps) the weight is the local vocab-row shard, so this is the
+        reference's masked local lookup + psum (mp_layers.py:47) —
+        bitwise equal to the replicated gather, because exactly one shard
+        contributes each row and the rest add zeros."""
+        mp = self.config.mp_axis
+        if mp is not None:
+            from ..distributed.fleet.mp_layers import current_manual_mp
+            if current_manual_mp() == mp:
+                w = self.embed_tokens.weight
+                per = w.shape[0]
+                local = input_ids - jax.lax.axis_index(mp) * per
+                ok = (local >= 0) & (local < per)
+                rows = jnp.take(w, jnp.clip(local, 0, per - 1), axis=0)
+                rows = jnp.where(ok[..., None], rows, 0)
+                return jax.lax.psum(rows, mp)
+        return self.embed_tokens(input_ids)
+
     def forward(self, input_ids, attn_mask=None, kv_caches=None, position_offset=0,
                 paged=None):
-        x = self.embed_tokens(input_ids)
+        x = self._embed(input_ids)
         cos, sin = self.rope_cos, self.rope_sin
         new_caches = []
         for i, layer in enumerate(self.layers):
@@ -373,6 +432,7 @@ class LlamaForCausalLM(Layer):
             logits = hidden @ self.model.embed_tokens.weight.T
         else:
             logits = self.lm_head(hidden)
+        logits = _mp_gather_logits(logits, self.config.mp_axis)
         return (logits, new_caches) if kv_caches is not None else logits
 
     def init_kv_caches(self, batch_size, max_len, dtype=None):

@@ -479,7 +479,13 @@ def paged_attention_decode(q, pool_k, pool_v, block_tables, seq_lens,
     HBM→VMEM by table lookup; anywhere else (tier-1 CPU runs) an XLA
     gather materializes [b, max_pages*page_size, kvh, d] and reuses the
     same grouped-GQA core as the contiguous decode path, so both backends
-    and both cache layouts agree. ``kernel_applicable`` gates on t == 1,
+    and both cache layouts agree. Head counts (h, kvh) are derived from
+    the ARRAY SHAPES, never from config — inside a tensor-parallel
+    shard_map step (serving/parallel.py) each shard calls this with its
+    local ``h/tp`` queries and ``kvh/tp`` pool heads and the whole
+    function, Pallas and XLA path alike, is shard-local: attention is
+    head-local math, the one psum per block lives in the model's o_proj,
+    not here. ``kernel_applicable`` gates on t == 1,
     so the multi-row verify step takes the XLA gather path on every
     backend — one code path to keep bit-identical to sequential decode.
     """

@@ -123,8 +123,9 @@ def render_fleet_prometheus(router) -> str:
         emit("paddle_serving_fleet_replica_up",
              health["state"] != "dead", labels=labels)
         for key in ("ready", "live", "queue_depth", "running",
-                    "pool_utilization", "consecutive_failures",
-                    "breaker_opens", "backoff_remaining"):
+                    "pool_utilization", "tp_degree",
+                    "consecutive_failures", "breaker_opens",
+                    "backoff_remaining"):
             emit(f"paddle_serving_fleet_replica_{key}", health[key],
                  labels=labels)
     # the client-visible stream summary, unlabeled — same names a

@@ -25,6 +25,12 @@ TPU mapping:
 Masking matches the XLA path exactly: position <= seq_lens[s] keeps a
 score, others take -1e30 (finite, so a fully-padded tail underflows to
 exactly 0 probability in fp32).
+
+The kernel is HEAD-LOCAL: every (slot, kv_head, page) grid step touches
+only its own head's slice, so under tensor parallelism
+(serving/parallel.py) each shard runs this same kernel unchanged on its
+``kvh/tp`` heads of the sharded pool — head counts are derived from the
+array shapes, and no collective ever appears inside attention.
 """
 
 from __future__ import annotations

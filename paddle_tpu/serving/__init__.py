@@ -22,10 +22,12 @@ streams.
 from .engine import ServingEngine
 from .errors import (EngineDrainingError, FleetOverloadedError,
                      QueueFullError, RequestTooLargeError,
-                     SchedulerStalledError, ServingError)
+                     SchedulerStalledError, ServingError, TPConfigError)
 from .fleet import FleetRequest, FleetRouter
 from .kv_cache import KVCachePool, PoolExhaustedError, PrefixMatch
 from .metrics import FleetMetrics, ServingMetrics, percentile
+from .parallel import (TPContext, collective_counts, partition_devices,
+                       validate_tp_config)
 from .scheduler import (FINISHED, PREEMPTED, RUNNING, WAITING, Request,
                         SamplingParams, Scheduler)
 from .snapshot import (RequestSnapshot, SnapshotStore,
@@ -49,4 +51,7 @@ __all__ = [
     "make_workload",
     "ServingError", "QueueFullError", "RequestTooLargeError",
     "SchedulerStalledError", "EngineDrainingError", "FleetOverloadedError",
+    "TPConfigError",
+    "TPContext", "partition_devices", "validate_tp_config",
+    "collective_counts",
 ]

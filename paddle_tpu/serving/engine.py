@@ -101,7 +101,8 @@ class ServingEngine:
                  kv_quant: bool = False, speculative=None,
                  host_tier=None, chunked: bool = True,
                  prefill_chunk: int = 64, snapshot_store=None,
-                 snapshot_interval: int = 16):
+                 snapshot_interval: int = 16, tp: int = 1,
+                 tp_devices=None):
         cfg = model.config
         self.model = model
         self.page_size = page_size
@@ -110,6 +111,19 @@ class ServingEngine:
                                    if max_pages_per_slot is not None
                                    else (num_pages - 1))
         self.prefix_cache = prefix_cache
+        # tensor parallelism (serving/parallel.py; SERVING.md
+        # "Tensor-parallel serving"): tp=N spans this engine over N
+        # devices (tp_devices, default the first N visible) — the KV
+        # pool shards its kv-head dim, weights go column/row-parallel,
+        # and each of the TWO step programs compiles as ONE shard_map
+        # over the mp axis. tp=1 is exactly the single-device engine.
+        # Un-shardable configs raise TPConfigError here, not a shape
+        # crash inside the compiled step.
+        from .parallel import TPContext, validate_tp_config
+        validate_tp_config(cfg, tp)
+        self.tp = int(tp)
+        self._tp = (TPContext(model, tp, devices=tp_devices)
+                    if tp > 1 else None)
         # int8 KV mode: kv_quant=True, or kv_dtype="int8"/jnp.int8 — the
         # pool stores int8 codes + fp32 absmax scales, quantized at
         # scatter time and dequantized inside the one shared decode core
@@ -128,7 +142,9 @@ class ServingEngine:
             dtype=(jnp.bfloat16 if kv_quant or kv_dtype is None
                    else kv_dtype),
             cache_enabled=prefix_cache, quantized=kv_quant,
-            host_tier=host_tier if prefix_cache else None)
+            host_tier=host_tier if prefix_cache else None,
+            sharding=(self._tp.kv_shardings() if self._tp else None),
+            tp_degree=self.tp)
         # every (re-)admission must fit the slot's block table and the
         # rope table — admission_check guards the window up front
         self._ctx_pages = min(self.max_pages_per_slot,
@@ -186,6 +202,8 @@ class ServingEngine:
         self.metrics.set_host_tier(self.pool.host_tier is not None)
         self.metrics.set_chunked(self.chunked)
         self.metrics.set_snapshots(snapshot_store is not None)
+        self.metrics.set_tp(self.tp,
+                            self.pool.kv_bytes_per_token_shard())
         # observability (OBSERVABILITY.md): the tracer is shared with
         # the scheduler (request-lifecycle spans) and the pool
         # (eviction/COW/quarantine events); construct it on the same
@@ -208,6 +226,10 @@ class ServingEngine:
         self.drain_timeout_s = drain_timeout_s
         self._watchdog = watchdog
         self._state = model.state_dict(include_non_persistable_buffer=True)
+        if self._tp is not None:
+            # one-time placement onto the TP mesh (column/row/vocab
+            # layout from the creation-time weight specs)
+            self._state = self._tp.shard_state(self._state)
         self._requests: dict[str, Request] = {}
         self._rid_counter = itertools.count()
         self._steps = 0
@@ -535,9 +557,12 @@ class ServingEngine:
         dir that :meth:`restore` rejects; the previous committed
         snapshot at ``path`` is replaced only by the atomic rename."""
         snaps = self._capture_requests()
+        # "tp" is informational: payloads are full logical pages (the
+        # capture device_get gathers shards), so a tp=2 snapshot
+        # restores into a tp=1 engine and vice versa
         save_engine_snapshot(path, snaps, meta={
             "steps": self._steps, "kv_quant": self.kv_quant,
-            "page_size": self.page_size})
+            "page_size": self.page_size, "tp": self.tp})
         self.metrics.counters["snapshot_saves"] += 1
         self.tracer.instant("snapshot_save", requests=len(snaps),
                             step=self._steps)
@@ -788,6 +813,7 @@ class ServingEngine:
                 "prefill_chunk": self.prefill_chunk,
                 "snapshots": self.snapshot_store is not None,
                 "snapshot_interval": self.snapshot_interval,
+                "tp": self.tp,
                 "tracing": self.tracer.enabled}
 
     # ------------------------------------------------------------------
@@ -882,7 +908,13 @@ class ServingEngine:
         trailing page is never in the prefix index while its owner
         runs (only full prompt pages are registered at the final
         chunk; the partial tail waits for release), so it is always
-        private."""
+        private.
+
+        Only kv head 0 is poisoned — under TP that head lives on ONE
+        shard, modelling single-device corruption in a TP group; the
+        NaN still reaches every slot output (o_proj mixes all query
+        heads, and at tp>1 the attention-block psum broadcasts it to
+        every shard), so the quarantine is fleet-wide either way."""
         if not req.pages:
             return
         page = req.pages[-1]
@@ -895,9 +927,10 @@ class ServingEngine:
             # fp page (and the quarantine scrub must therefore zero
             # scales as well as codes — tested in test_serving_quant)
             self.pool.pools[0] = (
-                QuantizedKV(pk.q, pk.scale.at[page].set(jnp.nan)), pv)
+                QuantizedKV(pk.q, pk.scale.at[page, :, 0].set(jnp.nan)),
+                pv)
         else:
-            self.pool.pools[0] = (pk.at[page].set(jnp.nan), pv)
+            self.pool.pools[0] = (pk.at[page, :, 0].set(jnp.nan), pv)
 
     # ------------------------------------------------------------------
     # compiled programs
@@ -907,7 +940,6 @@ class ServingEngine:
         from ..nn.module import functional_call
         model = self.model
 
-        @jax.jit
         def decode_step(state, pools, tok, tables, seq_lens, active,
                         temps, top_ps, greedy, seeds, counts):
             (logits, pools), _ = functional_call(
@@ -920,7 +952,14 @@ class ServingEngine:
             nt = _sample_rows(last, temps, top_ps, greedy, seeds, counts)
             return nt, ok, pools
 
-        return decode_step
+        if self._tp is None:
+            return jax.jit(decode_step)
+        # tp>1: the SAME body compiles as ONE shard_map program over the
+        # mp axis — state/pools come in sharded, the 9 host-built lanes
+        # replicated, tokens/ok out replicated (sampling ran on the
+        # all-gathered logits, identically on every shard)
+        return self._tp.compile_step(decode_step, self._state,
+                                     self.pool.pools, n_lanes=9, n_lead=2)
 
     def _build_mixed_step(self):
         """THE mixed step: ONE fixed-shape ``[max_slots, chunk]``
@@ -956,7 +995,6 @@ class ServingEngine:
         model = self.model
         ps = self.page_size
 
-        @jax.jit
         def mixed_step(state, pools, toks, tables, seq_lens, active,
                        n_live, forced, temps, top_ps, greedy, seeds,
                        counts):
@@ -1005,7 +1043,13 @@ class ServingEngine:
                      for pk, pv in pools]
             return samp, m, ok, pools
 
-        return mixed_step
+        if self._tp is None:
+            return jax.jit(mixed_step)
+        # tp>1: same body, ONE shard_map program (the rollback scatter is
+        # head-local — page/off index the replicated dims, every shard
+        # zeroes its own kvh/tp heads of the rejected rows)
+        return self._tp.compile_step(mixed_step, self._state,
+                                     self.pool.pools, n_lanes=11, n_lead=3)
 
     # ------------------------------------------------------------------
     # per-step work

@@ -39,13 +39,19 @@ router that acts on it is :class:`paddle_tpu.serving.fleet.FleetRouter`
   saturated *and* the shared backlog is full. Retryable after backoff
   (clients should retry with jitter), but there is no other replica to
   try — this is the signal to scale out.
+- :class:`TPConfigError` — the model cannot be tensor-parallel-sharded
+  at the requested degree (``kv_heads % tp``, ``vocab % tp``, … fail)
+  or the mesh cannot be built (too few devices). Raised at
+  ``ServingEngine(tp=N)`` construction instead of a shape crash inside
+  the compiled step. NOT retryable: every replica of the same config
+  would fail identically.
 """
 
 from __future__ import annotations
 
 __all__ = ["ServingError", "QueueFullError", "RequestTooLargeError",
            "SchedulerStalledError", "EngineDrainingError",
-           "FleetOverloadedError"]
+           "FleetOverloadedError", "TPConfigError"]
 
 
 class ServingError(RuntimeError):
@@ -96,6 +102,16 @@ class EngineDrainingError(ServingError):
     replicas at placement time."""
 
     retryable = True
+
+
+class TPConfigError(ServingError, ValueError):
+    """The model/mesh cannot support ``tp=N``: a sharded dimension
+    (kv heads, attention heads, vocab, FFN width) is not divisible by
+    the TP degree, or fewer than N devices are visible. Raised at
+    engine construction — the compiled step never sees the bad shapes.
+    Not retryable: homogeneous replicas all reject it identically."""
+
+    retryable = False
 
 
 class FleetOverloadedError(ServingError):

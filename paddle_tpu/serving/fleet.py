@@ -425,6 +425,11 @@ class FleetRouter:
             "running": len(sched.running),
             "pool_utilization": (pool.utilization()
                                  if pool is not None else 0.0),
+            # a replica is a TP *group*: tp devices serving one engine.
+            # One device failing takes the whole group — the breaker /
+            # failover-replay path below is the same either way
+            # (RESILIENCE.md), this gauge just sizes the blast radius.
+            "tp_degree": getattr(eng, "tp", 1),
             "consecutive_failures": rep.consecutive_failures,
             "breaker_opens": rep.opens,
             "backoff_remaining": max(0, rep.backoff_until - self._steps),

@@ -141,7 +141,7 @@ class KVCachePool:
     def __init__(self, num_layers: int, num_pages: int, page_size: int,
                  num_kv_heads: int, head_dim: int, dtype=jnp.bfloat16,
                  cache_enabled: bool = True, quantized: bool = False,
-                 host_tier=None):
+                 host_tier=None, sharding=None, tp_degree: int = 1):
         if num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is the "
                              "reserved scratch page)")
@@ -152,7 +152,20 @@ class KVCachePool:
         self.head_dim = head_dim
         self.quantized = quantized
         self.dtype = jnp.int8 if quantized else dtype
+        # tensor parallelism (serving/parallel.py): ``sharding`` is a
+        # (payload, scale) NamedSharding pair splitting the kv-head dim
+        # over the mp mesh. The arrays stay GLOBAL logical jax.Arrays —
+        # every host-side path below (alloc/refcount/hash metadata,
+        # .at[].set writes, device_get spill/snapshot capture) is
+        # tp-agnostic because sharding is a layout, not a shape change.
+        self.sharding = sharding
+        self.tp_degree = int(tp_degree)
         shape = (num_pages, page_size, num_kv_heads, head_dim)
+
+        def _place(z, scale=False):
+            if sharding is None:
+                return z
+            return jax.device_put(z, sharding[1] if scale else sharding[0])
         # per-layer (pool_k, pool_v); functionally replaced by the compiled
         # programs each step, so the handles here always name the latest.
         # Quantized mode stores int8 codes + one fp32 absmax scale per
@@ -160,11 +173,12 @@ class KVCachePool:
         if quantized:
             def _zeros():
                 return QuantizedKV(
-                    jnp.zeros(shape, jnp.int8),
-                    jnp.zeros(shape[:3], jnp.float32))
+                    _place(jnp.zeros(shape, jnp.int8)),
+                    _place(jnp.zeros(shape[:3], jnp.float32), scale=True))
             self.pools = [(_zeros(), _zeros()) for _ in range(num_layers)]
         else:
-            self.pools = [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            self.pools = [(_place(jnp.zeros(shape, dtype)),
+                           _place(jnp.zeros(shape, dtype)))
                           for _ in range(num_layers)]
         # fp and int8 caches chain their content hashes from different
         # roots — same tokens, different page content, never aliased
@@ -218,14 +232,15 @@ class KVCachePool:
     @classmethod
     def from_config(cls, config, num_pages: int, page_size: int,
                     dtype=jnp.bfloat16, cache_enabled: bool = True,
-                    quantized: bool = False,
-                    host_tier=None) -> "KVCachePool":
+                    quantized: bool = False, host_tier=None,
+                    sharding=None, tp_degree: int = 1) -> "KVCachePool":
         """Build from a model config carrying num_hidden_layers /
         num_key_value_heads / head_dim (LlamaConfig shape)."""
         return cls(config.num_hidden_layers, num_pages, page_size,
                    config.num_key_value_heads, config.head_dim, dtype,
                    cache_enabled=cache_enabled, quantized=quantized,
-                   host_tier=host_tier)
+                   host_tier=host_tier, sharding=sharding,
+                   tp_degree=tp_degree)
 
     # ---- accounting ----
 
@@ -273,13 +288,22 @@ class KVCachePool:
             per = kvh * d * jnp.dtype(self.dtype).itemsize
         return 2 * self.num_layers * per
 
+    def kv_bytes_per_token_shard(self) -> int:
+        """Per-DEVICE bytes one cached token costs under tensor
+        parallelism: the kv-head dim is split tp ways, so each shard
+        holds ``kvh/tp`` heads of every page (== the full figure at
+        tp=1). The per-chip HBM budget a TP deployment plans against."""
+        return self.kv_bytes_per_token() // max(self.tp_degree, 1)
+
     def stats(self) -> dict:
         # host-tier breakdown rides along (schema-stable zeros when the
         # tier is off) so dashboards reading pool stats don't need a
         # second call — and observability.render_prometheus turns every
-        # numeric key here into a paddle_serving_pool_* gauge
+        # numeric key here into a paddle_serving_pool_* gauge (the tp_*
+        # keys below become the paddle_serving_pool_tp_* family)
         tier = (self.host_tier.stats() if self.host_tier is not None
                 else HostTier.zero_stats())
+        shard_bpt = self.kv_bytes_per_token_shard()
         return {"num_pages": self.num_pages, "page_size": self.page_size,
                 "capacity": self.capacity, "in_use": self.num_in_use,
                 "pinned": self.num_in_use, "cached": self.num_cached,
@@ -288,6 +312,12 @@ class KVCachePool:
                 "indexed_pages": len(self._page_key),
                 "kv_quant": int(self.quantized),
                 "host_tier": int(self.host_tier is not None),
+                "tp_degree": self.tp_degree,
+                "tp_shard_kv_bytes_per_token": shard_bpt,
+                "tp_shard_in_use_bytes":
+                    self.num_in_use * self.page_size * shard_bpt,
+                "tp_shard_capacity_bytes":
+                    self.capacity * self.page_size * shard_bpt,
                 **tier,
                 **self.counters}
 
@@ -607,6 +637,12 @@ class KVCachePool:
                     parts.append(arr.scale[page])
                 else:
                     parts.append(arr[page])
+        if self.tp_degree > 1:
+            # the device_get below collects every shard's kvh/tp heads
+            # into the full logical page — the HostTier payload format
+            # stays tp-portable (a tp=2 spill restores into tp=1)
+            self.tracer.instant("shard_gather", track="pool", page=page,
+                                tp=self.tp_degree, kind="spill")
         return [np.asarray(x) for x in jax.device_get(parts)]
 
     def export_pages(self, pages: list[int]) -> list[list[np.ndarray]]:
@@ -626,6 +662,12 @@ class KVCachePool:
                         parts.append(arr.scale[page])
                     else:
                         parts.append(arr[page])
+        if self.tp_degree > 1:
+            # shard-gather: snapshot payloads hold full logical pages,
+            # so a tp=2 snapshot restores into a tp=1 engine (and back)
+            self.tracer.instant("shard_gather", track="pool",
+                                pages=len(pages), tp=self.tp_degree,
+                                kind="snapshot")
         flat = [np.asarray(x) for x in jax.device_get(parts)]
         k = len(flat) // len(pages)
         return [flat[i * k:(i + 1) * k] for i in range(len(pages))]

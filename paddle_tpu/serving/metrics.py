@@ -183,6 +183,12 @@ class ServingMetrics:
         # each capture — schema-stable zeros with snapshots off
         self.snapshots_enabled = 0
         self._snapshot_stats: dict[str, int] = {}
+        # tensor parallelism (SERVING.md "Tensor-parallel serving"): the
+        # TP degree gauge (1 == single-device engine) and the per-shard
+        # KV footprint per cached token — the tp_* keys become the
+        # paddle_serving_tp_* Prometheus family via render_prometheus
+        self.tp_degree = 1
+        self.tp_shard_kv_bytes_per_token = 0
         self._mixed_steps = 0
         self._chunk_tokens = 0
         self._chunks_dispatched = 0
@@ -375,6 +381,14 @@ class ServingMetrics:
         """Arm the snapshots_enabled gauge (int, for Prometheus)."""
         self.snapshots_enabled = int(bool(enabled))
 
+    # ---- tensor parallelism (serving/parallel.py) ----
+
+    def set_tp(self, tp: int, shard_kv_bytes_per_token: int = 0) -> None:
+        """Arm the TP gauges: the engine's TP degree and the per-DEVICE
+        KV bytes one cached token costs (== the full figure at tp=1)."""
+        self.tp_degree = int(tp)
+        self.tp_shard_kv_bytes_per_token = int(shard_kv_bytes_per_token)
+
     def on_snapshot_stats(self, stats: dict) -> None:
         """Mirror the snapshot store's capture gauges
         (SnapshotStore.stats()) into the summary — called by the
@@ -520,6 +534,10 @@ class ServingMetrics:
             # snapshotting off; the store's keys are snapshot_-prefixed)
             "snapshots_enabled": self.snapshots_enabled,
             **{**_SnapshotStore.zero_stats(), **self._snapshot_stats},
+            # tensor parallelism (schema-stable: tp_degree 1 on a
+            # single-device engine) — the paddle_serving_tp_* family
+            "tp_degree": self.tp_degree,
+            "tp_shard_kv_bytes_per_token": self.tp_shard_kv_bytes_per_token,
             # pool counters live under prefix_* so they can never
             # shadow a summary key (the pool already uses that prefix
             # for most of them — normalise the stragglers)

@@ -1,0 +1,236 @@
+"""Tensor-parallel serving: one engine spanning a TP mesh via shard_map.
+
+``ServingEngine(tp=N)`` keeps the engine's central contract — exactly TWO
+compiled programs, the ``[max_slots]`` decode step and the
+``[max_slots, chunk]`` mixed step — and runs each as ONE ``shard_map``
+program over the ``mp`` axis (Megatron-style head/column/row partitioning,
+Shoeybi et al. 2019; the 2D inference layouts of Pope et al. 2022 reduce
+to this on a 1D mp mesh). The division of labour:
+
+===========================  =============================================
+sharded (per-device)         replicated (host-side / every device)
+===========================  =============================================
+KV page payloads: the kv-    block tables, seq_lens, content hashes,
+head dim of every page       prefix registration, refcounts, eviction —
+(`kvh/tp` heads per shard;   ALL pool metadata. Sampling lanes (temps,
+int8 scales shard the same   top_ps, seeds, counts). Logits after the
+dim)                         final all_gather, so sampling runs once per
+q/k/v, gate/up weights       shard on identical values and the
+(column-parallel) and        ``fold_in(key, token_index)`` contract is
+o/down weights (row-         untouched.
+parallel); embed rows and
+lm_head columns (vocab)
+===========================  =============================================
+
+Attention is fully head-local: the paged scatter, the Pallas paged kernel
+and the shared GQA decode core all run per-shard unchanged (the GQA ratio
+``h/kvh`` survives sharding because both split by ``tp``). Each
+transformer block issues exactly ONE psum (after o_proj / down_proj), the
+vocab-parallel embedding one psum, and the vocab-sharded logits one
+all_gather — nothing ever gathers the KV pool
+(``tools/profile_serving.py --tp`` asserts these counts on the jaxpr).
+
+Because pool arrays and weights stay GLOBAL logical ``jax.Array``s with a
+``NamedSharding`` (sharding is a layout property, not a shape change),
+every host-side path — spill/restore, snapshot capture, prefix injection,
+scrub/rewind/cow — is tp-agnostic: ``device_get`` gathers shards into the
+HostTier payload format, so a tp=2 snapshot restores into a tp=1 engine
+and vice versa (SERVING.md "Tensor-parallel serving").
+
+CPU verification needs no chip: force a virtual multi-device platform with
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (the
+``dryrun_multichip`` harness; tests/conftest.py does this for the whole
+suite).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core import mesh as mesh_lib
+from ..core.compat import shard_map
+from ..distributed.fleet.mp_layers import manual_mp_region
+from .errors import TPConfigError
+
+__all__ = ["TPContext", "validate_tp_config", "partition_devices",
+           "collective_counts"]
+
+
+def validate_tp_config(config, tp: int) -> None:
+    """Reject un-shardable configs at construction time with a typed
+    :class:`TPConfigError` instead of a shape crash inside the compiled
+    step. Every dimension the TP layout splits must divide evenly."""
+    if tp < 1:
+        raise TPConfigError(f"tp must be >= 1, got {tp}")
+    if tp == 1:
+        return
+    checks = (
+        ("num_key_value_heads", "KV pool head dim"),
+        ("num_attention_heads", "query heads"),
+        ("vocab_size", "vocab-parallel embedding / lm_head"),
+        ("intermediate_size", "column-parallel gate/up"),
+    )
+    for field, what in checks:
+        val = getattr(config, field, None)
+        if val is not None and val % tp:
+            raise TPConfigError(
+                f"{field}={val} is not divisible by tp={tp} ({what} "
+                f"shards this dimension)")
+
+
+def partition_devices(n_groups: int, tp: int, devices=None) -> list[list]:
+    """Carve the device list into ``n_groups`` disjoint TP groups of
+    ``tp`` devices each — a fleet replica IS a TP group, so a 2-replica
+    tp=2 fleet on 4 devices is ``partition_devices(2, 2)`` feeding each
+    slice to ``ServingEngine(tp=2, tp_devices=slice)``."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    need = n_groups * tp
+    if len(devs) < need:
+        raise TPConfigError(
+            f"{n_groups} TP groups of {tp} need {need} devices, have "
+            f"{len(devs)} (CPU: set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={need})")
+    return [devs[i * tp:(i + 1) * tp] for i in range(n_groups)]
+
+
+def _trim(*entries) -> P:
+    """PartitionSpec with trailing Nones dropped. jax normalizes shard_map
+    output shardings this way, and jit's cache key compares specs
+    structurally — an input placed with ``P(None, None, 'mp', None)`` vs a
+    step output carrying ``P(None, None, 'mp')`` would retrace the step on
+    its second call even though the layouts are identical. Trimming at the
+    source keeps every pool array's sharding bit-stable across calls, so
+    ``step_program_counts()`` stays pinned."""
+    entries = list(entries)
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
+class TPContext:
+    """Everything the engine needs to span a TP group: the mp mesh over
+    its device slice, the weight/pool shardings, and the shard_map
+    wrapper that turns a step body into ONE manual-mp program."""
+
+    axis = "mp"
+
+    def __init__(self, model, tp: int, devices=None):
+        validate_tp_config(model.config, tp)
+        devs = list(devices) if devices is not None else list(jax.devices())
+        if len(devs) < tp:
+            raise TPConfigError(
+                f"tp={tp} needs {tp} devices, have {len(devs)} (CPU: set "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count={tp})")
+        self.tp = int(tp)
+        self.mesh = mesh_lib.make_mesh({self.axis: tp}, devices=devs[:tp])
+        self.devices = devs[:tp]
+        # weight specs from the model's creation-time PartitionSpecs: keep
+        # the mp entries, null every other axis (the serving mesh has only
+        # mp); state keys absent from spec_dict (buffers) are replicated
+        self._specs = {}
+        for name, spec in model.spec_dict().items():
+            if spec is None:
+                self._specs[name] = P()
+            else:
+                self._specs[name] = _trim(*[a if a == self.axis else None
+                                            for a in spec])
+
+    # -- shardings ---------------------------------------------------------
+
+    def spec_for(self, name: str) -> P:
+        return self._specs.get(name, P())
+
+    def shard_state(self, state: dict) -> dict:
+        """One-time placement of the weights/buffers onto the TP mesh
+        (column/row/vocab layout per the creation-time specs)."""
+        return {k: jax.device_put(v, NamedSharding(self.mesh, self.spec_for(k)))
+                for k, v in state.items()}
+
+    def kv_shardings(self):
+        """(payload, scale) NamedShardings for pool arrays: pages and
+        rows replicated, the kv-head dim split on mp — each shard owns
+        ``kvh/tp`` heads of EVERY page, so all page metadata stays valid
+        on every shard."""
+        return (NamedSharding(self.mesh, _trim(None, None, self.axis, None)),
+                NamedSharding(self.mesh, P(None, None, self.axis)))
+
+    def _kv_entry(self, arr):
+        if hasattr(arr, "q"):  # QuantizedKV: codes + per-(row, head) scales
+            return type(arr)(_trim(None, None, self.axis, None),
+                             P(None, None, self.axis))
+        return _trim(None, None, self.axis, None)
+
+    def pool_specs(self, pools):
+        return [(self._kv_entry(pk), self._kv_entry(pv)) for pk, pv in pools]
+
+    # -- step compilation --------------------------------------------------
+
+    def compile_step(self, fn, state, pools, n_lanes: int, n_lead: int):
+        """Wrap a step body ``fn(state, pools, *lanes) -> (*outs, pools)``
+        into ONE jitted shard_map program over the mp axis.
+
+        All host-built lanes (tokens, block tables, seq_lens, sampling
+        params) go in replicated; the ``n_lead`` leading outputs (sampled
+        tokens, finite masks, …) come out replicated — they are computed
+        identically on every shard from the all-gathered logits, which is
+        what keeps sampling and the fold_in contract single-program.
+        ``check_vma=False`` skips the replication proof for exactly those
+        outputs. The un-jitted shard_map callable is kept on the returned
+        function as ``_tp_inner`` so the collective-count report
+        (:func:`collective_counts`) can trace it."""
+        ax = self.axis
+
+        def body(state, pools, *lanes):
+            with manual_mp_region(ax):
+                return fn(state, pools, *lanes)
+
+        in_specs = ({k: self.spec_for(k) for k in state},
+                    self.pool_specs(pools), *([P()] * n_lanes))
+        out_specs = (*([P()] * n_lead), self.pool_specs(pools))
+        inner = shard_map(body, mesh=self.mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
+        step = jax.jit(inner)
+        step._tp_inner = inner
+        return step
+
+
+# -- collective-count report ----------------------------------------------
+
+_COLLECTIVES = ("psum", "all_gather", "all_to_all", "all_reduce",
+                "reduce_scatter", "ppermute")
+
+
+def _subjaxprs(v):
+    if hasattr(v, "eqns"):          # Jaxpr
+        yield v
+    elif hasattr(v, "jaxpr"):       # ClosedJaxpr
+        yield v.jaxpr
+    elif isinstance(v, (tuple, list)):
+        for item in v:
+            yield from _subjaxprs(item)
+
+
+def collective_counts(fn, *args) -> dict[str, int]:
+    """Trace ``fn(*args)`` and count collective primitives, recursing into
+    sub-jaxprs (shard_map/pjit/scan bodies). The TP contract audited by
+    ``tools/profile_serving.py --tp``: a step program carries exactly
+    ``2 * num_layers + 1`` psums (one per attention block, one per MLP
+    block, one for the vocab-parallel embedding) and exactly 1 all_gather
+    (the vocab-sharded logits) — never an all_gather of the KV pool."""
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    counts: dict[str, int] = {}
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            for c in _COLLECTIVES:
+                if name == c or name.startswith(c + "_") or name == c + "2":
+                    counts[c] = counts.get(c, 0) + 1
+                    break
+            for v in eqn.params.values():
+                for sub in _subjaxprs(v):
+                    walk(sub)
+
+    walk(jaxpr.jaxpr)
+    return counts

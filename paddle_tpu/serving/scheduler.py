@@ -11,7 +11,10 @@ token granularity; nothing ever waits for a whole batch to drain.
 All state here is host-side Python (deques and integer lists); the
 device-side consequences (block tables, active masks, position offsets)
 are materialized by the engine as plain array inputs to its single
-compiled decode program.
+compiled decode program. Under tensor parallelism (serving/parallel.py)
+nothing here changes: scheduler state is REPLICATED host metadata — one
+block table, one refcount ledger, one admission queue feed every shard
+of the TP group, because each shard holds its slice of every page.
 """
 
 from __future__ import annotations

@@ -17,7 +17,12 @@ each payload guarded by a blake2b-128 digest and the metadata by its
 own digest. Capture happens on the HOST side of the step via one
 batched ``device_get`` — never inside a compiled program — so the
 engine's no-retrace contract (``step_program_counts() == {"decode": 1,
-"mixed": 1}``) is untouched.
+"mixed": 1}``) is untouched. Under tensor parallelism that same
+``device_get`` gathers the kv-head-sharded pool shards into the one
+global payload format, which makes snapshots TP-PORTABLE: a tp=2
+capture restores into a tp=1 engine and vice versa (the engine records
+its ``tp`` degree in the snapshot meta for observability, not as a
+compatibility key).
 
 Two consumers:
 

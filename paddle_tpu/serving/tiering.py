@@ -22,6 +22,14 @@ the pool's quarantine rules: a quarantined page is never offered to
 ``spill`` (the pool guards it), and quarantining a page purges its
 host-tier entry too.
 
+Tensor parallelism (serving/parallel.py): pool arrays stay GLOBAL
+logical ``jax.Array``s whose kv-head dim is sharded across the TP
+group, so the ``device_get`` in ``spill`` transparently gathers every
+shard's slice into the SAME host payload format a tp=1 pool produces —
+host entries (and therefore snapshots built from them) are tp-portable
+in both directions. The pool emits a ``shard_gather`` trace instant on
+that path when ``tp > 1``.
+
 Accounting rule (SERVING.md "KV tiering & traffic harness"): restored
 tokens are cached tokens — they skip recompute FLOPs — but they pay
 restore BYTES, so the scheduler charges ``ceil(restored_tokens *

@@ -17,6 +17,7 @@ _DEFAULT_CONFIGS = {
     "llama_serving_prefix", "llama_decode_int8", "llama_serving_int8",
     "llama_serving_fleet", "llama_serving_spec", "llama_serving_tiered",
     "llama_serving_chunked", "llama_serving_failover",
+    "llama_serving_tp",
 }
 
 
@@ -213,6 +214,24 @@ def test_dry_serving_tiered_cell_carries_tier_keys():
                          "tier_host_hit_rate", "tier_miss_rate",
                          "spilled_pages", "restored_pages", "shed",
                          "goodput_at_slo", "goodput_at_slo_notier",
+                         "retraces"}, cell
+    assert all(v is None for v in cell.values()), cell
+
+
+def test_dry_serving_tp_cell_carries_tp_keys():
+    # the tensor-parallel arm (SERVING.md "Tensor-parallel serving"):
+    # the cell must surface the A/B evidence — tp degree, per-shard vs
+    # total KV bytes per token, and tokens/s + goodput_at_slo for BOTH
+    # arms — next to the usual serving keys
+    out = _run_dry("llama_serving_tp")
+    assert out.returncode == 0, out.stderr
+    last = json.loads(out.stdout.splitlines()[-1])
+    cell = last["bench_summary"]["llama_serving_tp"]
+    assert set(cell) >= {"value", "mfu", "spread",
+                         "ttft_p50", "ttft_p99", "tpot",
+                         "tp_degree", "tp_shard_kv_bytes_per_token",
+                         "kv_bytes_per_token", "tokens_per_s_tp1",
+                         "goodput_at_slo", "goodput_at_slo_tp1",
                          "retraces"}, cell
     assert all(v is None for v in cell.values()), cell
 

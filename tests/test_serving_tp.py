@@ -1,0 +1,384 @@
+"""paddle_tpu.serving.parallel — tensor-parallel paged serving.
+
+The TP contracts (SERVING.md "Tensor-parallel serving"):
+
+1. BITWISE ACROSS DEGREES — ``ServingEngine(tp=2)`` emits streams
+   bitwise identical to ``tp=1`` and to ``model.generate()``, composed
+   with prefix caching, int8 KV, speculation, chunked prefill and the
+   host tier: sharding the kv-head dim and the Megatron weight layout
+   changes WHERE math runs, never WHAT it computes (the one psum per
+   block sums exact partial products; sampling sees all-gathered
+   logits identical on every shard).
+2. TWO PROGRAMS, ANY DEGREE — ``step_program_counts()`` stays
+   ``{"decode": 1, "mixed": 1}`` over request churn at every tp; each
+   step is ONE jitted shard_map program.
+3. PORTABLE SNAPSHOTS — pool payloads device_get as GLOBAL arrays, so
+   a tp=2 snapshot restores into a tp=1 engine (and vice versa)
+   bitwise.
+4. TYPED REJECTION — un-shardable configs (kv heads or vocab not
+   divisible by tp) raise :class:`TPConfigError` at construction, not
+   a shape crash inside the compiled step.
+
+The suite runs on CPU: tests/conftest.py forces
+``--xla_force_host_platform_device_count=8`` for the whole run, so
+tp in {2, 4} and a 2-replica tp=2 fleet all fit. Chaos tests carry the
+``faults`` marker; heavy compile matrices are ``slow``.
+"""
+
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import fault
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, llama_tiny
+from paddle_tpu.observability import render_prometheus
+from paddle_tpu.serving import (FleetRouter, ServingEngine, TPConfigError,
+                                collective_counts, partition_devices,
+                                validate_tp_config)
+
+RNG = np.random.default_rng(41)
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(123)
+    m = LlamaForCausalLM(llama_tiny(dtype="float32",
+                                    mp_axis="mp", fsdp_axis=None))
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def model_kvh4():
+    """tp=4 needs num_key_value_heads % 4 == 0 (llama_tiny has 2)."""
+    pt.seed(123)
+    cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                      intermediate_size=384, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=4,
+                      max_position_embeddings=512, dtype="float32",
+                      mp_axis="mp", fsdp_axis=None)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture
+def fault_free(monkeypatch):
+    """No FaultPlan leaks out of a chaos test; no rank env leaks in."""
+    fault.deactivate()
+    monkeypatch.delenv("PADDLE_TRAINER_ID", raising=False)
+    monkeypatch.delenv("PROCESS_ID", raising=False)
+    monkeypatch.delenv("PADDLE_RESTART_EPOCH", raising=False)
+    yield
+    fault.deactivate()
+
+
+def _reference(model, prompt, max_new, **kw):
+    out = model.generate(jnp.asarray([prompt]), max_new_tokens=max_new, **kw)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def _mk(model, tp=1, **kw):
+    cfg = dict(num_pages=64, page_size=8, max_slots=4)
+    cfg.update(kw)
+    return ServingEngine(model, tp=tp, **cfg)
+
+
+def _prompts(n=3, lo=4, hi=14):
+    return [RNG.integers(1, 500, size=int(RNG.integers(lo, hi))).tolist()
+            for _ in range(n)]
+
+
+def _serve(model, tp, prompts, max_new=8, **kw):
+    eng = _mk(model, tp=tp, **kw)
+    rids = [eng.add_request(p, max_new, eos_token_id=None) for p in prompts]
+    out = eng.run_to_completion(max_steps=400)
+    assert eng.step_program_counts() == {"decode": 1, "mixed": 1}
+    eng.audit_pool()
+    return [out[r] for r in rids], eng
+
+
+# ---------------------------------------------------------------------------
+# typed construction-time rejection
+# ---------------------------------------------------------------------------
+
+class TestTPValidation:
+    def test_kv_heads_not_divisible(self, model, fault_free):
+        with pytest.raises(TPConfigError, match="num_key_value_heads"):
+            _mk(model, tp=4)            # llama_tiny: kvh=2, 2 % 4 != 0
+
+    def test_vocab_not_divisible(self):
+        cfg = SimpleNamespace(num_key_value_heads=2, num_attention_heads=2,
+                              vocab_size=511, intermediate_size=384)
+        with pytest.raises(TPConfigError, match="vocab_size"):
+            validate_tp_config(cfg, 2)
+
+    def test_tp_zero_rejected(self):
+        with pytest.raises(TPConfigError, match=">= 1"):
+            validate_tp_config(SimpleNamespace(), 0)
+
+    def test_tp_one_skips_divisibility(self):
+        validate_tp_config(SimpleNamespace(vocab_size=511), 1)
+
+    def test_partition_devices_too_few(self):
+        with pytest.raises(TPConfigError, match="host_platform_device_count"):
+            partition_devices(8, 4)
+
+    def test_partition_devices_disjoint(self):
+        groups = partition_devices(2, 2)
+        assert len(groups) == 2 and all(len(g) == 2 for g in groups)
+        assert len({d.id for g in groups for d in g}) == 4
+
+    def test_error_is_serving_error_and_value_error(self, model, fault_free):
+        from paddle_tpu.serving import ServingError
+        with pytest.raises(ServingError):
+            _mk(model, tp=4)
+        with pytest.raises(ValueError):
+            _mk(model, tp=4)
+
+
+# ---------------------------------------------------------------------------
+# bitwise parity across tp degrees x feature compositions
+# ---------------------------------------------------------------------------
+
+class TestTPParity:
+    def test_tp2_matches_tp1_and_generate(self, model, fault_free):
+        prompts = _prompts()
+        a, _ = _serve(model, 1, prompts)
+        b, _ = _serve(model, 2, prompts)
+        assert a == b
+        assert a[0] == _reference(model, prompts[0], 8, eos_token_id=None)
+
+    def test_tp2_prefix_reuse_bitwise(self, model, fault_free):
+        """Two prompts sharing a long prefix: the second is admitted
+        through the (sharded) prefix cache and still streams bitwise."""
+        base = RNG.integers(1, 500, size=16).tolist()
+        prompts = [base + [7, 8], base + [9, 10, 11]]
+
+        def sequential(tp):
+            eng = _mk(model, tp=tp)
+            streams = []
+            for p in prompts:         # 2nd admission sees 1st's pages
+                rid = eng.add_request(p, 8, eos_token_id=None)
+                streams.append(eng.run_to_completion(max_steps=200)[rid])
+            return streams, eng
+
+        a, _ = sequential(1)
+        b, eng = sequential(2)
+        assert a == b
+        assert eng.pool.counters["prefix_hits"] >= 1
+        assert eng.step_program_counts() == {"decode": 1, "mixed": 1}
+
+    def test_tp2_int8_kv_bitwise(self, model, fault_free):
+        prompts = _prompts()
+        a, _ = _serve(model, 1, prompts, kv_quant=True)
+        b, eng = _serve(model, 2, prompts, kv_quant=True)
+        assert a == b
+        assert eng.pool.stats()["tp_degree"] == 2
+
+    @pytest.mark.slow
+    def test_tp2_speculative_bitwise(self, model, fault_free):
+        prompts = _prompts()
+        a, _ = _serve(model, 1, prompts, speculative=2)
+        b, _ = _serve(model, 2, prompts, speculative=2)
+        assert a == b
+
+    @pytest.mark.slow
+    def test_tp2_chunked_prefill_bitwise(self, model, fault_free):
+        prompts = _prompts(lo=10, hi=20)
+        a, _ = _serve(model, 1, prompts, chunked=True, prefill_chunk=4)
+        b, _ = _serve(model, 2, prompts, chunked=True, prefill_chunk=4)
+        assert a == b
+
+    @pytest.mark.slow
+    def test_tp2_host_tier_bitwise(self, model, fault_free):
+        prompts = _prompts()
+        a, _ = _serve(model, 1, prompts, host_tier=True)
+        b, _ = _serve(model, 2, prompts, host_tier=True)
+        assert a == b
+
+    @pytest.mark.slow
+    def test_tp4_matches_tp1(self, model_kvh4, fault_free):
+        prompts = _prompts()
+        a, _ = _serve(model_kvh4, 1, prompts)
+        b, _ = _serve(model_kvh4, 4, prompts)
+        assert a == b
+
+
+# ---------------------------------------------------------------------------
+# program counts, collectives, observability
+# ---------------------------------------------------------------------------
+
+class TestTPPrograms:
+    def test_counts_pinned_over_churn_epochs(self, model, fault_free):
+        """3 admission waves through one tp=2 engine: churn changes
+        array values, never shapes — and under TP, never shardings."""
+        eng = _mk(model, tp=2)
+        for epoch in range(3):
+            rids = [eng.add_request(p, 6, eos_token_id=None)
+                    for p in _prompts(n=4)]
+            out = eng.run_to_completion(max_steps=400)
+            assert all(len(out[r]) == 6 for r in rids)
+            assert eng.step_program_counts() == {"decode": 1, "mixed": 1}, \
+                f"retraced in epoch {epoch}"
+        eng.audit_pool()
+
+    def test_exactly_one_psum_per_block(self, model, fault_free):
+        """The jaxpr of each step program carries 2 * num_layers + 1
+        psums (one per attention block, one per MLP block, one for the
+        vocab-parallel embedding) and exactly ONE all_gather (logits) —
+        nothing ever gathers the KV pool."""
+        eng = _mk(model, tp=2)
+        L = model.config.num_hidden_layers
+        S, M = eng.max_slots, eng.max_pages_per_slot
+        z = lambda *s: jnp.zeros(s, jnp.int32)         # noqa: E731
+        o = lambda *s: jnp.ones(s, jnp.float32)        # noqa: E731
+        decode_args = (eng._state, eng.pool.pools, z(S), z(S, M), z(S),
+                       jnp.zeros((S,), bool), o(S), o(S),
+                       jnp.ones((S,), bool), z(S), z(S))
+        K = eng._chunk
+        mixed_args = (eng._state, eng.pool.pools, z(S, K), z(S, M), z(S),
+                      jnp.zeros((S,), bool), z(S), jnp.zeros((S,), bool),
+                      o(S), o(S), jnp.ones((S,), bool), z(S), z(S))
+        for step, args in ((eng._decode_step, decode_args),
+                           (eng._mixed_step, mixed_args)):
+            c = collective_counts(step._tp_inner, *args)
+            assert c.get("psum", 0) == 2 * L + 1, c
+            assert c.get("all_gather", 0) == 1, c
+            assert c.get("all_to_all", 0) == 0, c
+
+    def test_tp_observability_surface(self, model, fault_free):
+        eng = _mk(model, tp=2)
+        eng.add_request(_prompts(n=1)[0], 4, eos_token_id=None)
+        eng.run_to_completion(max_steps=200)
+        st = eng.pool.stats()
+        assert st["tp_degree"] == 2
+        assert st["tp_shard_kv_bytes_per_token"] \
+            == eng.pool.kv_bytes_per_token() // 2
+        assert st["tp_shard_capacity_bytes"] > 0
+        assert eng.metrics.summary()["tp_degree"] == 2
+        assert eng.stats()["tp"] == 2
+        page = render_prometheus(eng.metrics.summary(), st,
+                                 eng.tracer.counters)
+        assert "paddle_serving_tp_degree 2" in page
+        assert "paddle_serving_pool_tp_shard_kv_bytes_per_token" in page
+
+    def test_tp1_has_no_tp_machinery(self, model, fault_free):
+        eng = _mk(model, tp=1)
+        assert eng._tp is None
+        assert eng.pool.stats()["tp_degree"] == 1
+        assert eng.metrics.summary()["tp_degree"] == 1
+
+
+# ---------------------------------------------------------------------------
+# snapshot portability across tp degrees
+# ---------------------------------------------------------------------------
+
+class TestTPSnapshotPortability:
+    def _partial(self, model, tmp_path, tp, steps=6, **kw):
+        prompts = [RNG.integers(1, 500, size=7).tolist(),
+                   RNG.integers(1, 500, size=5).tolist()]
+        eng = _mk(model, tp=tp, **kw)
+        rids = [eng.add_request(p, 10, eos_token_id=None) for p in prompts]
+        for _ in range(steps):
+            eng.step()
+        path = str(tmp_path / "snap")
+        eng.save_snapshot(path)
+        return eng, rids, path
+
+    def test_tp2_snapshot_restores_into_tp1(self, model, tmp_path,
+                                            fault_free):
+        """Page payloads device_get as GLOBAL arrays — a tp=2 snapshot
+        is just bytes a tp=1 engine can re-place unsharded."""
+        eng, rids, path = self._partial(model, tmp_path, tp=2)
+        warm = _mk(model, tp=1)
+        assert warm.restore(path) == rids
+        out = warm.run_to_completion(max_steps=100)
+        cont = eng.run_to_completion(max_steps=100)
+        for r in rids:
+            assert out[r] == cont[r]
+        assert warm.metrics.counters["snapshot_restore_corrupt"] == 0
+        warm.audit_pool()
+        eng.audit_pool()
+
+    @pytest.mark.slow
+    def test_tp1_snapshot_restores_into_tp2(self, model, tmp_path,
+                                            fault_free):
+        eng, rids, path = self._partial(model, tmp_path, tp=1)
+        warm = _mk(model, tp=2)
+        assert warm.restore(path) == rids
+        out = warm.run_to_completion(max_steps=100)
+        cont = eng.run_to_completion(max_steps=100)
+        for r in rids:
+            assert out[r] == cont[r]
+        # restore injects pages host-side, so the warm engine may go
+        # straight to pure decode — mixed compiles 0 or 1 programs
+        counts = warm.step_program_counts()
+        assert counts["decode"] == 1 and counts["mixed"] <= 1
+        warm.audit_pool()
+
+    def test_snapshot_meta_records_tp(self, model, tmp_path, fault_free):
+        from paddle_tpu.serving import load_engine_snapshot
+        _, _, path = self._partial(model, tmp_path, tp=2)
+        _, meta = load_engine_snapshot(path)
+        assert meta["tp"] == 2
+
+
+# ---------------------------------------------------------------------------
+# chaos: a fleet replica IS a TP group
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faults
+@pytest.mark.slow
+class TestTPFleetChaos:
+    def test_alloc_storm_and_poison_on_tp2_fleet(self, model, fault_free):
+        """2 replicas x tp=2 on 4 disjoint devices: a permanent alloc
+        storm pinned to replica 0 ejects it (failover replay), and one
+        NaN-poisoned request — corrupting ONE shard's kv-head slice —
+        is quarantined fleet-wide because the o_proj psum mixes every
+        shard's heads into the checked output. Survivor audits clean."""
+        groups = partition_devices(2, 2)
+        engines = [_mk(model, tp=2, tp_devices=g) for g in groups]
+        assert all(e.tp == 2 for e in engines)
+        router = FleetRouter(engines, max_queue_depth=64)
+        # lengths stay off page_size multiples: the poison NaNs the
+        # request's (private) LAST page, which must hold already-valid
+        # rows — a fresh boundary page's only row is overwritten by the
+        # next scatter and the rest is masked
+        prompts = _prompts(n=8, lo=4, hi=8)
+        refs = [_reference(model, p, 6, eos_token_id=None) for p in prompts]
+        poisoned_rid = "fleet-req-3"
+        fault.activate(fault.FaultPlan([
+            fault.FaultSpec(site="serving.alloc", action="raise",
+                            once=False, match=r"^0$"),
+            fault.FaultSpec(site="serving.decode", action="poison",
+                            match=rf"^{poisoned_rid}$"),
+        ]))
+        rids = [router.submit(p, 6, eos_token_id=None) for p in prompts]
+        events = []
+        while router.has_work():
+            events.extend(router.step())
+            assert router.stats()["steps"] < 2000, "router hang"
+        classified = 0
+        for rid, ref in zip(rids, refs):
+            rec = router.request(rid)
+            assert rec.finished
+            if rec.finish_reason in ("stop", "length"):
+                assert rec.tokens == ref
+            else:
+                classified += 1
+        assert classified >= 1
+        assert router.request(poisoned_rid).finish_reason in (
+            "nonfinite", "injected")
+        st = router.stats()
+        for h in st["replica_health"]:
+            assert h["tp_degree"] == 2      # blast radius = the TP group
+            if h["state"] != "dead":
+                eng = router.engines[h["replica"]]
+                assert eng.step_program_counts() == {"decode": 1,
+                                                     "mixed": 1}
+                eng.audit_pool()

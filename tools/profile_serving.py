@@ -94,6 +94,22 @@ Run: python tools/profile_serving.py            (real TPU)
                                                  flight-recorder dump path —
                                                  SERVING.md "Engine fleet &
                                                  failover")
+     python tools/profile_serving.py --tp       (tensor-parallel A/B on a
+                                                 forced 2-device CPU mesh:
+                                                 the same staggered trace
+                                                 served at tp=1 and tp=2 —
+                                                 bitwise stream parity vs
+                                                 generate() asserted on
+                                                 BOTH arms, then the per-
+                                                 step collective-count
+                                                 report: exactly ONE psum
+                                                 per attention/MLP block +
+                                                 embedding and ONE logits
+                                                 all_gather per program,
+                                                 never an all_gather of
+                                                 the KV pool — SERVING.md
+                                                 "Tensor-parallel
+                                                 serving")
      python tools/profile_serving.py --crash-restart
                                                 (warm-restart rehearsal:
                                                  run a staggered trace,
@@ -1267,6 +1283,92 @@ def main():
           f"dispatch the cost")
 
 
+def tp():
+    """Tensor-parallel serving A/B (SERVING.md "Tensor-parallel
+    serving"): one staggered trace served by a tp=1 engine, a tp=2
+    engine spanning a forced 2-device CPU mesh, and ``generate()`` —
+    all three must be bitwise identical. Then the collective audit:
+    trace both step programs' shard_map bodies and assert each carries
+    exactly ``2 * num_layers + 1`` psums (one per attention block, one
+    per MLP block, one vocab-parallel embedding) and exactly ONE
+    all_gather (the vocab-sharded logits) — an accidental all_gather of
+    the KV pool would show up here as a second one."""
+    import os
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.serving import ServingEngine, collective_counts
+
+    pt.seed(0)
+    model = LlamaForCausalLM(llama_tiny(dtype="float32",
+                                        mp_axis="mp", fsdp_axis=None))
+    model.eval()
+    L = model.config.num_hidden_layers
+
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, 500, size=int(n)).tolist()
+               for n in rng.integers(5, 14, size=6)]
+    max_new = 10
+    refs = [np.asarray(model.generate(jnp.asarray([p]),
+                                      max_new_tokens=max_new))[0, len(p):]
+            .tolist() for p in prompts]
+
+    arms = {}
+    for deg in (1, 2):
+        eng = ServingEngine(model, num_pages=64, page_size=8, max_slots=4,
+                            tp=deg)
+        rids = [eng.add_request(p, max_new, eos_token_id=None)
+                for p in prompts]
+        t0 = time.perf_counter()
+        out = eng.run_to_completion(max_steps=500)
+        dt = time.perf_counter() - t0
+        streams = [out[r] for r in rids]
+        assert streams == refs, f"tp={deg} diverged from generate()"
+        assert eng.step_program_counts() == {"decode": 1, "mixed": 1}
+        st = eng.pool.stats()
+        print(f"tp={deg}: {sum(map(len, streams))} tokens in {dt:6.3f}s  "
+              f"programs={eng.step_program_counts()}  "
+              f"shard kv B/tok={st['tp_shard_kv_bytes_per_token']}")
+        arms[deg] = (eng, streams)
+    assert arms[1][1] == arms[2][1]
+    print(f"bitwise parity: tp=2 == tp=1 == generate() "
+          f"({len(prompts)} streams x {max_new} tokens)")
+
+    # collective audit on the tp=2 step programs
+    eng = arms[2][0]
+    S, M, K = eng.max_slots, eng.max_pages_per_slot, eng._chunk
+    z = lambda *s: jnp.zeros(s, jnp.int32)           # noqa: E731
+    o = lambda *s: jnp.ones(s, jnp.float32)          # noqa: E731
+    programs = {
+        "decode": (eng._decode_step._tp_inner,
+                   (eng._state, eng.pool.pools, z(S), z(S, M), z(S),
+                    jnp.zeros((S,), bool), o(S), o(S),
+                    jnp.ones((S,), bool), z(S), z(S))),
+        "mixed": (eng._mixed_step._tp_inner,
+                  (eng._state, eng.pool.pools, z(S, K), z(S, M), z(S),
+                   jnp.zeros((S,), bool), z(S), jnp.zeros((S,), bool),
+                   o(S), o(S), jnp.ones((S,), bool), z(S), z(S))),
+    }
+    want_psum = 2 * L + 1
+    print(f"\ncollectives per step program (want: psum={want_psum} "
+          f"= 2 x {L} layers + embedding, all_gather=1 = logits):")
+    for name, (inner, args) in programs.items():
+        c = collective_counts(inner, *args)
+        print(f"  {name:6s}: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(c.items())) or "none")
+        assert c.get("psum", 0) == want_psum, (name, c)
+        assert c.get("all_gather", 0) == 1, (name, c)
+        assert c.get("all_to_all", 0) == 0, (name, c)
+    print("collective audit PASSED — one psum per block, logits-only "
+          "all_gather, the KV pool is never gathered")
+
+
 if __name__ == "__main__":
     if "--fleet-chaos" in sys.argv[1:]:
         fleet_chaos()
@@ -1286,5 +1388,7 @@ if __name__ == "__main__":
         spec()
     elif "--crash-restart" in sys.argv[1:]:
         crash_restart()
+    elif "--tp" in sys.argv[1:]:
+        tp()
     else:
         main()
