@@ -105,6 +105,10 @@ _SERVING_SLOS = {
     # tiered arm: prefix-cache SLOs — the host tier's job is to keep
     # the hit path (and its TTFT) alive under pool pressure
     "llama_serving_tiered": {"ttft_p99_s": 1.0, "itl_p99_s": 0.25},
+    # overload A/B: generous TTFT bound (the trace deliberately floods
+    # the queue — what matters is the COLD tenants' p99 against it and
+    # the goodput delta between the FCFS and fair+brownout arms)
+    "llama_serving_fairness": {"ttft_p99_s": 4.0, "itl_p99_s": 0.5},
     # tensor-parallel A/B: same workload and SLOs as llama_serving —
     # the mesh must not hide behind looser targets; both arms report
     # goodput against the identical budget
@@ -1726,6 +1730,130 @@ def bench_llama8b_shape(peak, peak_kind, batch=1, seq=4096, layers=2):
     }
 
 
+def bench_llama_serving_fairness(peak, peak_kind, n_requests=40,
+                                 trace_path=None):
+    """Overload-control A/B (SERVING.md "Overload control & tenant
+    fairness"): the canonical hot-tenant flood — ``overload_workload``,
+    where low-priority tenant 0 carries ~2/3 of a bursty trace and the
+    cold tenants are the interactive SLO classes — replayed twice on
+    the same model: FCFS (the legacy global queue: the flood buries
+    every cold arrival behind the hot backlog) vs fair scheduling +
+    the brownout ladder (weighted virtual-token-counter admission,
+    budget-shrink/drafter-off/priority-shed degradation). The evidence
+    the driver wants is the COLD tenants' worst p99 TTFT and aggregate
+    ``goodput_at_slo`` for BOTH arms in the bench_summary cell —
+    fairness bounds the former without moving the latter backwards.
+    Streams finished in both arms are asserted token-exact (scheduling
+    is invisible in the tokens) and both arms assert zero retraces:
+    every brownout level is host-side scalar churn, never a shape."""
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import (BrownoutConfig, ServingEngine,
+                                    ServingMetrics, overload_workload)
+
+    name = "llama_serving_fairness"
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                      intermediate_size=5632, num_hidden_layers=8,
+                      num_attention_heads=16, num_key_value_heads=8,
+                      max_position_embeddings=4096, dtype="bfloat16",
+                      mp_axis=None, fsdp_axis=None)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    n_params = model.num_params()
+    wl = overload_workload(seed=0, n_requests=n_requests, rate=2.0,
+                           zipf_alpha=1.6, vocab_size=cfg.vocab_size)
+    tracer = _make_tracer(trace_path)
+    arms = {}
+    for arm in ("fcfs", "fair"):
+        kw = {}
+        if arm == "fair":
+            kw = dict(fair_scheduling=True,
+                      brownout=BrownoutConfig(high_queue=10, low_queue=4,
+                                              dwell_steps=2))
+        eng = ServingEngine(model, num_pages=256, page_size=16,
+                            max_slots=8, max_pages_per_slot=16,
+                            prefill_token_budget=128,
+                            tracer=tracer if arm == "fair" else None,
+                            **kw)
+        wl.replay(eng, max_steps=4000, rid_prefix="warm-")
+        eng.metrics = ServingMetrics()  # compile time stays off the clock
+        eng.metrics.set_fair(arm == "fair")
+        eng.metrics.set_brownout(arm == "fair")
+        eng.metrics.set_slo(**_SERVING_SLOS[name])
+        rec = _StreamRecorder(eng)
+        out = wl.replay(rec, max_steps=4000, rid_prefix="run-")
+        m = eng.metrics.summary()
+        retraces = sum(n - 1 for n in eng.step_program_counts().values())
+        assert retraces == 0, "serving step program retraced"
+        arms[arm] = (eng, m, out, rec.tokens)
+    eng, m, out, toks = arms["fair"]
+    eng0, m0, out0, toks0 = arms["fcfs"]
+    # the fairness contract, priced into the headline: a request
+    # finished in BOTH arms decoded the identical stream — admission
+    # order and brownout levels are scheduling, never semantics
+    both = sorted(set(toks) & set(toks0))
+    assert both, "no request finished in both arms"
+    for rid in both:
+        assert toks[rid] == toks0[rid], f"{rid} diverged across arms"
+
+    def cold_p99(metrics):
+        per = metrics.per_tenant()
+        vals = [v["ttft_p99_s"] for t, v in per.items()
+                if t != 0 and v["finished"] > 0]
+        return max(vals) if vals else 0.0
+
+    hbm_bw = {"v4": 1.2e12,
+              "v5e": 0.82e12, "v5litepod": 0.82e12, "v5lite": 0.82e12,
+              "v5p": 2.77e12,
+              "v6e": 1.64e12, "trillium": 1.64e12,
+              }.get(peak_kind.split("(")[0], 0.82e12)
+    wall = max(m["wall_s"], 1e-9)
+    mbu = out["steps"] * 2.0 * n_params / wall / hbm_bw
+    trace_out = _dump_trace(tracer, trace_path, name)
+    return {
+        "metric": "llama_420m_serving_fairness_tokens_per_sec",
+        "value": round(m["tokens_per_s"], 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(m["tokens_per_s"]
+                             / max(m0["tokens_per_s"], 1e-9), 4),
+        "extra": {"params": n_params, "workload": wl.stats(),
+                  "engine_steps": out["steps"],
+                  "engine_steps_fcfs": out0["steps"],
+                  "submitted": out["submitted"],
+                  "tokens_per_s_fcfs": round(m0["tokens_per_s"], 1),
+                  "ttft_p50": round(m["ttft_p50_s"], 4),
+                  "ttft_p99": round(m["ttft_p99_s"], 4),
+                  "tpot": round(m["tpot_mean_s"], 5),
+                  "itl_p99": round(m["itl_p99_s"], 5),
+                  "cold_ttft_p99": round(cold_p99(eng.metrics), 4),
+                  "cold_ttft_p99_fcfs": round(cold_p99(eng0.metrics), 4),
+                  "per_tenant": {t: {"finished": v["finished"],
+                                     "ttft_p99_s": round(
+                                         v["ttft_p99_s"], 4),
+                                     "shed": v["shed"]}
+                                 for t, v in
+                                 eng.metrics.per_tenant().items()},
+                  "shed": m["shed"],
+                  "shed_by_priority": eng.metrics.shed_by_priority(),
+                  "brownout_transitions": m["brownout_transitions"],
+                  "brownout_level1_steps": m["brownout_level1_steps"],
+                  "brownout_level2_steps": m["brownout_level2_steps"],
+                  "brownout_level3_steps": m["brownout_level3_steps"],
+                  "goodput_at_slo": round(m["goodput_at_slo"], 4),
+                  "goodput_at_slo_fcfs": round(m0["goodput_at_slo"], 4),
+                  "slo": _SERVING_SLOS[name],
+                  "retraces": sum(
+                      n - 1
+                      for n in eng.step_program_counts().values()),
+                  "trace": trace_out,
+                  "mbu_weights_only": round(mbu, 4),
+                  "peak": peak_kind, "hbm_bw": hbm_bw,
+                  "pipeline": False, "runs": _RUNS,
+                  "spread": None},
+    }
+
+
 _CONFIGS = {
     "llama_420m": bench_llama,
     "resnet50": bench_resnet50,
@@ -1770,6 +1898,11 @@ _CONFIGS = {
     # (SERVING.md "KV tiering & traffic harness"): spill-off vs spill-on
     # under forced pool pressure; goodput_at_slo + tier hit rates
     "llama_serving_tiered": bench_llama_serving_tiered,
+    # overload-control A/B (SERVING.md "Overload control & tenant
+    # fairness"): FCFS vs fair-scheduling + brownout ladder on the
+    # canonical hot-tenant flood; cold-tenant p99 TTFT + goodput for
+    # both arms, streams finished in both asserted token-exact
+    "llama_serving_fairness": bench_llama_serving_fairness,
     # tensor-parallel serving A/B (SERVING.md "Tensor-parallel
     # serving"): tp=1 vs tp=2 on one seeded trace, streams asserted
     # bitwise identical; per-shard KV bytes + goodput for both arms.
@@ -1822,6 +1955,11 @@ _SUMMARY_EXTRA_KEYS = {
                              "spilled_pages", "restored_pages", "shed",
                              "goodput_at_slo", "goodput_at_slo_notier",
                              "retraces"),
+    "llama_serving_fairness": ("ttft_p50", "ttft_p99", "tpot",
+                               "cold_ttft_p99", "cold_ttft_p99_fcfs",
+                               "shed", "brownout_transitions",
+                               "goodput_at_slo", "goodput_at_slo_fcfs",
+                               "retraces"),
     "llama_serving_tp": ("ttft_p50", "ttft_p99", "tpot",
                          "tp_degree", "tp_shard_kv_bytes_per_token",
                          "kv_bytes_per_token",

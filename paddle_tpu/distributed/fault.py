@@ -42,6 +42,17 @@ Named sites wired into the runtime (see RESILIENCE.md):
   stored / about-to-be-injected payload WITHOUT updating its blake2b
   digests, so the restore-side re-verify must catch it and recompute —
   a poisoned snapshot can cost time, never correctness.
+- ``serving.admission`` / ``serving.brownout`` — the overload-control
+  sites (SERVING.md "Overload control & tenant fairness").
+  ``serving.admission`` fires in ``add_request`` after the request id
+  is fixed but before any quota/queue state changes (``ctx['path']``
+  is the request id); ``raise`` models the admission path itself
+  crashing — the fleet router counts it as a breaker failure and the
+  record stays queued. ``serving.brownout`` fires at every brownout
+  ladder transition, AFTER the new level is committed
+  (``ctx['path']`` is ``"old->new"``, e.g. ``"1->2"``); ``raise``
+  models the overload controller dying mid-transition — the step
+  aborts but the ladder state stays consistent.
 - ``fleet.dispatch`` / ``fleet.replica_kill`` / ``fleet.health`` — the
   serving fleet router's placement, replica-life and health-probe sites
   (SERVING.md "Engine fleet & failover"). ``ctx['path']`` is the request

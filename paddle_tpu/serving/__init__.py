@@ -19,10 +19,11 @@ placement, and deterministic failover replay with exactly-once client
 streams.
 """
 
-from .engine import ServingEngine
-from .errors import (EngineDrainingError, FleetOverloadedError,
-                     QueueFullError, RequestTooLargeError,
-                     SchedulerStalledError, ServingError, TPConfigError)
+from .engine import BrownoutConfig, ServingEngine
+from .errors import (AdmissionShedError, EngineDrainingError,
+                     FleetOverloadedError, QueueFullError,
+                     RequestTooLargeError, SchedulerStalledError,
+                     ServingError, TPConfigError)
 from .fleet import FleetRequest, FleetRouter
 from .kv_cache import KVCachePool, PoolExhaustedError, PrefixMatch
 from .metrics import FleetMetrics, ServingMetrics, percentile
@@ -35,10 +36,12 @@ from .snapshot import (RequestSnapshot, SnapshotStore,
 from .speculative import DraftProposer, NgramDrafter, SpeculativeConfig
 from .tiering import HostTier
 from .workload import (Workload, WorkloadRequest, WorkloadSpec,
-                       heavy_tail_workload, make_workload)
+                       heavy_tail_workload, make_workload,
+                       overload_workload)
 
 __all__ = [
-    "ServingEngine", "KVCachePool", "PoolExhaustedError", "PrefixMatch",
+    "ServingEngine", "BrownoutConfig",
+    "KVCachePool", "PoolExhaustedError", "PrefixMatch",
     "ServingMetrics", "FleetMetrics",
     "FleetRouter", "FleetRequest",
     "percentile", "Request", "SamplingParams", "Scheduler",
@@ -48,10 +51,10 @@ __all__ = [
     "SnapshotStore", "RequestSnapshot",
     "save_engine_snapshot", "load_engine_snapshot",
     "Workload", "WorkloadRequest", "WorkloadSpec", "heavy_tail_workload",
-    "make_workload",
+    "make_workload", "overload_workload",
     "ServingError", "QueueFullError", "RequestTooLargeError",
     "SchedulerStalledError", "EngineDrainingError", "FleetOverloadedError",
-    "TPConfigError",
+    "TPConfigError", "AdmissionShedError",
     "TPContext", "partition_devices", "validate_tp_config",
     "collective_counts",
 ]

@@ -64,6 +64,7 @@ of it deterministically chaos-testable.
 from __future__ import annotations
 
 import itertools
+from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
@@ -71,7 +72,7 @@ import numpy as np
 
 from ..distributed import fault as _fault
 from ..observability.trace import NULL_TRACER
-from .errors import (EngineDrainingError, QueueFullError,
+from .errors import (AdmissionShedError, EngineDrainingError, QueueFullError,
                      RequestTooLargeError, SchedulerStalledError)
 from .kv_cache import KVCachePool
 from .metrics import ServingMetrics
@@ -79,13 +80,57 @@ from .scheduler import FINISHED, Request, SamplingParams, Scheduler
 from .snapshot import (RequestSnapshot, load_engine_snapshot,
                        save_engine_snapshot)
 
-__all__ = ["ServingEngine"]
+__all__ = ["ServingEngine", "BrownoutConfig"]
 
 # consecutive zero-progress steps tolerated before SchedulerStalledError:
 # a deterministic livelock (preempt-self treadmill, un-admittable queue
 # head) repeats identically every step, while a transient injected alloc
 # storm recovers as soon as its fault spec stops matching — so > 1, small
 _STALL_PATIENCE = 3
+
+
+@dataclass
+class BrownoutConfig:
+    """The brownout ladder's watermarks (SERVING.md "Overload control &
+    tenant fairness"; RESILIENCE.md "Overload playbook").
+
+    Queue-depth/wait-time watermarks drive staged degradation, one
+    level per ``dwell_steps`` window (hysteresis — the ladder never
+    flaps on a single-step spike): the engine escalates one level when
+    ``queue_depth >= high_queue`` or the oldest queued request has
+    waited ``high_wait_s`` (metrics clock), and de-escalates one level
+    when ``queue_depth <= low_queue`` (and, if set, every queued wait
+    is back under ``low_wait_s``). The levels are pure HOST-SIDE
+    policy — no compiled shape moves, ``step_program_counts()`` stays
+    ``{"decode": 1, "mixed": 1}`` across every transition:
+
+    - level 1: the per-step prefill token budget shrinks to
+      ``budget_frac`` of its configured value (admission + chunk
+      metering slow down; decode latency recovers first);
+    - level 2: speculation is suspended — the drafter is host-side, so
+      skipping it just leaves the draft lanes empty;
+    - level 3: the lowest-priority queued requests are shed
+      (``finish_reason="shed"``, retryable) until the queue is back at
+      the high watermark.
+    """
+
+    high_queue: int = 8
+    low_queue: int = 2
+    high_wait_s: float | None = None
+    low_wait_s: float | None = None
+    budget_frac: float = 0.5
+    dwell_steps: int = 2
+
+    def __post_init__(self):
+        if self.low_queue > self.high_queue:
+            raise ValueError("brownout low_queue must be <= high_queue "
+                             f"(got {self.low_queue} > {self.high_queue})")
+        if not 0.0 < self.budget_frac <= 1.0:
+            raise ValueError("brownout budget_frac must be in (0, 1], "
+                             f"got {self.budget_frac}")
+        if self.dwell_steps < 1:
+            raise ValueError("brownout dwell_steps must be >= 1, "
+                             f"got {self.dwell_steps}")
 
 
 class ServingEngine:
@@ -102,7 +147,10 @@ class ServingEngine:
                  host_tier=None, chunked: bool = True,
                  prefill_chunk: int = 64, snapshot_store=None,
                  snapshot_interval: int = 16, tp: int = 1,
-                 tp_devices=None):
+                 tp_devices=None, fair_scheduling: bool = False,
+                 tenant_weights=None, tenant_max_live: int | None = None,
+                 tenant_max_queued_tokens: int | None = None,
+                 shed_infeasible: bool = False, brownout=None):
         cfg = model.config
         self.model = model
         self.page_size = page_size
@@ -150,9 +198,32 @@ class ServingEngine:
         self._ctx_pages = min(self.max_pages_per_slot,
                               self.pool.pages_for(
                                   cfg.max_position_embeddings))
-        self.scheduler = Scheduler(max_slots, prefill_token_budget,
-                                   max_queue_depth=max_queue_depth,
-                                   max_preemptions=max_preemptions)
+        # SLO-aware overload control (SERVING.md "Overload control &
+        # tenant fairness"): fair_scheduling turns on the weighted
+        # virtual-token-counter queue across tenants (FCFS within a
+        # tenant — streams stay bitwise identical to generate());
+        # tenant_max_live / tenant_max_queued_tokens are per-tenant
+        # admission quotas; shed_infeasible arms the deadline-
+        # infeasibility gate; brownout takes a BrownoutConfig (or True
+        # for defaults) to arm the staged-degradation ladder.
+        self.scheduler = Scheduler(
+            max_slots, prefill_token_budget,
+            max_queue_depth=max_queue_depth,
+            max_preemptions=max_preemptions,
+            fair=fair_scheduling, tenant_weights=tenant_weights,
+            tenant_max_live=tenant_max_live,
+            tenant_max_queued_tokens=tenant_max_queued_tokens)
+        if brownout is True:
+            brownout = BrownoutConfig()
+        elif brownout is False:
+            brownout = None
+        self._brownout: BrownoutConfig | None = brownout
+        self._brownout_level = 0
+        self._brownout_since = 0       # engine step of the last transition
+        self._shed_infeasible = bool(shed_infeasible)
+        # step-duration EMA on the metrics clock: the ONLY timing input
+        # to the deterministic retry_after_s / infeasibility estimators
+        self._step_dt_ema: float | None = None
         # speculative decoding (serving/speculative.py; SERVING.md
         # "Speculative decoding"): pass a SpeculativeConfig, an int k,
         # or True for defaults. Draft rows ride the mixed step's row
@@ -204,6 +275,8 @@ class ServingEngine:
         self.metrics.set_snapshots(snapshot_store is not None)
         self.metrics.set_tp(self.tp,
                             self.pool.kv_bytes_per_token_shard())
+        self.metrics.set_fair(fair_scheduling)
+        self.metrics.set_brownout(self._brownout is not None)
         # observability (OBSERVABILITY.md): the tracer is shared with
         # the scheduler (request-lifecycle spans) and the pool
         # (eviction/COW/quarantine events); construct it on the same
@@ -249,11 +322,14 @@ class ServingEngine:
                     eos_token_id: int | None = None,
                     rid: str | None = None,
                     deadline_s: float | None = None,
-                    max_queue_wait_s: float | None = None) -> str:
+                    max_queue_wait_s: float | None = None,
+                    tenant: int = 0, priority: int = 0) -> str:
         """Admission control happens HERE, not in the scheduler loop:
         a request that can never run raises RequestTooLargeError, a full
         bounded queue raises QueueFullError, a draining engine raises
-        EngineDrainingError — all typed (errors.py, each carrying a
+        EngineDrainingError, and an exhausted per-tenant quota or an
+        infeasible deadline raises AdmissionShedError (with a computed
+        ``retry_after_s``) — all typed (errors.py, each carrying a
         machine-readable ``retryable`` flag), all counted
         (metrics.counters). Callers holding a retryable rejection don't
         have to implement the retry themselves: a
@@ -261,7 +337,10 @@ class ServingEngine:
         draining replicas automatically (SERVING.md "Engine fleet &
         failover"). ``deadline_s`` / ``max_queue_wait_s`` are budgets
         from arrival on the metrics clock, enforced at step boundaries
-        with ``finish_reason="timeout"``."""
+        with ``finish_reason="timeout"``. ``tenant`` scopes the request
+        under the fair scheduler and the admission quotas; ``priority``
+        (larger = more important, default 0) orders brownout level-3
+        shedding — neither changes the tokens a stream produces."""
         if self._draining:
             raise EngineDrainingError(
                 "engine is draining (preempted or shut down); retry on "
@@ -278,12 +357,18 @@ class ServingEngine:
         rid = rid if rid is not None else f"req-{next(self._rid_counter)}"
         if rid in self._requests:
             raise ValueError(f"duplicate request id {rid!r}")
+        # chaos site: an injected admission fault models a crash in the
+        # overload-control path itself — typed, keyed by rid
+        _fault.trip("serving.admission", step=self._steps, path=rid)
+        self._check_overload_gates(len(prompt), max_new_tokens,
+                                   int(tenant), int(priority), deadline_s)
         req = Request(rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
                       sampling=sampling or SamplingParams(),
                       eos_token_id=eos_token_id,
                       deadline_s=deadline_s,
                       max_queue_wait_s=max_queue_wait_s,
-                      arrival_t=self.metrics.now())
+                      arrival_t=self.metrics.now(),
+                      tenant=int(tenant), priority=int(priority))
         try:
             self.scheduler.add(req, self.pool)
         except QueueFullError:
@@ -293,7 +378,8 @@ class ServingEngine:
             self.metrics.on_reject("too_large")
             raise
         self._requests[rid] = req
-        self.metrics.on_arrival(rid)
+        self.metrics.on_arrival(rid, tenant=int(tenant),
+                                priority=int(priority))
         return rid
 
     def admission_check(self, prompt_len: int, max_new_tokens: int) -> None:
@@ -320,6 +406,95 @@ class ServingEngine:
                 f"bounded by max_position_embeddings and "
                 f"max_pages_per_slot)")
 
+    def _check_overload_gates(self, prompt_len: int, max_new_tokens: int,
+                              tenant: int, priority: int,
+                              deadline_s: float | None) -> None:
+        """Load-DEPENDENT admission gates, layered over the
+        load-independent geometry check in :meth:`admission_check`:
+        the per-tenant queued-token quota, then the opt-in
+        deadline-infeasibility shed. Both raise
+        :class:`AdmissionShedError` (retryable, with a deterministic
+        ``retry_after_s`` drain estimate) BEFORE the request holds any
+        queue slot or pool page — shedding at the door is what keeps a
+        doomed request from evicting feasible work later."""
+        need = prompt_len + max_new_tokens
+        cap = self.scheduler.tenant_max_queued_tokens
+        if cap is not None:
+            held = self.scheduler.queued_tokens(tenant)
+            if held + need > cap:
+                retry = self._drain_eta_s(held)
+                self.metrics.on_reject("quota")
+                self.metrics.on_shed(tenant, priority)
+                self.tracer.instant("admission_shed", kind="tenant_quota",
+                                    tenant=tenant)
+                raise AdmissionShedError(
+                    f"tenant {tenant} queued-token quota exhausted "
+                    f"({held} held + {need} requested > cap {cap}); "
+                    f"retry after ~{retry:.3f}s",
+                    retry_after_s=retry, kind="tenant_quota",
+                    tenant=tenant)
+        if self._shed_infeasible and deadline_s is not None:
+            eta = self._completion_eta_s(prompt_len, max_new_tokens)
+            if eta is not None and eta > deadline_s:
+                retry = self._drain_eta_s(self._queued_service_tokens())
+                self.metrics.on_reject("infeasible")
+                self.metrics.on_shed(tenant, priority)
+                self.tracer.instant("admission_shed",
+                                    kind="deadline_infeasible",
+                                    tenant=tenant)
+                raise AdmissionShedError(
+                    f"deadline {deadline_s:.3f}s is infeasible: estimated "
+                    f"completion ~{eta:.3f}s behind the current backlog; "
+                    f"retry after ~{retry:.3f}s",
+                    retry_after_s=retry, kind="deadline_infeasible",
+                    tenant=tenant)
+
+    def _effective_prefill_budget(self) -> int:
+        """The per-step prefill/chunk token budget AFTER brownout:
+        level >= 1 shrinks it to ``budget_frac`` of the configured
+        value — a host-side scalar, never a compiled shape."""
+        base = self.scheduler.prefill_token_budget
+        if self._brownout is not None and self._brownout_level >= 1:
+            base = max(1, int(base * self._brownout.budget_frac))
+        return base
+
+    def _token_capacity_per_step(self) -> int:
+        """Service tokens one step can retire: the (brownout-effective)
+        prefill budget plus one decode token per slot."""
+        return max(1, self._effective_prefill_budget() + self.max_slots)
+
+    def _queued_service_tokens(self) -> int:
+        """Total service tokens (recompute + decode budget) held by the
+        waiting queue — the backlog the drain estimators divide down."""
+        return sum(max(r.recompute_len, 1) + r.max_new_tokens
+                   for r in self.scheduler.waiting)
+
+    def _drain_eta_s(self, tokens: int) -> float:
+        """Deterministic drain-rate estimate behind every
+        ``retry_after_s`` hint: queued service tokens over per-step
+        token capacity, scaled by the step-duration EMA on the metrics
+        clock. 0.0 before the first timed step — an honest "no data
+        yet", never a fabricated constant."""
+        if self._step_dt_ema is None or self._step_dt_ema <= 0.0:
+            return 0.0
+        return (tokens / self._token_capacity_per_step()
+                * self._step_dt_ema)
+
+    def _completion_eta_s(self, prompt_len: int,
+                          max_new_tokens: int) -> float | None:
+        """Estimated queue wait + prefill + decode for a NEW arrival:
+        the backlog drains first, then its own prefill streams at the
+        effective chunk budget, then ~one decoded token per step. None
+        before the first timed step (no EMA -> no estimate -> the
+        infeasibility gate never sheds on a cold engine)."""
+        if self._step_dt_ema is None or self._step_dt_ema <= 0.0:
+            return None
+        queue_steps = (self._queued_service_tokens()
+                       / self._token_capacity_per_step())
+        own_steps = (prompt_len / self._effective_prefill_budget()
+                     + max_new_tokens)
+        return (queue_steps + own_steps) * self._step_dt_ema
+
     def step(self) -> list[dict]:
         """One scheduling iteration: expire deadlines, admit newly
         runnable requests (chunked: map pages only; unchunked: run the
@@ -338,15 +513,23 @@ class ServingEngine:
         self.pool.fault_step = self._steps
         _fault.trip("serving.step", step=self._steps)
         tr = self.tracer
+        t_step0 = self.metrics.now()
         events: list[dict] = []
         with tr.span("deadline_sweep", queue=self.scheduler.queue_depth):
             self._expire_deadlines(events)
         if self._draining:
             self._flush_waiting(events)
+        elif self._brownout is not None:
+            # one hysteresis tick of the brownout ladder BEFORE the
+            # budget is computed, so a fresh transition takes effect
+            # this very step (level-3 queue sheds land in `events`)
+            with tr.span("brownout", level=self._brownout_level):
+                self._update_brownout(events)
         # the verify/chunk rows and any admission prefill share ONE
-        # per-step token-work bound: the prefill budget, minus the
-        # (spec_k - 1) verify rows each decoding slot may score
-        budget = (self.scheduler.prefill_token_budget
+        # per-step token-work bound: the (brownout-effective) prefill
+        # budget, minus the (spec_k - 1) verify rows each decoding slot
+        # may score
+        budget = (self._effective_prefill_budget()
                   - self.scheduler.verify_token_reserve())
         if not self._draining:
             # admit one request at a time. Unchunked: run its prefill
@@ -388,7 +571,14 @@ class ServingEngine:
         # drafts are proposed BEFORE the page guarantee so
         # ensure_decode_pages covers the speculative writes too
         if self._spec is not None and self.scheduler.running:
-            self._propose_drafts()
+            if self._brownout_level >= 2:
+                # brownout level 2: suspend speculation — the drafter is
+                # pure host code, so "off" is just empty draft lanes;
+                # the mixed program's row count never moves
+                for req in self.scheduler.running.values():
+                    req.draft_tokens = []
+            else:
+                self._propose_drafts()
         with tr.span("ensure_pages"):
             preempted = self.scheduler.ensure_decode_pages(self.pool)
         for victim in preempted:
@@ -452,6 +642,13 @@ class ServingEngine:
                     f"{head.rid!r} needs {snapshot['head_needs_pages']} "
                     f"pages, {snapshot['free_pages']} free "
                     f"(capacity {snapshot['capacity']})", snapshot)
+        # feed the step-duration EMA (metrics clock) the retry_after_s /
+        # infeasibility estimators divide by; a zero-dt step (virtual
+        # clock not advanced) contributes nothing
+        dt = self.metrics.now() - t_step0
+        if dt > 0.0:
+            self._step_dt_ema = (dt if self._step_dt_ema is None
+                                 else 0.8 * self._step_dt_ema + 0.2 * dt)
         return events
 
     def stream(self):
@@ -580,14 +777,21 @@ class ServingEngine:
         snaps, _meta = load_engine_snapshot(path)
         return [self.restore_request(s) for s in snaps]
 
-    def restore_request(self, snap: RequestSnapshot) -> str:
+    def restore_request(self, snap: RequestSnapshot,
+                        tenant: int = 0, priority: int = 0) -> str:
         """Re-admit one snapshotted request (fleet failover and warm
         restart both land here). The snapshot's KV payloads — if any,
         and if their digests still verify — are injected into the pool
         as refcount-0 cached pages, so the ordinary admission prefix
         match maps them and the request resumes with zero (or near-
         zero) recompute; any verification failure just downgrades to
-        the full-recompute path, which is bitwise-identical anyway."""
+        the full-recompute path, which is bitwise-identical anyway.
+        ``tenant``/``priority`` are re-attached by the caller (the
+        snapshot format is unchanged; the fleet router carries them on
+        its records) and the SURVIVOR's per-tenant quotas apply: a
+        failed-over request that would bust the survivor's quota is
+        refused with AdmissionShedError and stays queued at the router
+        for the next placement attempt."""
         if self._draining:
             raise EngineDrainingError(
                 "engine is draining; restore on another replica")
@@ -595,6 +799,8 @@ class ServingEngine:
         if rid in self._requests:
             raise ValueError(f"duplicate request id {rid!r}")
         self.admission_check(len(snap.prompt), snap.max_new_tokens)
+        self._check_overload_gates(len(snap.prompt), snap.max_new_tokens,
+                                   int(tenant), int(priority), None)
         # the payload is usable only in the pool's own storage format
         # (int8 codes+scales vs fp pages have different bytes) and page
         # geometry — a mismatch is a recompute, never a reinterpret
@@ -622,7 +828,8 @@ class ServingEngine:
                           temperature=snap.temperature, top_p=snap.top_p,
                           do_sample=snap.do_sample, seed=snap.seed),
                       eos_token_id=snap.eos_token_id,
-                      arrival_t=self.metrics.now())
+                      arrival_t=self.metrics.now(),
+                      tenant=int(tenant), priority=int(priority))
         req.tokens = list(snap.tokens)
         try:
             self.scheduler.add(req, self.pool)
@@ -630,7 +837,8 @@ class ServingEngine:
             self.metrics.on_reject("queue_full")
             raise
         self._requests[rid] = req
-        self.metrics.on_arrival(rid)
+        self.metrics.on_arrival(rid, tenant=int(tenant),
+                                priority=int(priority))
         self.metrics.counters["snapshot_restores"] += 1
         self.metrics.counters["snapshot_restored_tokens"] += len(snap.tokens)
         self.tracer.instant("snapshot_restore", track=rid,
@@ -814,7 +1022,15 @@ class ServingEngine:
                 "snapshots": self.snapshot_store is not None,
                 "snapshot_interval": self.snapshot_interval,
                 "tp": self.tp,
+                "fair": self.scheduler.fair,
+                "brownout": self._brownout is not None,
+                "brownout_level": self._brownout_level,
                 "tracing": self.tracer.enabled}
+
+    @property
+    def brownout_level(self) -> int:
+        """Current brownout ladder level (0 = normal service)."""
+        return self._brownout_level
 
     # ------------------------------------------------------------------
     # robustness internals
@@ -860,6 +1076,62 @@ class ServingEngine:
             if (req.deadline_s is not None
                     and now - req.arrival_t >= req.deadline_s):
                 self._finish_abnormal(req, "timeout", events)
+
+    def _update_brownout(self, events: list[dict]) -> None:
+        """One hysteresis tick of the brownout ladder (see
+        :class:`BrownoutConfig`): escalate one level when the queue is
+        over the high watermark (depth, or oldest queued wait), step
+        back down one level when it is under the low watermark, and
+        never move twice within ``dwell_steps`` — a single-step spike
+        cannot flap the ladder. Level 3 sheds lowest-priority queued
+        requests here. Pure host-side policy: transitions change a
+        budget scalar, a drafter skip, and queue membership — never a
+        compiled shape, so ``step_program_counts()`` is pinned across
+        every transition."""
+        cfg = self._brownout
+        now = self.metrics.now()
+        depth = self.scheduler.queue_depth
+        head_wait = max((now - r.arrival_t
+                         for r in self.scheduler.waiting), default=0.0)
+        hot = depth >= cfg.high_queue or (
+            cfg.high_wait_s is not None and head_wait >= cfg.high_wait_s)
+        cool = depth <= cfg.low_queue and (
+            cfg.low_wait_s is None or head_wait <= cfg.low_wait_s)
+        level = self._brownout_level
+        if self._steps - self._brownout_since >= cfg.dwell_steps:
+            new = level
+            if hot and level < 3:
+                new = level + 1
+            elif cool and level > 0:
+                new = level - 1
+            if new != level:
+                self._brownout_level = new
+                self._brownout_since = self._steps
+                self.metrics.on_brownout_transition(level, new)
+                self.tracer.instant("brownout", level=new, queue=depth)
+                # chaos site: a fault here models the overload
+                # controller crashing mid-transition (path "old->new")
+                _fault.trip("serving.brownout", step=self._steps,
+                            path=f"{level}->{new}")
+        if self._brownout_level >= 3:
+            self._shed_queued(events)
+        self.metrics.on_brownout_level(self._brownout_level)
+
+    def _shed_queued(self, events: list[dict]) -> None:
+        """Brownout level 3: shed the lowest-priority queued requests
+        (youngest first within a priority class — the oldest work is
+        closest to its SLO and is spared longest) until the queue is
+        back at the high watermark. ``finish_reason="shed"`` is
+        terminal on THIS engine but retryable fleet-wide — the
+        router's shed events carry ``retry_after_s``."""
+        cfg = self._brownout
+        while self.scheduler.queue_depth > cfg.high_queue:
+            victim = min(self.scheduler.waiting,
+                         key=lambda r: (r.priority, -r.arrival_seq))
+            self.metrics.on_shed(victim.tenant, victim.priority)
+            self.tracer.instant("brownout_shed", track=victim.rid,
+                                priority=victim.priority)
+            self._finish_abnormal(victim, "shed", events)
 
     def _flush_waiting(self, events: list[dict]) -> None:
         """Draining: nothing waits — evict the queue as retriable

@@ -38,7 +38,18 @@ router that acts on it is :class:`paddle_tpu.serving.fleet.FleetRouter`
   router's global queue is at capacity, meaning EVERY replica is
   saturated *and* the shared backlog is full. Retryable after backoff
   (clients should retry with jitter), but there is no other replica to
-  try — this is the signal to scale out.
+  try — this is the signal to scale out. Carries ``retry_after_s``, the
+  router's drain-rate estimate of when capacity frees (RESILIENCE.md
+  "Overload playbook").
+- :class:`AdmissionShedError` — SLO-aware overload control
+  (SERVING.md "Overload control & tenant fairness"): ``add_request``
+  shed the request at admission because a per-tenant quota (live slots
+  or queued tokens) is exhausted, or because its deadline is
+  INFEASIBLE — the estimated queue wait + prefill + decode already
+  exceeds the remaining ``deadline_s``, so running it would burn pool
+  pages on a guaranteed timeout. Retryable after ``retry_after_s``
+  (the engine's deterministic drain-rate estimate); ``kind`` says
+  which gate fired (``tenant_quota`` / ``deadline_infeasible``).
 - :class:`TPConfigError` — the model cannot be tensor-parallel-sharded
   at the requested degree (``kv_heads % tp``, ``vocab % tp``, … fail)
   or the mesh cannot be built (too few devices). Raised at
@@ -51,7 +62,7 @@ from __future__ import annotations
 
 __all__ = ["ServingError", "QueueFullError", "RequestTooLargeError",
            "SchedulerStalledError", "EngineDrainingError",
-           "FleetOverloadedError", "TPConfigError"]
+           "FleetOverloadedError", "TPConfigError", "AdmissionShedError"]
 
 
 class ServingError(RuntimeError):
@@ -120,6 +131,33 @@ class FleetOverloadedError(ServingError):
     is saturated and the shared backlog on top of them is too. The
     request was not accepted anywhere. Retryable after client-side
     backoff; sustained occurrence means the fleet needs more replicas,
-    not more retries."""
+    not more retries. ``retry_after_s`` is the router's deterministic
+    drain-rate estimate of when queue capacity frees — clients back
+    off at least that long (plus jitter) before resubmitting."""
 
     retryable = True
+
+    def __init__(self, msg: str, retry_after_s: float | None = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class AdmissionShedError(ServingError):
+    """SLO-aware admission shed (``ServingEngine.add_request``): a
+    per-tenant quota (live slots / queued tokens) is exhausted, or the
+    request's deadline is infeasible given the current backlog — the
+    estimated queue wait + prefill + decode time already exceeds
+    ``deadline_s``, so admitting it would spend pool pages on a
+    guaranteed timeout. Shed BEFORE any resources are held. Retryable
+    after ``retry_after_s`` (the engine's drain-rate estimate, 0.0
+    when no timing data exists yet); ``kind`` is ``"tenant_quota"`` or
+    ``"deadline_infeasible"`` for client-side classification."""
+
+    retryable = True
+
+    def __init__(self, msg: str, retry_after_s: float = 0.0,
+                 kind: str = "tenant_quota", tenant: int = 0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+        self.kind = kind
+        self.tenant = tenant

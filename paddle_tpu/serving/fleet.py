@@ -118,6 +118,8 @@ class FleetRequest:
     deadline_s: float | None
     max_queue_wait_s: float | None
     submit_seq: int
+    tenant: int = 0            # fair-scheduling / quota scope on replicas
+    priority: int = 0          # larger = more important (brownout shed order)
     tokens: list[int] = field(default_factory=list)
     emitted: int = 0           # tokens the client has seen (== len(tokens))
     produced: int = 0          # tokens produced by the current replica life
@@ -196,6 +198,9 @@ class FleetRouter:
         self._recovering: dict[str, float] = {}
         self._submit_seq = 0
         self._steps = 0
+        # router-step duration EMA (metrics clock): the timing input to
+        # the deterministic retry_after_s hint on fleet-level sheds
+        self._step_dt_ema: float | None = None
         self._idle_steps = 0
         self._draining = False
         self._guard = None
@@ -210,26 +215,35 @@ class FleetRouter:
                eos_token_id: int | None = None,
                rid: str | None = None,
                deadline_s: float | None = None,
-               max_queue_wait_s: float | None = None) -> str:
+               max_queue_wait_s: float | None = None,
+               tenant: int = 0, priority: int = 0) -> str:
         """Fleet admission. A full global queue sheds with
-        :class:`FleetOverloadedError`; a request no replica could EVER
-        run raises :class:`RequestTooLargeError` here, before it
-        occupies queue space anywhere (homogeneous fleet — replica 0's
-        ``admission_check`` speaks for all). Placement happens at the
-        next ``step()``, not here: dispatch failures are the router's
-        to retry, never the client's."""
+        :class:`FleetOverloadedError` (carrying ``retry_after_s``, the
+        router's drain-rate estimate — RESILIENCE.md "Overload
+        playbook"); a request no replica could EVER run raises
+        :class:`RequestTooLargeError` here, before it occupies queue
+        space anywhere (homogeneous fleet — replica 0's
+        ``admission_check`` speaks for all). ``tenant``/``priority``
+        ride the record to every placement (fair scheduling, quotas and
+        brownout shed order on the replicas — SERVING.md "Overload
+        control & tenant fairness"). Placement happens at the next
+        ``step()``, not here: dispatch failures are the router's to
+        retry, never the client's."""
         if self._draining:
             raise EngineDrainingError(
                 "fleet is draining (preempted or shut down); "
                 "retry against another fleet")
         if (self.max_queue_depth is not None
                 and len(self._pending) >= self.max_queue_depth):
+            retry = self._retry_after_s()
             self.fleet_metrics.bump("shed")
             self.metrics.on_reject("queue_full")
+            self.metrics.on_shed(int(tenant), int(priority))
             raise FleetOverloadedError(
                 f"fleet queue at max_queue_depth={self.max_queue_depth}; "
-                f"request shed (every replica saturated — retry with "
-                f"backoff or scale out)")
+                f"request shed (every replica saturated — retry after "
+                f"~{retry:.3f}s with backoff, or scale out)",
+                retry_after_s=retry)
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("prompt must be non-empty")
@@ -249,14 +263,36 @@ class FleetRouter:
                            eos_token_id=eos_token_id,
                            deadline_s=deadline_s,
                            max_queue_wait_s=max_queue_wait_s,
-                           submit_seq=self._submit_seq)
+                           submit_seq=self._submit_seq,
+                           tenant=int(tenant), priority=int(priority))
         self._submit_seq += 1
         self._records[rid] = rec
         self._pending.append(rec)
-        self.metrics.on_arrival(rid)
+        self.metrics.on_arrival(rid, tenant=int(tenant),
+                                priority=int(priority))
         self.tracer.instant("submit", track="fleet", rid=rid,
                             queue=len(self._pending))
         return rid
+
+    def _retry_after_s(self) -> float:
+        """Deterministic fleet drain-rate estimate behind the
+        ``retry_after_s`` hint on FleetOverloadedError and router shed
+        events: service tokens held by the router queue over the live
+        replicas' combined per-step token capacity, scaled by the
+        router-step-duration EMA (metrics clock). 0.0 before the first
+        timed step — honest "no data yet", never a made-up constant."""
+        if self._step_dt_ema is None or self._step_dt_ema <= 0.0:
+            return 0.0
+        tokens = sum(len(r.prompt) + r.max_new_tokens
+                     for r in self._pending)
+        cap = 0
+        for rep in self._replicas:
+            if rep.state == DEAD:
+                continue
+            per_step = getattr(rep.engine, "_token_capacity_per_step",
+                               None)
+            cap += int(per_step()) if per_step is not None else 1
+        return tokens / max(cap, 1) * self._step_dt_ema
 
     # ------------------------------------------------------------------
     # stepping
@@ -269,6 +305,7 @@ class FleetRouter:
         translation of their events into client events. Bounded work —
         a replica that cannot accept work this step is retried next
         step, never spun on."""
+        t_step0 = self.metrics.now()
         events: list[dict] = []
         self._kill_sweep()
         self._health_sweep()
@@ -306,6 +343,10 @@ class FleetRouter:
             for rec in list(self._pending):
                 self._finish_record(rec, "shed", events)
             self._pending.clear()
+        dt = self.metrics.now() - t_step0
+        if dt > 0.0:
+            self._step_dt_ema = (dt if self._step_dt_ema is None
+                                 else 0.8 * self._step_dt_ema + 0.2 * dt)
         return events
 
     def has_work(self) -> bool:
@@ -430,6 +471,10 @@ class FleetRouter:
             # failover-replay path below is the same either way
             # (RESILIENCE.md), this gauge just sizes the blast radius.
             "tp_degree": getattr(eng, "tp", 1),
+            # overload-control gauge: which brownout rung this replica
+            # is on (0 = normal service; engines without the ladder
+            # always read 0)
+            "brownout_level": getattr(eng, "brownout_level", 0),
             "consecutive_failures": rep.consecutive_failures,
             "breaker_opens": rep.opens,
             "backoff_remaining": max(0, rep.backoff_until - self._steps),
@@ -582,16 +627,22 @@ class FleetRouter:
         restore = getattr(rep.engine, "restore_request", None)
         if restore is None:
             snap = None
+        # tenant/priority ride every placement (fair scheduling, quotas
+        # and brownout shed order on the replica — restore included, so
+        # SURVIVOR quotas govern failover replay); forwarded only when
+        # set, keeping duck-typed engines without tenancy working
+        tp_kw = ({"tenant": rec.tenant, "priority": rec.priority}
+                 if (rec.tenant, rec.priority) != (0, 0) else {})
         try:
             _fault.trip("fleet.dispatch", step=self._steps, path=rec.rid)
             if snap is not None:
-                restore(snap)
+                restore(snap, **tp_kw)
             else:
                 rep.engine.add_request(
                     rec.prompt, rec.max_new_tokens, sampling=rec.sampling,
                     eos_token_id=rec.eos_token_id, rid=rec.rid,
                     deadline_s=rec.deadline_s,
-                    max_queue_wait_s=rec.max_queue_wait_s)
+                    max_queue_wait_s=rec.max_queue_wait_s, **tp_kw)
         except RequestTooLargeError:
             # cannot happen after submit-time admission_check on a
             # homogeneous fleet, but a duck-typed engine may disagree:
@@ -772,12 +823,17 @@ class FleetRouter:
         rec.finished = True
         rec.finish_reason = reason
         rec.replica = None
+        ev = {"rid": rec.rid, "token": None, "finished": True,
+              "finish_reason": reason, "replica": None}
         if reason == "shed":
             self.fleet_metrics.bump("shed")
+            self.metrics.on_shed(rec.tenant, rec.priority)
+            # clients implement backoff off the event itself
+            # (RESILIENCE.md "Overload playbook")
+            ev["retry_after_s"] = self._retry_after_s()
         self.metrics.on_finish(rec.rid, reason)
         self.metrics.on_outcome(reason)
-        events.append({"rid": rec.rid, "token": None, "finished": True,
-                       "finish_reason": reason, "replica": None})
+        events.append(ev)
         self.tracer.instant("finish", track="fleet", rid=rec.rid,
                             reason=reason)
 

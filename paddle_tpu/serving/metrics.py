@@ -126,9 +126,14 @@ class ServingMetrics:
         self._end = None
         self._admit_t: dict[str, float] = {}
         self._queue_wait: list[float] = []
-        # failure-outcome counters (typed error surface, SERVING.md)
+        # failure-outcome counters (typed error surface, SERVING.md):
+        # rejected_quota / rejected_infeasible are AdmissionShedError
+        # sheds (tenant quota exhausted / deadline infeasible), "shed"
+        # counts terminal shed outcomes (brownout level 3 + fleet)
         self.counters: dict[str, int] = {
             "rejected_queue_full": 0, "rejected_too_large": 0,
+            "rejected_quota": 0, "rejected_infeasible": 0,
+            "shed": 0,
             "timed_out": 0, "quarantined": 0, "preempted_limit": 0,
             "drained": 0, "injected": 0,
             # crash-consistent snapshots (serving/snapshot.py):
@@ -195,17 +200,36 @@ class ServingMetrics:
         self._chunk_prefill_tokens_last = 0
         self._chunk_decode_slots_last = 0
         self._chunks_in_flight_last = 0
+        # SLO-aware overload control (SERVING.md "Overload control &
+        # tenant fairness"): the fair/brownout flag gauges, the current
+        # brownout level + per-level step occupancy + transition count,
+        # and per-tenant / per-priority request attribution — tenants
+        # and priorities arrive via on_arrival/on_shed, and summary()
+        # flattens them to tenant{t}_* / shed_priority{p} keys so the
+        # Prometheus page carries the per-tenant view for free
+        self.fair_enabled = 0
+        self.brownout_enabled = 0
+        self._brownout_level = 0
+        self._brownout_steps: dict[int, int] = {1: 0, 2: 0, 3: 0}
+        self._brownout_transitions = 0
+        self._tenant: dict[str, int] = {}
+        self._priority: dict[str, int] = {}
+        self._shed_by_priority: dict[int, int] = {}
+        self._shed_by_tenant: dict[int, int] = {}
 
     def now(self) -> float:
         return self._clock()
 
     # ---- request lifecycle ----
 
-    def on_arrival(self, rid: str) -> None:
+    def on_arrival(self, rid: str, tenant: int = 0,
+                   priority: int = 0) -> None:
         t = self.now()
         if self._start is None:
             self._start = t
         self._arrival[rid] = t
+        self._tenant[rid] = int(tenant)
+        self._priority[rid] = int(priority)
 
     def on_token(self, rid: str) -> None:
         t = self.now()
@@ -247,9 +271,77 @@ class ServingMetrics:
         """Count an abnormal terminal outcome by its finish_reason."""
         key = {"timeout": "timed_out", "nonfinite": "quarantined",
                "preempted_limit": "preempted_limit", "preempted": "drained",
-               "injected": "injected"}.get(finish_reason)
+               "injected": "injected", "shed": "shed"}.get(finish_reason)
         if key is not None:
             self.counters[key] += 1
+
+    # ---- overload control (SERVING.md "Overload control & tenant
+    # fairness") ----
+
+    def set_fair(self, enabled: bool) -> None:
+        """Arm the fair_enabled gauge (int, for Prometheus export)."""
+        self.fair_enabled = int(bool(enabled))
+
+    def set_brownout(self, enabled: bool) -> None:
+        """Arm the brownout_enabled gauge (int, for Prometheus)."""
+        self.brownout_enabled = int(bool(enabled))
+
+    def on_brownout_level(self, level: int) -> None:
+        """One engine step spent at ``level`` (0 = normal service) —
+        feeds the current-level gauge and the per-level occupancy
+        counters the bench reports as brownout-level occupancy."""
+        self._brownout_level = int(level)
+        if level in self._brownout_steps:
+            self._brownout_steps[level] += 1
+
+    def on_brownout_transition(self, old: int, new: int) -> None:
+        self._brownout_transitions += 1
+
+    def on_shed(self, tenant: int = 0, priority: int = 0) -> None:
+        """One shed decision (admission quota/infeasibility or a
+        brownout level-3 queue shed), attributed to its tenant and
+        priority class — the shed-by-priority breakdown the fairness
+        bench reports."""
+        self._shed_by_priority[int(priority)] = (
+            self._shed_by_priority.get(int(priority), 0) + 1)
+        self._shed_by_tenant[int(tenant)] = (
+            self._shed_by_tenant.get(int(tenant), 0) + 1)
+
+    def tenant_of(self, rid: str) -> int:
+        return self._tenant.get(rid, 0)
+
+    def priority_of(self, rid: str) -> int:
+        return self._priority.get(rid, 0)
+
+    def per_tenant(self) -> dict[int, dict]:
+        """Per-tenant latency/outcome view: {tenant: {"arrived",
+        "finished", "ttft_p50_s", "ttft_p99_s", "shed"}} — finished
+        counts normal finishes only (stop/length/legacy None), sheds
+        count both admission sheds and terminal shed outcomes. This is
+        what the fairness bench ranks arms by (cold-tenant p99 TTFT)."""
+        tenants = (set(self._tenant.values())
+                   | set(self._shed_by_tenant))
+        out: dict[int, dict] = {}
+        for t in sorted(tenants):
+            rids = [r for r, tt in self._tenant.items() if tt == t]
+            ttft = [self._first_token[r] - self._arrival[r]
+                    for r in rids
+                    if r in self._first_token and r in self._arrival]
+            finished = sum(
+                1 for r in rids
+                if self._finish_reason.get(r, "")
+                in (None, "stop", "length"))
+            out[t] = {
+                "arrived": len(rids),
+                "finished": finished,
+                "ttft_p50_s": percentile(ttft, 50),
+                "ttft_p99_s": percentile(ttft, 99),
+                "shed": self._shed_by_tenant.get(t, 0),
+            }
+        return out
+
+    def shed_by_priority(self) -> dict[int, int]:
+        return dict(self._shed_by_priority)
 
     def on_prefill(self, cached_tokens: int, total_tokens: int,
                    restored_tokens: int = 0) -> None:
@@ -538,6 +630,22 @@ class ServingMetrics:
             # single-device engine) — the paddle_serving_tp_* family
             "tp_degree": self.tp_degree,
             "tp_shard_kv_bytes_per_token": self.tp_shard_kv_bytes_per_token,
+            # SLO-aware overload control (schema-stable zeros when fair
+            # scheduling / the brownout ladder are off); the per-tenant
+            # and per-priority flattenings below are dynamic keys, like
+            # the pool counters — present once a tenant/priority is seen
+            "fair_enabled": self.fair_enabled,
+            "brownout_enabled": self.brownout_enabled,
+            "brownout_level": self._brownout_level,
+            "brownout_transitions": self._brownout_transitions,
+            "brownout_level1_steps": self._brownout_steps.get(1, 0),
+            "brownout_level2_steps": self._brownout_steps.get(2, 0),
+            "brownout_level3_steps": self._brownout_steps.get(3, 0),
+            **{f"tenant{t}_{k}": v
+               for t, d in self.per_tenant().items()
+               for k, v in d.items()},
+            **{f"shed_priority{p}": n
+               for p, n in sorted(self._shed_by_priority.items())},
             # pool counters live under prefix_* so they can never
             # shadow a summary key (the pool already uses that prefix
             # for most of them — normalise the stragglers)

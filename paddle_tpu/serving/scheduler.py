@@ -8,6 +8,25 @@ requests in strict arrival order while slots, pool pages and the token
 budget allow. Requests therefore join and leave the running batch at
 token granularity; nothing ever waits for a whole batch to drain.
 
+With ``fair=True`` (SERVING.md "Overload control & tenant fairness"),
+global strict-FCFS admission becomes a weighted token-deficit queue
+ACROSS tenants — the virtual-token-counter fairness of Sheng et al.
+("Fairness in Serving Large Language Models", OSDI '24): each tenant
+carries a virtual counter of service tokens consumed (scaled by its
+weight); admission always serves the backlogged tenant with the
+smallest counter, and a tenant going idle never banks credit (its
+counter is lifted to the backlogged minimum when it returns). FCFS
+*within* a tenant is preserved, so every individual stream stays
+bitwise identical to ``generate()`` — only inter-request ordering
+changes, which the per-request ``fold_in(PRNGKey(seed), token_index)``
+sampling contract is already immune to. Per-tenant admission quotas
+(``tenant_max_live`` running slots, ``tenant_max_queued_tokens``
+queued work) bound how much of the engine one tenant can hold; the
+queued-token gate is enforced by the ENGINE at ``add_request`` (it
+owns the retry_after_s estimate), the live-slot gate here at head
+selection (a tenant at its cap is skipped, not errored — its turn
+comes back when a slot frees).
+
 All state here is host-side Python (deques and integer lists); the
 device-side consequences (block tables, active masks, position offsets)
 are materialized by the engine as plain array inputs to its single
@@ -52,6 +71,14 @@ class Request:
     max_new_tokens: int
     sampling: SamplingParams = field(default_factory=SamplingParams)
     eos_token_id: int | None = None
+
+    # multi-tenant SLO classes (SERVING.md "Overload control & tenant
+    # fairness"): tenant keys the fair-queue deficit counter and the
+    # admission quotas; priority only ever decides WHICH queued request
+    # a level-3 brownout sheds first (higher = more important) — neither
+    # touches the compiled step or the emitted stream
+    tenant: int = 0
+    priority: int = 0
 
     # lifecycle
     state: str = WAITING
@@ -120,11 +147,32 @@ class Request:
 class Scheduler:
     def __init__(self, max_slots: int, prefill_token_budget: int = 2048,
                  max_queue_depth: int | None = None,
-                 max_preemptions: int | None = None):
+                 max_preemptions: int | None = None,
+                 fair: bool = False,
+                 tenant_weights: dict | None = None,
+                 tenant_max_live: int | None = None,
+                 tenant_max_queued_tokens: int | None = None):
         self.max_slots = max_slots
         self.prefill_token_budget = prefill_token_budget
         self.max_queue_depth = max_queue_depth
         self.max_preemptions = max_preemptions
+        # tenant-aware fair scheduling + quotas (SERVING.md "Overload
+        # control & tenant fairness"): fair=False keeps the strict
+        # global FCFS this scheduler always had (the A/B baseline arm).
+        # tenant_weights scales each tenant's virtual-token charge
+        # (weight 2.0 = entitled to twice the service; default 1.0);
+        # tenant_max_live caps RUNNING slots per tenant (enforced at
+        # head selection); tenant_max_queued_tokens caps queued
+        # prompt+decode tokens per tenant (enforced by the engine at
+        # add_request, where the retry_after_s estimate lives).
+        self.fair = bool(fair)
+        self.tenant_weights = dict(tenant_weights or {})
+        self.tenant_max_live = tenant_max_live
+        self.tenant_max_queued_tokens = tenant_max_queued_tokens
+        # the virtual token counters (Sheng et al., OSDI '24): service
+        # tokens charged per tenant at admission, divided by the
+        # tenant's weight — min-counter tenant is served next
+        self._vtc: dict[int, float] = {}
         self.waiting: list[Request] = []   # kept sorted by arrival_seq
         self.running: dict[int, Request] = {}   # slot -> request
         self._free_slots = list(range(max_slots - 1, -1, -1))
@@ -185,6 +233,17 @@ class Scheduler:
                         f"({cached} cached), but the pool has only "
                         f"{pool.capacity} allocatable pages — it "
                         f"could never run")
+        if self.fair:
+            # VTC lift (Sheng et al.): a tenant returning from idle is
+            # lifted to the minimum counter of the currently-active
+            # tenants, so idling never BANKS credit to burst with later
+            # — fairness is over backlogged work, not history
+            active = ({r.tenant for r in self.waiting}
+                      | {r.tenant for r in self.running.values()})
+            if req.tenant not in active and active:
+                floor = min(self._vtc.get(t, 0.0) for t in active)
+                self._vtc[req.tenant] = max(
+                    self._vtc.get(req.tenant, 0.0), floor)
         req.arrival_seq = self._arrival_counter
         self._arrival_counter += 1
         req.state = WAITING
@@ -332,14 +391,68 @@ class Scheduler:
                         break  # it preempted itself; nothing left to grow
         return preempted
 
+    # ---- tenant accounting (SERVING.md "Overload control & tenant
+    # fairness") ----
+
+    def live_slots(self, tenant: int) -> int:
+        """RUNNING slots this tenant holds right now (the quantity
+        ``tenant_max_live`` caps)."""
+        return sum(1 for r in self.running.values() if r.tenant == tenant)
+
+    def queued_tokens(self, tenant: int) -> int:
+        """Queued service tokens (prompt + decode budget) this tenant
+        holds in the waiting queue — what ``tenant_max_queued_tokens``
+        caps at ``add_request`` (the engine raises the typed shed)."""
+        return sum(max(r.recompute_len, 1) + r.max_new_tokens
+                   for r in self.waiting if r.tenant == tenant)
+
+    def _tenant_weight(self, tenant: int) -> float:
+        w = float(self.tenant_weights.get(tenant, 1.0))
+        return w if w > 0 else 1.0
+
+    def _select_head(self) -> Request | None:
+        """The next admission candidate. FCFS mode: the oldest waiting
+        request (skipping tenants at their live-slot cap when quotas
+        are on). Fair mode: the oldest waiting request OF the
+        backlogged tenant with the smallest weighted virtual token
+        counter — FCFS within the tenant, min-deficit across tenants;
+        ties break by arrival for determinism. Returns None when every
+        waiting request belongs to a tenant at its live cap."""
+        cap = self.tenant_max_live
+        if not self.fair:
+            if cap is None:
+                return self.waiting[0] if self.waiting else None
+            for req in self.waiting:
+                if self.live_slots(req.tenant) < cap:
+                    return req
+            return None
+        best: Request | None = None
+        best_key: tuple | None = None
+        seen: set[int] = set()
+        for req in self.waiting:   # arrival-sorted -> per-tenant FCFS head
+            t = req.tenant
+            if t in seen:
+                continue
+            seen.add(t)
+            if cap is not None and self.live_slots(t) >= cap:
+                continue
+            key = (self._vtc.get(t, 0.0), req.arrival_seq)
+            if best_key is None or key < best_key:
+                best, best_key = req, key
+        return best
+
     def admit(self, pool: KVCachePool, limit: int | None = None,
               budget: int | None = None,
               first: bool = True) -> list[Request]:
-        """Admit waiting requests in strict FCFS order while a slot, the
-        pool, and the per-step prefill token budget allow. Stops at the
-        first request that does not fit (no queue jumping). Returns the
-        admitted requests with slot + prompt pages assigned; the engine
-        runs their prefills.
+        """Admit waiting requests while a slot, the pool, and the
+        per-step prefill token budget allow — in strict FCFS order by
+        default, or fair-queue order across tenants with ``fair=True``
+        (``_select_head``; FCFS within a tenant either way). Stops at
+        the first selected head that does not fit (no queue jumping —
+        the same head is re-selected next step, so it can never be
+        starved by smaller requests behind it). Returns the admitted
+        requests with slot + prompt pages assigned; the engine runs
+        their prefills.
 
         The engine calls this with ``limit=1`` in a loop; ``budget``
         carries the remaining step budget across those calls and
@@ -355,7 +468,9 @@ class Scheduler:
         budget = self.prefill_token_budget if budget is None else budget
         while (self.waiting and self._free_slots
                and (limit is None or len(admitted) < limit)):
-            req = self.waiting[0]
+            req = self._select_head()
+            if req is None:
+                break  # every waiting tenant is at its live-slot cap
             n_valid = max(req.recompute_len, 1)
             # prefix-cache lookup: a fresh request caps the match at
             # n_valid - 1 (at least one suffix token must run through the
@@ -453,7 +568,17 @@ class Scheduler:
                 restored_tok += match.host_partial_len
             if match is not None:
                 pool.count_match(match)
-            self.waiting.pop(0)
+            self.waiting.remove(req)
+            if self.fair:
+                # charge the tenant's virtual token counter with the
+                # service this admission buys (context to materialize +
+                # decode budget), scaled by the tenant's weight — the
+                # deficit that decides who is served next. Recomputes
+                # after preemption charge again: they are real service.
+                self._vtc[req.tenant] = (
+                    self._vtc.get(req.tenant, 0.0)
+                    + (n_valid + req.max_new_tokens)
+                    / self._tenant_weight(req.tenant))
             req.pages = ((list(match.full_pages) if match else [])
                          + chain_pages + pages)
             req.cached_len = cached

@@ -35,23 +35,29 @@ replay it on every arm, ship its ``stats()`` in the bench summary.
 
 from __future__ import annotations
 
+import inspect
 from dataclasses import dataclass, field
 
 import numpy as np
 
 __all__ = ["Workload", "WorkloadRequest", "WorkloadSpec",
-           "heavy_tail_workload", "make_workload"]
+           "heavy_tail_workload", "make_workload", "overload_workload"]
 
 
 @dataclass
 class WorkloadRequest:
     """One trace entry: submit ``prompt`` at engine step
-    ``arrival_step`` asking for ``max_new_tokens``."""
+    ``arrival_step`` asking for ``max_new_tokens``. ``priority``
+    (larger = more important) and ``deadline_s`` express the request's
+    SLO class (SERVING.md "Overload control & tenant fairness") —
+    replay forwards them to targets that accept them."""
     rid: str
     arrival_step: int
     prompt: list[int]
     max_new_tokens: int
     tenant: int
+    priority: int = 0
+    deadline_s: float | None = None
 
 
 @dataclass
@@ -95,6 +101,15 @@ class WorkloadSpec:
     lognormal_sigma: float = 0.8
     suffix_clip: tuple[int, int] = (48, 320)
     light_max_new: tuple[int, int] | None = None
+    # SLO classes (the overload-control regime): per-tenant priority
+    # (one int per tenant, larger = more important) and per-tenant
+    # deadline distribution — each entry is None (no deadline), a
+    # scalar seconds value, or an inclusive (lo, hi) uniform range
+    # drawn per request. Both default off, so every existing trace
+    # stays bitwise identical. Tenant 0 hot + LOW priority is the
+    # canonical overload trace (:func:`overload_workload`).
+    tenant_priorities: tuple | None = None
+    tenant_deadlines: tuple | None = None
 
 
 class Workload:
@@ -161,6 +176,16 @@ class Workload:
         has_work = (getattr(target, "has_work", None)
                     or target.scheduler.has_work)
         eos = self.spec.eos_token_id if self.spec is not None else None
+        # forward tenant/priority/deadline_s only to targets whose
+        # submit accepts them (signature probe, computed once) — a
+        # scripted replay target without tenancy keeps working
+        try:
+            params = inspect.signature(submit).parameters
+            slo_aware = ("tenant" in params or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in params.values()))
+        except (TypeError, ValueError):
+            slo_aware = False
         i, step, shed = 0, 0, 0
         rids: list[str] = []
         n = len(self.requests)
@@ -168,10 +193,16 @@ class Workload:
             while i < n and self.requests[i].arrival_step <= step:
                 r = self.requests[i]
                 i += 1
+                kw: dict = {}
+                if slo_aware:
+                    kw["tenant"] = r.tenant
+                    kw["priority"] = r.priority
+                    if r.deadline_s is not None:
+                        kw["deadline_s"] = r.deadline_s
                 try:
                     rids.append(submit(r.prompt, r.max_new_tokens,
                                        eos_token_id=eos,
-                                       rid=rid_prefix + r.rid))
+                                       rid=rid_prefix + r.rid, **kw))
                 except ServingError:
                     shed += 1
             target.step()
@@ -221,6 +252,16 @@ def make_workload(spec: WorkloadSpec | None = None, **kw) -> Workload:
         raise ValueError(f"unknown suffix_dist {spec.suffix_dist!r}")
     if spec.tenants < 1:
         raise ValueError("tenants must be >= 1")
+    if (spec.tenant_priorities is not None
+            and len(spec.tenant_priorities) != spec.tenants):
+        raise ValueError(
+            f"tenant_priorities needs one entry per tenant "
+            f"({len(spec.tenant_priorities)} != {spec.tenants})")
+    if (spec.tenant_deadlines is not None
+            and len(spec.tenant_deadlines) != spec.tenants):
+        raise ValueError(
+            f"tenant_deadlines needs one entry per tenant "
+            f"({len(spec.tenant_deadlines)} != {spec.tenants})")
     rng = np.random.default_rng(spec.seed)
     # per-tenant system prompts (the shared prefixes): lengths first,
     # then token draws, all from the one seeded stream
@@ -256,10 +297,25 @@ def make_workload(spec: WorkloadSpec | None = None, **kw) -> Workload:
               if not heavy and spec.light_max_new is not None
               else spec.max_new)
         max_new = int(rng.integers(mn[0], mn[1] + 1))
+        # SLO class: priority is a pure per-tenant lookup (no draw);
+        # a deadline draw happens ONLY for tenants that have one, so
+        # traces without SLO classes keep their exact draw order
+        priority = (int(spec.tenant_priorities[tenant])
+                    if spec.tenant_priorities is not None else 0)
+        deadline: float | None = None
+        if spec.tenant_deadlines is not None:
+            d = spec.tenant_deadlines[tenant]
+            if d is not None:
+                try:
+                    lo_d, hi_d = d
+                    deadline = float(rng.uniform(lo_d, hi_d))
+                except TypeError:
+                    deadline = float(d)
         requests.append(WorkloadRequest(
             rid=f"wl-{i:04d}", arrival_step=arrival,
             prompt=system_prompts[tenant] + suffix,
-            max_new_tokens=max_new, tenant=tenant))
+            max_new_tokens=max_new, tenant=tenant,
+            priority=priority, deadline_s=deadline))
     return Workload(requests, spec=spec, system_prompts=system_prompts)
 
 
@@ -282,5 +338,32 @@ def heavy_tail_workload(seed: int = 0, n_requests: int = 24,
                     suffix_clip=(48, 320),
                     prompt_mix=((1.0, 4, 12),),
                     max_new=(4, 8), light_max_new=(16, 48))
+    kw.update(overrides)
+    return make_workload(WorkloadSpec(**kw))
+
+
+def overload_workload(seed: int = 0, n_requests: int = 48,
+                      **overrides) -> Workload:
+    """The canonical hot-tenant overload preset (SERVING.md "Overload
+    control & tenant fairness"): tenant 0 is HOT (steep Zipf head,
+    ~2/3 of all traffic) and LOW priority — the batch scraper flooding
+    a shared fleet — while the cold tenants carry higher priorities,
+    i.e. the interactive SLO classes a brownout must protect. Bursty
+    arrivals overflow the queue during on-phases so admission quotas,
+    fair scheduling and the brownout ladder all engage; FCFS collapses
+    the cold tenants' TTFT on this trace, which is exactly what
+    ``bench.py llama_serving_fairness`` A/Bs. Deadlines default OFF
+    (pass ``tenant_deadlines=...`` to exercise infeasibility shedding
+    on a virtual clock). Deterministic in ``seed``; any
+    :class:`WorkloadSpec` field can be overridden."""
+    kw: dict = dict(seed=seed, n_requests=n_requests,
+                    arrival="bursty", rate=1.25,
+                    burst_on=6, burst_off=10,
+                    burst_factor=4.0, idle_factor=0.25,
+                    tenants=4, zipf_alpha=2.5, system_len=(12, 20),
+                    prompt_mix=((0.5, 8, 24), (0.35, 24, 64),
+                                (0.15, 64, 96)),
+                    max_new=(6, 16),
+                    tenant_priorities=(0, 2, 2, 3))
     kw.update(overrides)
     return make_workload(WorkloadSpec(**kw))

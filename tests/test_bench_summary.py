@@ -17,7 +17,7 @@ _DEFAULT_CONFIGS = {
     "llama_serving_prefix", "llama_decode_int8", "llama_serving_int8",
     "llama_serving_fleet", "llama_serving_spec", "llama_serving_tiered",
     "llama_serving_chunked", "llama_serving_failover",
-    "llama_serving_tp",
+    "llama_serving_tp", "llama_serving_fairness",
 }
 
 
@@ -232,6 +232,25 @@ def test_dry_serving_tp_cell_carries_tp_keys():
                          "tp_degree", "tp_shard_kv_bytes_per_token",
                          "kv_bytes_per_token", "tokens_per_s_tp1",
                          "goodput_at_slo", "goodput_at_slo_tp1",
+                         "retraces"}, cell
+    assert all(v is None for v in cell.values()), cell
+
+
+def test_dry_serving_fairness_cell_carries_overload_ab_keys():
+    # the overload-control arm (SERVING.md "Overload control & tenant
+    # fairness"): the cell must surface the A/B evidence — the cold
+    # tenants' worst p99 TTFT under FCFS vs fair+brownout, what the
+    # ladder shed, how often it moved, and goodput_at_slo for BOTH
+    # arms — next to the usual serving keys
+    out = _run_dry("llama_serving_fairness")
+    assert out.returncode == 0, out.stderr
+    last = json.loads(out.stdout.splitlines()[-1])
+    cell = last["bench_summary"]["llama_serving_fairness"]
+    assert set(cell) >= {"value", "mfu", "spread",
+                         "ttft_p50", "ttft_p99", "tpot",
+                         "cold_ttft_p99", "cold_ttft_p99_fcfs",
+                         "shed", "brownout_transitions",
+                         "goodput_at_slo", "goodput_at_slo_fcfs",
                          "retraces"}, cell
     assert all(v is None for v in cell.values()), cell
 

@@ -70,6 +70,24 @@ Run: python tools/profile_serving.py            (real TPU)
                                                  with the tier, spill/
                                                  restore + goodput deltas
                                                  printed)
+     python tools/profile_serving.py --overload (overload-control walk:
+                                                 the canonical hot-tenant
+                                                 flood pushed at a fair-
+                                                 scheduled engine with the
+                                                 brownout ladder armed —
+                                                 prints the per-step level
+                                                 trajectory as the burst
+                                                 walks the ladder UP and
+                                                 the drain walks it back
+                                                 DOWN, the per-tenant TTFT
+                                                 p99 / shed breakdown and
+                                                 the quota rejections;
+                                                 asserts zero recompiles
+                                                 across every transition
+                                                 and a clean pool audit at
+                                                 teardown — SERVING.md
+                                                 "Overload control &
+                                                 tenant fairness")
      python tools/profile_serving.py --chaos    (replay the fixed
                                                  FaultPlan below and print
                                                  the outcome histogram —
@@ -1184,6 +1202,122 @@ def crash_restart():
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def overload():
+    """Overload-control walk (SERVING.md "Overload control & tenant
+    fairness"): the canonical hot-tenant flood (``overload_workload`` —
+    low-priority tenant 0 carries ~2/3 of a bursty trace) replayed on a
+    fair-scheduled engine with per-tenant quotas and the brownout
+    ladder armed. The run prints the level trajectory — the burst walks
+    the ladder UP (budget shrink -> drafter off -> lowest-priority
+    shed), the drain walks it back DOWN through the hysteresis — then
+    the per-tenant TTFT p99 / shed breakdown and the admission-quota
+    rejections. The invariants asserted at the end are the tentpole's
+    contract: the ladder is host-side scalar churn only, so the decode
+    + mixed program pair never retraces across ANY transition, the
+    ladder fully releases once load clears, and the pool audits clean
+    at teardown."""
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                         llama_tiny)
+    from paddle_tpu.serving import (BrownoutConfig, ServingEngine,
+                                    ServingError, overload_workload)
+
+    backend = jax.default_backend()
+    smoke = "--smoke" in sys.argv[1:] or backend != "tpu"
+    if backend != "tpu":
+        print(f"WARNING: backend={backend} — timings are meaningless "
+              f"off-chip, running the smoke shapes")
+
+    pt.seed(0)
+    if smoke:
+        cfg = llama_tiny(mp_axis=None, fsdp_axis=None)
+        n_requests = 24
+        page_size, num_pages, max_slots = 4, 128, 4
+        budget = 32
+        bo = BrownoutConfig(high_queue=4, low_queue=1, dwell_steps=1)
+    else:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5632, num_hidden_layers=8,
+                          num_attention_heads=16, num_key_value_heads=8,
+                          max_position_embeddings=4096, dtype="bfloat16",
+                          mp_axis=None, fsdp_axis=None)
+        n_requests = 40
+        page_size, num_pages, max_slots = 16, 256, 8
+        budget = 128
+        bo = BrownoutConfig(high_queue=10, low_queue=4, dwell_steps=2)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+
+    wl = overload_workload(seed=0, n_requests=n_requests, rate=2.0,
+                           zipf_alpha=1.6, vocab_size=cfg.vocab_size)
+    ws = wl.stats()
+    print(f"trace: {ws['n_requests']} requests over {ws['tenants']} "
+          f"Zipf tenants (counts {ws['tenant_counts']}; tenant 0 is the "
+          f"hot LOW-priority flood), bursty arrivals over "
+          f"{ws['arrival_span_steps']} steps, greedy")
+    print(f"ladder: budget {budget}->"
+          f"{max(1, int(budget * bo.budget_frac))} at level 1, drafter "
+          f"off at 2, priority-shed at 3; watermarks "
+          f"{bo.high_queue}/{bo.low_queue}, dwell {bo.dwell_steps}")
+
+    eng = ServingEngine(model, num_pages=num_pages, page_size=page_size,
+                        max_slots=max_slots, prefill_token_budget=budget,
+                        fair_scheduling=True, speculative=2,
+                        tenant_max_queued_tokens=40 * page_size,
+                        brownout=bo)
+    reqs = wl.requests
+    i, step, rejected = 0, 0, 0
+    trajectory = []
+    while i < len(reqs) or eng.scheduler.has_work():
+        while i < len(reqs) and reqs[i].arrival_step <= step:
+            r = reqs[i]
+            i += 1
+            try:
+                eng.add_request(r.prompt, r.max_new_tokens, rid=r.rid,
+                                tenant=r.tenant, priority=r.priority)
+            except ServingError:
+                rejected += 1
+        eng.step()
+        trajectory.append(eng.brownout_level)
+        step += 1
+        assert step < 4000, "flood did not drain"
+
+    # the walk itself: one char per step (level 0-3)
+    print(f"\nladder trajectory ({step} steps, '.'=0):")
+    line = "".join("." if v == 0 else str(v) for v in trajectory)
+    for off in range(0, len(line), 72):
+        print(f"  {line[off:off + 72]}")
+    peak = max(trajectory)
+    m = eng.metrics.summary()
+    print(f"peak level {peak}, {m['brownout_transitions']} transitions, "
+          f"occupancy l1/l2/l3 = {m['brownout_level1_steps']}/"
+          f"{m['brownout_level2_steps']}/{m['brownout_level3_steps']} "
+          f"steps; final level {eng.brownout_level}")
+    print(f"admission: {rejected} rejected at the door "
+          f"(quota={m['rejected_quota']}), {m['shed']} shed by the "
+          f"ladder; all sheds by priority "
+          f"{dict(eng.metrics.shed_by_priority())}")
+    print("per-tenant (p99 TTFT is what fairness bounds):")
+    for t, row in sorted(eng.metrics.per_tenant().items()):
+        print(f"  tenant {t}: arrived={row['arrived']:3d} "
+              f"finished={row['finished']:3d} shed={row['shed']:3d} "
+              f"ttft_p99={row['ttft_p99_s'] * 1000:8.1f}ms")
+
+    counts = eng.step_program_counts()
+    assert counts == {"decode": 1, "mixed": 1}, (
+        f"a brownout transition retraced a step program: {counts}")
+    assert peak >= 1, "the flood never engaged the ladder"
+    assert eng.brownout_level == 0, "the ladder never released"
+    eng.audit_pool()
+    print(f"\ninvariants held: programs {counts} across every "
+          f"transition, ladder released to 0, pool audit clean")
+    if smoke:
+        print("(smoke mode: the trajectory is logic evidence only — "
+              "rerun on-chip for the PERF.md numbers)")
+
+
 def main():
     import jax
 
@@ -1386,6 +1520,8 @@ if __name__ == "__main__":
         tiered()
     elif "--spec" in sys.argv[1:]:
         spec()
+    elif "--overload" in sys.argv[1:]:
+        overload()
     elif "--crash-restart" in sys.argv[1:]:
         crash_restart()
     elif "--tp" in sys.argv[1:]:
