@@ -94,6 +94,10 @@ _SERVING_SLOS = {
     # failover A/B (full vs bounded replay): same kill, same budgets as
     # the fleet arm — snapshots must win on replay work, not on SLOs
     "llama_serving_failover": {"ttft_p99_s": 2.0, "itl_p99_s": 1.0},
+    # partition A/B (clean vs lossy wire): retransmissions and a healed
+    # partition stretch inter-token gaps — the fleet ITL budget prices
+    # the lease ejection + replay, same as any other failover
+    "llama_serving_partition": {"ttft_p99_s": 2.0, "itl_p99_s": 1.0},
     # chunked-prefill A/B: long prompts land mid-decode, so the OFF
     # arm's itl_p99 carries the head-of-line stall chunking removes; a
     # tight ITL SLO makes goodput_at_slo sensitive to exactly that
@@ -1449,6 +1453,161 @@ def bench_llama_serving_failover(peak, peak_kind, n_requests=12,
     }
 
 
+def bench_llama_serving_partition(peak, peak_kind, n_requests=12,
+                                  max_new_tokens=48, partition_step=12,
+                                  trace_path=None):
+    """Clean-vs-lossy wire A/B (SERVING.md "Fleet transport &
+    membership"): the same 420M model and staggered trace served by a
+    3-replica FleetRouter twice. Arm A runs on the default
+    ``LoopbackTransport`` (lossless, synchronous). Arm B routes every
+    router<->replica message through a seeded ``ChaosTransport`` —
+    drops, duplicates, deterministic reordering — and two-way
+    partitions replica 2 at ``partition_step`` until its lease expires,
+    the router ejects it and replays its requests on the survivors; the
+    partition then heals and the zombie's held traffic must be fenced.
+    Both arms must produce bitwise-identical client streams (asserted —
+    the exactly-once contract priced by this cell), so the evidence is
+    what the lossy wire cost: ``failovers``, ``stale_epoch_discarded``,
+    ``duplicates_suppressed``, transport drop volume, and
+    ``goodput_at_slo`` for both arms."""
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import (ChaosTransport, FleetMetrics,
+                                    FleetRouter, ServingEngine,
+                                    ServingMetrics)
+
+    name = "llama_serving_partition"
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                      intermediate_size=5632, num_hidden_layers=8,
+                      num_attention_heads=16, num_key_value_heads=8,
+                      max_position_embeddings=4096, dtype="bfloat16",
+                      mp_axis=None, fsdp_axis=None)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    n_params = model.num_params()
+    weight_bytes = 2.0 * n_params
+    rng = np.random.default_rng(0)
+    lens = [int(x) for x in rng.integers(64, 256, n_requests)]
+    prompts = [rng.integers(0, cfg.vocab_size, n).astype(np.int32)
+               for n in lens]
+    tracer = _make_tracer(trace_path)
+
+    def _arm(lossy):
+        wire = None
+        if lossy:
+            wire = ChaosTransport(seed=42, drop_p=0.05, dup_p=0.15,
+                                  reorder=True)
+            wire.partition("router", "replica:2", two_way=True,
+                           start=partition_step)
+        arm_tracer = tracer if lossy else None
+        engines = [ServingEngine(model, num_pages=256, page_size=16,
+                                 max_slots=8, max_pages_per_slot=32,
+                                 tracer=arm_tracer) for _ in range(3)]
+        engines[0].warm_programs()
+        engines[1].add_request(prompts[0], 2)
+        engines[1].run_to_completion(max_steps=100)
+        warm_steps = [e.stats()["steps"] for e in engines]
+        router = FleetRouter(engines, tracer=arm_tracer, transport=wire,
+                             lease_steps=6)
+        router.metrics = ServingMetrics()  # compile time stays out
+        router.metrics.set_slo(**_SERVING_SLOS[name])
+        router.fleet_metrics = FleetMetrics()
+        added = 2
+        for p in prompts[:2]:
+            router.submit(p, max_new_tokens)
+        steps = 0
+        out = {}
+        while router.has_work() or added < n_requests:
+            for ev in router.step():
+                if ev.get("token") is not None:
+                    out.setdefault(ev["rid"], []).append(ev["token"])
+            steps += 1
+            if added < n_requests and steps % 4 == 0:
+                router.submit(prompts[added], max_new_tokens)
+                added += 1
+            assert steps < 5000, "fleet hung on the lossy wire"
+        if lossy:
+            wire.heal()      # the zombie's held traffic arrives ...
+            for ev in router.step():  # ... and must be fenced, not
+                if ev.get("token") is not None:   # re-emitted
+                    out.setdefault(ev["rid"], []).append(ev["token"])
+            steps += 1
+        survivors = [e for e, rep in zip(engines, router._replicas)
+                     if rep.state != "dead"]
+        for e in survivors:
+            assert e.decode_program_count() == 1, "serving decode retraced"
+            e.audit_pool()
+        engine_steps = sum(e.stats()["steps"] - w
+                           for e, w in zip(engines, warm_steps))
+        return {"m": router.metrics.summary(),
+                "fleet": router.fleet_metrics.summary(),
+                "wire": dict(router.transport.stats()),
+                "out": out, "steps": steps, "engine_steps": engine_steps,
+                "retraces": sum(e.decode_program_count() - 1
+                                for e in survivors),
+                "ejected": 3 - router.replicas_live()}
+
+    clean = _arm(lossy=False)
+    lossy = _arm(lossy=True)
+    # the exactly-once contract: the lossy wire may cost latency and
+    # replay work, never tokens — streams identical to the clean arm
+    assert lossy["out"] == clean["out"], \
+        "lossy-wire arm diverged from the clean arm"
+    m, fleet, wire = lossy["m"], lossy["fleet"], lossy["wire"]
+    m0, fleet0 = clean["m"], clean["fleet"]
+    assert wire["corrupt_dropped"] == wire["corrupt_injected"]
+    assert fleet["lease_expirations"] >= 1, "the partition never expired"
+    hbm_bw = {"v4": 1.2e12,
+              "v5e": 0.82e12, "v5litepod": 0.82e12, "v5lite": 0.82e12,
+              "v5p": 2.77e12,
+              "v6e": 1.64e12, "trillium": 1.64e12,
+              }.get(peak_kind.split("(")[0], 0.82e12)
+    wall = max(m["wall_s"], 1e-9)
+    mbu = lossy["engine_steps"] * weight_bytes / wall / hbm_bw
+    trace_out = _dump_trace(tracer, trace_path, name)
+    return {
+        "metric": "llama_420m_serving_partition_tokens_per_sec",
+        "value": round(m["tokens_per_s"], 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(m["tokens_per_s"]
+                             / max(m0["tokens_per_s"], 1e-9), 4),
+        "extra": {"params": n_params, "n_requests": n_requests,
+                  "max_new_tokens": max_new_tokens,
+                  "prompt_lens": lens,
+                  "replicas": 3, "partition_step": partition_step,
+                  "replicas_ejected": lossy["ejected"],
+                  "router_steps": lossy["steps"],
+                  "engine_steps": lossy["engine_steps"],
+                  # the A/B evidence: what the lossy wire cost
+                  "failovers": fleet["failovers"],
+                  "failovers_clean": fleet0["failovers"],
+                  "stale_epoch_discarded": fleet["stale_epoch_discarded"],
+                  "lease_expirations": fleet["lease_expirations"],
+                  "duplicates_suppressed": fleet["duplicates_suppressed"],
+                  "replayed_tokens": fleet["replayed_tokens"],
+                  "transport_dropped": wire["dropped"],
+                  "transport_duplicated": wire["duplicated"],
+                  "transport_held": wire["held"],
+                  "token_exact": True,
+                  "shed": fleet["shed"],
+                  "ttft_p50": round(m["ttft_p50_s"], 4),
+                  "ttft_p99": round(m["ttft_p99_s"], 4),
+                  "tpot": round(m["tpot_mean_s"], 5),
+                  "itl_p99": round(m["itl_p99_s"], 5),
+                  "goodput_at_slo": round(m["goodput_at_slo"], 4),
+                  "goodput_at_slo_clean": round(m0["goodput_at_slo"], 4),
+                  "tokens_per_s_clean": round(m0["tokens_per_s"], 1),
+                  "slo": _SERVING_SLOS[name],
+                  "retraces": lossy["retraces"] + clean["retraces"],
+                  "trace": trace_out,
+                  "mbu_weights_only": round(mbu, 4),
+                  "peak": peak_kind, "hbm_bw": hbm_bw,
+                  "pipeline": False, "runs": _RUNS,
+                  "spread": None},
+    }
+
+
 def bench_llama_serving_tiered(peak, peak_kind, n_requests=12,
                                max_new_tokens=48, trace_path=None):
     """Tiered-KV serving A/B (SERVING.md "KV tiering & traffic
@@ -1886,6 +2045,12 @@ _CONFIGS = {
     # bitwise-identical client streams by assertion, replay-work +
     # goodput_at_slo evidence for both arms
     "llama_serving_failover": bench_llama_serving_failover,
+    # clean-vs-lossy wire A/B (SERVING.md "Fleet transport &
+    # membership"): loopback vs seeded chaos transport with a healed
+    # mid-run partition and a lease ejection; bitwise-identical client
+    # streams by assertion, failover/fencing/goodput evidence for both
+    # arms
+    "llama_serving_partition": bench_llama_serving_partition,
     # chunked-prefill A/B (SERVING.md "Chunked prefill & mixed steps"):
     # whole-prompt vs chunk-streamed prefill on a long-prompt +
     # decode-heavy trace; itl_p99/goodput for both arms, token-exact
@@ -1939,6 +2104,14 @@ _SUMMARY_EXTRA_KEYS = {
                                "recovery_replayed_tokens",
                                "goodput_at_slo", "goodput_at_slo_full",
                                "retraces"),
+    "llama_serving_partition": ("ttft_p50", "ttft_p99", "tpot",
+                                "failovers", "failovers_clean",
+                                "stale_epoch_discarded",
+                                "lease_expirations",
+                                "duplicates_suppressed",
+                                "transport_dropped",
+                                "goodput_at_slo", "goodput_at_slo_clean",
+                                "retraces"),
     "llama_serving_chunked": ("ttft_p50", "ttft_p99", "tpot",
                               "itl_p99", "itl_p99_baseline",
                               "itl_p99_ratio",

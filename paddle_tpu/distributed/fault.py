@@ -59,14 +59,29 @@ Named sites wired into the runtime (see RESILIENCE.md):
   id for ``fleet.dispatch`` and the replica index for the other two, so
   ``match=r"^1$"`` chaos-kills exactly replica 1; ``step`` is the
   router's step counter.
+- ``fleet.transport.send`` / ``fleet.transport.recv`` — the fleet
+  transport's per-message sites (SERVING.md "Fleet transport &
+  membership"), fired for EVERY router<->replica message at send and at
+  delivery. ``ctx['path']`` is ``"<KIND>:<rid>"`` (e.g.
+  ``"SUBMIT:fleet-req-3"``), so ``match`` pins a fault to one message
+  kind of one request. They support the transport actions ``drop``
+  (message vanishes), ``dup`` (delivered twice — receiver dedup must
+  collapse it), ``delay`` (``arg`` = router steps on the injectable
+  clock) and ``corrupt`` (flip one payload byte WITHOUT updating the
+  digest — the receive-side blake2b re-verify must catch it); ``step``
+  is the router's step counter.
 
 Actions: ``hang`` (sleep ``arg`` seconds — trips the comm watchdog),
 ``kill`` (SIGKILL self: the un-catchable death), ``exit`` (``os._exit(arg)``),
 ``raise`` (raise :class:`FaultInjected`), ``torn`` (truncate the file in
 ``ctx['path']`` to half its size — a torn write), ``corrupt`` (flip one
-byte mid-file), ``poison`` (invoke the site's ``ctx['poison']`` callback —
+byte mid-file, or invoke the site's ``ctx['corrupt']`` callback when one
+is passed — the fleet transport corrupts in-memory wire bytes, not
+files), ``poison`` (invoke the site's ``ctx['poison']`` callback —
 serving sites pass one that writes NaN into the request's KV pages, the
-device-buffer analogue of ``corrupt``).
+device-buffer analogue of ``corrupt``), ``drop`` / ``dup`` / ``delay``
+(invoke the site's same-named callbacks — message-transport faults; a
+site that passes no such callback raises :class:`FaultInjected`).
 
 Activation: programmatically via :func:`activate`, or across process
 boundaries via the ``PADDLE_FAULT_PLAN`` env var holding
@@ -116,7 +131,7 @@ class FaultSpec:
 
     def __post_init__(self):
         if self.action not in ("hang", "kill", "exit", "raise", "torn",
-                               "corrupt", "poison"):
+                               "corrupt", "poison", "drop", "dup", "delay"):
             raise ValueError(f"unknown fault action {self.action!r}")
 
 
@@ -206,6 +221,19 @@ class FaultPlan:
             if fn is None:
                 raise FaultInjected(f"{tag}: site passed no poison callback")
             fn()
+        elif spec.action in ("drop", "dup", "delay"):
+            fn = ctx.get(spec.action)
+            if fn is None:
+                raise FaultInjected(
+                    f"{tag}: site passed no {spec.action} callback")
+            if spec.action == "delay":
+                fn(spec.arg if spec.arg is not None else 1)
+            else:
+                fn()
+        elif spec.action == "corrupt" and callable(ctx.get("corrupt")):
+            # message-transport sites corrupt in-memory wire bytes via a
+            # callback; file-based corruption below stays the default
+            ctx["corrupt"]()
         elif spec.action in ("torn", "corrupt"):
             path = ctx.get("path")
             if not path or not os.path.exists(path):

@@ -118,6 +118,14 @@ def render_fleet_prometheus(router) -> str:
     for key, value in sorted(stats["fleet"].items()):
         emit(f"paddle_serving_fleet_{_NAME_RE.sub('_', key)}_total",
              value, "counter")
+    # the wire itself (SERVING.md "Fleet transport & membership"):
+    # per-message delivery counters + heartbeat round-trip percentiles
+    for key, value in sorted(stats.get("transport", {}).items()):
+        emit(f"paddle_serving_fleet_transport_"
+             f"{_NAME_RE.sub('_', key)}_total", value, "counter")
+    for key in ("heartbeat_rtt_p50_steps", "heartbeat_rtt_p99_steps"):
+        if key in stats:
+            emit(f"paddle_serving_fleet_{key}", stats[key])
     for health in stats["replica_health"]:
         labels = '{replica="%d"}' % health["replica"]
         emit("paddle_serving_fleet_replica_up",
@@ -125,7 +133,7 @@ def render_fleet_prometheus(router) -> str:
         for key in ("ready", "live", "queue_depth", "running",
                     "pool_utilization", "tp_degree",
                     "consecutive_failures", "breaker_opens",
-                    "backoff_remaining"):
+                    "backoff_remaining", "epoch", "lease_age"):
             emit(f"paddle_serving_fleet_replica_{key}", health[key],
                  labels=labels)
     # the client-visible stream summary, unlabeled — same names a

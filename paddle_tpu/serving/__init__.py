@@ -23,8 +23,11 @@ from .engine import BrownoutConfig, ServingEngine
 from .errors import (AdmissionShedError, EngineDrainingError,
                      FleetOverloadedError, QueueFullError,
                      RequestTooLargeError, SchedulerStalledError,
-                     ServingError, TPConfigError)
+                     ServingError, StaleEpochError, TPConfigError,
+                     TransportError)
 from .fleet import FleetRequest, FleetRouter
+from .transport import (ChaosTransport, EngineServer, LoopbackTransport,
+                        Message, Transport, deterministic_jitter)
 from .kv_cache import KVCachePool, PoolExhaustedError, PrefixMatch
 from .metrics import FleetMetrics, ServingMetrics, percentile
 from .parallel import (TPContext, collective_counts, partition_devices,
@@ -55,6 +58,9 @@ __all__ = [
     "ServingError", "QueueFullError", "RequestTooLargeError",
     "SchedulerStalledError", "EngineDrainingError", "FleetOverloadedError",
     "TPConfigError", "AdmissionShedError",
+    "TransportError", "StaleEpochError",
+    "Transport", "LoopbackTransport", "ChaosTransport", "EngineServer",
+    "Message", "deterministic_jitter",
     "TPContext", "partition_devices", "validate_tp_config",
     "collective_counts",
 ]

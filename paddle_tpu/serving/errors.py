@@ -56,13 +56,26 @@ router that acts on it is :class:`paddle_tpu.serving.fleet.FleetRouter`
   ``ServingEngine(tp=N)`` construction instead of a shape crash inside
   the compiled step. NOT retryable: every replica of the same config
   would fail identically.
+- :class:`TransportError` — a fleet wire message failed its blake2b
+  digest re-verify at receive (``serving/transport.py``): the payload
+  was corrupted in flight. The message is dropped and counted, never
+  consumed; retryable — the sender's at-least-once retransmission
+  delivers an intact copy.
+- :class:`StaleEpochError` — epoch fencing (SERVING.md "Fleet
+  transport & membership"): a message carried a replica epoch below
+  the receiver's fence, i.e. a zombie replica returning from a
+  partition tried to ack work the router already failed over. The
+  message is discarded and counted; retryable only in the sense that
+  the CURRENT epoch owns the request — the stale sender must never
+  retry it.
 """
 
 from __future__ import annotations
 
 __all__ = ["ServingError", "QueueFullError", "RequestTooLargeError",
            "SchedulerStalledError", "EngineDrainingError",
-           "FleetOverloadedError", "TPConfigError", "AdmissionShedError"]
+           "FleetOverloadedError", "TPConfigError", "AdmissionShedError",
+           "TransportError", "StaleEpochError"]
 
 
 class ServingError(RuntimeError):
@@ -161,3 +174,23 @@ class AdmissionShedError(ServingError):
         self.retry_after_s = retry_after_s
         self.kind = kind
         self.tenant = tenant
+
+
+class TransportError(ServingError):
+    """A fleet wire message failed its blake2b digest re-verify at
+    receive (``serving/transport.py``): corrupted in flight. Dropped
+    and counted (``corrupt_dropped``), never consumed. Retryable: the
+    sender's at-least-once retransmission delivers an intact copy."""
+
+    retryable = True
+
+
+class StaleEpochError(ServingError):
+    """Epoch fencing: the message's replica epoch is below the
+    receiver's fence — a zombie replica back from a partition trying to
+    ack work the router already failed over, or a fenced replica being
+    handed zombie-epoch commands. Discarded and counted
+    (``stale_epoch_discarded`` / ``fenced_dropped``); the CURRENT
+    epoch owns the request."""
+
+    retryable = True

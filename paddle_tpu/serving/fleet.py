@@ -1,9 +1,10 @@
 """Engine fleet: replicated serving with health-checked routing and
-deterministic failover replay (SERVING.md "Engine fleet & failover").
+deterministic failover replay (SERVING.md "Engine fleet & failover",
+"Fleet transport & membership").
 
-``FleetRouter`` fronts N in-process data-parallel :class:`ServingEngine`
-replicas (same model, same config — homogeneous) and owns the three
-things a single engine cannot:
+``FleetRouter`` fronts N data-parallel :class:`ServingEngine` replicas
+(same model, same config — homogeneous) and owns the three things a
+single engine cannot:
 
 - **Admission.** One global bounded queue; when it is full ``submit``
   sheds with :class:`FleetOverloadedError` (retryable after client
@@ -15,60 +16,69 @@ things a single engine cannot:
   content-hash index, ``pool.match_prefix``) wins over an idle cold one,
   because the cached prefill is the cheaper admission.
 
-- **Health.** Per replica: *ready* = would accept a dispatch now (not
-  draining, queue below its bound, breaker not open); *live* = making
-  step progress. Transient dispatch/health failures feed a
-  consecutive-failure circuit breaker — at ``breaker_threshold`` the
-  replica goes OPEN and is skipped for placement for a bounded
-  exponential backoff (deterministic hash jitter, measured in router
-  steps — no wall-clock entropy), then HALF_OPEN where a single probe
-  dispatch decides: success closes the breaker, failure reopens it with
-  doubled backoff. The breaker gates NEW placements only; an OPEN
-  replica keeps stepping its in-flight work.
+- **Health.** Lease-based membership over the transport: the router
+  heartbeats every replica (seq-numbered, deterministically phased via
+  the shared :func:`~.transport.deterministic_jitter`) and reads health
+  from the gauges piggybacked on every reply — never by calling the
+  engine directly, because over a real wire there is no engine to call.
+  A heartbeat unacked past half the lease marks the replica SUSPECT
+  (one circuit-breaker failure per missed seq — the same breaker that
+  absorbs dispatch failures); total silence past ``lease_steps`` router
+  steps expires the lease and ejects the replica (``lease_expired``,
+  counted). The breaker itself is unchanged: at ``breaker_threshold``
+  consecutive failures the replica goes OPEN and is skipped for
+  placement for a bounded exponential backoff (deterministic hash
+  jitter, measured in router steps — no wall-clock entropy), then
+  HALF_OPEN where a single probe dispatch decides. The breaker gates
+  NEW placements only; an OPEN replica keeps stepping its in-flight
+  work.
 
-- **Failover, exactly-once.** When a replica dies (chaos kill via the
-  ``fleet.replica_kill`` fault site, an unexpected exception), stalls
-  (:class:`SchedulerStalledError`) or drains, the router marks it DEAD,
-  dumps its flight recorder, and re-queues its in-flight requests for
-  placement on a healthy replica — same rid, same prompt, same seed.
-  Because the engine is bitwise deterministic (engine == ``generate()``
-  parity; per-slot sampling keyed ``fold_in(PRNGKey(seed), token_idx)``,
-  independent of slot placement and batch composition), the replay
-  reproduces the original token stream exactly. The router tracks per
-  request how many tokens the CLIENT has seen (``emitted``) versus how
-  many the current replica life has produced (``produced``, reset to 0
-  at each dispatch): replayed positions ``produced <= emitted`` are
-  verified bitwise against the delivered stream and suppressed, the
-  first fresh position is delivered — so every client sees each token
-  exactly once, and the whole stream equals a single-engine run
-  bit-for-bit. Replay is possible precisely because faults land at step
-  boundaries: a step either completes (its events were translated) or
-  raises (no events), so ``emitted`` can never include a half-delivered
-  step. With a shared :class:`~.snapshot.SnapshotStore` (the engines'
-  periodic captures), the replay is BOUNDED: the replacement replica
-  restores the request's KV and already-generated tokens from its
-  latest digest-verified snapshot and re-produces only the delta since
-  capture — a missing or corrupt snapshot silently degrades to the
-  full replay above (slower, never wrong; RESILIENCE.md "Serving
-  recovery playbook").
+- **Failover, exactly-once — now over a lossy wire.** ALL
+  router<->replica traffic crosses a :class:`~.transport.Transport`
+  (:class:`~.transport.LoopbackTransport` by default — bitwise
+  identical to the old in-process fleet; a seeded
+  :class:`~.transport.ChaosTransport` for hostile-network tests).
+  Replies stream back on a per-replica ordered, acked, retransmitted
+  channel: the router applies result batches in seq order, buffers the
+  future, suppresses duplicates (``duplicates_suppressed``), and the
+  replica-side :class:`~.transport.EngineServer` resends whatever the
+  router has not acked — at-least-once delivery + receiver dedup =
+  exactly-once application. Each replica life has a monotonically
+  increasing **epoch**, bumped at ejection: a zombie replica returning
+  from a partition keeps sending with its old epoch, and every such
+  message is counted (``stale_epoch_discarded``) and dropped — it can
+  neither ack stale work nor double-emit. When a replica dies (chaos
+  kill via the ``fleet.replica_kill`` fault site, a typed ERROR from
+  its server, a lapsed lease), the router marks it DEAD, dumps its
+  flight recorder, and re-queues its in-flight requests from its OWN
+  records (the dead replica cannot be asked) for placement on a healthy
+  replica — same rid, same prompt, same seed. Because the engine is
+  bitwise deterministic (per-slot sampling keyed
+  ``fold_in(PRNGKey(seed), token_idx)``), the replay reproduces the
+  original stream exactly; replayed positions ``produced <= emitted``
+  are verified bitwise and suppressed, so every client sees each token
+  exactly once even across drops, duplicates, reordering and healed
+  partitions. With a shared :class:`~.snapshot.SnapshotStore` the
+  replay is BOUNDED (snapshot-seeded, delta-only); replicas with
+  private stores are harvested over the wire via SNAPSHOT_FETCH, each
+  snapshot re-verified through its own digests at receive — a corrupt
+  one is stripped and the failover degrades to full replay.
 
 The router never hangs: if every replica is DEAD (or zero placement
 progress persists past ``shed_patience`` router steps) the pending
 queue is shed with the classified terminal outcome
 ``finish_reason="shed"`` rather than spinning. Fleet-wide SIGTERM drain
-composes with ``PreemptionGuard`` exactly like the single engine:
-``attach_preemption_guard`` + ``stream``/``run_to_completion`` notice
-the flag at a step boundary and ``drain()`` every replica, returning
-structured retry-elsewhere outcomes.
+composes with ``PreemptionGuard`` exactly like the single engine.
 
 Fault sites (RESILIENCE.md): ``fleet.dispatch`` (ctx path = rid),
-``fleet.replica_kill`` and ``fleet.health`` (ctx path = replica index,
-so ``match=r"^1$"`` chaos-kills exactly replica 1); the router also
-sets each pool's ``fault_path`` to the replica index so a
-``serving.alloc`` storm can be pinned to one replica.
+``fleet.replica_kill`` and ``fleet.health`` (ctx path = replica index),
+plus the per-message ``fleet.transport.send`` / ``fleet.transport.recv``
+sites inside the transport itself (ctx path = ``"<KIND>:<rid>"``,
+actions drop/dup/delay/corrupt); the router also sets each pool's
+``fault_path`` to the replica index so a ``serving.alloc`` storm can be
+pinned to one replica.
 
-Homogeneous replicas may share ONE :class:`~.tiering.HostTier`
-(``ServingEngine(..., host_tier=tier)`` with the same instance): tier
+Homogeneous replicas may share ONE :class:`~.tiering.HostTier`: tier
 keys are chained content hashes namespaced per KV dtype, so a page
 spilled by replica A restores bit-exactly on replica B — after a
 failover the replacement replica warm-starts from the dead replica's
@@ -79,16 +89,16 @@ spilled prefixes instead of recomputing them (chaos-tested in
 from __future__ import annotations
 
 import bisect
-import hashlib
-from dataclasses import dataclass, field
+from dataclasses import asdict, dataclass, field
 
 from ..distributed import fault as _fault
 from ..observability.trace import NULL_TRACER
 from .errors import (EngineDrainingError, FleetOverloadedError,
-                     RequestTooLargeError, SchedulerStalledError,
-                     ServingError)
-from .metrics import FleetMetrics, ServingMetrics
+                     RequestTooLargeError)
+from .metrics import FleetMetrics, ServingMetrics, percentile
 from .scheduler import SamplingParams
+from .transport import (EngineServer, LoopbackTransport, Message,
+                        deterministic_jitter)
 
 __all__ = ["FleetRouter", "FleetRequest",
            "CLOSED", "OPEN", "HALF_OPEN", "DEAD"]
@@ -97,9 +107,11 @@ __all__ = ["FleetRouter", "FleetRequest",
 CLOSED = "closed"          # healthy, accepts placements
 OPEN = "open"              # breaker open: no placements until backoff ends
 HALF_OPEN = "half_open"    # probing: one placement decides close/reopen
-DEAD = "dead"              # ejected (killed/stalled) — terminal
+DEAD = "dead"              # ejected (killed/stalled/lease-expired) — terminal
 
 _SHED_PATIENCE = 50        # zero-progress router steps before shedding
+_LEASE_STEPS = 8           # heartbeat silence (router steps) before eject
+_DRAIN_PATIENCE = 64       # lossy-wire drain: resend rounds before eject
 
 
 @dataclass
@@ -140,6 +152,18 @@ class _Replica:
     last_progress_step: int = 0
     dead_reason: str | None = None
     dump_path: str | None = None
+    # --- transport-side membership state (SERVING.md "Fleet transport") ---
+    epoch: int = 1              # this replica life; bumped at ejection
+    applied_seq: int = 0        # result stream applied through this seq
+    buffer: dict = field(default_factory=dict)   # out-of-order stream batches
+    gauges: dict = field(default_factory=dict)   # last health payload seen
+    last_heard: int = 0         # router step of the last applied message
+    hb_seq: int = 0             # heartbeat seqnos sent
+    hb_acked: int = 0           # highest heartbeat seq acked
+    hb_sent_step: dict = field(default_factory=dict)  # seq -> step sent
+    hb_suspected: int = 0       # highest seq already counted as a miss
+    hb_next: int = 0            # next step a heartbeat is due
+    live_rids: set = field(default_factory=set)  # requests placed here
 
 
 class FleetRouter:
@@ -154,6 +178,13 @@ class FleetRouter:
     its TTFT/ITL/goodput are the honest client-visible numbers across
     failovers (a replayed token that was suppressed never counts
     twice); per-replica engine metrics stay on the engines.
+
+    All replica interaction goes through ``transport`` (default
+    :class:`~.transport.LoopbackTransport` — synchronous, lossless,
+    bitwise-identical to the pre-transport in-process fleet). The
+    engine objects are retained ONLY for out-of-band introspection
+    (``engines`` property, flight-recorder dumps, pool fault-path
+    pinning) — never for serving-path calls.
     """
 
     def __init__(self, engines, max_queue_depth: int | None = None,
@@ -161,7 +192,10 @@ class FleetRouter:
                  breaker_backoff_steps: int = 2,
                  breaker_backoff_max: int = 16,
                  shed_patience: int = _SHED_PATIENCE,
-                 clock=None, tracer=None, snapshot_store=None):
+                 clock=None, tracer=None, snapshot_store=None,
+                 transport=None, lease_steps: int = _LEASE_STEPS,
+                 heartbeat_interval: int = 1,
+                 snapshot_fetch_interval: int = 4):
         if not engines:
             raise ValueError("FleetRouter needs at least one engine")
         self._replicas = [_Replica(i, e) for i, e in enumerate(engines)]
@@ -175,6 +209,9 @@ class FleetRouter:
         self.breaker_backoff_steps = breaker_backoff_steps
         self.breaker_backoff_max = breaker_backoff_max
         self.shed_patience = shed_patience
+        self.lease_steps = max(1, int(lease_steps))
+        self.heartbeat_interval = max(1, int(heartbeat_interval))
+        self.snapshot_fetch_interval = int(snapshot_fetch_interval)
         self.tracer = tracer if tracer is not None else NULL_TRACER
         self.metrics = ServingMetrics(clock)     # client-visible stream
         self.fleet_metrics = FleetMetrics()
@@ -205,6 +242,33 @@ class FleetRouter:
         self._draining = False
         self._guard = None
         self.last_drain_events: list[dict] = []
+        # --- the wire (SERVING.md "Fleet transport & membership") ---
+        self._transport = (transport if transport is not None
+                           else LoopbackTransport())
+        self._transport.bind("router")           # inbox endpoint
+        self._servers = [EngineServer(i, e, self._transport)
+                         for i, e in enumerate(engines)]
+        # submits in flight: rid -> (replica idx, attempt, sent Message).
+        # A pinned submit is retransmitted verbatim until its reply
+        # lands — never re-placed elsewhere, so a delayed reply can
+        # never cause a double placement.
+        self._outstanding: dict[str, tuple] = {}
+        self._attempts: dict[str, int] = {}
+        self._submit_outcomes: dict[tuple, str] = {}  # (rid, attempt) -> ..
+        self._hb_rtt: list[int] = []             # heartbeat RTTs, in steps
+        # gauge bootstrap: a direct pre-network read at construction
+        # (the wire has not carried anything yet); replicas whose engine
+        # keeps a PRIVATE snapshot store get harvested over the wire
+        for rep, srv in zip(self._replicas, self._servers):
+            rep.gauges = srv.gauges()
+            # phase heartbeats off the shared deterministic jitter so a
+            # large fleet does not burst every lease in the same step
+            rep.hb_next = deterministic_jitter(
+                f"fleet-hb:{rep.idx}", self.heartbeat_interval)
+        self._fetch_idx = [
+            i for i, e in enumerate(engines)
+            if getattr(e, "snapshot_store", None) is not None
+            and e.snapshot_store is not self._snapshot_store]
 
     # ------------------------------------------------------------------
     # admission
@@ -223,12 +287,14 @@ class FleetRouter:
         playbook"); a request no replica could EVER run raises
         :class:`RequestTooLargeError` here, before it occupies queue
         space anywhere (homogeneous fleet — replica 0's
-        ``admission_check`` speaks for all). ``tenant``/``priority``
-        ride the record to every placement (fair scheduling, quotas and
-        brownout shed order on the replicas — SERVING.md "Overload
-        control & tenant fairness"). Placement happens at the next
-        ``step()``, not here: dispatch failures are the router's to
-        retry, never the client's."""
+        ``admission_check``, probed over the transport's advisory
+        channel, speaks for all; an unreachable replica 0 skips the
+        probe and dispatch classification covers it). ``tenant`` /
+        ``priority`` ride the record to every placement (fair
+        scheduling, quotas and brownout shed order on the replicas —
+        SERVING.md "Overload control & tenant fairness"). Placement
+        happens at the next ``step()``, not here: dispatch failures are
+        the router's to retry, never the client's."""
         if self._draining:
             raise EngineDrainingError(
                 "fleet is draining (preempted or shut down); "
@@ -247,13 +313,13 @@ class FleetRouter:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("prompt must be non-empty")
-        check = getattr(self._replicas[0].engine, "admission_check", None)
-        if check is not None:
-            try:
-                check(len(prompt), max_new_tokens)
-            except RequestTooLargeError:
-                self.metrics.on_reject("too_large")
-                raise
+        res = self._transport.query(
+            "replica:0", "admission_check",
+            {"prompt_len": len(prompt), "max_new_tokens": max_new_tokens})
+        if res is not None and not res.get("ok", True):
+            self.metrics.on_reject("too_large")
+            raise RequestTooLargeError(
+                res.get("detail", "admission refused"))
         rid = rid if rid is not None else f"fleet-req-{self._submit_seq}"
         if rid in self._records:
             raise ValueError(f"duplicate request id {rid!r}")
@@ -278,9 +344,10 @@ class FleetRouter:
         """Deterministic fleet drain-rate estimate behind the
         ``retry_after_s`` hint on FleetOverloadedError and router shed
         events: service tokens held by the router queue over the live
-        replicas' combined per-step token capacity, scaled by the
-        router-step-duration EMA (metrics clock). 0.0 before the first
-        timed step — honest "no data yet", never a made-up constant."""
+        replicas' combined per-step token capacity (from the gauges the
+        transport carried last), scaled by the router-step-duration EMA
+        (metrics clock). 0.0 before the first timed step — honest "no
+        data yet", never a made-up constant."""
         if self._step_dt_ema is None or self._step_dt_ema <= 0.0:
             return 0.0
         tokens = sum(len(r.prompt) + r.max_new_tokens
@@ -289,9 +356,8 @@ class FleetRouter:
         for rep in self._replicas:
             if rep.state == DEAD:
                 continue
-            per_step = getattr(rep.engine, "_token_capacity_per_step",
-                               None)
-            cap += int(per_step()) if per_step is not None else 1
+            tc = rep.gauges.get("token_capacity")
+            cap += int(tc) if tc is not None else 1
         return tokens / max(cap, 1) * self._step_dt_ema
 
     # ------------------------------------------------------------------
@@ -299,38 +365,39 @@ class FleetRouter:
     # ------------------------------------------------------------------
 
     def step(self) -> list[dict]:
-        """One router iteration: chaos/health sweep, placement of the
-        router queue, one engine step per live replica (ejecting and
-        failing over any that die or stall), and exactly-once
-        translation of their events into client events. Bounded work —
-        a replica that cannot accept work this step is retried next
+        """One router iteration: transport tick + late-arrival apply,
+        chaos/health/lease sweep, placement of the router queue, one
+        STEP command per replica believed to hold work (applying the
+        result streams — ejecting and failing over any replica that
+        reports death or goes silent past its lease), and exactly-once
+        translation of replica events into client events. Bounded work
+        — a replica that cannot accept work this step is retried next
         step, never spun on."""
         t_step0 = self.metrics.now()
         events: list[dict] = []
+        self._progress_flag = False
+        self._submit_outcomes.clear()
+        self._transport.tick(self._steps)
+        self._pump_and_apply(events)     # delayed/held arrivals
         self._kill_sweep()
-        self._health_sweep()
+        self._health_sweep(events)
+        self._pump_and_apply(events)     # heartbeat acks (loopback: now)
+        self._snapshot_fetch()
         self._dispatch(events)
-        progressed = bool(events)
+        if events:
+            self._progress_flag = True
         for rep in list(self._replicas):
-            if rep.state == DEAD or not rep.engine.scheduler.has_work():
+            if rep.state == DEAD or not rep.live_rids:
                 continue
-            try:
-                replica_events = rep.engine.step()
-            except SchedulerStalledError as e:
-                self._eject(rep, "stalled", snapshot=e.snapshot)
-                continue
-            except ServingError as e:
-                self._eject(rep, f"error:{type(e).__name__}")
-                continue
-            except _fault.FaultInjected:
-                self._eject(rep, "killed")
-                continue
-            if replica_events:
-                rep.last_progress_step = self._steps
-                progressed = True
-            self._translate(rep, replica_events, events)
+            self._transport.send(Message.make(
+                "STEP", "router", f"replica:{rep.idx}", epoch=rep.epoch,
+                payload={"router_step": self._steps,
+                         "ack": rep.applied_seq}))
+            # loopback: the results of exactly this step apply here,
+            # preserving the pre-transport per-replica translate order
+            self._pump_and_apply(events)
         self._steps += 1
-        if progressed or not self._pending:
+        if self._progress_flag or not self._pending:
             self._idle_steps = 0
         else:
             self._idle_steps += 1
@@ -352,7 +419,7 @@ class FleetRouter:
     def has_work(self) -> bool:
         if self._pending:
             return True
-        return any(rep.state != DEAD and rep.engine.scheduler.has_work()
+        return any(rep.state != DEAD and rep.live_rids
                    for rep in self._replicas)
 
     def stream(self):
@@ -384,37 +451,181 @@ class FleetRouter:
         return {rid: list(r.tokens) for rid, r in self._records.items()}
 
     # ------------------------------------------------------------------
+    # the receive path: ordered streams, epoch fencing, application
+    # ------------------------------------------------------------------
+
+    def _pump_and_apply(self, sink: list[dict]) -> None:
+        """Run transport deliveries, then apply everything addressed to
+        the router: epoch-fence, stream-order and dedup each message,
+        translating replica events into ``sink``."""
+        self._transport.pump()
+        for msg in self._transport.recv("router"):
+            self._on_message(msg, sink)
+
+    def _on_message(self, msg: Message, sink: list[dict]) -> None:
+        try:
+            idx = int(msg.src.split(":", 1)[1])
+            rep = self._replicas[idx]
+        except (IndexError, ValueError):
+            return
+        # THE fence: traffic from a dead replica, or stamped with an
+        # epoch that is not this replica's current life, is zombie
+        # output from before a partition/ejection — counted, dropped,
+        # never applied. This is what makes double emission impossible.
+        if rep.state == DEAD or msg.epoch != rep.epoch:
+            self.fleet_metrics.bump("stale_epoch_discarded")
+            return
+        rep.last_heard = self._steps          # any applied message renews
+        if msg.kind == "HEARTBEAT_ACK":
+            p = msg.payload()
+            if p["hb_seq"] > rep.hb_acked:    # freshest ack wins
+                rep.hb_acked = p["hb_seq"]
+                rep.gauges = p["gauges"]
+                self._hb_rtt.append(self._steps - int(p["sent_step"]))
+                if len(self._hb_rtt) > 1024:
+                    del self._hb_rtt[:512]
+            return
+        # ordered result stream: apply in seq order, buffer the future,
+        # suppress what was already applied (at-least-once -> once)
+        if msg.seq <= rep.applied_seq or msg.seq in rep.buffer:
+            self.fleet_metrics.bump("duplicates_suppressed")
+            return
+        rep.buffer[msg.seq] = msg
+        while rep.applied_seq + 1 in rep.buffer:
+            m = rep.buffer.pop(rep.applied_seq + 1)
+            rep.applied_seq += 1
+            self._apply(rep, m, sink)
+            if rep.state == DEAD:             # applying ejected it
+                break
+
+    def _apply(self, rep: _Replica, msg: Message,
+               sink: list[dict]) -> None:
+        p = msg.payload()
+        if "gauges" in p:
+            rep.gauges = p["gauges"]
+        kind = msg.kind
+        if kind == "SUBMIT_REPLY":
+            self._apply_submit_reply(rep, p, sink)
+        elif kind in ("STEP_RESULTS", "DRAIN_RESULTS"):
+            if p["events"]:
+                rep.last_progress_step = self._steps
+                self._progress_flag = True
+            self._translate(rep, p["events"], sink)
+        elif kind == "ERROR":
+            self._eject(rep, p["reason"], snapshot=p.get("snapshot"))
+        elif kind == "SNAPSHOT_DATA":
+            store = self._snapshot_store
+            if store is not None:
+                # the transport already stripped any snapshot that
+                # failed its digest re-verify (counted corrupt_dropped)
+                for snap in msg.snaps:
+                    rec = self._records.get(snap.rid)
+                    if rec is not None and not rec.finished:
+                        store.put(snap.rid, snap)
+
+    def _apply_submit_reply(self, rep: _Replica, p: dict,
+                            sink: list[dict]) -> None:
+        rid, attempt = p["rid"], p["attempt"]
+        entry = self._outstanding.get(rid)
+        if entry is None or entry[0] != rep.idx or entry[1] != attempt:
+            return   # a cancelled/superseded attempt — already failed over
+        del self._outstanding[rid]
+        rec = self._records.get(rid)
+        if rec is None or rec.finished:
+            return
+        if not p["ok"]:
+            if p.get("error") == "RequestTooLargeError":
+                # cannot happen after submit-time admission_check on a
+                # homogeneous fleet, but a duck-typed engine may
+                # disagree: classify, never retry (retryable=False)
+                self._finish_record(rec, "rejected_too_large", sink)
+                if rec in self._pending:
+                    self._pending.remove(rec)
+                self._submit_outcomes[(rid, attempt)] = "finished"
+            else:
+                # retryable territory (queue full / draining / injected
+                # dispatch fault): breaker data point, record stays
+                # queued for the next candidate / next step
+                self._breaker_failure(rep)
+                self._submit_outcomes[(rid, attempt)] = "retry"
+            return
+        self._breaker_success(rep)
+        rec.replica = rep.idx
+        # the replica's first emission is token index len(snap.tokens):
+        # seeding produced keeps the dedup's position arithmetic exact
+        rec.produced = (int(p.get("restored", 0))
+                        if p.get("used_snapshot") else 0)
+        if rec.replays:
+            fm = self.fleet_metrics
+            # THE bounded-vs-full A/B number: tokens this failover still
+            # re-produces (full replay pays the whole emitted count)
+            fm.bump("recovery_replayed_tokens", rec.emitted - rec.produced)
+            if p.get("used_snapshot"):
+                fm.bump("snapshot_restores")
+                fm.bump("recovery_restored_tokens", rec.produced)
+            elif self._snapshot_store is not None:
+                fm.bump("snapshot_fallbacks")
+        self.metrics.on_admit(rid)
+        self.fleet_metrics.bump("dispatched")
+        if rec.replays:
+            self.fleet_metrics.bump("replayed_requests")
+        rep.live_rids.add(rid)
+        if rec in self._pending:
+            self._pending.remove(rec)
+        self.tracer.instant("dispatch", track="fleet", rid=rid,
+                            replica=rep.idx, replay=rec.replays,
+                            restored=rec.produced)
+        self._submit_outcomes[(rid, attempt)] = "placed"
+
+    # ------------------------------------------------------------------
     # drain / preemption
     # ------------------------------------------------------------------
 
     def drain(self, timeout_s: float | None = None) -> dict:
         """Fleet-wide graceful shutdown: shed the router queue as
         retriable ``preempted`` outcomes (nothing was computed for
-        them), then drain every live replica — running requests decode
-        to their own finish within ``timeout_s`` (per replica, on its
-        metrics clock) and their events flow through the exactly-once
-        translation like any other step. Returns
-        {rid: {finish_reason, tokens, retriable}} over ALL fleet
-        requests; terminal events land in ``last_drain_events``."""
+        them), then DRAIN every replica believed to hold work — running
+        requests decode to their own finish within ``timeout_s`` (per
+        replica, on its metrics clock) and their events flow through
+        the exactly-once translation like any other step. Over a lossy
+        wire the DRAIN is retransmitted with the transport clock
+        advancing; a replica that never answers is ejected
+        (``died_in_drain``) and its requests classify as preempted.
+        Returns {rid: {finish_reason, tokens, retriable}} over ALL
+        fleet requests; terminal events land in ``last_drain_events``."""
         events: list[dict] = []
         self._draining = True
         for rec in list(self._pending):
             self._finish_record(rec, "preempted", events)
         self._pending.clear()
         for rep in self._replicas:
-            if rep.state == DEAD or not rep.engine.scheduler.has_work():
+            if rep.state == DEAD or not rep.live_rids:
                 continue
-            try:
-                rep.engine.drain(timeout_s=timeout_s)
-                self._translate(rep, rep.engine.last_drain_events, events)
-            except (ServingError, _fault.FaultInjected):
-                self._eject(rep, "died_in_drain")
+            msg = Message.make(
+                "DRAIN", "router", f"replica:{rep.idx}", epoch=rep.epoch,
+                payload={"timeout_s": timeout_s, "ack": rep.applied_seq})
+            self._transport.send(msg)
+            self._pump_and_apply(events)
+            tries = 0
+            while rep.state != DEAD and rep.live_rids:
+                tries += 1
+                if tries > _DRAIN_PATIENCE:
+                    self._eject(rep, "died_in_drain")
+                    break
+                # lossy wire: advance the injectable clock so delayed /
+                # held deliveries release, and retransmit (the server's
+                # drain is a one-shot latch — duplicates are cheap)
+                self._steps += 1
+                self._transport.tick(self._steps)
+                self._transport.send(msg)
+                self._pump_and_apply(events)
         # anything still unfinished (its replica died mid-drain and
         # there is nowhere left to replay) is preempted: retryable,
         # nothing the client saw is lost
         for rec in self._records.values():
             if not rec.finished:
                 self._finish_record(rec, "preempted", events)
+        self._pending.clear()    # eject-failover re-queues are moot now
         self.last_drain_events = events
         self.tracer.instant("fleet_drain", track="fleet",
                             requests=len(self._records))
@@ -440,20 +651,21 @@ class FleetRouter:
                 and not self._draining)
 
     # ------------------------------------------------------------------
-    # health / breaker
+    # health / membership / breaker
     # ------------------------------------------------------------------
 
     def health(self, idx: int) -> dict:
-        """One replica's health view: *ready* (would accept a dispatch
-        now — queue/pool pressure + breaker), *live* (step progress;
-        vacuously true while it has nothing to do), and the breaker
-        bookkeeping an operator alerts on."""
+        """One replica's health view — entirely from the gauges its
+        server piggybacks on heartbeat acks and stream replies (a dead
+        or partitioned replica shows its last-known gauges): *ready*
+        (would accept a dispatch now — queue/pool pressure + breaker),
+        *live* (step progress; vacuously true while it has nothing to
+        do), and the breaker/lease bookkeeping an operator alerts on."""
         rep = self._replicas[idx]
-        eng = rep.engine
-        sched = eng.scheduler
-        qd = sched.queue_depth
-        pool = getattr(eng, "pool", None)
-        has_work = sched.has_work()
+        g = rep.gauges
+        qd = int(g.get("queue_depth", 0))
+        running = int(g.get("running", 0))
+        has_work = bool(rep.live_rids) or qd + running > 0
         return {
             "replica": idx,
             "state": rep.state,
@@ -463,40 +675,47 @@ class FleetRouter:
                           or self._steps - rep.last_progress_step
                           <= self.shed_patience)),
             "queue_depth": qd,
-            "running": len(sched.running),
-            "pool_utilization": (pool.utilization()
-                                 if pool is not None else 0.0),
+            "running": running,
+            "pool_utilization": float(g.get("pool_utilization", 0.0)),
             # a replica is a TP *group*: tp devices serving one engine.
             # One device failing takes the whole group — the breaker /
             # failover-replay path below is the same either way
             # (RESILIENCE.md), this gauge just sizes the blast radius.
-            "tp_degree": getattr(eng, "tp", 1),
+            "tp_degree": int(g.get("tp_degree", 1)),
             # overload-control gauge: which brownout rung this replica
             # is on (0 = normal service; engines without the ladder
             # always read 0)
-            "brownout_level": getattr(eng, "brownout_level", 0),
+            "brownout_level": int(g.get("brownout_level", 0)),
             "consecutive_failures": rep.consecutive_failures,
             "breaker_opens": rep.opens,
             "backoff_remaining": max(0, rep.backoff_until - self._steps),
             "dead_reason": rep.dead_reason,
             "flight_recorder": rep.dump_path,
+            # membership gauges (SERVING.md "Fleet transport")
+            "epoch": rep.epoch,
+            "lease_age": max(0, self._steps - rep.last_heard),
         }
 
     def _ready(self, rep: _Replica) -> bool:
         if rep.state == DEAD or rep.state == OPEN:
             return False
-        eng = rep.engine
-        if getattr(eng, "_draining", False):
+        g = rep.gauges
+        if g.get("draining"):
             return False
-        mqd = getattr(eng.scheduler, "max_queue_depth", None)
-        if mqd is not None and eng.scheduler.queue_depth >= mqd:
+        mqd = g.get("max_queue_depth")
+        if mqd is not None and int(g.get("queue_depth", 0)) >= int(mqd):
             return False
         return True
 
-    def _health_sweep(self) -> None:
-        """Advance breaker timers + fire the ``fleet.health`` site per
-        live replica (an injected health failure counts as a transient
-        breaker failure, exactly like a failed dispatch)."""
+    def _health_sweep(self, events: list[dict]) -> None:
+        """Lease-based membership: advance breaker timers, fire the
+        ``fleet.health`` site per live replica (an injected health
+        failure counts as a transient breaker failure, exactly like a
+        failed dispatch), suspect replicas whose heartbeats go unacked
+        (one breaker failure per missed seq), expire the lease of a
+        replica silent past ``lease_steps`` (eject + failover), and
+        send the next heartbeat when due."""
+        hb_miss = max(2, self.lease_steps // 2)
         for rep in self._replicas:
             if rep.state == DEAD:
                 continue
@@ -510,6 +729,38 @@ class FleetRouter:
                             path=str(rep.idx))
             except _fault.FaultInjected:
                 self._breaker_failure(rep)
+            # missed-ack suspicion: an unacked heartbeat past half the
+            # lease is a breaker data point (per missed seq, once)
+            for seq in sorted(rep.hb_sent_step):
+                if seq <= rep.hb_acked:
+                    del rep.hb_sent_step[seq]
+                    continue
+                if (seq > rep.hb_suspected
+                        and self._steps - rep.hb_sent_step[seq] >= hb_miss):
+                    rep.hb_suspected = seq
+                    self.tracer.instant("lease_suspect", track="fleet",
+                                        replica=rep.idx, hb_seq=seq)
+                    self._breaker_failure(rep)
+                    if rep.state == DEAD:
+                        break
+            if rep.state == DEAD:
+                continue
+            # lease expiry: total silence -> the replica is gone (or
+            # partitioned, which over this wire is the same thing)
+            if self._steps - rep.last_heard > self.lease_steps:
+                self.fleet_metrics.bump("lease_expirations")
+                self._eject(rep, "lease_expired")
+                continue
+            if self._steps >= rep.hb_next:
+                rep.hb_seq += 1
+                rep.hb_sent_step[rep.hb_seq] = self._steps
+                rep.hb_next = self._steps + self.heartbeat_interval
+                self._transport.send(Message.make(
+                    "HEARTBEAT", "router", f"replica:{rep.idx}",
+                    epoch=rep.epoch,
+                    payload={"hb_seq": rep.hb_seq,
+                             "sent_step": self._steps,
+                             "ack": rep.applied_seq}))
 
     def _breaker_failure(self, rep: _Replica) -> None:
         rep.consecutive_failures += 1
@@ -537,12 +788,11 @@ class FleetRouter:
 
     @staticmethod
     def _jitter(idx: int, opens: int, backoff: int) -> int:
-        """Deterministic jitter in [0, backoff): a hash draw, never
-        wall-clock entropy, so chaos runs replay bit-identically."""
-        if backoff <= 1:
-            return 0
-        h = hashlib.sha256(f"fleet-jitter:{idx}:{opens}".encode()).digest()
-        return int.from_bytes(h[:4], "big") % backoff
+        """Deterministic backoff jitter in [0, backoff) — delegates to
+        the shared :func:`~.transport.deterministic_jitter` (the same
+        helper that phases heartbeats), preserving the exact historical
+        key string so chaos runs replay bit-identically across PRs."""
+        return deterministic_jitter(f"fleet-jitter:{idx}:{opens}", backoff)
 
     # ------------------------------------------------------------------
     # placement
@@ -551,13 +801,26 @@ class FleetRouter:
     def _dispatch(self, events: list[dict]) -> None:
         """Place router-queued records onto ready replicas, FCFS by
         submit order. Best-effort prefix-cache affinity first (largest
-        ``match_prefix`` hit), then least-loaded; every failure is a
-        breaker data point and the record simply stays queued for the
-        next step — bounded work, no spinning."""
+        ``match_prefix`` hit via the transport's advisory query), then
+        least-loaded; every typed failure reply is a breaker data point
+        and the record simply stays queued for the next step — bounded
+        work, no spinning. A submit whose reply has not arrived stays
+        PINNED to its replica (retransmitted verbatim each step) so a
+        delayed reply can never race a second placement."""
         if not self._pending:
             return
-        placed: list[FleetRequest] = []
-        for rec in self._pending:
+        for rec in list(self._pending):
+            if rec not in self._pending:
+                continue     # resolved while pumping an earlier submit
+            if rec.finished or rec.replica is not None:
+                self._pending.remove(rec)
+                continue
+            if rec.rid in self._outstanding:
+                idx, attempt, msg = self._outstanding[rec.rid]
+                self._transport.send(msg)       # retransmit the pinned submit
+                self._pump_and_apply(events)
+                if self._submit_outcomes.get((rec.rid, attempt)) != "retry":
+                    continue    # placed/finished (applied) or still pinned
             candidates = [rep for rep in self._replicas
                           if self._ready(rep)]
             if not candidates:
@@ -566,35 +829,27 @@ class FleetRouter:
                 candidates,
                 key=lambda rep: (-self._affinity(rep, rec),
                                  self._load(rep), rep.idx))
-            ok = False
             for rep in ordered:
-                if self._try_place(rec, rep, events):
-                    ok = True
+                out = self._submit_to(rec, rep, events)
+                if out in ("placed", "finished") or rec.finished:
                     break
-                if rec.finished:   # non-retryable dispatch classification
-                    ok = True
-                    break
-            if ok:
-                placed.append(rec)
-        for rec in placed:
-            self._pending.remove(rec)
+                if out is None:
+                    break       # no reply yet — pinned to this replica
+                # out == "retry": try the next candidate this same step
 
     @staticmethod
     def _load(rep: _Replica) -> int:
-        sched = rep.engine.scheduler
-        return sched.queue_depth + len(sched.running)
+        g = rep.gauges
+        return int(g.get("queue_depth", 0)) + int(g.get("running", 0))
 
-    @staticmethod
-    def _affinity(rep: _Replica, rec: FleetRequest) -> int:
+    def _affinity(self, rep: _Replica, rec: FleetRequest) -> int:
         """Cached-prefix tokens this replica's pool already holds for
-        the prompt — pure lookup against the content-hash index."""
-        pool = getattr(rep.engine, "pool", None)
-        if pool is None or not getattr(pool, "cache_enabled", False):
-            return 0
-        try:
-            return int(pool.match_prefix(rec.prompt).cached_tokens)
-        except Exception:  # noqa: BLE001 — affinity is best-effort only
-            return 0
+        the prompt — the transport's advisory query against the
+        content-hash index (0 for an unreachable replica: a partition
+        costs affinity, never correctness)."""
+        res = self._transport.query(f"replica:{rep.idx}", "affinity",
+                                    {"prompt": rec.prompt})
+        return int(res["cached_tokens"]) if res else 0
 
     def _usable_snapshot(self, rec: FleetRequest):
         """The record's latest VERIFIED snapshot, iff seeding from it
@@ -616,67 +871,47 @@ class FleetRouter:
             return None
         return snap
 
-    def _try_place(self, rec: FleetRequest, rep: _Replica,
-                   events: list[dict]) -> bool:
-        # bounded-replay failover: a replayed record with a usable
-        # snapshot restores from it (KV injected, tokens seeded) and
-        # replays only the delta since capture; the seeded tokens flow
-        # through the SAME emitted-vs-produced dedup via the produced
-        # counter, so client streams stay exactly-once and bitwise
-        snap = self._usable_snapshot(rec) if rec.replays else None
-        restore = getattr(rep.engine, "restore_request", None)
-        if restore is None:
-            snap = None
-        # tenant/priority ride every placement (fair scheduling, quotas
-        # and brownout shed order on the replica — restore included, so
-        # SURVIVOR quotas govern failover replay); forwarded only when
-        # set, keeping duck-typed engines without tenancy working
-        tp_kw = ({"tenant": rec.tenant, "priority": rec.priority}
-                 if (rec.tenant, rec.priority) != (0, 0) else {})
+    def _submit_to(self, rec: FleetRequest, rep: _Replica,
+                   events: list[dict]) -> str | None:
+        """Send one SUBMIT attempt over the wire and (when the reply is
+        synchronous — loopback) resolve its outcome: ``"placed"``,
+        ``"retry"`` (typed retryable failure — breaker fed, caller
+        tries the next candidate), ``"finished"`` (classified
+        non-retryable), or None (reply in flight — the submit is pinned
+        and retransmitted until it resolves)."""
+        attempt = self._attempts.get(rec.rid, 0) + 1
+        self._attempts[rec.rid] = attempt
         try:
             _fault.trip("fleet.dispatch", step=self._steps, path=rec.rid)
-            if snap is not None:
-                restore(snap, **tp_kw)
-            else:
-                rep.engine.add_request(
-                    rec.prompt, rec.max_new_tokens, sampling=rec.sampling,
-                    eos_token_id=rec.eos_token_id, rid=rec.rid,
-                    deadline_s=rec.deadline_s,
-                    max_queue_wait_s=rec.max_queue_wait_s, **tp_kw)
-        except RequestTooLargeError:
-            # cannot happen after submit-time admission_check on a
-            # homogeneous fleet, but a duck-typed engine may disagree:
-            # classify, never retry (retryable=False)
-            self._finish_record(rec, "rejected_too_large", events)
-            return False
-        except (ServingError, _fault.FaultInjected):
-            # retryable=True territory (queue full / draining / injected
-            # dispatch fault): breaker data point, record stays queued
+        except _fault.FaultInjected:
             self._breaker_failure(rep)
-            return False
-        self._breaker_success(rep)
-        rec.replica = rep.idx
-        # the replica's first emission is token index len(snap.tokens):
-        # seeding produced keeps the dedup's position arithmetic exact
-        rec.produced = len(snap.tokens) if snap is not None else 0
-        if rec.replays:
-            fm = self.fleet_metrics
-            # THE bounded-vs-full A/B number: tokens this failover still
-            # re-produces (full replay pays the whole emitted count)
-            fm.bump("recovery_replayed_tokens", rec.emitted - rec.produced)
-            if snap is not None:
-                fm.bump("snapshot_restores")
-                fm.bump("recovery_restored_tokens", rec.produced)
-            elif self._snapshot_store is not None:
-                fm.bump("snapshot_fallbacks")
-        self.metrics.on_admit(rec.rid)
-        self.fleet_metrics.bump("dispatched")
-        if rec.replays:
-            self.fleet_metrics.bump("replayed_requests")
-        self.tracer.instant("dispatch", track="fleet", rid=rec.rid,
-                            replica=rep.idx, replay=rec.replays,
-                            restored=rec.produced)
-        return True
+            return "retry"
+        # bounded-replay failover: a replayed record with a usable
+        # snapshot ships it on the message (the snapshot re-verifies its
+        # own digests at receive); the server restores KV + seeded
+        # tokens and replays only the delta since capture. The seeded
+        # tokens flow through the SAME emitted-vs-produced dedup via the
+        # produced counter, so client streams stay exactly-once and
+        # bitwise. tenant/priority ride every placement (fair
+        # scheduling, quotas and brownout shed order on the replica —
+        # restore included, so SURVIVOR quotas govern failover replay).
+        snap = self._usable_snapshot(rec) if rec.replays else None
+        msg = Message.make(
+            "SUBMIT", "router", f"replica:{rep.idx}", epoch=rep.epoch,
+            rid=rec.rid,
+            payload={"attempt": attempt, "prompt": rec.prompt,
+                     "max_new_tokens": rec.max_new_tokens,
+                     "sampling": asdict(rec.sampling),
+                     "eos_token_id": rec.eos_token_id,
+                     "deadline_s": rec.deadline_s,
+                     "max_queue_wait_s": rec.max_queue_wait_s,
+                     "tenant": rec.tenant, "priority": rec.priority,
+                     "ack": rep.applied_seq},
+            snaps=(snap,) if snap is not None else ())
+        self._outstanding[rec.rid] = (rep.idx, attempt, msg)
+        self._transport.send(msg)
+        self._pump_and_apply(events)
+        return self._submit_outcomes.get((rec.rid, attempt))
 
     # ------------------------------------------------------------------
     # failure handling
@@ -707,9 +942,15 @@ class FleetRouter:
 
     def _eject(self, rep: _Replica, reason: str,
                snapshot: dict | None = None) -> None:
-        """Replica death: flight-recorder dump, DEAD state, and failover
-        — every live request it held goes back to the router queue (in
-        submit order) for deterministic replay on a healthy replica."""
+        """Replica death: flight-recorder dump, DEAD state, epoch bump
+        (the fence — any message the zombie sends afterwards carries a
+        stale epoch and is discarded), and failover — every live
+        request it held, from the ROUTER's records (the dead replica
+        cannot be asked over a partition), goes back to the router
+        queue in submit order for deterministic replay on a healthy
+        replica. A best-effort FENCE tells the replica's server to
+        refuse its old epoch too (defense in depth for commands still
+        in flight)."""
         rep.state = DEAD
         rep.dead_reason = reason
         self.fleet_metrics.bump("ejections")
@@ -724,16 +965,20 @@ class FleetRouter:
                 rep.dump_path = None
         self.tracer.instant("replica_eject", track="fleet",
                             replica=rep.idx, reason=reason)
-        live = getattr(rep.engine.scheduler, "live_requests", None)
-        if live is not None:
-            survivors = live()
-        else:
-            survivors = (list(rep.engine.scheduler.waiting)
-                         + list(rep.engine.scheduler.running.values()))
-        for req in survivors:
-            rec = self._records.get(req.rid)
-            if rec is None or rec.finished:
-                continue
+        old_epoch = rep.epoch
+        rep.epoch += 1
+        rep.buffer.clear()
+        rep.live_rids = set()
+        # cancel submits pinned to it: their records are still pending
+        # and will re-place on a healthy replica with a fresh attempt
+        for rid in [r for r, e in self._outstanding.items()
+                    if e[0] == rep.idx]:
+            del self._outstanding[rid]
+        survivors = sorted(
+            (r for r in self._records.values()
+             if r.replica == rep.idx and not r.finished),
+            key=lambda r: r.submit_seq)
+        for rec in survivors:
             rec.replica = None
             rec.produced = 0
             rec.replays += 1
@@ -747,6 +992,9 @@ class FleetRouter:
                 bisect.bisect_left(keys, rec.submit_seq), rec)
             self.tracer.instant("failover", track="fleet", rid=rec.rid,
                                 emitted=rec.emitted, replica=rep.idx)
+        self._transport.send(Message.make(
+            "FENCE", "router", f"replica:{rep.idx}", epoch=old_epoch,
+            payload={"reason": reason}))
 
     # ------------------------------------------------------------------
     # exactly-once translation
@@ -763,7 +1011,10 @@ class FleetRouter:
         fresh position is delivered and ``emitted`` advances. Terminal
         classification events (token None) always deliver — they can
         never duplicate, because a finished record leaves the in-flight
-        set and is never replayed."""
+        set and is never replayed. (Whole duplicated/reordered BATCHES
+        never reach here — the per-replica seq stream already collapsed
+        them; this dedup is the per-TOKEN one that makes failover
+        replay invisible.)"""
         for ev in replica_events:
             rec = self._records.get(ev["rid"])
             if rec is None or rec.finished:
@@ -803,6 +1054,7 @@ class FleetRouter:
                 reason = ev.get("finish_reason")
                 rec.finished = True
                 rec.finish_reason = reason
+                rep.live_rids.discard(rec.rid)
                 self._recovering.pop(rec.rid, None)
                 self.metrics.on_finish(rec.rid, reason)
                 if reason not in ("stop", "length"):
@@ -822,6 +1074,8 @@ class FleetRouter:
         rejected): the client gets a typed outcome, never silence."""
         rec.finished = True
         rec.finish_reason = reason
+        if rec.replica is not None:
+            self._replicas[rec.replica].live_rids.discard(rec.rid)
         rec.replica = None
         ev = {"rid": rec.rid, "token": None, "finished": True,
               "finish_reason": reason, "replica": None}
@@ -838,6 +1092,29 @@ class FleetRouter:
                             reason=reason)
 
     # ------------------------------------------------------------------
+    # snapshot harvest (replicas with private stores)
+    # ------------------------------------------------------------------
+
+    def _snapshot_fetch(self) -> None:
+        """Pull snapshot captures from replicas whose engine keeps a
+        PRIVATE store (a shared store needs no wire — the common
+        default, where this is inert). Every snapshot re-verifies its
+        own digests at receive; corrupt ones are stripped by the
+        transport and simply not harvested."""
+        if (self._snapshot_store is None or not self._fetch_idx
+                or self.snapshot_fetch_interval <= 0
+                or self._steps % self.snapshot_fetch_interval != 0):
+            return
+        for i in self._fetch_idx:
+            rep = self._replicas[i]
+            if rep.state == DEAD:
+                continue
+            self._transport.send(Message.make(
+                "SNAPSHOT_FETCH", "router", f"replica:{i}",
+                epoch=rep.epoch,
+                payload={"known": {}, "ack": rep.applied_seq}))
+
+    # ------------------------------------------------------------------
     # introspection
     # ------------------------------------------------------------------
 
@@ -848,8 +1125,9 @@ class FleetRouter:
         return sum(1 for rep in self._replicas if rep.state != DEAD)
 
     def stats(self) -> dict:
-        """Fleet-level stats: router counters + per-replica health (the
-        shape ``observability.render_fleet_prometheus`` exports)."""
+        """Fleet-level stats: router counters, per-replica health, and
+        the transport/membership telemetry (the shape
+        ``observability.render_fleet_prometheus`` exports)."""
         return {
             "steps": self._steps,
             "replicas": len(self._replicas),
@@ -860,6 +1138,9 @@ class FleetRouter:
             "requests": len(self._records),
             "draining": self._draining,
             "fleet": self.fleet_metrics.summary(),
+            "transport": self._transport.stats(),
+            "heartbeat_rtt_p50_steps": percentile(self._hb_rtt, 50),
+            "heartbeat_rtt_p99_steps": percentile(self._hb_rtt, 99),
             "replica_health": [self.health(i)
                                for i in range(len(self._replicas))],
         }
@@ -867,6 +1148,12 @@ class FleetRouter:
     @property
     def engines(self):
         return [rep.engine for rep in self._replicas]
+
+    @property
+    def transport(self):
+        """The message fabric every router<->replica interaction
+        crosses (LoopbackTransport unless injected)."""
+        return self._transport
 
     @property
     def snapshot_store(self):

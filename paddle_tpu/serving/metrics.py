@@ -74,6 +74,17 @@ class FleetMetrics:
     - ``recovery_ttfrt_p50_s`` / ``_p99_s`` (summary only): ejection ->
       first FRESH post-recovery token, via :meth:`observe_recovery`
 
+    Partition-tolerant transport (serving/transport.py; SERVING.md
+    "Fleet transport & membership") adds:
+
+    - ``duplicates_suppressed``  result batches the router's per-replica
+      seq dedup collapsed (at-least-once delivery made exactly-once)
+    - ``stale_epoch_discarded``  messages from a zombie epoch (a replica
+      back from a partition after ejection) counted and dropped — each
+      one is the fence doing its job
+    - ``lease_expirations``      replicas ejected because their
+      heartbeat lease lapsed (no ack within ``lease_steps``)
+
     Client-visible latency/goodput lives on the router's own
     :class:`ServingMetrics`, not here — this bag is pure fleet-control
     accounting."""
@@ -85,6 +96,8 @@ class FleetMetrics:
             "breaker_opens": 0, "probes": 0,
             "snapshot_restores": 0, "snapshot_fallbacks": 0,
             "recovery_restored_tokens": 0, "recovery_replayed_tokens": 0,
+            "duplicates_suppressed": 0, "stale_epoch_discarded": 0,
+            "lease_expirations": 0,
         }
         # time-to-first-recovered-token samples: ejection -> the first
         # token beyond the request's pre-failover stream

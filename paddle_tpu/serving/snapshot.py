@@ -235,6 +235,12 @@ class SnapshotStore:
         bounded by live requests, not by history)."""
         self._snaps.pop(rid, None)
 
+    def rids(self) -> list:
+        """The request ids currently holding snapshots — the transport's
+        SNAPSHOT_FETCH enumeration (serving/transport.py). Sorted for a
+        deterministic wire order."""
+        return sorted(self._snaps)
+
     def corrupt(self, rid) -> None:
         """Poison hook for the fault sites: corrupt the stored snapshot
         in place (no-op on a missing rid — the fault can race a

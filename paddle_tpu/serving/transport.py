@@ -1,0 +1,763 @@
+"""Partition-tolerant fleet transport (SERVING.md "Fleet transport &
+membership"; ROADMAP item 4).
+
+Every fleet guarantee before this module — exactly-once failover
+replay, bounded-replay snapshots, overload control — silently assumed
+the router calls its replicas as in-process Python objects: calls never
+drop, never duplicate, never arrive from a replica the router already
+gave up on. This module breaks that assumption on purpose. ALL
+router<->replica traffic becomes typed :class:`Message` values crossing
+a :class:`Transport`:
+
+- :class:`LoopbackTransport` delivers synchronously and losslessly —
+  the default, reproducing the pre-transport in-process fleet bitwise
+  (every existing fleet/snapshot/fairness suite runs unchanged on it).
+- :class:`ChaosTransport` is a seeded hostile network: it
+  deterministically drops, duplicates, delays (in router steps — the
+  fleet's only injectable clock), reorders, corrupts and one- or
+  two-way partitions traffic. Partitioned messages are HELD, not
+  dropped, and released when the partition heals — which is exactly
+  what lets a zombie replica's stale acks arrive after the router has
+  ejected it, the scenario epoch fencing exists for.
+- :class:`EngineServer` is the replica-side shim: it owns one engine,
+  dedups at-least-once delivery (submits by ``(rid, epoch, attempt)``,
+  steps by the router's step seqno), tags every reply with the epoch it
+  is answering, and retransmits unacknowledged result batches whenever
+  the router contacts it — at-least-once send + receiver dedup =
+  exactly-once application.
+
+Wire integrity follows the HostTier/snapshot precedent
+(serving/tiering.py, serving/snapshot.py): every message body carries a
+blake2b-128 digest over its exact serialized bytes, re-verified at
+receive — a corrupted payload is dropped and counted
+(:class:`~.errors.TransportError`), never consumed. Snapshots ride
+messages as :class:`~.snapshot.RequestSnapshot` values whose OWN page
+and meta digests are re-verified at receive; a corrupt snapshot is
+stripped from the message (counted) and the failover degrades to full
+replay — slower, never wrong.
+
+Ordering model: replica->router results (submit replies, step results,
+drain results, snapshot data, typed errors) form ONE per-replica
+ordered stream with per-batch seqnos — the router applies batches in
+seq order, buffers the future, suppresses duplicates, and acks
+cumulatively on every message it sends; the server resends unacked
+batches whenever it hears from the router. Heartbeat acks are
+out-of-band (idempotent gauge refreshes — freshest seqno wins).
+Router->replica messages need no stream: each kind is idempotent at
+the server by construction.
+
+Fault sites (RESILIENCE.md): ``fleet.transport.send`` and
+``fleet.transport.recv`` fire per message with ``ctx['path'] =
+"<KIND>:<rid>"`` and support the transport actions ``drop``, ``dup``,
+``delay`` (``arg`` = steps) and ``corrupt`` — so a FaultPlan can make
+even the loopback wire lossy for one message kind of one request.
+
+The deterministic backoff-jitter helper the fleet circuit breaker and
+the heartbeat scheduler share lives here too (:func:`deterministic_jitter`):
+a sha256 draw keyed on a caller-chosen string — never wall-clock
+entropy, so chaos runs replay bit-identically.
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import json
+from dataclasses import dataclass, field
+
+from ..distributed import fault as _fault
+from .errors import (RequestTooLargeError, SchedulerStalledError,
+                     ServingError, StaleEpochError, TransportError)
+from .scheduler import SamplingParams
+
+__all__ = ["Message", "Transport", "LoopbackTransport", "ChaosTransport",
+           "EngineServer", "deterministic_jitter"]
+
+
+def deterministic_jitter(key: str, bound: int) -> int:
+    """Deterministic jitter in ``[0, bound)``: a sha256 draw over a
+    caller-chosen key string, never wall-clock entropy — chaos runs
+    replay bit-identically. Shared by the fleet circuit breaker's
+    backoff (``key = "fleet-jitter:<replica>:<opens>"``) and the
+    heartbeat scheduler's phase offset (``key = "fleet-hb:<replica>"``)."""
+    if bound <= 1:
+        return 0
+    h = hashlib.sha256(key.encode()).digest()
+    return int.from_bytes(h[:4], "big") % bound
+
+
+def _jsonable(obj):
+    """JSON fallback for numpy scalars riding event/payload dicts."""
+    item = getattr(obj, "item", None)
+    if item is not None:
+        return item()
+    tolist = getattr(obj, "tolist", None)
+    if tolist is not None:
+        return tolist()
+    raise TypeError(f"not wire-serializable: {type(obj).__name__}")
+
+
+def _encode_body(payload: dict) -> bytes:
+    """Canonical wire bytes for a payload dict (sorted keys, compact
+    separators) — the exact bytes the digest covers."""
+    return json.dumps(payload, sort_keys=True, separators=(",", ":"),
+                      default=_jsonable).encode()
+
+
+def _body_digest(body: bytes) -> bytes:
+    """blake2b-128 over the body bytes — same construction as the
+    HostTier/snapshot payload digests (tiering._payload_digest)."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(body)
+    return h.digest()
+
+
+@dataclass
+class Message:
+    """One typed wire message.
+
+    ``body`` is the canonical JSON serialization of the payload;
+    ``digest`` is blake2b-128 over those exact bytes, re-verified at
+    receive. ``snaps`` carries :class:`RequestSnapshot` values, each
+    self-verifying through its own page/meta digests. ``seq`` orders
+    the replica->router result stream (0 = unordered); ``epoch`` is the
+    replica life the message belongs to — the fence the router checks."""
+    kind: str
+    src: str
+    dst: str
+    epoch: int = 0
+    seq: int = 0
+    rid: str = ""
+    body: bytes = b"{}"
+    digest: bytes = b""
+    snaps: tuple = ()
+    msg_id: int = -1          # assigned by the transport at (re)send
+
+    _payload_cache: dict | None = field(default=None, repr=False,
+                                        compare=False)
+
+    @classmethod
+    def make(cls, kind: str, src: str, dst: str, *, epoch: int = 0,
+             seq: int = 0, rid: str = "", payload: dict | None = None,
+             snaps: tuple = ()) -> "Message":
+        body = _encode_body(payload or {})
+        return cls(kind=kind, src=src, dst=dst, epoch=int(epoch),
+                   seq=int(seq), rid=str(rid), body=body,
+                   digest=_body_digest(body), snaps=tuple(snaps))
+
+    def payload(self) -> dict:
+        if self._payload_cache is None:
+            self._payload_cache = json.loads(self.body.decode())
+        return self._payload_cache
+
+    def verify(self) -> bool:
+        """Re-check the body digest — the receive-side integrity gate."""
+        return _body_digest(self.body) == self.digest
+
+    @property
+    def path(self) -> str:
+        """The fault-site / trace path: message kind + request id."""
+        return f"{self.kind}:{self.rid}"
+
+
+class Transport:
+    """Message fabric between the router and its replica endpoints.
+
+    Endpoints are named (``"router"``, ``"replica:<i>"``). An endpoint
+    binds either a handler (called at delivery — how :class:`EngineServer`
+    processes traffic) or an inbox (drained with :meth:`recv` — how the
+    router consumes replies). :meth:`tick` advances the transport clock
+    in ROUTER STEPS (the fleet's injectable clock); :meth:`pump` runs
+    deliveries until quiescent.
+
+    The base class owns the full delivery machinery — queues, the step
+    clock, fault sites, digest verification, counters — and delivers
+    losslessly; :class:`ChaosTransport` overrides only the routing
+    policy. ``query`` is the ADVISORY side channel (prefix-affinity
+    probes, construction-time gauge seeding): best-effort reads that
+    never carry stream state, executed directly under loopback and
+    refused (``None``) across a partition.
+    """
+
+    def __init__(self):
+        self._handlers: dict = {}
+        self._query_handlers: dict = {}
+        self._inboxes: dict[str, list] = {}
+        self._ready: list[Message] = []
+        self._delayed: list[tuple[int, int, Message]] = []
+        self._step = 0
+        self._send_seq = 0
+        self.counters: dict[str, int] = {
+            "sent": 0, "received": 0, "dropped": 0, "duplicated": 0,
+            "delayed": 0, "reordered": 0, "held": 0,
+            "corrupt_injected": 0, "corrupt_dropped": 0,
+            "fenced_dropped": 0,
+        }
+
+    # ---- endpoints ----
+
+    def bind(self, name: str, handler=None) -> None:
+        """Attach an endpoint: ``handler(msg)`` runs at delivery; with
+        no handler the endpoint gets an inbox drained via :meth:`recv`."""
+        if handler is not None:
+            self._handlers[name] = handler
+        else:
+            self._inboxes.setdefault(name, [])
+
+    def bind_query(self, name: str, fn) -> None:
+        """Attach the advisory query handler ``fn(kind, payload)``."""
+        self._query_handlers[name] = fn
+
+    # ---- clock ----
+
+    def tick(self, step: int) -> None:
+        """Advance the transport clock (router steps). Delayed messages
+        whose release step arrived become deliverable, in msg_id order."""
+        self._step = int(step)
+        due = [e for e in self._delayed if e[0] <= self._step]
+        if due:
+            self._delayed = [e for e in self._delayed if e[0] > self._step]
+            for _, _, msg in sorted(due, key=lambda e: e[1]):
+                self._ready.append(msg)
+
+    # ---- send / deliver ----
+
+    def send(self, msg: Message) -> None:
+        """Accept a message for delivery. Fires the
+        ``fleet.transport.send`` fault site, then the routing policy
+        (:meth:`_route` — lossless here, hostile in the chaos
+        subclass). Re-sending the same :class:`Message` retransmits it
+        with a fresh ``msg_id`` (fresh chaos draws) but the SAME seq,
+        so receiver dedup still collapses it."""
+        self._send_seq += 1
+        msg.msg_id = self._send_seq
+        self.counters["sent"] += 1
+        fx = _trip_transport_site("fleet.transport.send", msg, self._step)
+        if fx["corrupt"]:
+            msg = _corrupt_copy(msg)
+            self.counters["corrupt_injected"] += 1
+        if fx["drop"]:
+            self.counters["dropped"] += 1
+            return
+        if fx["dup"]:
+            self.counters["duplicated"] += 1
+            self._route(copy.copy(msg))
+        if fx["delay"]:
+            self.counters["delayed"] += 1
+            self._delayed.append(
+                (self._step + int(fx["delay"]), msg.msg_id, msg))
+            return
+        self._route(msg)
+
+    def _route(self, msg: Message) -> None:
+        """Routing policy hook: the lossless base just queues for
+        delivery."""
+        self._ready.append(msg)
+
+    def _order_batch(self, batch: list) -> list:
+        """Delivery order within one pump sweep — FIFO here; the chaos
+        transport may shuffle deterministically."""
+        return batch
+
+    def pump(self) -> None:
+        """Run deliveries until quiescent. Handlers (the engine
+        servers) may send replies mid-pump; those deliver in the same
+        call, which is what makes loopback exchanges synchronous."""
+        guard = 0
+        while self._ready:
+            batch, self._ready = self._order_batch(self._ready), []
+            for msg in batch:
+                self._deliver(msg)
+            guard += 1
+            if guard > 100_000:
+                raise RuntimeError("transport pump did not quiesce")
+
+    def _deliver(self, msg: Message) -> None:
+        fx = _trip_transport_site("fleet.transport.recv", msg, self._step)
+        if fx["drop"]:
+            self.counters["dropped"] += 1
+            return
+        if fx["dup"]:
+            # duplicate before corrupting — the copy travels clean, so
+            # each corruption damages exactly one delivery
+            self.counters["duplicated"] += 1
+            self._ready.append(copy.copy(msg))
+        if fx["corrupt"]:
+            msg = _corrupt_copy(msg)
+            self.counters["corrupt_injected"] += 1
+        if fx["delay"]:
+            self.counters["delayed"] += 1
+            self._delayed.append(
+                (self._step + int(fx["delay"]), msg.msg_id, msg))
+            return
+        # receive-side integrity gate: the body digest must match the
+        # bytes, and every snapshot must pass its own digest re-verify.
+        # A corrupt body drops the whole message; a corrupt snapshot is
+        # stripped (the submit degrades to full replay) — wrong bytes
+        # are never consumed either way.
+        try:
+            if not msg.verify():
+                raise TransportError(
+                    f"payload digest mismatch on {msg.path} "
+                    f"({msg.src} -> {msg.dst})")
+        except TransportError:
+            self.counters["corrupt_dropped"] += 1
+            return
+        if msg.snaps:
+            kept = tuple(s for s in msg.snaps if s.verify())
+            if len(kept) != len(msg.snaps):
+                self.counters["corrupt_dropped"] += len(msg.snaps) - len(kept)
+                msg = copy.copy(msg)
+                msg.snaps = kept
+        self.counters["received"] += 1
+        handler = self._handlers.get(msg.dst)
+        if handler is not None:
+            try:
+                handler(msg)
+            except StaleEpochError:
+                # a fenced replica refusing zombie-epoch work is the
+                # fence WORKING, not a delivery failure
+                self.counters["fenced_dropped"] += 1
+            return
+        self._inboxes.setdefault(msg.dst, []).append(msg)
+
+    def recv(self, dst: str) -> list:
+        """Drain an inbox endpoint (the router's receive path)."""
+        box = self._inboxes.get(dst)
+        if not box:
+            return []
+        self._inboxes[dst] = []
+        return box
+
+    # ---- advisory side channel ----
+
+    def query(self, dst: str, kind: str, payload: dict):
+        """Best-effort advisory read against ``dst`` (affinity probes,
+        gauge seeding). Loopback executes directly; a chaos transport
+        refuses it across a partition. Never used for stream state."""
+        fn = self._query_handlers.get(dst)
+        if fn is None:
+            return None
+        return fn(kind, payload)
+
+    # ---- introspection ----
+
+    def stats(self) -> dict:
+        return {**self.counters,
+                "in_flight": len(self._ready) + len(self._delayed)
+                + self._held_count()}
+
+    def _held_count(self) -> int:
+        return 0
+
+
+def _trip_transport_site(site: str, msg: Message, step: int) -> dict:
+    """Fire a transport fault site with the drop/dup/delay/corrupt
+    action callbacks; returns the effect flags the site armed."""
+    fx = {"drop": False, "dup": False, "delay": 0, "corrupt": False}
+    if _fault.active_plan() is None:
+        return fx
+    _fault.trip(
+        site, step=step, path=msg.path,
+        drop=lambda: fx.__setitem__("drop", True),
+        dup=lambda: fx.__setitem__("dup", True),
+        delay=lambda steps: fx.__setitem__("delay", max(1, int(steps))),
+        corrupt=lambda: fx.__setitem__("corrupt", True))
+    return fx
+
+
+def _corrupt_copy(msg: Message) -> Message:
+    """Flip one byte of the wire payload WITHOUT updating any digest —
+    the receive-side re-verify must catch it. Prefers the body; a
+    message whose payload is its snapshots corrupts the first snapshot
+    instead (its own page digests catch that)."""
+    out = copy.copy(msg)
+    if len(out.body) > 2:
+        flat = bytearray(out.body)
+        flat[len(flat) // 2] ^= 0xFF
+        out.body = bytes(flat)
+        out._payload_cache = None
+    elif out.snaps:
+        out.snaps = tuple(copy.deepcopy(s) for s in out.snaps)
+        out.snaps[0].corrupt()
+    return out
+
+
+class LoopbackTransport(Transport):
+    """The default in-process wire: synchronous, lossless, ordered —
+    bitwise-identical behavior to the pre-transport fleet. It still
+    runs the full message path (serialization, digests, fault sites),
+    so a FaultPlan can make even loopback lossy for chaos tests."""
+
+
+class ChaosTransport(Transport):
+    """Seeded hostile network. Every per-message decision is a sha256
+    draw over ``(seed, decision, msg_id)`` — no wall-clock entropy, so
+    a chaos run replays bit-identically.
+
+    - ``drop_p``    — message vanishes
+    - ``dup_p``     — message delivers twice (same seq: receiver dedups)
+    - ``delay_p``   / ``max_delay_steps`` — delivery postponed 1..N
+      router steps on the injectable clock
+    - ``corrupt_p`` — one payload byte flips, digests untouched (the
+      receive-side re-verify MUST catch it)
+    - ``reorder``   — each pump sweep delivers in hash-shuffled order
+    - partitions    — :meth:`partition` blocks a direction (or both);
+      blocked messages are HELD and released at :meth:`heal` / window
+      end, so stale zombie traffic arrives late instead of vanishing —
+      the epoch-fencing scenario.
+    """
+
+    def __init__(self, seed: int = 0, drop_p: float = 0.0,
+                 dup_p: float = 0.0, delay_p: float = 0.0,
+                 max_delay_steps: int = 3, corrupt_p: float = 0.0,
+                 reorder: bool = False):
+        super().__init__()
+        self.seed = int(seed)
+        self.drop_p = float(drop_p)
+        self.dup_p = float(dup_p)
+        self.delay_p = float(delay_p)
+        self.max_delay_steps = max(1, int(max_delay_steps))
+        self.corrupt_p = float(corrupt_p)
+        self.reorder = bool(reorder)
+        # active windows: dicts with a, b, two_way, start, until
+        self._partitions: list[dict] = []
+        self._held: list[tuple[int, Message]] = []
+
+    # ---- deterministic draws ----
+
+    def _draw(self, what: str, msg_id: int) -> float:
+        h = hashlib.sha256(
+            f"chaos:{self.seed}:{what}:{msg_id}".encode()).digest()
+        return int.from_bytes(h[:8], "big") / 2**64
+
+    # ---- partitions ----
+
+    def partition(self, a: str, b: str, two_way: bool = True,
+                  start: int | None = None,
+                  until: int | None = None) -> None:
+        """Block ``a -> b`` (and ``b -> a`` when ``two_way``) from step
+        ``start`` (now if None) until step ``until`` (or until
+        :meth:`heal`). Blocked messages are held, not dropped."""
+        self._partitions.append({
+            "a": a, "b": b, "two_way": bool(two_way),
+            "start": self._step if start is None else int(start),
+            "until": until if until is None else int(until)})
+
+    def heal(self) -> None:
+        """End every partition now and release held traffic."""
+        self._partitions.clear()
+        self._release_held()
+
+    def _blocked(self, src: str, dst: str) -> bool:
+        for w in self._partitions:
+            if w["start"] > self._step:
+                continue
+            if w["until"] is not None and self._step >= w["until"]:
+                continue
+            if (src, dst) == (w["a"], w["b"]):
+                return True
+            if w["two_way"] and (src, dst) == (w["b"], w["a"]):
+                return True
+        return False
+
+    def _release_held(self) -> None:
+        if not self._held:
+            return
+        still, released = [], []
+        for mid, msg in self._held:
+            if self._blocked(msg.src, msg.dst):
+                still.append((mid, msg))
+            else:
+                released.append((mid, msg))
+        self._held = still
+        for _, msg in sorted(released, key=lambda e: e[0]):
+            self._ready.append(msg)
+
+    def _held_count(self) -> int:
+        return len(self._held)
+
+    # ---- routing policy ----
+
+    def tick(self, step: int) -> None:
+        super().tick(step)
+        # windows that expired this step release their held traffic
+        self._partitions = [w for w in self._partitions
+                            if w["until"] is None or w["until"] > step]
+        self._release_held()
+
+    def _route(self, msg: Message) -> None:
+        mid = msg.msg_id
+        if self._blocked(msg.src, msg.dst):
+            self.counters["held"] += 1
+            self._held.append((mid, msg))
+            return
+        if self._draw("drop", mid) < self.drop_p:
+            self.counters["dropped"] += 1
+            return
+        if self._draw("dup", mid) < self.dup_p:
+            # duplicate BEFORE corrupting: the copy is a separate wire
+            # journey, so one corruption draw damages one delivery and
+            # corrupt_injected == corrupt_dropped stays exact
+            self.counters["duplicated"] += 1
+            self._ready.append(copy.copy(msg))
+        if self._draw("corrupt", mid) < self.corrupt_p:
+            msg = _corrupt_copy(msg)
+            self.counters["corrupt_injected"] += 1
+        if self._draw("delay", mid) < self.delay_p:
+            steps = 1 + int(self._draw("delay_steps", mid)
+                            * self.max_delay_steps)
+            self.counters["delayed"] += 1
+            self._delayed.append((self._step + steps, mid, msg))
+            return
+        self._ready.append(msg)
+
+    def _order_batch(self, batch: list) -> list:
+        if not self.reorder or len(batch) < 2:
+            return batch
+        self.counters["reordered"] += 1
+        return sorted(batch,
+                      key=lambda m: self._draw("order", m.msg_id))
+
+    def query(self, dst: str, kind: str, payload: dict):
+        # advisory reads cross the same partitions the stream does
+        if self._blocked("router", dst) or self._blocked(dst, "router"):
+            return None
+        return super().query(dst, kind, payload)
+
+
+# ---------------------------------------------------------------------------
+# replica-side shim
+# ---------------------------------------------------------------------------
+
+class EngineServer:
+    """One replica's message endpoint: owns the engine, executes router
+    commands exactly once under at-least-once delivery, and streams
+    seq-numbered result batches back.
+
+    Dedup keys: submits by ``(rid, epoch, attempt)`` with the reply
+    cached and re-sent verbatim (same seq — the router collapses it);
+    steps by the router's step seqno (a duplicate STEP never re-steps
+    the engine, it only triggers retransmission of unacked results);
+    drain by a one-shot latch. A FENCE for epoch ``e`` raises this
+    server's floor to ``e+1``: zombie-epoch traffic after that is
+    refused with :class:`StaleEpochError` (counted by the transport as
+    ``fenced_dropped``) — a fenced replica can never ack stale work."""
+
+    STREAM_KINDS = ("SUBMIT_REPLY", "STEP_RESULTS", "DRAIN_RESULTS",
+                    "SNAPSHOT_DATA", "ERROR")
+
+    def __init__(self, idx: int, engine, transport: Transport,
+                 router: str = "router"):
+        self.idx = int(idx)
+        self.engine = engine
+        self.transport = transport
+        self.name = f"replica:{idx}"
+        self._router = router
+        self._min_epoch = 0           # FENCE floor: epochs below are refused
+        self._out_seq = 0
+        self._resend: dict[int, Message] = {}   # unacked stream batches
+        self._submit_replies: dict = {}         # (rid, epoch, attempt) -> msg
+        self._last_step_seq = -1
+        self._drain_reply: Message | None = None
+        transport.bind(self.name, self.handle)
+        transport.bind_query(self.name, self.query)
+
+    # ---- gauges: the health payload piggybacked on every reply ----
+
+    def gauges(self) -> dict:
+        eng = self.engine
+        sched = eng.scheduler
+        pool = getattr(eng, "pool", None)
+        cap = getattr(eng, "_token_capacity_per_step", None)
+        mqd = getattr(sched, "max_queue_depth", None)
+        return {
+            "queue_depth": int(sched.queue_depth),
+            "running": len(sched.running),
+            "pool_utilization": (float(pool.utilization())
+                                 if pool is not None else 0.0),
+            "draining": bool(getattr(eng, "_draining", False)),
+            "brownout_level": int(getattr(eng, "brownout_level", 0)),
+            "tp_degree": int(getattr(eng, "tp", 1)),
+            "max_queue_depth": None if mqd is None else int(mqd),
+            "token_capacity": None if cap is None else int(cap()),
+        }
+
+    def query(self, kind: str, payload: dict):
+        """Advisory reads: prefix-affinity probes and gauge seeding."""
+        if kind == "affinity":
+            pool = getattr(self.engine, "pool", None)
+            if pool is None or not getattr(pool, "cache_enabled", False):
+                return {"cached_tokens": 0}
+            try:
+                hit = pool.match_prefix(payload["prompt"])
+                return {"cached_tokens": int(hit.cached_tokens)}
+            except Exception:  # noqa: BLE001 — affinity is best-effort
+                return {"cached_tokens": 0}
+        if kind == "gauges":
+            return self.gauges()
+        if kind == "admission_check":
+            check = getattr(self.engine, "admission_check", None)
+            if check is None:
+                return {"ok": True}
+            try:
+                check(payload["prompt_len"], payload["max_new_tokens"])
+            except RequestTooLargeError as e:
+                return {"ok": False, "detail": str(e)}
+            return {"ok": True}
+        return None
+
+    # ---- the message handler ----
+
+    def handle(self, msg: Message) -> None:
+        if msg.epoch < self._min_epoch:
+            raise StaleEpochError(
+                f"replica {self.idx} fenced at epoch {self._min_epoch}; "
+                f"refusing {msg.kind} from epoch {msg.epoch}")
+        p = msg.payload()
+        ack = p.get("ack")
+        if ack is not None:
+            for seq in [s for s in self._resend if s <= ack]:
+                del self._resend[seq]
+        kind = msg.kind
+        if kind == "FENCE":
+            self._min_epoch = max(self._min_epoch, msg.epoch + 1)
+            return
+        # any contact from the router retransmits whatever it has not
+        # acked yet — the at-least-once half of exactly-once
+        self._resend_unacked()
+        if kind == "HEARTBEAT":
+            self.transport.send(Message.make(
+                "HEARTBEAT_ACK", self.name, self._router, epoch=msg.epoch,
+                payload={"hb_seq": p["hb_seq"], "sent_step": p["sent_step"],
+                         "gauges": self.gauges()}))
+        elif kind == "SUBMIT":
+            self._handle_submit(msg, p)
+        elif kind == "STEP":
+            self._handle_step(msg, p)
+        elif kind == "DRAIN":
+            self._handle_drain(msg, p)
+        elif kind == "SNAPSHOT_FETCH":
+            self._handle_snapshot_fetch(msg, p)
+
+    def _resend_unacked(self) -> None:
+        for seq in sorted(self._resend):
+            self.transport.send(self._resend[seq])
+
+    def _stream(self, kind: str, epoch: int, rid: str, payload: dict,
+                snaps: tuple = ()) -> Message:
+        self._out_seq += 1
+        m = Message.make(kind, self.name, self._router, epoch=epoch,
+                         seq=self._out_seq, rid=rid, payload=payload,
+                         snaps=snaps)
+        self._resend[self._out_seq] = m
+        self.transport.send(m)
+        return m
+
+    # ---- command execution (each idempotent under redelivery) ----
+
+    def _handle_submit(self, msg: Message, p: dict) -> None:
+        key = (msg.rid, msg.epoch, p["attempt"])
+        cached = self._submit_replies.get(key)
+        if cached is not None:
+            self.transport.send(cached)   # same seq: the router dedups
+            return
+        eng = self.engine
+        snap = msg.snaps[0] if msg.snaps else None
+        if getattr(eng, "restore_request", None) is None:
+            snap = None
+        tenant, priority = int(p.get("tenant", 0)), int(p.get("priority", 0))
+        tp_kw = ({"tenant": tenant, "priority": priority}
+                 if (tenant, priority) != (0, 0) else {})
+        reply = {"rid": msg.rid, "attempt": p["attempt"], "ok": True,
+                 "used_snapshot": False, "restored": 0}
+        try:
+            if snap is not None:
+                eng.restore_request(snap, **tp_kw)
+                reply["used_snapshot"] = True
+                reply["restored"] = len(snap.tokens)
+            else:
+                eng.add_request(
+                    p["prompt"], p["max_new_tokens"],
+                    sampling=SamplingParams(**p["sampling"]),
+                    eos_token_id=p["eos_token_id"], rid=msg.rid,
+                    deadline_s=p["deadline_s"],
+                    max_queue_wait_s=p["max_queue_wait_s"], **tp_kw)
+        except RequestTooLargeError as e:
+            reply.update(ok=False, error="RequestTooLargeError",
+                         retryable=False, detail=str(e))
+        except _fault.FaultInjected as e:
+            reply.update(ok=False, error="FaultInjected",
+                         retryable=True, detail=str(e))
+        except ServingError as e:
+            reply.update(ok=False, error=type(e).__name__,
+                         retryable=bool(e.retryable), detail=str(e))
+        reply["gauges"] = self.gauges()
+        self._submit_replies[key] = self._stream(
+            "SUBMIT_REPLY", msg.epoch, msg.rid, reply)
+
+    def _handle_step(self, msg: Message, p: dict) -> None:
+        if p["router_step"] <= self._last_step_seq:
+            return                       # duplicate STEP: never re-step
+        self._last_step_seq = int(p["router_step"])
+        eng = self.engine
+        if not eng.scheduler.has_work():
+            self._stream("STEP_RESULTS", msg.epoch, "",
+                         {"events": [], "gauges": self.gauges()})
+            return
+        try:
+            events = eng.step()
+        except SchedulerStalledError as e:
+            self._stream("ERROR", msg.epoch, "",
+                         {"reason": "stalled",
+                          "error": "SchedulerStalledError",
+                          "snapshot": e.snapshot,
+                          "gauges": self.gauges()})
+            return
+        except _fault.FaultInjected:
+            self._stream("ERROR", msg.epoch, "",
+                         {"reason": "killed", "error": "FaultInjected",
+                          "gauges": self.gauges()})
+            return
+        except ServingError as e:
+            self._stream("ERROR", msg.epoch, "",
+                         {"reason": f"error:{type(e).__name__}",
+                          "error": type(e).__name__,
+                          "gauges": self.gauges()})
+            return
+        self._stream("STEP_RESULTS", msg.epoch, "",
+                     {"events": events, "gauges": self.gauges()})
+
+    def _handle_drain(self, msg: Message, p: dict) -> None:
+        if self._drain_reply is not None:
+            self.transport.send(self._drain_reply)
+            return
+        try:
+            self.engine.drain(timeout_s=p.get("timeout_s"))
+        except (ServingError, _fault.FaultInjected):
+            self._drain_reply = self._stream(
+                "ERROR", msg.epoch, "",
+                {"reason": "died_in_drain", "error": "drain",
+                 "gauges": self.gauges()})
+            return
+        self._drain_reply = self._stream(
+            "DRAIN_RESULTS", msg.epoch, "",
+            {"events": self.engine.last_drain_events,
+             "gauges": self.gauges()})
+
+    def _handle_snapshot_fetch(self, msg: Message, p: dict) -> None:
+        store = getattr(self.engine, "snapshot_store", None)
+        snaps = []
+        if store is not None:
+            known = p.get("known", {})
+            for rid in store.rids():
+                snap = store.get(rid)     # digest re-verified by the store
+                if snap is None:
+                    continue
+                if len(snap.tokens) <= int(known.get(rid, -1)):
+                    continue              # the router already has this much
+                snaps.append(snap)
+        self._stream("SNAPSHOT_DATA", msg.epoch, "",
+                     {"rids": [s.rid for s in snaps],
+                      "gauges": self.gauges()},
+                     snaps=tuple(snaps))

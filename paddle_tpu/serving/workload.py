@@ -35,7 +35,9 @@ replay it on every arm, ship its ``stats()`` in the bench summary.
 
 from __future__ import annotations
 
+import bisect
 import inspect
+import math
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -163,14 +165,20 @@ class Workload:
         }
 
     def replay(self, target, max_steps: int | None = None,
-               rid_prefix: str = "") -> dict:
+               rid_prefix: str = "", retry_sheds: bool = True) -> dict:
         """Drive ``target`` (engine or fleet router) through the trace:
         at each step, submit the requests due, then ``target.step()``;
         keep stepping until the target drains. Backpressure rejections
         (typed ServingError subclasses with ``retryable`` set) are
-        counted as shed, not raised — a traffic harness measures load
-        shedding, it doesn't crash on it. Returns
-        ``{"steps", "submitted", "shed", "rids"}``."""
+        retried ONCE, deterministically: the request re-enqueues at
+        ``step + max(1, ceil(retry_after_s))`` (1 when the error
+        carries no hint), honouring the backoff the engine computed —
+        so lossy-transport benches measure goodput, not shed luck. A
+        request rejected again on its retry (or non-retryably) counts
+        as shed, not raised — a traffic harness measures load shedding,
+        it doesn't crash on it. ``retry_sheds=False`` restores the
+        drop-on-first-shed behaviour. Returns ``{"steps", "submitted",
+        "shed", "retried", "rids"}``."""
         from .errors import ServingError
         submit = getattr(target, "submit", None) or target.add_request
         has_work = (getattr(target, "has_work", None)
@@ -186,25 +194,44 @@ class Workload:
                 for p in params.values()))
         except (TypeError, ValueError):
             slo_aware = False
-        i, step, shed = 0, 0, 0
+        i, step, shed, retried = 0, 0, 0, 0
         rids: list[str] = []
+        deferred: list[tuple[int, object]] = []   # (due step, request)
         n = len(self.requests)
-        while i < n or has_work():
+
+        def _submit_one(r, is_retry: bool) -> None:
+            nonlocal shed, retried
+            kw: dict = {}
+            if slo_aware:
+                kw["tenant"] = r.tenant
+                kw["priority"] = r.priority
+                if r.deadline_s is not None:
+                    kw["deadline_s"] = r.deadline_s
+            try:
+                rids.append(submit(r.prompt, r.max_new_tokens,
+                                   eos_token_id=eos,
+                                   rid=rid_prefix + r.rid, **kw))
+            except ServingError as e:
+                if retry_sheds and not is_retry and e.retryable:
+                    # single deterministic re-enqueue honouring the
+                    # engine's own backoff hint (retry_after_s rides
+                    # FleetOverloadedError / AdmissionShedError; errors
+                    # without one wait the minimum one step)
+                    hint = getattr(e, "retry_after_s", None) or 0.0
+                    delay = max(1, math.ceil(hint))
+                    bisect.insort(deferred, (step + delay, id(r), r))
+                    retried += 1
+                else:
+                    shed += 1
+
+        while i < n or deferred or has_work():
             while i < n and self.requests[i].arrival_step <= step:
                 r = self.requests[i]
                 i += 1
-                kw: dict = {}
-                if slo_aware:
-                    kw["tenant"] = r.tenant
-                    kw["priority"] = r.priority
-                    if r.deadline_s is not None:
-                        kw["deadline_s"] = r.deadline_s
-                try:
-                    rids.append(submit(r.prompt, r.max_new_tokens,
-                                       eos_token_id=eos,
-                                       rid=rid_prefix + r.rid, **kw))
-                except ServingError:
-                    shed += 1
+                _submit_one(r, is_retry=False)
+            while deferred and deferred[0][0] <= step:
+                _, _, r = deferred.pop(0)
+                _submit_one(r, is_retry=True)
             target.step()
             step += 1
             if max_steps is not None and step >= max_steps:
@@ -212,7 +239,7 @@ class Workload:
                     f"workload replay did not drain in {step} steps "
                     f"({n - i} unsubmitted, target still busy)")
         return {"steps": step, "submitted": len(rids), "shed": shed,
-                "rids": rids}
+                "retried": retried, "rids": rids}
 
 
 def _arrival_steps(spec: WorkloadSpec, rng) -> list[int]:

@@ -17,6 +17,7 @@ _DEFAULT_CONFIGS = {
     "llama_serving_prefix", "llama_decode_int8", "llama_serving_int8",
     "llama_serving_fleet", "llama_serving_spec", "llama_serving_tiered",
     "llama_serving_chunked", "llama_serving_failover",
+    "llama_serving_partition",
     "llama_serving_tp", "llama_serving_fairness",
 }
 
@@ -159,6 +160,27 @@ def test_dry_failover_cell_carries_replay_ab_keys():
                          "recovery_restored_tokens",
                          "recovery_replayed_tokens",
                          "goodput_at_slo", "goodput_at_slo_full",
+                         "retraces"}, cell
+    assert all(v is None for v in cell.values()), cell
+
+
+def test_dry_partition_cell_carries_lossy_wire_ab_keys():
+    # the clean-vs-lossy wire A/B (SERVING.md "Fleet transport &
+    # membership"): the cell must surface what the lossy wire cost —
+    # failovers in each arm, the fencing + dedup counters that prove
+    # the exactly-once contract did real work, the transport drop
+    # volume, and goodput_at_slo for BOTH arms — next to the usual
+    # serving keys
+    out = _run_dry("llama_serving_partition")
+    assert out.returncode == 0, out.stderr
+    last = json.loads(out.stdout.splitlines()[-1])
+    cell = last["bench_summary"]["llama_serving_partition"]
+    assert set(cell) >= {"value", "mfu", "spread",
+                         "ttft_p50", "ttft_p99", "tpot",
+                         "failovers", "failovers_clean",
+                         "stale_epoch_discarded", "lease_expirations",
+                         "duplicates_suppressed", "transport_dropped",
+                         "goodput_at_slo", "goodput_at_slo_clean",
                          "retraces"}, cell
     assert all(v is None for v in cell.values()), cell
 

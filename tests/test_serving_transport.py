@@ -1,0 +1,822 @@
+"""paddle_tpu.serving.transport — the partition-tolerant fleet wire.
+
+Contracts under test (SERVING.md "Fleet transport & membership"):
+
+1. LOOPBACK PARITY — with the default LoopbackTransport the fleet
+   behaves bitwise like the pre-transport in-process router: same
+   streams, same step-by-step event lists, zero transport losses.
+2. DELIVERY SEMANTICS — a seeded ChaosTransport deterministically
+   drops, duplicates, delays, reorders, corrupts and partitions; the
+   receiver side turns at-least-once delivery back into exactly-once
+   (seq dedup, digest re-verify, idempotent command handlers).
+3. FENCING — a zombie replica returning from a partition after its
+   lease expired cannot ack stale work or double-emit: its traffic is
+   counted (``stale_epoch_discarded`` / ``fenced_dropped``) and
+   dropped, and client streams stay exactly-once and bitwise.
+4. FAULT SITES — ``fleet.transport.send`` / ``fleet.transport.recv``
+   make even the loopback wire lossy for one message kind of one
+   request, and the stream still survives bitwise.
+
+Router/transport logic runs on scripted fake engines (fast, tier-1);
+the real-model kill-during-partition sweep runs llama_tiny replicas
+behind ``slow``/``faults`` markers.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import fault
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+from paddle_tpu.observability import parse_prometheus, render_fleet_prometheus
+from paddle_tpu.serving import (ChaosTransport, EngineDrainingError,
+                                EngineServer, FleetRouter,
+                                LoopbackTransport, Message, QueueFullError,
+                                RequestTooLargeError, SamplingParams,
+                                SchedulerStalledError, ServingEngine,
+                                deterministic_jitter)
+from paddle_tpu.serving.fleet import DEAD
+
+RNG = np.random.default_rng(23)
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(123)
+    m = LlamaForCausalLM(llama_tiny(dtype="float32",
+                                    mp_axis=None, fsdp_axis=None))
+    m.eval()
+    return m
+
+
+@pytest.fixture
+def fault_free(monkeypatch):
+    fault.deactivate()
+    monkeypatch.delenv("PADDLE_TRAINER_ID", raising=False)
+    monkeypatch.delenv("PROCESS_ID", raising=False)
+    monkeypatch.delenv("PADDLE_RESTART_EPOCH", raising=False)
+    yield
+    fault.deactivate()
+
+
+def _reference(model, prompt, max_new, **kw):
+    out = model.generate(jnp.asarray([prompt]), max_new_tokens=max_new, **kw)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+# ---------------------------------------------------------------------------
+# scripted fake engine (the same duck-typed surface test_serving_fleet pins)
+# ---------------------------------------------------------------------------
+
+class FakeScheduler:
+    def __init__(self, max_queue_depth=None):
+        self.waiting = []
+        self.running = {}
+        self.max_queue_depth = max_queue_depth
+
+    @property
+    def queue_depth(self):
+        return len(self.waiting)
+
+    def has_work(self):
+        return bool(self.waiting or self.running)
+
+    def live_requests(self):
+        return list(self.waiting) + list(self.running.values())
+
+
+class FakeReq:
+    def __init__(self, rid, prompt):
+        self.rid = rid
+        self.prompt = prompt
+        self.produced = 0
+
+
+class FakeEngine:
+    """Deterministic scripted engine: request [p0, ...] emits the stream
+    p0*100, p0*100+1, ... — same tokens wherever (re)placed."""
+
+    def __init__(self, max_slots=4, max_queue_depth=None, add_fails=0,
+                 stall_after=None):
+        self.scheduler = FakeScheduler(max_queue_depth)
+        self.pool = None
+        self._draining = False
+        self.last_drain_events = []
+        self.max_slots = max_slots
+        self.add_fails = add_fails
+        self.stall_after = stall_after
+        self.steps = 0
+        self.flight_recorder = None
+
+    def admission_check(self, prompt_len, max_new_tokens):
+        if prompt_len + max_new_tokens > 10_000:
+            raise RequestTooLargeError("scripted: never fits")
+
+    def add_request(self, prompt, max_new_tokens, sampling=None,
+                    eos_token_id=None, rid=None, deadline_s=None,
+                    max_queue_wait_s=None):
+        if self._draining:
+            raise EngineDrainingError("draining")
+        if self.add_fails > 0:
+            self.add_fails -= 1
+            raise QueueFullError("scripted queue full")
+        r = FakeReq(rid, list(prompt))
+        r.max_new = max_new_tokens
+        if len(self.scheduler.running) < self.max_slots:
+            slot = min(set(range(self.max_slots))
+                       - set(self.scheduler.running))
+            self.scheduler.running[slot] = r
+        else:
+            self.scheduler.waiting.append(r)
+        return rid
+
+    def step(self):
+        self.steps += 1
+        if self.stall_after is not None and self.steps > self.stall_after:
+            raise SchedulerStalledError("scripted stall",
+                                        {"step": self.steps})
+        events = []
+        while (self.scheduler.waiting
+               and len(self.scheduler.running) < self.max_slots):
+            slot = min(set(range(self.max_slots))
+                       - set(self.scheduler.running))
+            self.scheduler.running[slot] = self.scheduler.waiting.pop(0)
+        for slot, r in sorted(self.scheduler.running.items()):
+            tok = r.prompt[0] * 100 + r.produced
+            r.produced += 1
+            fin = r.produced >= r.max_new
+            events.append({"rid": r.rid, "token": tok, "finished": fin,
+                           "finish_reason": "length" if fin else None})
+            if fin:
+                del self.scheduler.running[slot]
+        return events
+
+    def drain(self, timeout_s=None):
+        self._draining = True
+        events = []
+        for r in self.scheduler.waiting:
+            events.append({"rid": r.rid, "token": None, "finished": True,
+                           "finish_reason": "preempted"})
+        self.scheduler.waiting.clear()
+        while self.scheduler.running:
+            events.extend(self.step())
+        self.last_drain_events = events
+        return {}
+
+    def decode_program_count(self):
+        return 1
+
+
+def _expected(prompt, max_new):
+    return [prompt[0] * 100 + i for i in range(max_new)]
+
+
+def _submit_payload(rid, prompt, max_new, attempt=1):
+    return {"attempt": attempt, "prompt": list(prompt),
+            "max_new_tokens": max_new,
+            "sampling": {"temperature": 1.0, "top_p": 1.0,
+                         "do_sample": False, "seed": 0},
+            "eos_token_id": None, "deadline_s": None,
+            "max_queue_wait_s": None, "tenant": 0, "priority": 0,
+            "ack": 0}
+
+
+def _collect_tokens(events):
+    seen: dict[str, list] = {}
+    for ev in events:
+        if ev.get("token") is not None:
+            seen.setdefault(ev["rid"], []).append(ev["token"])
+    return seen
+
+
+# ---------------------------------------------------------------------------
+# the shared deterministic jitter helper
+# ---------------------------------------------------------------------------
+
+class TestDeterministicJitter:
+    def test_reproducible_and_bounded(self):
+        for key in ("fleet-jitter:1:2", "fleet-hb:0", "x"):
+            for bound in (2, 7, 100):
+                v = deterministic_jitter(key, bound)
+                assert v == deterministic_jitter(key, bound)
+                assert 0 <= v < bound
+
+    def test_degenerate_bounds(self):
+        assert deterministic_jitter("k", 0) == 0
+        assert deterministic_jitter("k", 1) == 0
+
+    def test_fleet_breaker_delegates_with_historical_key(self):
+        # the breaker's backoff jitter must keep its exact pre-refactor
+        # hash key — chaos runs replay bit-identically across PRs
+        import hashlib
+        h = hashlib.sha256(b"fleet-jitter:1:2").digest()
+        assert FleetRouter._jitter(1, 2, 8) \
+            == int.from_bytes(h[:4], "big") % 8
+        assert FleetRouter._jitter(3, 1, 1) == 0
+
+
+# ---------------------------------------------------------------------------
+# Message: wire format + digest gate
+# ---------------------------------------------------------------------------
+
+class TestMessage:
+    def test_payload_round_trip_verifies(self):
+        m = Message.make("SUBMIT", "router", "replica:0", epoch=2,
+                         rid="r1", payload={"a": 1, "b": [2, 3]})
+        assert m.verify()
+        assert m.payload() == {"a": 1, "b": [2, 3]}
+        assert m.path == "SUBMIT:r1"
+
+    def test_numpy_scalars_serialize(self):
+        m = Message.make("STEP_RESULTS", "replica:0", "router", payload={
+            "events": [{"rid": "r", "token": np.int32(7),
+                        "finished": False, "finish_reason": None}]})
+        assert m.payload()["events"][0]["token"] == 7
+
+    def test_flipped_byte_fails_verify(self):
+        m = Message.make("STEP", "router", "replica:0",
+                         payload={"router_step": 3, "ack": 0})
+        flat = bytearray(m.body)
+        flat[len(flat) // 2] ^= 0xFF
+        m.body = bytes(flat)
+        assert not m.verify()
+
+    def test_corrupt_body_is_dropped_never_delivered(self, fault_free):
+        t = LoopbackTransport()
+        got = []
+        t.bind("sink", got.append)
+        m = Message.make("STEP", "router", "sink",
+                         payload={"router_step": 0, "ack": 0})
+        flat = bytearray(m.body)
+        flat[0] ^= 0xFF
+        m.body = bytes(flat)
+        t.send(m)
+        t.pump()
+        assert got == []
+        assert t.counters["corrupt_dropped"] == 1
+        assert t.counters["received"] == 0
+
+
+# ---------------------------------------------------------------------------
+# ChaosTransport delivery semantics (endpoint-level units)
+# ---------------------------------------------------------------------------
+
+def _inbox_pair(**kw):
+    t = ChaosTransport(**kw)
+    t.bind("a")
+    t.bind("b")
+    return t
+
+
+def _msg(i=0, src="a", dst="b"):
+    return Message.make("STEP_RESULTS", src, dst, seq=i + 1,
+                        payload={"i": i})
+
+
+class TestChaosDelivery:
+    def test_drop_everything(self, fault_free):
+        t = _inbox_pair(seed=1, drop_p=1.0)
+        t.send(_msg())
+        t.pump()
+        assert t.recv("b") == []
+        assert t.counters["dropped"] == 1
+
+    def test_duplicate_everything(self, fault_free):
+        t = _inbox_pair(seed=1, dup_p=1.0)
+        t.send(_msg())
+        t.pump()
+        got = t.recv("b")
+        assert len(got) == 2
+        assert got[0].payload() == got[1].payload()
+        assert t.counters["duplicated"] == 1
+
+    def test_delay_releases_on_tick(self, fault_free):
+        t = _inbox_pair(seed=1, delay_p=1.0, max_delay_steps=3)
+        t.tick(0)
+        t.send(_msg())
+        t.pump()
+        assert t.recv("b") == []          # in flight, not lost
+        assert t.counters["delayed"] == 1
+        for step in range(1, 6):
+            t.tick(step)
+            t.pump()
+        assert len(t.recv("b")) == 1      # released within max_delay_steps
+
+    def test_corrupt_injected_always_caught(self, fault_free):
+        t = _inbox_pair(seed=1, corrupt_p=1.0)
+        for i in range(10):
+            t.send(_msg(i))
+        t.pump()
+        assert t.recv("b") == []          # zero corrupt payloads consumed
+        assert t.counters["corrupt_injected"] == 10
+        assert t.counters["corrupt_dropped"] == 10
+
+    def test_reorder_is_deterministic_permutation(self, fault_free):
+        def run():
+            t = _inbox_pair(seed=5, reorder=True)
+            for i in range(8):
+                t.send(_msg(i))
+            t.pump()
+            return [m.payload()["i"] for m in t.recv("b")]
+        once, twice = run(), run()
+        assert once == twice              # seeded -> replayable
+        assert sorted(once) == list(range(8))
+        assert once != list(range(8))     # actually permuted
+
+    def test_same_seed_same_outcomes(self, fault_free):
+        def run():
+            t = _inbox_pair(seed=9, drop_p=0.3, dup_p=0.3, delay_p=0.3)
+            for i in range(40):
+                t.send(_msg(i))
+            t.pump()
+            return dict(t.counters)
+        assert run() == run()
+
+    def test_partition_holds_then_heals(self, fault_free):
+        t = _inbox_pair(seed=1)
+        t.partition("a", "b", two_way=True)
+        t.send(_msg(0))
+        t.pump()
+        assert t.recv("b") == []
+        assert t.counters["held"] == 1
+        assert t.stats()["in_flight"] == 1    # held, not dropped
+        t.heal()
+        t.pump()
+        assert len(t.recv("b")) == 1          # late, intact, delivered
+
+    def test_one_way_partition_blocks_one_direction(self, fault_free):
+        t = _inbox_pair(seed=1)
+        t.partition("a", "b", two_way=False)
+        t.send(_msg(0, src="a", dst="b"))
+        t.send(_msg(1, src="b", dst="a"))
+        t.pump()
+        assert t.recv("b") == []              # a -> b blocked
+        assert len(t.recv("a")) == 1          # b -> a flows
+
+    def test_partition_window_expires_on_tick(self, fault_free):
+        t = _inbox_pair(seed=1)
+        t.partition("a", "b", start=0, until=3)
+        t.tick(0)
+        t.send(_msg(0))
+        t.pump()
+        assert t.recv("b") == []
+        t.tick(3)                             # window closed: release
+        t.pump()
+        assert len(t.recv("b")) == 1
+
+    def test_query_refused_across_partition(self, fault_free):
+        t = ChaosTransport(seed=1)
+        t.bind_query("replica:0", lambda kind, p: {"kind": kind})
+        assert t.query("replica:0", "gauges", {}) == {"kind": "gauges"}
+        t.partition("router", "replica:0")
+        assert t.query("replica:0", "gauges", {}) is None
+
+
+# ---------------------------------------------------------------------------
+# EngineServer: idempotent command execution under redelivery
+# ---------------------------------------------------------------------------
+
+class TestEngineServer:
+    def _rig(self):
+        t = LoopbackTransport()
+        t.bind("router")
+        eng = FakeEngine()
+        srv = EngineServer(0, eng, t)
+        return t, eng, srv
+
+    def test_submit_redelivery_places_once(self, fault_free):
+        t, eng, _ = self._rig()
+        m = Message.make("SUBMIT", "router", "replica:0", epoch=1,
+                         rid="r1", payload=_submit_payload("r1", [3], 4))
+        for _ in range(3):                    # at-least-once redelivery
+            t.send(m)
+            t.pump()
+        replies = [r for r in t.recv("router")
+                   if r.kind == "SUBMIT_REPLY"]
+        assert len(replies) >= 3
+        # every copy is the SAME stream batch — identical seq, so the
+        # router-side dedup collapses them to one application
+        assert len({r.seq for r in replies}) == 1
+        assert replies[0].payload()["ok"] is True
+        assert len(eng.scheduler.running) == 1    # placed exactly once
+
+    def test_step_redelivery_steps_once(self, fault_free):
+        t, eng, _ = self._rig()
+        t.send(Message.make("SUBMIT", "router", "replica:0", epoch=1,
+                            rid="r1",
+                            payload=_submit_payload("r1", [3], 4)))
+        t.pump()
+        step = Message.make("STEP", "router", "replica:0", epoch=1,
+                            payload={"router_step": 0, "ack": 0})
+        for _ in range(3):
+            t.send(step)
+            t.pump()
+        assert eng.steps == 1                 # duplicate STEP never re-steps
+        results = [r for r in t.recv("router")
+                   if r.kind == "STEP_RESULTS"]
+        assert len({r.seq for r in results}) == 1   # same batch, resent
+
+    def test_fence_refuses_stale_epoch(self, fault_free):
+        t, eng, _ = self._rig()
+        t.send(Message.make("FENCE", "router", "replica:0", epoch=1,
+                            payload={}))
+        t.pump()
+        t.send(Message.make("STEP", "router", "replica:0", epoch=1,
+                            payload={"router_step": 0, "ack": 0}))
+        t.pump()
+        assert eng.steps == 0                 # zombie-epoch work refused
+        assert t.counters["fenced_dropped"] == 1
+        # the CURRENT epoch still serves
+        t.send(Message.make("SUBMIT", "router", "replica:0", epoch=2,
+                            rid="r1",
+                            payload=_submit_payload("r1", [3], 4)))
+        t.send(Message.make("STEP", "router", "replica:0", epoch=2,
+                            payload={"router_step": 1, "ack": 0}))
+        t.pump()
+        assert eng.steps == 1
+
+    def test_ack_prunes_resend_buffer(self, fault_free):
+        t, eng, srv = self._rig()
+        t.send(Message.make("SUBMIT", "router", "replica:0", epoch=1,
+                            rid="r1",
+                            payload=_submit_payload("r1", [3], 4)))
+        t.pump()
+        assert len(srv._resend) == 1          # unacked SUBMIT_REPLY
+        p = _submit_payload("r2", [4], 4, attempt=1)
+        p["ack"] = 1                          # cumulative ack
+        t.send(Message.make("SUBMIT", "router", "replica:0", epoch=1,
+                            rid="r2", payload=p))
+        t.pump()
+        assert 1 not in srv._resend           # pruned by the ack
+
+
+# ---------------------------------------------------------------------------
+# loopback parity: the default wire is the pre-transport fleet, bitwise
+# ---------------------------------------------------------------------------
+
+class TestLoopbackParity:
+    def test_default_transport_is_loopback(self, fault_free):
+        router = FleetRouter([FakeEngine()])
+        assert type(router.transport) is LoopbackTransport
+
+    def test_streams_bitwise_and_lossless(self, fault_free):
+        router = FleetRouter([FakeEngine(), FakeEngine()])
+        rids = [router.submit([p], 4) for p in (3, 5, 7)]
+        events = []
+        while router.has_work():
+            events.extend(router.step())
+        for rid, p in zip(rids, (3, 5, 7)):
+            assert router.request(rid).tokens == _expected([p], 4)
+        seen = _collect_tokens(events)
+        for rid in rids:
+            assert seen[rid] == router.request(rid).tokens  # exactly-once
+        tstats = router.transport.stats()
+        assert tstats["sent"] > 0 and tstats["received"] > 0
+        assert tstats["dropped"] == 0 and tstats["corrupt_dropped"] == 0
+        fc = router.fleet_metrics.counters
+        assert fc["duplicates_suppressed"] == 0
+        assert fc["stale_epoch_discarded"] == 0
+        assert fc["lease_expirations"] == 0
+
+    def test_explicit_loopback_equals_default_step_for_step(self,
+                                                            fault_free):
+        def run(transport):
+            router = FleetRouter([FakeEngine(), FakeEngine()],
+                                 transport=transport)
+            rids = [router.submit([p], 5) for p in (2, 4, 6, 8)]
+            steps = []
+            while router.has_work():
+                steps.append(router.step())
+            return rids, steps
+        rids_a, steps_a = run(None)
+        rids_b, steps_b = run(LoopbackTransport())
+        assert rids_a == rids_b
+        assert steps_a == steps_b             # identical per-step events
+
+    def test_prometheus_carries_transport_series(self, fault_free):
+        router = FleetRouter([FakeEngine(), FakeEngine()])
+        router.submit([3], 3)
+        router.run_to_completion(max_steps=30)
+        page = render_fleet_prometheus(router)
+        parsed = parse_prometheus(page)
+        assert parsed["paddle_serving_fleet_transport_sent_total"] > 0
+        assert parsed["paddle_serving_fleet_transport_dropped_total"] == 0
+        assert "paddle_serving_fleet_duplicates_suppressed_total" in parsed
+        assert "paddle_serving_fleet_stale_epoch_discarded_total" in parsed
+        assert "paddle_serving_fleet_lease_expirations_total" in parsed
+        assert "paddle_serving_fleet_heartbeat_rtt_p50_steps" in parsed
+        assert "paddle_serving_fleet_heartbeat_rtt_p99_steps" in parsed
+        assert parsed['paddle_serving_fleet_replica_epoch{replica="0"}'] \
+            == 1
+
+
+# ---------------------------------------------------------------------------
+# the fleet over a hostile wire: exactly-once, bitwise, no hangs
+# ---------------------------------------------------------------------------
+
+class TestFleetUnderChaos:
+    def _run_fleet(self, transport, prompts, max_new=5, n_replicas=2,
+                   **router_kw):
+        engines = [FakeEngine() for _ in range(n_replicas)]
+        router = FleetRouter(engines, transport=transport, **router_kw)
+        rids = [router.submit(list(p), max_new) for p in prompts]
+        events = []
+        guard = 0
+        while router.has_work():
+            events.extend(router.step())
+            guard += 1
+            assert guard < 2000, "router hang under chaos"
+        return router, rids, events
+
+    def _assert_exact(self, router, rids, events, prompts, max_new=5):
+        seen = _collect_tokens(events)
+        for rid, p in zip(rids, prompts):
+            rec = router.request(rid)
+            assert rec.finished and rec.finish_reason == "length"
+            assert rec.tokens == _expected(list(p), max_new), rid
+            assert seen.get(rid, []) == rec.tokens  # exactly-once
+
+    def test_duplicates_and_reorder_collapse(self, fault_free):
+        prompts = [[p] for p in (2, 3, 5, 7, 9)]
+        t = ChaosTransport(seed=3, dup_p=0.6, reorder=True)
+        router, rids, events = self._run_fleet(t, prompts)
+        self._assert_exact(router, rids, events, prompts)
+        assert t.counters["duplicated"] > 0
+        assert router.fleet_metrics.counters["duplicates_suppressed"] > 0
+
+    def test_drops_and_delays_retransmit_through(self, fault_free):
+        prompts = [[p] for p in (2, 3, 5, 7)]
+        t = ChaosTransport(seed=11, drop_p=0.15, delay_p=0.3,
+                           max_delay_steps=3)
+        router, rids, events = self._run_fleet(t, prompts)
+        self._assert_exact(router, rids, events, prompts)
+        assert t.counters["dropped"] > 0
+
+    def test_corruption_always_caught_never_consumed(self, fault_free):
+        prompts = [[p] for p in (2, 3, 5)]
+        t = ChaosTransport(seed=17, corrupt_p=0.2)
+        router, rids, events = self._run_fleet(t, prompts)
+        self._assert_exact(router, rids, events, prompts)
+        assert t.counters["corrupt_injected"] > 0
+        # THE digest-gate invariant: every injected corruption was
+        # caught at receive — zero corrupt payloads consumed
+        assert t.counters["corrupt_dropped"] \
+            == t.counters["corrupt_injected"]
+
+    def test_acceptance_drops_dups_reorder_partition_kill(self,
+                                                          fault_free):
+        """ISSUE 16 acceptance combo: drops + duplicates + reorder + a
+        healed partition + one replica kill — every client stream
+        exactly-once and bitwise, no hangs, zero corrupt consumed."""
+        prompts = [[p] for p in (2, 3, 5, 7, 9, 11, 13, 17)]
+        t = ChaosTransport(seed=29, drop_p=0.08, dup_p=0.25,
+                           delay_p=0.15, max_delay_steps=2,
+                           corrupt_p=0.05, reorder=True)
+        t.partition("router", "replica:2", two_way=True, start=4)
+        engines = [FakeEngine() for _ in range(3)]
+        router = FleetRouter(engines, transport=t, lease_steps=5)
+        rids = [router.submit(list(p), 6) for p in prompts]
+        events = []
+        guard = 0
+        while router.has_work():
+            if guard == 6:
+                router.kill_replica(1)        # the one replica kill
+            events.extend(router.step())
+            guard += 1
+            assert guard < 2000, "router hang under chaos"
+        t.heal()                              # the partition heals: any
+        events.extend(router.step())          # zombie traffic arrives now
+        events.extend(router.step())
+        seen = _collect_tokens(events)
+        for rid, p in zip(rids, prompts):
+            rec = router.request(rid)
+            assert rec.finished and rec.finish_reason == "length"
+            assert rec.tokens == _expected(list(p), 6), rid
+            assert seen.get(rid, []) == rec.tokens
+        assert t.counters["corrupt_dropped"] \
+            == t.counters["corrupt_injected"]
+        st = router.stats()
+        assert st["replicas_ejected"] == 2    # killed + partitioned
+        fc = router.fleet_metrics.counters
+        assert fc["failovers"] >= 1
+
+
+class TestZombieFencing:
+    def test_partitioned_replica_ejected_then_fenced(self, fault_free):
+        """The epoch-fencing scenario end to end: a one-way partition
+        silences replica 1's replies while it keeps receiving STEPs and
+        producing tokens; its lease expires, the router ejects it and
+        replays elsewhere; the partition heals and the zombie's held
+        results arrive — every one counted stale and discarded, no
+        token delivered twice, streams bitwise."""
+        prompts = [[3], [5], [7], [9]]
+        t = ChaosTransport(seed=0)
+        engines = [FakeEngine(), FakeEngine()]
+        router = FleetRouter(engines, transport=t, lease_steps=4)
+        rids = [router.submit(list(p), 6) for p in prompts]
+        events = []
+        events.extend(router.step())          # placed on both replicas
+        assert any(router.request(r).replica == 1 for r in rids)
+        t.partition("replica:1", "router", two_way=False)  # mute replies
+        guard = 0
+        while router.has_work():
+            events.extend(router.step())
+            guard += 1
+            assert guard < 200
+        rep1 = router.stats()["replica_health"][1]
+        assert rep1["state"] == "dead"
+        assert rep1["dead_reason"] == "lease_expired"
+        assert rep1["epoch"] == 2             # the fence moved
+        fc = router.fleet_metrics.counters
+        assert fc["lease_expirations"] == 1
+        assert fc["failovers"] >= 1
+        # the zombie DID produce while partitioned (STEPs still arrived)
+        assert engines[1].steps > 0
+        assert t.counters["held"] > 0
+        before = fc["stale_epoch_discarded"]
+        t.heal()                              # zombie replies arrive now
+        events.extend(router.step())
+        assert fc["stale_epoch_discarded"] > before
+        # exactly-once + bitwise despite the zombie's double production
+        seen = _collect_tokens(events)
+        for rid, p in zip(rids, prompts):
+            rec = router.request(rid)
+            assert rec.tokens == _expected(list(p), 6)
+            assert seen.get(rid, []) == rec.tokens    # no double emission
+
+    def test_heal_before_lease_expiry_no_failover(self, fault_free):
+        """A partition shorter than the lease: held replies release at
+        the window end, apply normally (same epoch), and nothing is
+        ejected or replayed — partitions cost latency, not work."""
+        t = ChaosTransport(seed=0)
+        t.partition("replica:1", "router", two_way=False, start=2,
+                    until=4)
+        router = FleetRouter([FakeEngine(), FakeEngine()], transport=t,
+                             lease_steps=8)
+        rids = [router.submit([p], 6) for p in (3, 5)]
+        events = []
+        guard = 0
+        while router.has_work():
+            events.extend(router.step())
+            guard += 1
+            assert guard < 200
+        assert router.stats()["replicas_ejected"] == 0
+        assert router.fleet_metrics.counters["failovers"] == 0
+        seen = _collect_tokens(events)
+        for rid, p in zip(rids, (3, 5)):
+            assert router.request(rid).tokens == _expected([p], 6)
+            assert seen[rid] == router.request(rid).tokens
+
+
+# ---------------------------------------------------------------------------
+# fleet.transport.send / fleet.transport.recv fault sites
+# ---------------------------------------------------------------------------
+
+class TestTransportFaultSites:
+    def _run(self, plan, n=3, max_new=4):
+        fault.activate(plan)
+        router = FleetRouter([FakeEngine(), FakeEngine()])
+        rids = [router.submit([p], max_new) for p in (3, 5, 7)[:n]]
+        events = []
+        guard = 0
+        while router.has_work():
+            events.extend(router.step())
+            guard += 1
+            assert guard < 500
+        return router, rids, events
+
+    def test_drop_action_on_results_recovers_by_resend(self, fault_free):
+        plan = fault.FaultPlan([fault.FaultSpec(
+            site="fleet.transport.send", action="drop",
+            match=r"^STEP_RESULTS")])
+        router, rids, events = self._run(plan)
+        assert router.transport.counters["dropped"] == 1
+        seen = _collect_tokens(events)
+        for rid, p in zip(rids, (3, 5, 7)):
+            assert router.request(rid).tokens == _expected([p], 4)
+            assert seen[rid] == router.request(rid).tokens
+
+    def test_dup_action_is_suppressed(self, fault_free):
+        plan = fault.FaultPlan([fault.FaultSpec(
+            site="fleet.transport.send", action="dup",
+            match=r"^STEP_RESULTS")])
+        router, rids, events = self._run(plan)
+        assert router.transport.counters["duplicated"] == 1
+        assert router.fleet_metrics.counters["duplicates_suppressed"] >= 1
+        seen = _collect_tokens(events)
+        for rid in rids:
+            assert seen[rid] == router.request(rid).tokens
+
+    def test_delay_action_arrives_late_and_exact(self, fault_free):
+        plan = fault.FaultPlan([fault.FaultSpec(
+            site="fleet.transport.send", action="delay", arg=2,
+            match=r"^STEP_RESULTS")])
+        router, rids, events = self._run(plan)
+        assert router.transport.counters["delayed"] == 1
+        seen = _collect_tokens(events)
+        for rid, p in zip(rids, (3, 5, 7)):
+            assert router.request(rid).tokens == _expected([p], 4)
+            assert seen[rid] == router.request(rid).tokens
+
+    def test_corrupt_action_caught_at_recv(self, fault_free):
+        plan = fault.FaultPlan([fault.FaultSpec(
+            site="fleet.transport.send", action="corrupt",
+            match=r"^SUBMIT:fleet-req-0$")])
+        router, rids, events = self._run(plan)
+        t = router.transport.counters
+        assert t["corrupt_injected"] == 1
+        assert t["corrupt_dropped"] == 1      # digest gate caught it
+        # the pinned submit retransmitted and the stream survived
+        for rid, p in zip(rids, (3, 5, 7)):
+            assert router.request(rid).tokens == _expected([p], 4)
+
+    def test_recv_site_fires_with_kind_rid_path(self, fault_free):
+        plan = fault.FaultPlan([fault.FaultSpec(
+            site="fleet.transport.recv", action="drop",
+            match=r"^HEARTBEAT_ACK")])
+        fault.activate(plan)
+        router = FleetRouter([FakeEngine()])
+        router.submit([3], 2)
+        router.run_to_completion(max_steps=50)
+        assert router.transport.counters["dropped"] == 1
+        assert router.request("fleet-req-0").tokens == _expected([3], 2)
+
+
+# ---------------------------------------------------------------------------
+# real-model acceptance: kill during a partition (slow/faults)
+# ---------------------------------------------------------------------------
+
+def _mk_engine(model, **kw):
+    cfg = dict(num_pages=64, page_size=16, max_slots=4)
+    cfg.update(kw)
+    return ServingEngine(model, **cfg)
+
+
+@pytest.mark.slow
+class TestRealModelTransport:
+    def test_loopback_fleet_matches_generate_bitwise(self, model,
+                                                     fault_free):
+        prompts = [RNG.integers(1, 500, size=int(n)).tolist()
+                   for n in (5, 9, 7)]
+        refs = [_reference(model, p, 6) for p in prompts]
+        router = FleetRouter([_mk_engine(model), _mk_engine(model)])
+        rids = [router.submit(p, 6) for p in prompts]
+        out = router.run_to_completion(max_steps=200)
+        for rid, ref in zip(rids, refs):
+            assert out[rid] == ref
+        assert router.transport.stats()["dropped"] == 0
+
+    @pytest.mark.faults
+    def test_kill_during_partition_sweep(self, model, fault_free):
+        """ISSUE 16: the faults-marked kill-during-partition sweep —
+        replica 2 partitioned two-way (lease expires -> eject), replica
+        1 chaos-killed at step k while the partition is open, mild
+        drop/dup/reorder chaos on every surviving message. For every
+        kill point: each stream bitwise equals single-engine
+        ``generate()``, exactly once; ``step_program_counts()`` stays
+        pinned (no retrace) and ``audit_pool()`` is clean on the
+        survivor."""
+        prompts = [RNG.integers(1, 500, size=int(RNG.integers(4, 10)))
+                   .tolist() for _ in range(6)]
+        max_new = 6
+        refs = [_reference(model, p, max_new) for p in prompts]
+        for k in (2, 4, 6):
+            fault.activate(fault.FaultPlan([
+                fault.FaultSpec(site="fleet.replica_kill", action="raise",
+                                step=k, match=r"^1$")]))
+            t = ChaosTransport(seed=100 + k, drop_p=0.05, dup_p=0.2,
+                               delay_p=0.1, max_delay_steps=2,
+                               reorder=True)
+            t.partition("router", "replica:2", two_way=True, start=1)
+            engines = [_mk_engine(model) for _ in range(3)]
+            router = FleetRouter(engines, transport=t, lease_steps=4)
+            rids = [router.submit(p, max_new) for p in prompts]
+            events = []
+            guard = 0
+            while router.has_work():
+                events.extend(router.step())
+                guard += 1
+                assert guard < 1000, f"router hang (kill step {k})"
+            t.heal()
+            events.extend(router.step())      # flush zombie traffic
+            seen = _collect_tokens(events)
+            for rid, ref in zip(rids, refs):
+                rec = router.request(rid)
+                assert rec.finished
+                assert rec.tokens == ref, f"kill step {k}, {rid}"
+                assert seen.get(rid, []) == rec.tokens   # exactly-once
+            st = router.stats()
+            assert st["replicas_ejected"] == 2
+            dead = {h["dead_reason"] for h in st["replica_health"]
+                    if h["state"] == DEAD}
+            assert dead == {"killed", "lease_expired"}
+            for h in st["replica_health"]:
+                if h["state"] != DEAD:
+                    eng = router.engines[h["replica"]]
+                    counts = eng.step_program_counts()
+                    assert all(v == 1 for v in counts.values()), counts
+                    assert eng.decode_program_count() == 1
+                    eng.audit_pool()
+            fault.deactivate()

@@ -112,6 +112,19 @@ Run: python tools/profile_serving.py            (real TPU)
                                                  flight-recorder dump path —
                                                  SERVING.md "Engine fleet &
                                                  failover")
+     python tools/profile_serving.py --netchaos (lossy-wire replay: the
+                                                 3-replica fleet behind a
+                                                 seeded ChaosTransport —
+                                                 drops/dups/delays/reorder/
+                                                 corruption plus a healed
+                                                 partition with a lease
+                                                 ejection; prints the
+                                                 message-outcome histogram
+                                                 and asserts every stream
+                                                 bitwise, zero corrupt
+                                                 consumed, zombie fenced —
+                                                 SERVING.md "Fleet
+                                                 transport & membership")
      python tools/profile_serving.py --tp       (tensor-parallel A/B on a
                                                  forced 2-device CPU mesh:
                                                  the same staggered trace
@@ -426,6 +439,96 @@ def fleet_chaos():
             assert eng.decode_program_count() == 1, "decode retraced"
     print("invariants held: all classified, 2 ejections dumped, "
           "survivors never retraced")
+
+
+def netchaos():
+    """Lossy-wire replay (SERVING.md "Fleet transport & membership"): a
+    3-replica FleetRouter on the tiny CPU model with every
+    router<->replica message routed through a seeded ChaosTransport —
+    drops, duplicates, delays, deterministic reordering, a low rate of
+    byte corruption, and a two-way partition that isolates replica 2
+    mid-run until its lease expires and the router ejects it. After the
+    run the partition heals and the zombie's held traffic arrives,
+    which the epoch fence must discard.
+
+    Prints the message-outcome histogram (sent / dropped / duplicated /
+    delayed / reordered / held / corrupt injected vs caught), the
+    fleet's dedup + fencing counters, and each replica's terminal
+    health row. The invariants asserted at the end are the transport
+    contract: every client stream bitwise equals a single-engine
+    ``generate()`` despite the lossy wire (exactly-once), zero corrupt
+    payloads were ever consumed, and the healed zombie acked no stale
+    work."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.serving import ChaosTransport, FleetRouter, ServingEngine
+
+    pt.seed(0)
+    model = LlamaForCausalLM(llama_tiny(mp_axis=None, fsdp_axis=None))
+    model.eval()
+
+    rng = np.random.default_rng(0)
+    n_requests, max_new = 10, 6
+    prompts = [rng.integers(0, model.config.vocab_size,
+                            int(rng.integers(4, 9))).astype(np.int32)
+               for _ in range(n_requests)]
+    refs = [np.asarray(model.generate(jnp.asarray([p]),
+                                      max_new_tokens=max_new))
+            [0, len(p):].tolist() for p in prompts]
+
+    wire = ChaosTransport(seed=42, drop_p=0.08, dup_p=0.2, delay_p=0.15,
+                          max_delay_steps=2, corrupt_p=0.05, reorder=True)
+    wire.partition("router", "replica:2", two_way=True, start=3)
+    engines = [ServingEngine(model, num_pages=64, page_size=4, max_slots=4)
+               for _ in range(3)]
+    router = FleetRouter(engines, transport=wire, lease_steps=4)
+
+    submitted = [router.submit(p, max_new) for p in prompts[:4]]
+    steps = 0
+    while router.has_work() or len(submitted) < n_requests:
+        router.step()
+        steps += 1
+        if len(submitted) < n_requests and steps % 2 == 0:
+            submitted.append(router.submit(prompts[len(submitted)],
+                                           max_new))
+        assert steps < 2000, "fleet hung on the lossy wire"
+    wire.heal()       # the zombie's held traffic arrives now ...
+    router.step()     # ... and the epoch fence must discard it
+    steps += 1
+
+    st = router.stats()
+    fleet = router.fleet_metrics.summary()
+    tc = wire.counters
+    print(f"\nnet chaos replay: {n_requests} requests over 3 replicas, "
+          f"{steps} router steps, transport seed=42")
+    print("message-outcome histogram:")
+    for k in sorted(tc):
+        print(f"  {k:18s} {tc[k]}")
+    print("fleet counters: "
+          + "  ".join(f"{k}={v}" for k, v in sorted(fleet.items())))
+    print("replica health:")
+    for h in st["replica_health"]:
+        line = (f"  replica {h['replica']}: state={h['state']:9s} "
+                f"epoch={h['epoch']} breaker_opens={h['breaker_opens']}")
+        if h["dead_reason"]:
+            line += f" dead_reason={h['dead_reason']}"
+        print(line)
+
+    mismatched = [rid for rid, ref in zip(submitted, refs)
+                  if router.request(rid).tokens != ref]
+    assert not mismatched, f"streams diverged: {mismatched}"
+    assert tc["corrupt_dropped"] == tc["corrupt_injected"], \
+        "a corrupt payload slipped past the digest gate"
+    assert fleet["lease_expirations"] == 1, "the partition never expired"
+    assert st["replicas_ejected"] == 1
+    assert fleet["stale_epoch_discarded"] + tc["fenced_dropped"] >= 1, \
+        "the healed zombie's traffic was never fenced"
+    print(f"invariants held: {n_requests}/{n_requests} streams bitwise "
+          "under the lossy wire, zero corrupt consumed, zombie fenced "
+          f"(stale_epoch_discarded={fleet['stale_epoch_discarded']} "
+          f"fenced_dropped={tc['fenced_dropped']})")
 
 
 def prefix():
@@ -1504,7 +1607,9 @@ def tp():
 
 
 if __name__ == "__main__":
-    if "--fleet-chaos" in sys.argv[1:]:
+    if "--netchaos" in sys.argv[1:]:
+        netchaos()
+    elif "--fleet-chaos" in sys.argv[1:]:
         fleet_chaos()
     elif "--chaos" in sys.argv[1:]:
         chaos()
