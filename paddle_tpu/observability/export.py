@@ -130,6 +130,11 @@ def render_fleet_prometheus(router) -> str:
         labels = '{replica="%d"}' % health["replica"]
         emit("paddle_serving_fleet_replica_up",
              health["state"] != "dead", labels=labels)
+        # disaggregated placement (SERVING.md "Disaggregated serving"):
+        # 1 while the replica is a prefill specialist, 0 for decode or
+        # colocated — a re-roll shows up as the series flipping
+        emit("paddle_serving_fleet_replica_prefill",
+             health.get("role") == "prefill", labels=labels)
         for key in ("ready", "live", "queue_depth", "running",
                     "pool_utilization", "tp_degree",
                     "consecutive_failures", "breaker_opens",

@@ -39,8 +39,8 @@ from .snapshot import (RequestSnapshot, SnapshotStore,
 from .speculative import DraftProposer, NgramDrafter, SpeculativeConfig
 from .tiering import HostTier
 from .workload import (Workload, WorkloadRequest, WorkloadSpec,
-                       heavy_tail_workload, make_workload,
-                       overload_workload)
+                       heavy_tail_workload, long_prompt_workload,
+                       make_workload, overload_workload)
 
 __all__ = [
     "ServingEngine", "BrownoutConfig",
@@ -54,7 +54,7 @@ __all__ = [
     "SnapshotStore", "RequestSnapshot",
     "save_engine_snapshot", "load_engine_snapshot",
     "Workload", "WorkloadRequest", "WorkloadSpec", "heavy_tail_workload",
-    "make_workload", "overload_workload",
+    "long_prompt_workload", "make_workload", "overload_workload",
     "ServingError", "QueueFullError", "RequestTooLargeError",
     "SchedulerStalledError", "EngineDrainingError", "FleetOverloadedError",
     "TPConfigError", "AdmissionShedError",

@@ -304,6 +304,11 @@ class ServingEngine:
             # layout from the creation-time weight specs)
             self._state = self._tp.shard_state(self._state)
         self._requests: dict[str, Request] = {}
+        # disaggregated serving (SERVING.md "Disaggregated serving"):
+        # finished-prefill KV exports waiting to be offered over the
+        # fleet wire — filled by _handoff_finish at final-chunk
+        # completion, drained by the EngineServer via take_handoffs()
+        self._handoff_outbox: list[RequestSnapshot] = []
         self._rid_counter = itertools.count()
         self._steps = 0
         self._idle_steps = 0
@@ -323,7 +328,8 @@ class ServingEngine:
                     rid: str | None = None,
                     deadline_s: float | None = None,
                     max_queue_wait_s: float | None = None,
-                    tenant: int = 0, priority: int = 0) -> str:
+                    tenant: int = 0, priority: int = 0,
+                    prefill_only: bool = False) -> str:
         """Admission control happens HERE, not in the scheduler loop:
         a request that can never run raises RequestTooLargeError, a full
         bounded queue raises QueueFullError, a draining engine raises
@@ -340,7 +346,14 @@ class ServingEngine:
         with ``finish_reason="timeout"``. ``tenant`` scopes the request
         under the fair scheduler and the admission quotas; ``priority``
         (larger = more important, default 0) orders brownout level-3
-        shedding — neither changes the tokens a stream produces."""
+        shedding — neither changes the tokens a stream produces.
+        ``prefill_only=True`` marks a disaggregated-serving handoff
+        request (SERVING.md "Disaggregated serving"): the engine runs
+        the prompt through its mixed-step chunks, then — instead of
+        emitting the first token — exports the finished KV to the
+        handoff outbox (:meth:`take_handoffs`) and finishes the request
+        with reason ``"handoff"``; a decode-role replica emits every
+        token of the stream."""
         if self._draining:
             raise EngineDrainingError(
                 "engine is draining (preempted or shut down); retry on "
@@ -355,8 +368,15 @@ class ServingEngine:
             self.metrics.on_reject("too_large")
             raise
         rid = rid if rid is not None else f"req-{next(self._rid_counter)}"
-        if rid in self._requests:
-            raise ValueError(f"duplicate request id {rid!r}")
+        old = self._requests.get(rid)
+        if old is not None:
+            if not old.done:
+                raise ValueError(f"duplicate request id {rid!r}")
+            # a FINISHED record is safe to supersede — the disagg
+            # router legitimately re-admits a rid after its prefill
+            # phase finished here with reason "handoff" (fallback
+            # recompute landing back on the warm prefill replica)
+            del self._requests[rid]
         # chaos site: an injected admission fault models a crash in the
         # overload-control path itself — typed, keyed by rid
         _fault.trip("serving.admission", step=self._steps, path=rid)
@@ -368,7 +388,8 @@ class ServingEngine:
                       deadline_s=deadline_s,
                       max_queue_wait_s=max_queue_wait_s,
                       arrival_t=self.metrics.now(),
-                      tenant=int(tenant), priority=int(priority))
+                      tenant=int(tenant), priority=int(priority),
+                      handoff=bool(prefill_only))
         try:
             self.scheduler.add(req, self.pool)
         except QueueFullError:
@@ -796,8 +817,14 @@ class ServingEngine:
             raise EngineDrainingError(
                 "engine is draining; restore on another replica")
         rid = snap.rid
-        if rid in self._requests:
-            raise ValueError(f"duplicate request id {rid!r}")
+        old = self._requests.get(rid)
+        if old is not None:
+            if not old.done:
+                raise ValueError(f"duplicate request id {rid!r}")
+            # superseding a finished life of the same rid (see
+            # add_request) — a KV_PULL may land on the very replica
+            # that ran the prefill phase when the decode role starves
+            del self._requests[rid]
         self.admission_check(len(snap.prompt), snap.max_new_tokens)
         self._check_overload_gates(len(snap.prompt), snap.max_new_tokens,
                                    int(tenant), int(priority), None)
@@ -929,6 +956,76 @@ class ServingEngine:
         store.counters["snapshots_captured"] += 1
         self.metrics.on_snapshot_stats(store.stats())
 
+    # ---- disaggregated prefill/decode serving (SERVING.md
+    # "Disaggregated serving") ----
+
+    def take_handoffs(self) -> list[RequestSnapshot]:
+        """Drain the handoff outbox: sealed KV exports of prefill-only
+        requests whose final chunk completed since the last call. The
+        fleet's EngineServer streams each one to the router as an
+        epoch-stamped ``KV_OFFER``; a decode-role replica then lands it
+        via :meth:`restore_request` (``inject_prefix``)."""
+        out, self._handoff_outbox = self._handoff_outbox, []
+        return out
+
+    def _capture_handoff(self, req: Request) -> RequestSnapshot:
+        """Sealed snapshot of ONE request's finished prompt KV — the
+        same HostTier payload format + per-page blake2b digests as
+        :meth:`_capture_requests`, exported with one batched
+        ``device_get`` outside both compiled programs. Captured at
+        final-chunk completion, so ``tokens`` is empty and
+        ``context_len`` is the full prompt length: the decode side
+        re-admits it as a fresh request whose injected prefix matches
+        ``n_valid - 1`` tokens and recomputes exactly one suffix row —
+        the row whose sample is the (bitwise-identical) first token."""
+        ps = self.page_size
+        n = 0
+        if req.pages and req.context_len > 0:
+            n = min(self.pool.pages_for(req.context_len), len(req.pages))
+        payloads = self.pool.export_pages(list(req.pages[:n]))
+        q = req.context_len % ps
+        if n and q and n == self.pool.pages_for(req.context_len):
+            # zero the tail page's stale rows host-side (the spill
+            # invariant: zeros beyond the partial length) so the digest
+            # is deterministic — same rule as _capture_requests
+            tail = payloads[-1]
+            for k, a in enumerate(tail):
+                a = np.array(a)
+                a[q:] = 0
+                tail[k] = a
+        return RequestSnapshot(
+            rid=req.rid, prompt=list(req.prompt),
+            max_new_tokens=req.max_new_tokens,
+            eos_token_id=req.eos_token_id,
+            temperature=req.sampling.temperature,
+            top_p=req.sampling.top_p,
+            do_sample=req.sampling.do_sample,
+            seed=req.sampling.seed, arrival_seq=req.arrival_seq,
+            tokens=list(req.tokens), context_len=int(req.context_len),
+            step=self._steps, kv_tag=self.pool._tier_tag,
+            page_size=ps, payloads=payloads).seal()
+
+    def _handoff_finish(self, req: Request, events: list[dict]) -> None:
+        """Final-chunk completion of a prefill-only request: export its
+        KV to the handoff outbox INSTEAD of emitting the first token,
+        then finish it locally with reason ``"handoff"`` (the router
+        treats that as a phase transition, not a terminal event — the
+        client stream starts on the decode replica). Capture happens
+        BEFORE the scheduler releases the pages; the release itself
+        registers the prompt in the local prefix index, so a fallback
+        recompute on this replica would still be a full cache hit."""
+        snap = self._capture_handoff(req)
+        self._handoff_outbox.append(snap)
+        self.metrics.counters["handoff_exports"] += 1
+        self.metrics.on_prefill_complete(req.rid)
+        self.scheduler.finish(req, self.pool, "handoff")
+        self.metrics.on_finish(req.rid, "handoff")
+        self._trace_finish(req, "handoff")
+        if self.snapshot_store is not None:
+            self.snapshot_store.drop(req.rid)
+        events.append({"rid": req.rid, "token": None, "finished": True,
+                       "finish_reason": "handoff"})
+
     def attach_preemption_guard(self, guard=None):
         """Wire SIGTERM to a graceful drain: with a guard attached,
         ``stream`` / ``run_to_completion`` notice ``guard.preempted``
@@ -979,26 +1076,33 @@ class ServingEngine:
         return {"decode": int(self._decode_step._cache_size()),
                 "mixed": int(self._mixed_step._cache_size())}
 
-    def warm_programs(self) -> None:
-        """Compile both step programs with an all-inactive dispatch
+    def warm_programs(self, *, decode: bool = True,
+                      mixed: bool = True) -> None:
+        """Compile the step programs with an all-inactive dispatch
         (every row targets the reserved scratch page 0) so benches and
         profilers can separate compile time from steady-state latency
         without fabricating requests. Idempotent — reuses the jit
-        caches; ``step_program_counts()`` reads 1/1 afterwards."""
+        caches; ``step_program_counts()`` reads 1/1 afterwards. A
+        disagg prefill specialist warms with ``decode=False`` so the
+        phase-split contract (``{"decode": 0, "mixed": 1}``, SERVING.md
+        "Disaggregated serving") survives warming."""
         S, M, K = self.max_slots, self.max_pages_per_slot, self._chunk
         zi = jnp.zeros((S,), jnp.int32)
         zb = jnp.zeros((S,), bool)
         ones = jnp.ones((S,), jnp.float32)
         gt = jnp.ones((S,), bool)
         tables = jnp.zeros((S, M), jnp.int32)
-        _, _, pools = self._decode_step(
-            self._state, self.pool.pools, zi, tables, zi, zb,
-            ones, ones, gt, zi, zi)
-        self.pool.pools = pools
-        _, _, _, pools = self._mixed_step(
-            self._state, self.pool.pools, jnp.zeros((S, K), jnp.int32),
-            tables, zi, zb, zi, zb, ones, ones, gt, zi, zi)
-        self.pool.pools = pools
+        if decode:
+            _, _, pools = self._decode_step(
+                self._state, self.pool.pools, zi, tables, zi, zb,
+                ones, ones, gt, zi, zi)
+            self.pool.pools = pools
+        if mixed:
+            _, _, _, pools = self._mixed_step(
+                self._state, self.pool.pools,
+                jnp.zeros((S, K), jnp.int32),
+                tables, zi, zb, zi, zb, ones, ones, gt, zi, zi)
+            self.pool.pools = pools
         self._note_retraces()
 
     def stats(self) -> dict:
@@ -1432,6 +1536,11 @@ class ServingEngine:
         if req.tokens:
             return  # recompute after preemption: cache rebuilt, the stored
                     # last token is the next decode input — no new emission
+        if req.handoff:
+            # disaggregated serving (unchunked arm): same publish-
+            # instead-of-emit rule as the mixed-step final chunk
+            self._handoff_finish(req, events)
+            return
         self._emit(req, tok, events)
 
     def _qscale_max(self, pages: list[int]) -> float:
@@ -1674,6 +1783,13 @@ class ServingEngine:
                         continue  # recompute after preemption: cache
                                   # rebuilt, the stored last token is
                                   # the next decode input
+                    if req.handoff:
+                        # disaggregated serving: publish the finished
+                        # KV instead of emitting — the decode replica
+                        # recomputes this same final row and emits the
+                        # bitwise-identical first token itself
+                        self._handoff_finish(req, events)
+                        continue
                     self._emit(req, int(samp[slot, n - 1]), events)
                 else:
                     n_draft = n_drafted[slot]

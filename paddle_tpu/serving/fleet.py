@@ -70,11 +70,45 @@ queue is shed with the classified terminal outcome
 ``finish_reason="shed"`` rather than spinning. Fleet-wide SIGTERM drain
 composes with ``PreemptionGuard`` exactly like the single engine.
 
+With ``placement="disagg"`` the fleet splits into PREFILL-specialist
+and DECODE-specialist replicas (SERVING.md "Disaggregated serving").
+Fresh requests place only on prefill-role replicas with
+``prefill_only=True`` — the engine runs the prompt through mixed-step
+chunks at full prefill budget and, instead of emitting a first token,
+exports the finished KV (HostTier payload format, per-page blake2b
+digests) and finishes the request locally with
+``finish_reason="handoff"``. The router treats that finish as a phase
+transition, not a terminal: the record re-enters the router queue at
+its ORIGINAL submit order, the replica's ``KV_OFFER`` (a seq-numbered
+stream message, so at-least-once + dedup + epoch fencing are free)
+parks the sealed snapshot in the router's offer table, and the next
+dispatch sends a ``KV_PULL`` to a decode-role replica, which lands the
+pages via ``inject_prefix`` and serves the ENTIRE decode phase — the
+first token included — from its ``[max_slots]`` decode program after
+one forced suffix row through the mixed program. Because the decode
+side recomputes exactly the row the colocated engine would have
+sampled from (same seed, same ``fold_in(PRNGKey(seed), 0)`` key),
+streams are bitwise identical to a colocated run and the existing
+emitted-vs-produced dedup keeps them exactly-once. A landed pull is
+acknowledged to the prefill side with ``KV_COMMIT`` (frees its held
+copy); every failure degrades DOWN the recompute ladder, never wrong:
+offer dropped/corrupt (the wire's digest gate strips a damaged
+payload) or prefill source dead before offering or offer waited past
+``handoff_timeout_steps`` -> the record falls back to a plain
+colocated recompute on any replica. Role re-rolling is elastic: every
+``reroll_interval`` steps a sustained pressure imbalance (router queue
++ prefill load vs decode load + brownout rungs, ``reroll_dwell``
+consecutive readings) flips one IDLE replica to the starved role — an
+extinct role is restored immediately, and a fleet whose prefill side
+died entirely simply colocates until it recovers.
+
 Fault sites (RESILIENCE.md): ``fleet.dispatch`` (ctx path = rid),
 ``fleet.replica_kill`` and ``fleet.health`` (ctx path = replica index),
-plus the per-message ``fleet.transport.send`` / ``fleet.transport.recv``
-sites inside the transport itself (ctx path = ``"<KIND>:<rid>"``,
-actions drop/dup/delay/corrupt); the router also sets each pool's
+``fleet.handoff`` (ctx path = rid; actions drop/delay/corrupt the
+KV-offer payload in flight), plus the per-message
+``fleet.transport.send`` / ``fleet.transport.recv`` sites inside the
+transport itself (ctx path = ``"<KIND>:<rid>"``, actions
+drop/dup/delay/corrupt); the router also sets each pool's
 ``fault_path`` to the replica index so a ``serving.alloc`` storm can be
 pinned to one replica.
 
@@ -139,6 +173,11 @@ class FleetRequest:
     finish_reason: str | None = None
     replica: int | None = None  # current placement (None = router queue)
     replays: int = 0            # failover re-dispatches
+    # --- disaggregated serving (SERVING.md "Disaggregated serving") ---
+    handoff_src: int | None = None  # prefill replica that finished the phase
+    handoff_wait_since: int = 0     # router step the wait (offer/pull) began
+    handoff_fallback: bool = False  # degraded to plain colocated recompute
+    handoff_committed: bool = False  # KV_COMMIT sent (held copy freed)
 
 
 @dataclass
@@ -146,6 +185,7 @@ class _Replica:
     idx: int
     engine: object
     state: str = CLOSED
+    role: str = "colocated"     # "prefill" / "decode" under disagg placement
     consecutive_failures: int = 0
     opens: int = 0              # times the breaker opened (backoff exponent)
     backoff_until: int = 0      # router step when HALF_OPEN probing begins
@@ -195,9 +235,20 @@ class FleetRouter:
                  clock=None, tracer=None, snapshot_store=None,
                  transport=None, lease_steps: int = _LEASE_STEPS,
                  heartbeat_interval: int = 1,
-                 snapshot_fetch_interval: int = 4):
+                 snapshot_fetch_interval: int = 4,
+                 placement: str = "affinity",
+                 disagg_prefill_frac: float = 0.5,
+                 handoff_timeout_steps: int = 16,
+                 reroll_interval: int = 16,
+                 reroll_dwell: int = 3):
         if not engines:
             raise ValueError("FleetRouter needs at least one engine")
+        if placement not in ("affinity", "disagg"):
+            raise ValueError(f"unknown placement mode {placement!r} "
+                             "(expected 'affinity' or 'disagg')")
+        if placement == "disagg" and len(engines) < 2:
+            raise ValueError("placement='disagg' needs >= 2 replicas "
+                             "(at least one per role)")
         self._replicas = [_Replica(i, e) for i, e in enumerate(engines)]
         for rep in self._replicas:
             pool = getattr(rep.engine, "pool", None)
@@ -209,6 +260,20 @@ class FleetRouter:
         self.breaker_backoff_steps = breaker_backoff_steps
         self.breaker_backoff_max = breaker_backoff_max
         self.shed_patience = shed_patience
+        # --- disaggregated placement (SERVING.md "Disaggregated serving") ---
+        self.placement = placement
+        self.handoff_timeout_steps = max(1, int(handoff_timeout_steps))
+        self.reroll_interval = int(reroll_interval)
+        self.reroll_dwell = max(1, int(reroll_dwell))
+        if placement == "disagg":
+            n = len(self._replicas)
+            n_pre = max(1, min(n - 1,
+                               round(n * float(disagg_prefill_frac))))
+            for rep in self._replicas:
+                rep.role = "prefill" if rep.idx < n_pre else "decode"
+        self._offers: dict[str, tuple] = {}      # rid -> (src idx, snapshot)
+        self._handoff_delayed: list[tuple] = []  # (release, src, rid, snap)
+        self._reroll_pressure = 0                # signed dwell counter
         self.lease_steps = max(1, int(lease_steps))
         self.heartbeat_interval = max(1, int(heartbeat_interval))
         self.snapshot_fetch_interval = int(snapshot_fetch_interval)
@@ -383,6 +448,8 @@ class FleetRouter:
         self._health_sweep(events)
         self._pump_and_apply(events)     # heartbeat acks (loopback: now)
         self._snapshot_fetch()
+        self._handoff_sweep()
+        self._reroll_sweep()
         self._dispatch(events)
         if events:
             self._progress_flag = True
@@ -513,6 +580,8 @@ class FleetRouter:
             self._translate(rep, p["events"], sink)
         elif kind == "ERROR":
             self._eject(rep, p["reason"], snapshot=p.get("snapshot"))
+        elif kind == "KV_OFFER":
+            self._apply_offer(rep, msg)
         elif kind == "SNAPSHOT_DATA":
             store = self._snapshot_store
             if store is not None:
@@ -529,6 +598,7 @@ class FleetRouter:
         entry = self._outstanding.get(rid)
         if entry is None or entry[0] != rep.idx or entry[1] != attempt:
             return   # a cancelled/superseded attempt — already failed over
+        was_pull = entry[2].kind == "KV_PULL"
         del self._outstanding[rid]
         rec = self._records.get(rid)
         if rec is None or rec.finished:
@@ -565,6 +635,18 @@ class FleetRouter:
                 fm.bump("recovery_restored_tokens", rec.produced)
             elif self._snapshot_store is not None:
                 fm.bump("snapshot_fallbacks")
+        if was_pull:
+            # the decode replica landed (or refused) the offered KV:
+            # count the pull, mark the handoff-transfer end for the
+            # TTFT breakdown, and commit so the prefill side frees its
+            # held copy. kv_injected=False means the digest gate
+            # refused the payload and the decode replica recomputed the
+            # prefill itself — slower, never wrong.
+            self.fleet_metrics.bump("handoff_pulls")
+            if not p.get("kv_injected", True):
+                self.fleet_metrics.bump("handoff_corrupt")
+            self.metrics.on_handoff_landed(rid)
+            self._commit_handoff(rec)
         self.metrics.on_admit(rid)
         self.fleet_metrics.bump("dispatched")
         if rec.replays:
@@ -669,6 +751,9 @@ class FleetRouter:
         return {
             "replica": idx,
             "state": rep.state,
+            # "colocated" outside disagg placement; "prefill"/"decode"
+            # under it (may change over a replica's life — re-rolling)
+            "role": rep.role,
             "ready": self._ready(rep),
             "live": (rep.state != DEAD
                      and (not has_work
@@ -806,9 +891,22 @@ class FleetRouter:
         and the record simply stays queued for the next step — bounded
         work, no spinning. A submit whose reply has not arrived stays
         PINNED to its replica (retransmitted verbatim each step) so a
-        delayed reply can never race a second placement."""
+        delayed reply can never race a second placement.
+
+        Under ``placement="disagg"`` each record rides one of four
+        LANES, picked by its handoff state: a held KV offer dispatches
+        a ``KV_PULL`` to a decode-role replica (original submit order —
+        ``_pending`` is submit_seq-sorted, so re-admission preserves
+        arrival order); a record whose prefill finished but whose offer
+        has not arrived waits (the handoff sweep owns its timeout); a
+        fresh record places STRICTLY on a prefill-role replica with
+        ``prefill_only`` (waiting for a busy specialist beats smearing
+        prefill work across decode replicas — unless the role is
+        extinct, in which case it colocates); everything else (failover
+        replays, handoff fallbacks) takes the plain colocated lane."""
         if not self._pending:
             return
+        disagg = self.placement == "disagg"
         for rec in list(self._pending):
             if rec not in self._pending:
                 continue     # resolved while pumping an earlier submit
@@ -821,16 +919,42 @@ class FleetRouter:
                 self._pump_and_apply(events)
                 if self._submit_outcomes.get((rec.rid, attempt)) != "retry":
                     continue    # placed/finished (applied) or still pinned
+            kind, snap, prefill_only = "SUBMIT", None, False
             candidates = [rep for rep in self._replicas
                           if self._ready(rep)]
+            if disagg:
+                offer = self._offers.get(rec.rid)
+                if offer is not None and not rec.handoff_fallback:
+                    # pull lane: land the offered KV on a decode replica
+                    # (any ready replica if the decode role is starved —
+                    # the pages inject the same either way)
+                    kind = "KV_PULL"
+                    snap = ((self._usable_snapshot(rec) if rec.replays
+                             else None) or offer[1])
+                    decode = [rep for rep in candidates
+                              if rep.role == "decode"]
+                    candidates = decode or candidates
+                elif (rec.handoff_src is not None
+                        and not rec.handoff_fallback):
+                    continue   # prefill done, offer in flight — the
+                               # handoff sweep owns the timeout
+                elif (not rec.replays and not rec.handoff_fallback
+                        and any(r.state != DEAD and r.role == "prefill"
+                                for r in self._replicas)):
+                    prefill_only = True
+                    candidates = [rep for rep in candidates
+                                  if rep.role == "prefill"]
             if not candidates:
+                if disagg:
+                    continue  # lanes differ per record — try the next
                 break  # nothing can take the head now — FCFS, try later
             ordered = sorted(
                 candidates,
                 key=lambda rep: (-self._affinity(rep, rec),
                                  self._load(rep), rep.idx))
             for rep in ordered:
-                out = self._submit_to(rec, rep, events)
+                out = self._submit_to(rec, rep, events, kind=kind,
+                                      snap=snap, prefill_only=prefill_only)
                 if out in ("placed", "finished") or rec.finished:
                     break
                 if out is None:
@@ -872,13 +996,14 @@ class FleetRouter:
         return snap
 
     def _submit_to(self, rec: FleetRequest, rep: _Replica,
-                   events: list[dict]) -> str | None:
-        """Send one SUBMIT attempt over the wire and (when the reply is
-        synchronous — loopback) resolve its outcome: ``"placed"``,
-        ``"retry"`` (typed retryable failure — breaker fed, caller
-        tries the next candidate), ``"finished"`` (classified
-        non-retryable), or None (reply in flight — the submit is pinned
-        and retransmitted until it resolves)."""
+                   events: list[dict], kind: str = "SUBMIT",
+                   snap=None, prefill_only: bool = False) -> str | None:
+        """Send one SUBMIT/KV_PULL attempt over the wire and (when the
+        reply is synchronous — loopback) resolve its outcome:
+        ``"placed"``, ``"retry"`` (typed retryable failure — breaker
+        fed, caller tries the next candidate), ``"finished"``
+        (classified non-retryable), or None (reply in flight — the
+        submit is pinned and retransmitted until it resolves)."""
         attempt = self._attempts.get(rec.rid, 0) + 1
         self._attempts[rec.rid] = attempt
         try:
@@ -895,18 +1020,27 @@ class FleetRouter:
         # bitwise. tenant/priority ride every placement (fair
         # scheduling, quotas and brownout shed order on the replica —
         # restore included, so SURVIVOR quotas govern failover replay).
-        snap = self._usable_snapshot(rec) if rec.replays else None
+        # A KV_PULL is the same exchange seeded with the handoff
+        # snapshot the dispatch lane chose; prefill_only marks the
+        # disagg prefill lane (the engine exports KV instead of
+        # emitting a first token).
+        if kind == "SUBMIT" and snap is None and rec.replays:
+            snap = self._usable_snapshot(rec)
+        payload = {"attempt": attempt, "prompt": rec.prompt,
+                   "max_new_tokens": rec.max_new_tokens,
+                   "sampling": asdict(rec.sampling),
+                   "eos_token_id": rec.eos_token_id,
+                   "deadline_s": rec.deadline_s,
+                   "max_queue_wait_s": rec.max_queue_wait_s,
+                   "tenant": rec.tenant, "priority": rec.priority,
+                   "ack": rep.applied_seq}
+        if prefill_only:
+            payload["prefill_only"] = True
+        if kind == "KV_PULL":
+            payload["handoff_pull"] = True
         msg = Message.make(
-            "SUBMIT", "router", f"replica:{rep.idx}", epoch=rep.epoch,
-            rid=rec.rid,
-            payload={"attempt": attempt, "prompt": rec.prompt,
-                     "max_new_tokens": rec.max_new_tokens,
-                     "sampling": asdict(rec.sampling),
-                     "eos_token_id": rec.eos_token_id,
-                     "deadline_s": rec.deadline_s,
-                     "max_queue_wait_s": rec.max_queue_wait_s,
-                     "tenant": rec.tenant, "priority": rec.priority,
-                     "ack": rep.applied_seq},
+            kind, "router", f"replica:{rep.idx}", epoch=rep.epoch,
+            rid=rec.rid, payload=payload,
             snaps=(snap,) if snap is not None else ())
         self._outstanding[rec.rid] = (rep.idx, attempt, msg)
         self._transport.send(msg)
@@ -997,6 +1131,210 @@ class FleetRouter:
             payload={"reason": reason}))
 
     # ------------------------------------------------------------------
+    # disaggregated serving: KV handoff + elastic role re-rolling
+    # ------------------------------------------------------------------
+
+    def _apply_offer(self, rep: _Replica, msg: Message) -> None:
+        """A prefill replica published a finished request's KV
+        (``KV_OFFER`` on its result stream — seq-ordered and
+        epoch-fenced upstream, so duplicate and zombie offers never
+        reach here). The sealed snapshot rides the message's snapshot
+        channel, whose per-page digests were re-verified at receive —
+        a STRIPPED (empty) offer therefore means wire corruption, and
+        the record falls back to a full colocated recompute
+        immediately. The ``fleet.handoff`` chaos site (ctx path = rid)
+        drops, delays (in router steps) or corrupts the offer in
+        flight; a corrupted-but-delivered payload is caught one hop
+        later, by the decode replica's own digest gate at KV_PULL."""
+        p = msg.payload()
+        rid = p.get("rid", msg.rid)
+        rec = self._records.get(rid)
+        if rec is None or rec.finished:
+            # late offer for a finished/shed record: nothing will ever
+            # pull it — free the prefill server's held copy
+            self._transport.send(Message.make(
+                "KV_COMMIT", "router", f"replica:{rep.idx}",
+                epoch=rep.epoch, rid=rid,
+                payload={"rid": rid, "ack": rep.applied_seq}))
+            return
+        snap = msg.snaps[0] if msg.snaps else None
+        fx = {"drop": False, "delay": 0}
+        try:
+            _fault.trip(
+                "fleet.handoff", step=self._steps, path=rid,
+                drop=lambda: fx.__setitem__("drop", True),
+                delay=lambda steps: fx.__setitem__("delay", int(steps)),
+                corrupt=(snap.corrupt if snap is not None
+                         else lambda: None))
+        except _fault.FaultInjected:
+            fx["drop"] = True
+        if fx["drop"]:
+            self._handoff_fallback(rec, "offer_dropped")
+            return
+        if snap is None:
+            # the wire's digest gate stripped a corrupt payload
+            self.fleet_metrics.bump("handoff_corrupt")
+            self._handoff_fallback(rec, "offer_corrupt")
+            return
+        if fx["delay"] > 0:
+            self._handoff_delayed.append(
+                (self._steps + fx["delay"], rep.idx, rid, snap))
+            return
+        self._store_offer(rep.idx, rid, snap)
+
+    def _store_offer(self, src_idx: int, rid: str, snap) -> None:
+        rec = self._records.get(rid)
+        if rec is None or rec.finished or rec.handoff_fallback:
+            return
+        self._offers[rid] = (src_idx, snap)
+        rec.handoff_src = src_idx
+        rec.handoff_wait_since = self._steps   # restart: the pull phase
+        self.fleet_metrics.bump("handoff_offers")
+        self.fleet_metrics.bump("handoff_bytes", int(snap.nbytes))
+        self._progress_flag = True
+        self.tracer.instant("handoff_offer", track="fleet", rid=rid,
+                            replica=src_idx, nbytes=int(snap.nbytes))
+
+    def _handoff_fallback(self, rec: FleetRequest, why: str) -> None:
+        """Degrade a handoff to a plain colocated recompute: the
+        record re-enters the normal placement lane, charging a full
+        prefill — slower, never wrong. (The prefill replica registered
+        the prompt in its prefix index when the handoff finished, so a
+        recompute landing back THERE is a warm cache hit.)"""
+        if rec.finished or rec.handoff_fallback:
+            return
+        rec.handoff_fallback = True
+        self._offers.pop(rec.rid, None)
+        self.fleet_metrics.bump("handoff_recomputes")
+        self._progress_flag = True
+        self.tracer.instant("handoff_fallback", track="fleet",
+                            rid=rec.rid, reason=why)
+
+    def _commit_handoff(self, rec: FleetRequest) -> None:
+        """Tell the prefill source its held KV copy is safe to free
+        (idempotent under redelivery; at most once per record). The
+        ROUTER keeps its own offer reference until the record finishes,
+        so a decode-replica death after commit still re-pulls from the
+        router-held snapshot rather than recomputing."""
+        if rec.handoff_src is None or rec.handoff_committed:
+            return
+        rec.handoff_committed = True
+        src = self._replicas[rec.handoff_src]
+        if src.state == DEAD:
+            return             # the life that held the copy is gone
+        self.fleet_metrics.bump("handoff_commits")
+        self._transport.send(Message.make(
+            "KV_COMMIT", "router", f"replica:{src.idx}", epoch=src.epoch,
+            rid=rec.rid, payload={"rid": rec.rid,
+                                  "ack": src.applied_seq}))
+
+    def _handoff_release(self, rec: FleetRequest) -> None:
+        """Terminal cleanup: drop the router-held offer and free the
+        source's held copy if the pull never landed."""
+        self._offers.pop(rec.rid, None)
+        self._commit_handoff(rec)
+
+    def _handoff_sweep(self) -> None:
+        """Disagg liveness: release chaos-delayed offers whose hold
+        expired, then fall back to full recompute for any record whose
+        offer can no longer arrive (prefill source DEAD before
+        publishing) or has waited past ``handoff_timeout_steps``.
+        The timeout sits strictly inside ``shed_patience``, so a
+        wedged handoff degrades to a recompute long before the router
+        would shed the request."""
+        if self.placement != "disagg":
+            return
+        if self._handoff_delayed:
+            due = [d for d in self._handoff_delayed
+                   if d[0] <= self._steps]
+            if due:
+                self._handoff_delayed = [d for d in self._handoff_delayed
+                                         if d[0] > self._steps]
+                for _, src_idx, rid, snap in due:
+                    self._store_offer(src_idx, rid, snap)
+        for rec in self._pending:
+            if (rec.finished or rec.handoff_src is None
+                    or rec.handoff_fallback
+                    or rec.rid in self._offers
+                    or rec.rid in self._outstanding):
+                continue
+            src = self._replicas[rec.handoff_src]
+            in_delay = any(d[2] == rec.rid for d in self._handoff_delayed)
+            if src.state == DEAD and not in_delay:
+                # unclaimed offer died with its source -> recompute
+                self._handoff_fallback(rec, "src_dead")
+            elif (self._steps - rec.handoff_wait_since
+                    > self.handoff_timeout_steps):
+                self.fleet_metrics.bump("handoff_timeouts")
+                self._handoff_fallback(rec, "timeout")
+
+    def _reroll_sweep(self) -> None:
+        """Elastic role re-rolling: every ``reroll_interval`` router
+        steps, compare prefill-side pressure (router queue of requests
+        still owing a prefill + load on prefill-role replicas, per
+        replica) against decode-side pressure (load + brownout rungs
+        on decode-role replicas, per replica — the ladder's rung IS
+        the ITL-pressure signal). A sustained imbalance —
+        ``reroll_dwell`` consecutive readings leaning the same way —
+        flips ONE IDLE replica from the calm side to the starved side,
+        never the last member of a role; an extinct role is restored
+        immediately. Only an idle replica flips (no live requests, no
+        pinned submits), so a re-roll never migrates or disturbs
+        in-flight work: "draining" a donor is simply the role filter
+        in ``_dispatch`` no longer placing new work on it."""
+        if (self.placement != "disagg" or self.reroll_interval <= 0
+                or self._steps == 0
+                or self._steps % self.reroll_interval):
+            return
+        alive = [r for r in self._replicas if r.state != DEAD]
+        pre = [r for r in alive if r.role == "prefill"]
+        dec = [r for r in alive if r.role == "decode"]
+        if not alive:
+            return
+        if not pre and dec:
+            self._reroll(dec, "prefill")   # restore the extinct role
+            return
+        if not dec and pre:
+            self._reroll(pre, "decode")
+            return
+        owing = sum(1 for rec in self._pending
+                    if not rec.finished and rec.handoff_src is None
+                    and not rec.handoff_fallback)
+        pre_p = (owing + sum(self._load(r) for r in pre)) / len(pre)
+        dec_p = sum(self._load(r)
+                    + int(r.gauges.get("brownout_level", 0))
+                    for r in dec) / len(dec)
+        if pre_p > 2.0 * dec_p + 1.0:
+            self._reroll_pressure = max(1, self._reroll_pressure + 1)
+        elif dec_p > 2.0 * pre_p + 1.0:
+            self._reroll_pressure = min(-1, self._reroll_pressure - 1)
+        else:
+            self._reroll_pressure = 0
+        if self._reroll_pressure >= self.reroll_dwell and len(dec) > 1:
+            if self._reroll(dec, "prefill"):
+                self._reroll_pressure = 0
+        elif self._reroll_pressure <= -self.reroll_dwell and len(pre) > 1:
+            if self._reroll(pre, "decode"):
+                self._reroll_pressure = 0
+
+    def _reroll(self, donors: list, new_role: str) -> bool:
+        """Flip the least-loaded IDLE donor to ``new_role``; False if
+        every donor still holds work (try again next interval)."""
+        pinned = {e[0] for e in self._outstanding.values()}
+        idle = [r for r in donors
+                if not r.live_rids and self._load(r) == 0
+                and r.idx not in pinned]
+        if not idle:
+            return False
+        rep = min(idle, key=lambda r: r.idx)
+        was = rep.role
+        rep.role = new_role
+        self.fleet_metrics.bump("rerolls")
+        self.tracer.instant("reroll", track="fleet", replica=rep.idx,
+                            role=new_role, was=was)
+        return True
+
+    # ------------------------------------------------------------------
     # exactly-once translation
     # ------------------------------------------------------------------
 
@@ -1019,6 +1357,28 @@ class FleetRouter:
             rec = self._records.get(ev["rid"])
             if rec is None or rec.finished:
                 continue  # not ours / already terminal (late drain echo)
+            if ev.get("finished") and ev.get("finish_reason") == "handoff":
+                # disagg phase boundary, NOT a terminal: the prefill
+                # replica finished the prompt and exported its KV. The
+                # record re-enters the router queue at its ORIGINAL
+                # submit order to await the offer/pull; the client sees
+                # nothing (its first token comes from the decode side).
+                rep.live_rids.discard(rec.rid)
+                if rec.replica == rep.idx:
+                    rec.replica = None
+                rec.produced = 0
+                rec.handoff_src = rep.idx
+                rec.handoff_wait_since = self._steps
+                self.metrics.on_prefill_complete(rec.rid)
+                self.fleet_metrics.bump("handoff_prefills")
+                if rec not in self._pending:
+                    keys = [r.submit_seq for r in self._pending]
+                    self._pending.insert(
+                        bisect.bisect_left(keys, rec.submit_seq), rec)
+                self._progress_flag = True
+                self.tracer.instant("handoff_prefill", track="fleet",
+                                    rid=rec.rid, replica=rep.idx)
+                continue
             token = ev.get("token")
             if token is not None:
                 rec.produced += 1
@@ -1056,6 +1416,7 @@ class FleetRouter:
                 rec.finish_reason = reason
                 rep.live_rids.discard(rec.rid)
                 self._recovering.pop(rec.rid, None)
+                self._handoff_release(rec)
                 self.metrics.on_finish(rec.rid, reason)
                 if reason not in ("stop", "length"):
                     self.metrics.on_outcome(reason)
@@ -1077,6 +1438,7 @@ class FleetRouter:
         if rec.replica is not None:
             self._replicas[rec.replica].live_rids.discard(rec.rid)
         rec.replica = None
+        self._handoff_release(rec)
         ev = {"rid": rec.rid, "token": None, "finished": True,
               "finish_reason": reason, "replica": None}
         if reason == "shed":
@@ -1130,11 +1492,13 @@ class FleetRouter:
         ``observability.render_fleet_prometheus`` exports)."""
         return {
             "steps": self._steps,
+            "placement": self.placement,
             "replicas": len(self._replicas),
             "replicas_live": self.replicas_live(),
             "replicas_ejected": sum(1 for r in self._replicas
                                     if r.state == DEAD),
             "queue_depth": len(self._pending),
+            "handoff_offers_held": len(self._offers),
             "requests": len(self._records),
             "draining": self._draining,
             "fleet": self.fleet_metrics.summary(),

@@ -85,6 +85,26 @@ class FleetMetrics:
     - ``lease_expirations``      replicas ejected because their
       heartbeat lease lapsed (no ack within ``lease_steps``)
 
+    Disaggregated prefill/decode serving (``placement="disagg"``;
+    SERVING.md "Disaggregated serving") adds the handoff ledger:
+
+    - ``handoff_prefills``   requests whose prefill finished on a
+      prefill-role replica (the KV now owes a handoff)
+    - ``handoff_offers``     KV_OFFER messages the router received
+    - ``handoff_bytes``      payload bytes carried by those offers
+    - ``handoff_pulls``      KV_PULL placements that landed on a
+      decode-role replica (includes re-pulls after a decode death)
+    - ``handoff_commits``    KV_COMMIT releases sent back to the
+      prefill replica (frees its held copy)
+    - ``handoff_corrupt``    offered payloads the digest gate rejected
+      (stripped on the wire, or refused at inject time)
+    - ``handoff_timeouts``   offers that never became pullable within
+      ``handoff_timeout_steps``
+    - ``handoff_recomputes`` requests that fell back to a full
+      colocated recompute (dropped/corrupt/timed-out/orphaned offer)
+    - ``rerolls``            replica role flips (prefill <-> decode)
+      under sustained queue-wait vs ITL pressure imbalance
+
     Client-visible latency/goodput lives on the router's own
     :class:`ServingMetrics`, not here — this bag is pure fleet-control
     accounting."""
@@ -98,6 +118,11 @@ class FleetMetrics:
             "recovery_restored_tokens": 0, "recovery_replayed_tokens": 0,
             "duplicates_suppressed": 0, "stale_epoch_discarded": 0,
             "lease_expirations": 0,
+            "handoff_prefills": 0, "handoff_offers": 0,
+            "handoff_bytes": 0, "handoff_pulls": 0,
+            "handoff_commits": 0, "handoff_corrupt": 0,
+            "handoff_timeouts": 0, "handoff_recomputes": 0,
+            "rerolls": 0,
         }
         # time-to-first-recovered-token samples: ejection -> the first
         # token beyond the request's pre-failover stream
@@ -139,6 +164,14 @@ class ServingMetrics:
         self._end = None
         self._admit_t: dict[str, float] = {}
         self._queue_wait: list[float] = []
+        # disaggregated serving (SERVING.md "Disaggregated serving"):
+        # per-request phase timestamps for ttft_breakdown() — when a
+        # prefill-role replica finished the prompt (the KV handoff
+        # starts) and when the pulled KV landed on a decode replica.
+        # Colocated requests never touch these dicts, so their TTFT
+        # attributes entirely to queue-wait + prefill-compute.
+        self._prefill_done_t: dict[str, float] = {}
+        self._handoff_admit_t: dict[str, float] = {}
         # failure-outcome counters (typed error surface, SERVING.md):
         # rejected_quota / rejected_infeasible are AdmissionShedError
         # sheds (tenant quota exhausted / deadline infeasible), "shed"
@@ -155,6 +188,9 @@ class ServingMetrics:
             "snapshot_restores": 0, "snapshot_restored_tokens": 0,
             "snapshot_restore_failed": 0, "snapshot_restore_corrupt": 0,
             "snapshot_saves": 0,
+            # disaggregated serving (engine side): finished-prefill KV
+            # exports published to the handoff outbox
+            "handoff_exports": 0,
         }
         # prefix-cache accounting (SERVING.md "Prefix caching"):
         # per-admission token totals accumulate here; the pool's page
@@ -275,6 +311,55 @@ class ServingMetrics:
         t = self.now()
         self._admit_t[rid] = t
         self._queue_wait.append(t - self._arrival[rid])
+
+    def on_prefill_complete(self, rid: str) -> None:
+        """Disaggregated serving: the prefill phase finished (the
+        prefill-role replica published the request's KV for handoff).
+        First call wins — a retried handoff keeps the original mark."""
+        if rid not in self._prefill_done_t:
+            self._prefill_done_t[rid] = self.now()
+
+    def on_handoff_landed(self, rid: str) -> None:
+        """Disaggregated serving: the pulled KV was injected and the
+        request re-admitted on a decode-role replica. First call wins,
+        so re-pulls after a decode-replica death keep the original
+        transfer latency."""
+        if rid not in self._handoff_admit_t:
+            self._handoff_admit_t[rid] = self.now()
+
+    def ttft_breakdown(self) -> dict:
+        """Split each request's TTFT into the three phases the disagg
+        A/B attributes cost to: queue-wait (arrival -> first
+        admission), prefill-compute (admission -> prefill finished),
+        and handoff-transfer (prefill finished -> first token, i.e. the
+        KV offer/pull/re-admission plus the decode replica's first
+        step). Colocated requests have no prefill-done mark, so their
+        compute span runs to the first token and handoff is 0 —
+        schema-stable across both serving modes."""
+        qw: list[float] = []
+        pf: list[float] = []
+        ho: list[float] = []
+        for rid, t1 in self._first_token.items():
+            t0 = self._arrival.get(rid)
+            ta = self._admit_t.get(rid)
+            if t0 is None or ta is None:
+                continue
+            qw.append(ta - t0)
+            td = self._prefill_done_t.get(rid)
+            if td is not None:
+                pf.append(max(td - ta, 0.0))
+                ho.append(max(t1 - td, 0.0))
+            else:
+                pf.append(max(t1 - ta, 0.0))
+                ho.append(0.0)
+        return {
+            "ttft_queue_wait_p50_s": percentile(qw, 50),
+            "ttft_queue_wait_p99_s": percentile(qw, 99),
+            "ttft_prefill_p50_s": percentile(pf, 50),
+            "ttft_prefill_p99_s": percentile(pf, 99),
+            "ttft_handoff_p50_s": percentile(ho, 50),
+            "ttft_handoff_p99_s": percentile(ho, 99),
+        }
 
     def on_reject(self, kind: str) -> None:
         """An add_request rejection: kind is 'queue_full' or 'too_large'."""
@@ -596,6 +681,10 @@ class ServingMetrics:
             "kv_util_peak": max(self._pool_util, default=0.0),
             "queue_wait_p50_s": percentile(self._queue_wait, 50),
             "queue_wait_p99_s": percentile(self._queue_wait, 99),
+            # TTFT attribution (SERVING.md "Disaggregated serving"):
+            # queue-wait / prefill-compute / handoff-transfer — always
+            # present; handoff percentiles are 0 for colocated serving
+            **self.ttft_breakdown(),
             "rejected": (self.counters["rejected_queue_full"]
                          + self.counters["rejected_too_large"]),
             "cache_hit_rate": self.cache_hit_rate(),

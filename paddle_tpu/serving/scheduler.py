@@ -123,6 +123,17 @@ class Request:
     # list every step. Drafts never affect the emitted stream — only how
     # many tokens a step emits — so this is working state, not history.
     draft_tokens: list[int] = field(default_factory=list)
+    # disaggregated serving (SERVING.md "Disaggregated serving"): a
+    # prefill-only request stops at final-chunk completion — the engine
+    # exports its KV to the handoff outbox instead of emitting the
+    # first token, and finishes it with reason "handoff". The decode
+    # side re-admits the handed-off request through the NORMAL path: a
+    # fresh request whose full-prompt KV was injected matches
+    # n_valid - 1 cached tokens (the cap above), so its admission
+    # charges zero prefill-budget tokens beyond the one forced suffix
+    # row that produces the first logits — bitwise identical to the
+    # colocated final chunk.
+    handoff: bool = False
 
     @property
     def recompute_len(self) -> int:

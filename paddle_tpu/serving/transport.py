@@ -542,10 +542,23 @@ class EngineServer:
     drain by a one-shot latch. A FENCE for epoch ``e`` raises this
     server's floor to ``e+1``: zombie-epoch traffic after that is
     refused with :class:`StaleEpochError` (counted by the transport as
-    ``fenced_dropped``) — a fenced replica can never ack stale work."""
+    ``fenced_dropped``) — a fenced replica can never ack stale work.
+
+    Disaggregated serving (SERVING.md "Disaggregated serving") adds
+    the KV-handoff half of the protocol: after each STEP/DRAIN the
+    server drains the engine's handoff outbox and streams every
+    finished-prefill KV export to the router as an epoch-stamped
+    ``KV_OFFER`` (a seq-numbered stream kind — at-least-once with
+    dedup for free), retaining a copy in ``_handoff_held`` until the
+    router's ``KV_COMMIT`` confirms a decode replica landed it. A
+    ``KV_PULL`` is executed exactly like a snapshot-seeded SUBMIT (the
+    decode replica pulls the offered KV into its pool via
+    ``restore_request``/``inject_prefix``) and replies SUBMIT_REPLY
+    with a ``kv_injected`` verdict so the router can count payloads
+    the digest gate refused."""
 
     STREAM_KINDS = ("SUBMIT_REPLY", "STEP_RESULTS", "DRAIN_RESULTS",
-                    "SNAPSHOT_DATA", "ERROR")
+                    "SNAPSHOT_DATA", "ERROR", "KV_OFFER")
 
     def __init__(self, idx: int, engine, transport: Transport,
                  router: str = "router"):
@@ -560,6 +573,9 @@ class EngineServer:
         self._submit_replies: dict = {}         # (rid, epoch, attempt) -> msg
         self._last_step_seq = -1
         self._drain_reply: Message | None = None
+        # disaggregated serving: offered-but-uncommitted KV exports,
+        # freed by KV_COMMIT (or re-offerable if the router asks again)
+        self._handoff_held: dict[str, object] = {}
         transport.bind(self.name, self.handle)
         transport.bind_query(self.name, self.query)
 
@@ -581,6 +597,7 @@ class EngineServer:
             "tp_degree": int(getattr(eng, "tp", 1)),
             "max_queue_depth": None if mqd is None else int(mqd),
             "token_capacity": None if cap is None else int(cap()),
+            "handoff_held": len(self._handoff_held),
         }
 
     def query(self, kind: str, payload: dict):
@@ -631,7 +648,9 @@ class EngineServer:
                 "HEARTBEAT_ACK", self.name, self._router, epoch=msg.epoch,
                 payload={"hb_seq": p["hb_seq"], "sent_step": p["sent_step"],
                          "gauges": self.gauges()}))
-        elif kind == "SUBMIT":
+        elif kind in ("SUBMIT", "KV_PULL"):
+            # a KV_PULL is a submit seeded with the offered handoff KV
+            # — same dedup key, same cached-reply retransmission
             self._handle_submit(msg, p)
         elif kind == "STEP":
             self._handle_step(msg, p)
@@ -639,6 +658,10 @@ class EngineServer:
             self._handle_drain(msg, p)
         elif kind == "SNAPSHOT_FETCH":
             self._handle_snapshot_fetch(msg, p)
+        elif kind == "KV_COMMIT":
+            # a decode replica landed the handoff — release the held
+            # copy (idempotent under redelivery)
+            self._handoff_held.pop(p.get("rid", msg.rid), None)
 
     def _resend_unacked(self) -> None:
         for seq in sorted(self._resend):
@@ -670,12 +693,31 @@ class EngineServer:
         tp_kw = ({"tenant": tenant, "priority": priority}
                  if (tenant, priority) != (0, 0) else {})
         reply = {"rid": msg.rid, "attempt": p["attempt"], "ok": True,
-                 "used_snapshot": False, "restored": 0}
+                 "used_snapshot": False, "restored": 0,
+                 "kv_injected": snap is not None}
+
+        def _restore_misses() -> int:
+            c = getattr(getattr(eng, "metrics", None), "counters", None)
+            if c is None:
+                return 0
+            return (c.get("snapshot_restore_failed", 0)
+                    + c.get("snapshot_restore_corrupt", 0))
+
+        if p.get("prefill_only"):
+            tp_kw["prefill_only"] = True
         try:
             if snap is not None:
+                misses0 = _restore_misses()
+                tp_kw.pop("prefill_only", None)   # a seeded submit
+                # already owns its KV — nothing left to hand off
                 eng.restore_request(snap, **tp_kw)
                 reply["used_snapshot"] = True
                 reply["restored"] = len(snap.tokens)
+                # the digest gate (snap.verify inside restore_request)
+                # decides whether the pages actually injected; a refusal
+                # falls back to a full recompute on THIS replica — count
+                # it for the router's handoff_corrupt ledger
+                reply["kv_injected"] = _restore_misses() == misses0
             else:
                 eng.add_request(
                     p["prompt"], p["max_new_tokens"],
@@ -727,6 +769,26 @@ class EngineServer:
             return
         self._stream("STEP_RESULTS", msg.epoch, "",
                      {"events": events, "gauges": self.gauges()})
+        self._stream_handoffs(msg.epoch)
+
+    def _stream_handoffs(self, epoch: int) -> None:
+        """Publish every finished-prefill KV export the engine produced
+        this step as a ``KV_OFFER`` stream message (the sealed snapshot
+        rides ``msg.snaps``, so the wire's digest gate covers the
+        payload page by page). Offers are emitted AFTER the step's
+        results: the router sees the request's "handoff" finish first,
+        then the offer — though its offer handler accepts either
+        order."""
+        take = getattr(self.engine, "take_handoffs", None)
+        if take is None:
+            return
+        for snap in take():
+            self._handoff_held[snap.rid] = snap
+            self._stream("KV_OFFER", epoch, snap.rid,
+                         {"context_len": int(snap.context_len),
+                          "nbytes": int(snap.nbytes),
+                          "gauges": self.gauges()},
+                         snaps=(snap,))
 
     def _handle_drain(self, msg: Message, p: dict) -> None:
         if self._drain_reply is not None:
@@ -744,6 +806,7 @@ class EngineServer:
             "DRAIN_RESULTS", msg.epoch, "",
             {"events": self.engine.last_drain_events,
              "gauges": self.gauges()})
+        self._stream_handoffs(msg.epoch)
 
     def _handle_snapshot_fetch(self, msg: Message, p: dict) -> None:
         store = getattr(self.engine, "snapshot_store", None)

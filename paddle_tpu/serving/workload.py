@@ -43,7 +43,8 @@ from dataclasses import dataclass, field
 import numpy as np
 
 __all__ = ["Workload", "WorkloadRequest", "WorkloadSpec",
-           "heavy_tail_workload", "make_workload", "overload_workload"]
+           "heavy_tail_workload", "long_prompt_workload", "make_workload",
+           "overload_workload"]
 
 
 @dataclass
@@ -365,6 +366,40 @@ def heavy_tail_workload(seed: int = 0, n_requests: int = 24,
                     suffix_clip=(48, 320),
                     prompt_mix=((1.0, 4, 12),),
                     max_new=(4, 8), light_max_new=(16, 48))
+    kw.update(overrides)
+    return make_workload(WorkloadSpec(**kw))
+
+
+def long_prompt_workload(seed: int = 0, n_requests: int = 16,
+                         prompt_scale: float = 1.0,
+                         **overrides) -> Workload:
+    """The disaggregated-serving trace (ROADMAP item 1, SERVING.md
+    "Disaggregated serving"): long-prompt-HEAVY Poisson arrivals over
+    Zipf-shared system prompts — a lognormal prompt-length mixture
+    where most requests (~70%) carry a LONG prompt and every request
+    decodes a modest stream, the regime where prefill and decode fight
+    hardest for the per-step budget even under chunking.
+    ``prompt_scale`` is the 10x knob: it shifts the lognormal mu by
+    ``ln(prompt_scale)`` and scales the clip range, so
+    ``prompt_scale=10`` makes the same trace's prompts ~10x longer
+    while arrivals, tenants and decode lengths stay fixed —
+    ``bench.py llama_serving_disagg`` and ``tools/profile_serving.py
+    --disagg`` sweep this knob to show colocated ITL degrading while
+    the disaggregated arm stays flat. Deterministic in ``seed``; any
+    :class:`WorkloadSpec` field can be overridden."""
+    scale = float(prompt_scale)
+    if scale <= 0.0:
+        raise ValueError(f"prompt_scale must be > 0, got {prompt_scale}")
+    kw: dict = dict(seed=seed, n_requests=n_requests,
+                    arrival="poisson", rate=0.5,
+                    tenants=2, zipf_alpha=1.2, system_len=(8, 16),
+                    suffix_dist="lognormal", heavy_frac=0.7,
+                    lognormal_mu=3.3 + math.log(scale),
+                    lognormal_sigma=0.6,
+                    suffix_clip=(max(8, int(round(16 * scale))),
+                                 max(16, int(round(160 * scale)))),
+                    prompt_mix=((1.0, 4, 12),),
+                    max_new=(6, 12), light_max_new=(8, 16))
     kw.update(overrides)
     return make_workload(WorkloadSpec(**kw))
 

@@ -19,6 +19,7 @@ _DEFAULT_CONFIGS = {
     "llama_serving_chunked", "llama_serving_failover",
     "llama_serving_partition",
     "llama_serving_tp", "llama_serving_fairness",
+    "llama_serving_disagg",
 }
 
 
@@ -273,6 +274,29 @@ def test_dry_serving_fairness_cell_carries_overload_ab_keys():
                          "cold_ttft_p99", "cold_ttft_p99_fcfs",
                          "shed", "brownout_transitions",
                          "goodput_at_slo", "goodput_at_slo_fcfs",
+                         "retraces"}, cell
+    assert all(v is None for v in cell.values()), cell
+
+
+def test_dry_serving_disagg_cell_carries_handoff_ab_keys():
+    # the disaggregated arm (SERVING.md "Disaggregated serving"): the
+    # cell must surface the A/B evidence — itl_p99 for both arms plus
+    # each arm's 10x-prompt flatness ratio (the split's whole point),
+    # the handoff volume/fallback counters, and goodput_at_slo for
+    # BOTH arms — next to the usual serving keys
+    out = _run_dry("llama_serving_disagg")
+    assert out.returncode == 0, out.stderr
+    last = json.loads(out.stdout.splitlines()[-1])
+    cell = last["bench_summary"]["llama_serving_disagg"]
+    assert set(cell) >= {"value", "mfu", "spread",
+                         "ttft_p50", "ttft_p99", "ttft_p99_colocated",
+                         "tpot",
+                         "itl_p99", "itl_p99_colocated",
+                         "itl_p99_ratio_10x",
+                         "itl_p99_colocated_ratio_10x",
+                         "handoff_pulls", "handoff_bytes",
+                         "handoff_recomputes",
+                         "goodput_at_slo", "goodput_at_slo_colocated",
                          "retraces"}, cell
     assert all(v is None for v in cell.values()), cell
 

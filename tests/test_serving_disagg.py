@@ -1,0 +1,370 @@
+"""paddle_tpu.serving.fleet — disaggregated prefill/decode serving.
+
+The disagg contracts (SERVING.md "Disaggregated serving"):
+
+1. BITWISE — ``placement="disagg"`` relocates the decode phase to a
+   different replica via the KV handoff; it never changes the math.
+   Every stream is bitwise identical to single-engine ``generate()``
+   and to the colocated fleet, including the first token (emitted from
+   the decode side with the same sampling key the prefill replica
+   would have used).
+2. PHASE SPLIT — a prefill-role replica only ever compiles/runs the
+   mixed program (``step_program_counts() == {"decode": 0, "mixed":
+   1}``); the decode replica owns the whole decode phase.
+3. DEGRADE, NEVER CORRUPT — a dropped offer, a corrupt payload (caught
+   by the per-page digest gate), a timed-out handoff, or a replica
+   killed mid-handoff all degrade to a full recompute somewhere; the
+   client stream stays bitwise and exactly-once throughout, and the
+   pool invariants survive (``audit_pool``).
+4. ELASTIC — roles re-roll on sustained imbalance and an extinct role
+   is restored immediately; only idle replicas flip.
+
+The ``fleet.handoff`` chaos site (ctx path = rid) drops/delays/
+corrupts the offer in flight; kill chaos goes through
+``kill_replica`` like the fleet suite.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import fault
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+from paddle_tpu.observability import parse_prometheus, render_fleet_prometheus
+from paddle_tpu.serving import FleetRouter, ServingEngine
+from paddle_tpu.serving.fleet import DEAD
+
+RNG = np.random.default_rng(7)
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(123)
+    m = LlamaForCausalLM(llama_tiny(dtype="float32",
+                                    mp_axis=None, fsdp_axis=None))
+    m.eval()
+    return m
+
+
+@pytest.fixture
+def fault_free(monkeypatch):
+    """No FaultPlan leaks out of a chaos test; no rank env leaks in."""
+    fault.deactivate()
+    monkeypatch.delenv("PADDLE_TRAINER_ID", raising=False)
+    monkeypatch.delenv("PROCESS_ID", raising=False)
+    monkeypatch.delenv("PADDLE_RESTART_EPOCH", raising=False)
+    yield
+    fault.deactivate()
+
+
+def _reference(model, prompt, max_new, **kw):
+    out = model.generate(jnp.asarray([prompt]), max_new_tokens=max_new, **kw)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def _mk_engine(model, **kw):
+    cfg = dict(num_pages=64, page_size=16, max_slots=4)
+    cfg.update(kw)
+    return ServingEngine(model, **cfg)
+
+
+def _roles(router):
+    return [h["role"] for h in router.stats()["replica_health"]]
+
+
+def _run_exactly_once(router, rids, max_steps=400, events=None):
+    """Drain the router collecting client events; assert each stream
+    was delivered exactly once (event tokens == the record, no dup, no
+    gap) and return {rid: tokens}. ``events`` seeds the collection
+    with client events a test already drove manually (warm-up steps
+    before a kill) — they are part of the exactly-once stream and must
+    not be dropped."""
+    events = list(events or [])
+    while router.has_work():
+        events.extend(router.step())
+        assert router.stats()["steps"] < max_steps, "router hang"
+    seen = {rid: [] for rid in rids}
+    for ev in events:
+        if ev.get("token") is not None:
+            seen[ev["rid"]].append(ev["token"])
+    out = {}
+    for rid in rids:
+        rec = router.request(rid)
+        assert rec.finished
+        assert seen[rid] == rec.tokens      # no dup, no gap
+        out[rid] = rec.tokens
+    return out
+
+
+# ---------------------------------------------------------------------------
+# placement validation + role wiring (fast)
+# ---------------------------------------------------------------------------
+
+class TestDisaggPlacement:
+    def test_unknown_placement_rejected(self, model):
+        with pytest.raises(ValueError):
+            FleetRouter([_mk_engine(model), _mk_engine(model)],
+                        placement="sideways")
+
+    def test_disagg_needs_two_replicas(self, model):
+        with pytest.raises(ValueError):
+            FleetRouter([_mk_engine(model)], placement="disagg")
+
+    def test_roles_assigned_and_exported(self, model):
+        router = FleetRouter([_mk_engine(model) for _ in range(3)],
+                             placement="disagg", disagg_prefill_frac=0.5)
+        assert _roles(router) == ["prefill", "prefill", "decode"]
+        st = router.stats()
+        assert st["placement"] == "disagg"
+        assert st["handoff_offers_held"] == 0
+        series = parse_prometheus(render_fleet_prometheus(router))
+        assert series['paddle_serving_fleet_replica_prefill'
+                      '{replica="0"}'] == 1.0
+        assert series['paddle_serving_fleet_replica_prefill'
+                      '{replica="2"}'] == 0.0
+
+    def test_colocated_default_has_no_roles(self, model):
+        router = FleetRouter([_mk_engine(model), _mk_engine(model)])
+        assert _roles(router) == ["colocated", "colocated"]
+        series = parse_prometheus(render_fleet_prometheus(router))
+        assert series['paddle_serving_fleet_replica_prefill'
+                      '{replica="0"}'] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# happy path: bitwise streams, phase split, counters (tier-1, real model)
+# ---------------------------------------------------------------------------
+
+class TestDisaggHappyPath:
+    def test_streams_bitwise_and_phase_split(self, model, fault_free):
+        prompts = [RNG.integers(1, 500, size=int(n)).tolist()
+                   for n in (5, 9, 7, 12)]
+        refs = [_reference(model, p, 6) for p in prompts]
+        engines = [_mk_engine(model), _mk_engine(model)]
+        router = FleetRouter(engines, placement="disagg")
+        assert _roles(router) == ["prefill", "decode"]
+        rids = [router.submit(p, 6) for p in prompts]
+        out = _run_exactly_once(router, rids)
+        for rid, ref in zip(rids, refs):
+            assert out[rid] == ref
+        # phase split: the prefill specialist NEVER compiled decode —
+        # its entire life is mixed-step prompt chunks
+        assert engines[0].step_program_counts() == {"decode": 0,
+                                                    "mixed": 1}
+        assert engines[1].decode_program_count() == 1
+        c = router.fleet_metrics.counters
+        assert c.get("handoff_prefills") == 4
+        assert c.get("handoff_offers") == 4
+        assert c.get("handoff_pulls") == 4
+        assert c.get("handoff_commits") == 4
+        assert c.get("handoff_bytes", 0) > 0
+        assert c.get("handoff_recomputes", 0) == 0
+        for e in engines:
+            e.audit_pool()
+        # TTFT decomposes into queue-wait / prefill / handoff
+        m = router.metrics.summary()
+        assert m["ttft_prefill_p50_s"] > 0.0
+        assert m["ttft_handoff_p50_s"] > 0.0
+        # counters + per-replica roles land on the Prometheus page
+        series = parse_prometheus(render_fleet_prometheus(router))
+        assert series["paddle_serving_fleet_handoff_pulls_total"] == 4.0
+        assert series["paddle_serving_fleet_handoff_bytes_total"] > 0
+
+    def test_matches_colocated_fleet(self, model, fault_free):
+        prompts = [RNG.integers(1, 500, size=int(n)).tolist()
+                   for n in (6, 11, 8)]
+
+        def run(placement):
+            router = FleetRouter([_mk_engine(model), _mk_engine(model)],
+                                 placement=placement)
+            rids = [router.submit(p, 5, rid=f"r{i}")
+                    for i, p in enumerate(prompts)]
+            return router.run_to_completion(max_steps=300), rids
+
+        colo, rids = run("affinity")
+        disagg, _ = run("disagg")
+        assert all(disagg[r] == colo[r] for r in rids)
+
+
+# ---------------------------------------------------------------------------
+# elastic re-rolling
+# ---------------------------------------------------------------------------
+
+class TestDisaggReroll:
+    def test_extinct_prefill_role_restored(self, model, fault_free):
+        """Kill the ONLY prefill specialist, then submit a second wave
+        that still owes its prefill: the sweep must promote a drained
+        decode replica to restore the role (an extinct role is
+        restored as soon as an idle donor exists), and the new wave
+        flows prefill -> handoff -> decode on the re-rolled fleet."""
+        prompts = [RNG.integers(1, 500, size=int(n)).tolist()
+                   for n in (5, 8, 6, 7, 9)]
+        refs = [_reference(model, p, 5) for p in prompts]
+        router = FleetRouter([_mk_engine(model) for _ in range(3)],
+                             placement="disagg", disagg_prefill_frac=0.34,
+                             reroll_interval=1)
+        assert _roles(router) == ["prefill", "decode", "decode"]
+        rids = [router.submit(p, 5) for p in prompts[:3]]
+        pre = []
+        guard = 0
+        c = router.fleet_metrics.counters
+        while c.get("handoff_prefills", 0) < 3:   # wave 1 past prefill
+            pre.extend(router.step())
+            guard += 1
+            assert guard < 100
+        router.kill_replica(0)          # the ONLY prefill specialist dies
+        rids += [router.submit(p, 5) for p in prompts[3:]]  # owe prefill
+        out = _run_exactly_once(router, rids, events=pre)
+        for rid, ref in zip(rids, refs):
+            assert out[rid] == ref
+        # an idle decode replica was re-rolled to restore the role
+        assert router.fleet_metrics.counters.get("rerolls", 0) >= 1
+        live_roles = [h["role"] for h in router.stats()["replica_health"]
+                      if h["state"] != DEAD]
+        assert "prefill" in live_roles
+        assert "decode" in live_roles
+
+
+# ---------------------------------------------------------------------------
+# chaos: the handoff fallback ladder + kill-during-handoff (slow/faults)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestDisaggChaos:
+    def _prompts_and_refs(self, model, n=4, max_new=5):
+        prompts = [RNG.integers(1, 500, size=int(RNG.integers(4, 12)))
+                   .tolist() for _ in range(n)]
+        return prompts, [_reference(model, p, max_new) for p in prompts]
+
+    @pytest.mark.faults
+    def test_offer_dropped_recomputes(self, model, fault_free):
+        prompts, refs = self._prompts_and_refs(model)
+        fault.activate(fault.FaultPlan([
+            fault.FaultSpec(site="fleet.handoff", action="drop",
+                            match=r"^fleet-req-0$"),
+        ]))
+        router = FleetRouter([_mk_engine(model), _mk_engine(model)],
+                             placement="disagg")
+        rids = [router.submit(p, 5) for p in prompts]
+        out = _run_exactly_once(router, rids)
+        for rid, ref in zip(rids, refs):
+            assert out[rid] == ref
+        c = router.fleet_metrics.counters
+        assert c.get("handoff_recomputes") == 1
+        assert c.get("handoff_offers") == 3     # the dropped one never lands
+        for e in router.engines:
+            e.audit_pool()
+
+    @pytest.mark.faults
+    def test_offer_corrupt_caught_by_digest_gate(self, model, fault_free):
+        prompts, refs = self._prompts_and_refs(model)
+        fault.activate(fault.FaultPlan([
+            fault.FaultSpec(site="fleet.handoff", action="corrupt",
+                            match=r"^fleet-req-1$"),
+        ]))
+        router = FleetRouter([_mk_engine(model), _mk_engine(model)],
+                             placement="disagg")
+        rids = [router.submit(p, 5) for p in prompts]
+        out = _run_exactly_once(router, rids)
+        for rid, ref in zip(rids, refs):
+            assert out[rid] == ref
+        # the decode replica's per-page digest gate refused the payload
+        # and recomputed from the prompt — corruption NEVER lands
+        assert router.fleet_metrics.counters.get("handoff_corrupt", 0) >= 1
+        for e in router.engines:
+            e.audit_pool()
+
+    @pytest.mark.faults
+    def test_offer_delayed_within_budget_still_pulls(self, model,
+                                                     fault_free):
+        prompts, refs = self._prompts_and_refs(model)
+        fault.activate(fault.FaultPlan([
+            fault.FaultSpec(site="fleet.handoff", action="delay", arg=3,
+                            once=False),
+        ]))
+        router = FleetRouter([_mk_engine(model), _mk_engine(model)],
+                             placement="disagg")
+        rids = [router.submit(p, 5) for p in prompts]
+        out = _run_exactly_once(router, rids)
+        for rid, ref in zip(rids, refs):
+            assert out[rid] == ref
+        c = router.fleet_metrics.counters
+        assert c.get("handoff_pulls") == 4
+        assert c.get("handoff_recomputes", 0) == 0
+
+    @pytest.mark.faults
+    def test_offer_delayed_past_timeout_recomputes(self, model,
+                                                   fault_free):
+        prompts, refs = self._prompts_and_refs(model)
+        fault.activate(fault.FaultPlan([
+            fault.FaultSpec(site="fleet.handoff", action="delay", arg=40,
+                            match=r"^fleet-req-2$"),
+        ]))
+        router = FleetRouter([_mk_engine(model), _mk_engine(model)],
+                             placement="disagg", handoff_timeout_steps=8)
+        rids = [router.submit(p, 5) for p in prompts]
+        out = _run_exactly_once(router, rids)
+        for rid, ref in zip(rids, refs):
+            assert out[rid] == ref
+        c = router.fleet_metrics.counters
+        assert c.get("handoff_timeouts") == 1
+        assert c.get("handoff_recomputes") == 1
+
+    def test_kill_prefill_during_handoff_sweep(self, model, fault_free):
+        """Kill the prefill specialist at every early router step: the
+        offer is either recomputed (died before publishing) or already
+        router-held (pull proceeds) — bitwise + exactly-once always."""
+        prompts = [RNG.integers(1, 500, size=int(n)).tolist()
+                   for n in (5, 9, 7, 12)]
+        refs = [_reference(model, p, 5) for p in prompts]
+        for kill_step in range(1, 7):
+            router = FleetRouter([_mk_engine(model) for _ in range(3)],
+                                 placement="disagg",
+                                 disagg_prefill_frac=0.34,
+                                 reroll_interval=1)
+            rids = [router.submit(p, 5) for p in prompts]
+            pre = []
+            for _ in range(kill_step):
+                pre.extend(router.step())
+            router.kill_replica(0)      # the prefill specialist
+            out = _run_exactly_once(router, rids, max_steps=500,
+                                    events=pre)
+            for rid, ref in zip(rids, refs):
+                assert out[rid] == ref, f"kill_step={kill_step}"
+            for h in router.stats()["replica_health"]:
+                if h["state"] != DEAD:
+                    eng = router.engines[h["replica"]]
+                    # chaos must not retrace either program
+                    assert all(n <= 1 for n
+                               in eng.step_program_counts().values())
+                    eng.audit_pool()
+
+    def test_kill_decode_after_pull(self, model, fault_free):
+        """The decode replica dies AFTER pulling: the router still
+        holds its own offer reference until the record finishes, so
+        the replacement re-pulls instead of recomputing the prompt."""
+        prompts = [RNG.integers(1, 500, size=int(n)).tolist()
+                   for n in (5, 9, 7, 12)]
+        refs = [_reference(model, p, 5) for p in prompts]
+        router = FleetRouter([_mk_engine(model) for _ in range(3)],
+                             placement="disagg", disagg_prefill_frac=0.34,
+                             reroll_interval=1)
+        rids = [router.submit(p, 5) for p in prompts]
+        pre = []
+        guard = 0
+        while router.fleet_metrics.counters.get("handoff_pulls", 0) < 1:
+            pre.extend(router.step())
+            guard += 1
+            assert guard < 100
+        victims = [h["replica"] for h in router.stats()["replica_health"]
+                   if h["role"] == "decode" and h["live"]]
+        router.kill_replica(victims[0] if victims else 1)
+        out = _run_exactly_once(router, rids, max_steps=500, events=pre)
+        for rid, ref in zip(rids, refs):
+            assert out[rid] == ref
+        assert router.fleet_metrics.counters.get("failovers", 0) >= 1
+        for h in router.stats()["replica_health"]:
+            if h["state"] != DEAD:
+                router.engines[h["replica"]].audit_pool()
