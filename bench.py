@@ -123,6 +123,10 @@ _SERVING_SLOS = {
     # split exists to protect is ITL: decode replicas never run prefill
     # chunks, so inter-token gaps must stay flat as prompts grow
     "llama_serving_disagg": {"ttft_p99_s": 8.0, "itl_p99_s": 1.0},
+    # multi-tenant LoRA arm: same workload and SLOs as llama_serving —
+    # paging adapters through the slot pool must not hide behind looser
+    # targets; the A/B vs the single-adapter arm prices the churn
+    "llama_serving_lora": {"ttft_p99_s": 2.0, "itl_p99_s": 0.25},
 }
 
 
@@ -2206,6 +2210,149 @@ def bench_llama_serving_fairness(peak, peak_kind, n_requests=40,
     }
 
 
+def bench_llama_serving_lora(peak, peak_kind, n_requests=24, n_adapters=32,
+                             max_new_tokens=48, trace_path=None):
+    """Multi-tenant LoRA serving A/B (SERVING.md "Multi-tenant LoRA
+    serving"): one staggered-arrival ragged trace served three ways on
+    identically-configured engines — no adapter pool at all ("base"),
+    every request bound to ONE adapter ("single"), and every request
+    drawing its adapter from a Zipf-popularity distribution over
+    ``n_adapters`` tenants ("multi", the headline arm). The pool holds
+    8 live slots against 32 registered adapters, so the multi arm pays
+    real churn: misses page adapters in from host RAM, LRU evictions
+    spill cold ones back, and the adapter-table value swaps every
+    admission — while ``step_program_counts()`` must stay
+    ``{"decode": 1, "mixed": 1}`` (asserted; the design contract).
+    The bench_summary cell carries the adapter economics next to the
+    usual serving SLO keys: adapter_hit_rate (Zipf should keep it
+    high), lora_bytes_streamed (the HBM<->host bandwidth adapter churn
+    cost), and multi_vs_single_ratio — the acceptance gate is multi
+    tokens/s >= 0.8x the single-adapter arm."""
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import ServingEngine, ServingMetrics
+    from paddle_tpu.serving.lora import LoRAAdapter
+
+    name = "llama_serving_lora"
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                      intermediate_size=5632, num_hidden_layers=8,
+                      num_attention_heads=16, num_key_value_heads=8,
+                      max_position_embeddings=4096, dtype="bfloat16",
+                      mp_axis=None, fsdp_axis=None)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    n_params = model.num_params()
+    rng = np.random.default_rng(0)
+    lens = [int(x) for x in rng.integers(64, 256, n_requests)]
+    prompts = [rng.integers(0, cfg.vocab_size, n).astype(np.int32)
+               for n in lens]
+    adapters = [LoRAAdapter.random(f"tenant-{i}", cfg, rank=8, seed=i)
+                for i in range(n_adapters)]
+    # Zipf tenant popularity (alpha 1.2, same shape the tiered bench's
+    # Workload uses): a few hot adapters dominate, the tail forces
+    # misses + evictions
+    w = 1.0 / np.arange(1, n_adapters + 1) ** 1.2
+    zipf_draw = rng.choice(n_adapters, size=n_requests, p=w / w.sum())
+    # plant the coldest tenants at the tail: together with the hot head
+    # draws the trace touches more distinct adapters than the pool has
+    # slots, so the multi arm's eviction churn is deterministic
+    n_cold = min(8, n_adapters - 1, n_requests // 2)
+    zipf_draw[-n_cold:] = np.arange(n_adapters - n_cold, n_adapters)
+    tracer = _make_tracer(trace_path)
+
+    def run_arm(arm):
+        lora = (None if arm == "base"
+                else {"max_live": 9, "max_rank": 8,
+                      "host_tier": 1 << 30})
+        eng = ServingEngine(model, num_pages=512, page_size=16,
+                            max_slots=8, max_pages_per_slot=32,
+                            tracer=tracer, lora=lora)
+        hexes = ([] if arm == "base"
+                 else [eng.register_adapter(a) for a in adapters])
+        per_req = {"base": [None] * n_requests,
+                   "single": [hexes[0] if hexes else None] * n_requests,
+                   "multi": [hexes[k] if hexes else None
+                             for k in zipf_draw]}[arm]
+        eng.warm_programs()
+        eng.metrics = ServingMetrics()  # compile time stays off the clock
+        eng.metrics.set_lora(eng.adapters is not None)
+        eng.metrics.set_slo(**_SERVING_SLOS[name])
+        added = 2
+        for p, a in zip(prompts[:2], per_req[:2]):
+            eng.add_request(p, max_new_tokens, adapter=a)
+        steps = 0
+        while eng.scheduler.has_work() or added < n_requests:
+            eng.step()
+            steps += 1
+            if added < n_requests and steps % 4 == 0:
+                eng.add_request(prompts[added], max_new_tokens,
+                                adapter=per_req[added])
+                added += 1
+        m = eng.metrics.summary()
+        counts = eng.step_program_counts()
+        assert counts["decode"] == 1 and counts["mixed"] <= 1, \
+            f"{arm} arm retraced: {counts}"
+        return eng, m, steps
+
+    arms = {arm: run_arm(arm) for arm in ("base", "single", "multi")}
+    eng, m, steps = arms["multi"]
+    m_base, m_single = arms["base"][1], arms["single"][1]
+    lst = eng.adapters.stats()
+    assert lst["adapter_evictions"] > 0, \
+        "multi arm never evicted — pool no longer under adapter pressure"
+    hbm_bw = {"v4": 1.2e12,
+              "v5e": 0.82e12, "v5litepod": 0.82e12, "v5lite": 0.82e12,
+              "v5p": 2.77e12,
+              "v6e": 1.64e12, "trillium": 1.64e12,
+              }.get(peak_kind.split("(")[0], 0.82e12)
+    wall = max(m["wall_s"], 1e-9)
+    mbu = steps * 2.0 * n_params / wall / hbm_bw
+    trace_out = _dump_trace(tracer, trace_path, name)
+    return {
+        "metric": "llama_420m_serving_lora_tokens_per_sec",
+        "value": round(m["tokens_per_s"], 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mbu, 4),
+        "extra": {"params": n_params, "n_requests": n_requests,
+                  "n_adapters": n_adapters,
+                  "max_new_tokens": max_new_tokens,
+                  "prompt_lens": lens, "engine_steps": steps,
+                  "adapter_hit_rate": round(lst["adapter_hit_rate"], 4),
+                  "adapter_loads": lst["adapter_loads"],
+                  "adapter_evictions": lst["adapter_evictions"],
+                  "adapter_spills": lst["adapter_spills"],
+                  "lora_bytes_streamed": lst["lora_bytes_streamed"],
+                  "lora_bytes_per_slot": lst["bytes_per_slot"],
+                  "tokens_per_s_base": round(m_base["tokens_per_s"], 1),
+                  "tokens_per_s_single":
+                      round(m_single["tokens_per_s"], 1),
+                  "multi_vs_single_ratio":
+                      round(m["tokens_per_s"]
+                            / max(m_single["tokens_per_s"], 1e-9), 4),
+                  "ttft_p50": round(m["ttft_p50_s"], 4),
+                  "ttft_p99": round(m["ttft_p99_s"], 4),
+                  "tpot": round(m["tpot_mean_s"], 5),
+                  "itl_p99": round(m["itl_p99_s"], 5),
+                  "preemptions": m["preemptions"],
+                  "rejected": m["rejected"],
+                  "goodput_at_slo": round(m["goodput_at_slo"], 4),
+                  "goodput_at_slo_base":
+                      round(m_base["goodput_at_slo"], 4),
+                  "goodput_at_slo_single":
+                      round(m_single["goodput_at_slo"], 4),
+                  "slo": _SERVING_SLOS[name],
+                  "retraces": sum(
+                      max(n - 1, 0)
+                      for n in eng.step_program_counts().values()),
+                  "trace": trace_out,
+                  "mbu_weights_only": round(mbu, 4),
+                  "peak": peak_kind, "hbm_bw": hbm_bw,
+                  "pipeline": False, "runs": _RUNS,
+                  "spread": None},
+    }
+
+
 _CONFIGS = {
     "llama_420m": bench_llama,
     "resnet50": bench_resnet50,
@@ -2273,6 +2420,12 @@ _CONFIGS = {
     # clock; itl_p99 flatness + handoff counters + goodput for both
     # arms, streams asserted bitwise identical per scale
     "llama_serving_disagg": bench_llama_serving_disagg,
+    # multi-tenant LoRA A/B (SERVING.md "Multi-tenant LoRA serving"):
+    # base-only vs single-adapter vs Zipf-popular 32-adapter arms on
+    # one staggered trace; adapter hit rate + streamed bytes + the
+    # multi/single throughput ratio (acceptance: >= 0.8), programs
+    # pinned at {decode: 1, mixed: 1} through the churn
+    "llama_serving_lora": bench_llama_serving_lora,
 }
 
 # configs whose bench_summary cell carries extra keys beyond
@@ -2347,6 +2500,14 @@ _SUMMARY_EXTRA_KEYS = {
                              "handoff_recomputes",
                              "goodput_at_slo",
                              "goodput_at_slo_colocated", "retraces"),
+    "llama_serving_lora": ("ttft_p50", "ttft_p99", "tpot",
+                           "n_adapters", "adapter_hit_rate",
+                           "adapter_loads", "adapter_evictions",
+                           "lora_bytes_streamed",
+                           "tokens_per_s_base", "tokens_per_s_single",
+                           "multi_vs_single_ratio",
+                           "goodput_at_slo", "goodput_at_slo_base",
+                           "retraces"),
 }
 
 # opt-in configs (not in the default driver run — kept out to bound its

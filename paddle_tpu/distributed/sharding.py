@@ -44,10 +44,12 @@ def group_sharded_parallel(model: Layer, optimizer, level: str = "p_g_os",
         raise ValueError("group_sharded_parallel requires an active mesh")
     if level not in ("os", "os_g", "p_g_os"):
         raise ValueError(f"unknown sharding level {level!r}")
-    if offload and not _host_memory_available():
+    host_kind = _host_memory_kind()
+    if offload and host_kind is None:
         raise NotImplementedError(
-            "offload=True requires a backend with 'pinned_host' memory "
-            "(TPU/GPU PJRT or jax CPU); this backend reports none")
+            "offload=True requires a backend exposing a host memory space "
+            "(pinned_host on TPU/GPU PJRT, unpinned_host on jax CPU); this "
+            "backend reports none")
     params = model.param_dict()
     if level == "p_g_os":
         specs = fsdp_rules(params, axis=axis, min_size=segment_size)
@@ -59,8 +61,7 @@ def group_sharded_parallel(model: Layer, optimizer, level: str = "p_g_os",
             mod.set_param_spec(leaf, tuple(s))
         if offload:
             optimizer._state_sharding = {
-                k: NamedSharding(mesh, specs[k],
-                                 memory_kind="pinned_host")
+                k: NamedSharding(mesh, specs[k], memory_kind=host_kind)
                 for k, v in params.items()}
             _patch_optimizer_state_sharding(optimizer)
     else:
@@ -74,19 +75,26 @@ def group_sharded_parallel(model: Layer, optimizer, level: str = "p_g_os",
             k: NamedSharding(
                 mesh,
                 fsdp_rules({k: v}, axis=axis, min_size=segment_size)[k],
-                memory_kind="pinned_host" if offload else None)
+                memory_kind=host_kind if offload else None)
             for k, v in params.items()
         }
         _patch_optimizer_state_sharding(optimizer)
     return model, optimizer, scaler
 
 
-def _host_memory_available() -> bool:
+def _host_memory_kind() -> str | None:
+    """The backend's host memory space name, or None if it has none.
+    TPU/GPU PJRT backends call it "pinned_host"; the jax CPU backend
+    (which models host offload for tests) calls it "unpinned_host" —
+    matching on the literal "pinned_host" alone broke offload there."""
     try:
-        return any(m.kind == "pinned_host"
-                   for m in jax.devices()[0].addressable_memories())
+        kinds = [m.kind for m in jax.devices()[0].addressable_memories()]
     except Exception:
-        return False
+        return None
+    for kind in ("pinned_host", "unpinned_host"):
+        if kind in kinds:
+            return kind
+    return None
 
 
 def _patch_optimizer_state_sharding(optimizer):

@@ -100,6 +100,49 @@ def _mp_gather_logits(logits, axis):
     return logits
 
 
+def _lora_delta(x, lora, name):
+    """Gathered per-row LoRA delta for projection ``name`` (S-LoRA /
+    Punica batched-adapter form, serving/lora.py). ``lora`` =
+    ``(table [b] int32, params {name: (A [max_live, in, r],
+    B [max_live, r, out])}, scales [max_live] f32)`` — the table is an
+    array VALUE, so adapter churn in the serving engine never retraces.
+    The low-rank path runs in fp32 regardless of the base dtype (an
+    int8 base weight composes with a full-precision delta); row b
+    computes ``(x_b @ A[t_b]) @ B[t_b] * scale[t_b]``, and slot 0's
+    all-zero A/B + zero scale make the base-model delta exactly zero.
+    Returns None when the target is absent."""
+    table, params, scales = lora
+    ab = params.get(name)
+    if ab is None:
+        return None
+    A, B = ab
+    xf = x.astype(jnp.float32)
+    h = jnp.einsum("bsi,bir->bsr", xf, A[table].astype(jnp.float32))
+    d = jnp.einsum("bsr,bro->bso", h, B[table].astype(jnp.float32))
+    return d * scales[table][:, None, None]
+
+
+def _apply_lora(y, x, lora, name):
+    """Add projection ``name``'s LoRA delta (computed from the
+    projection INPUT ``x``) onto the base output ``y``; no-op without
+    an adapter spec or target."""
+    if lora is None:
+        return y
+    d = _lora_delta(x, lora, name)
+    return y if d is None else y + d.astype(y.dtype)
+
+
+def _lora_layer(lora, i):
+    """Slice the per-layer view of the gathered adapter buffers: layer
+    ``i`` of every target's ``[max_live, L, in, r]`` stack (i is a
+    Python int — the layer loop is unrolled under jit)."""
+    if lora is None:
+        return None
+    table, params, scales = lora
+    return (table, {t: (a[:, i], b[:, i]) for t, (a, b) in params.items()},
+            scales)
+
+
 def apply_rotary_pos_emb(x, cos, sin, position_ids=None):
     """x: [b, s, h, d]; cos/sin: [S, d/2] (parity:
     incubate fused_rotary_position_embedding — here one fused XLA graph)."""
@@ -135,7 +178,7 @@ class LlamaAttention(Layer):
                                 weight_spec=(mp, None))
 
     def forward(self, x, cos, sin, attn_mask=None, kv_cache=None, position_offset=0,
-                paged=None):
+                paged=None, lora=None):
         b, s, _ = x.shape
         cfg = self.config
         d = cfg.head_dim
@@ -145,6 +188,17 @@ class LlamaAttention(Layer):
         # every branch below is head-local (the GQA ratio h/kvh survives
         # because both divide by tp). Unsharded, local == global.
         q, k, v = self.q_proj(x), self.k_proj(x), self.v_proj(x)
+        if lora is not None:
+            q = _apply_lora(q, x, lora, "q_proj")
+            k = _apply_lora(k, x, lora, "k_proj")
+            v = _apply_lora(v, x, lora, "v_proj")
+
+        def _out_proj(t):
+            # o_proj + its LoRA delta; the delta lands AFTER the mp
+            # psum — the full-width input would otherwise be reduced
+            # once per shard (delta × mp_degree)
+            y = _mp_psum(self.o_proj(t), cfg.mp_axis)
+            return _apply_lora(y, t, lora, "o_proj")
         h, kvh = q.shape[-1] // d, k.shape[-1] // d
         q = q.reshape(b, s, h, d)
         k = k.reshape(b, s, kvh, d)
@@ -191,8 +245,7 @@ class LlamaAttention(Layer):
                 pk = pk.at[page, off].set(k.astype(pk.dtype))
                 pv = pv.at[page, off].set(v.astype(pv.dtype))
             out = F.paged_attention_decode(q, pk, pv, tables, seq_lens)
-            out = _mp_psum(self.o_proj(out.reshape(b, s, h * d)), cfg.mp_axis)
-            return out, (pk, pv)
+            return _out_proj(out.reshape(b, s, h * d)), (pk, pv)
         # sequence parallelism: when tracing inside a manual-sep shard_map
         # region (the pipelined train step), x is the LOCAL seq shard —
         # rope positions are offset by the shard start and attention runs
@@ -212,8 +265,7 @@ class LlamaAttention(Layer):
             # GQA k/v stay at kvh heads — ring_attention_manual repeats
             # per-step so rotating buffers are h/kvh smaller
             out = _sp.ring_attention_manual(q, k, v, axis=sep, causal=True)
-            return _mp_psum(self.o_proj(out.reshape(b, s, h * d)),
-                            cfg.mp_axis)
+            return _out_proj(out.reshape(b, s, h * d))
         static_zero = not isinstance(position_offset, jax.Array) and position_offset == 0
         if static_zero:
             q = apply_rotary_pos_emb(q, cos, sin)
@@ -237,8 +289,7 @@ class LlamaAttention(Layer):
             seq_lens = jnp.broadcast_to(jnp.asarray(position_offset), (b,))
             out, ck, cv = FF.masked_multihead_attention(
                 q, k, v, kv_cache[0], kv_cache[1], seq_lens)
-            out = _mp_psum(self.o_proj(out.reshape(b, s, h * d)), cfg.mp_axis)
-            return out, (ck, cv)
+            return _out_proj(out.reshape(b, s, h * d)), (ck, cv)
         if kv_cache is not None:
             ck, cv = kv_cache
             from ..quantization.serving import (QuantizedKV, kv_dequantize,
@@ -279,9 +330,7 @@ class LlamaAttention(Layer):
                 seq_lens = jnp.broadcast_to(jnp.asarray(position_offset), (b,))
                 out = F.cached_prefill_attention(q, new_cache[0],
                                                  new_cache[1], seq_lens)
-                out = _mp_psum(self.o_proj(out.reshape(b, s, h * d)),
-                               cfg.mp_axis)
-                return out, new_cache
+                return _out_proj(out.reshape(b, s, h * d)), new_cache
         if kvh != h:  # GQA: repeat kv heads
             rep = h // kvh
             k = jnp.repeat(k, rep, axis=2)
@@ -299,7 +348,7 @@ class LlamaAttention(Layer):
         out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
                                              is_causal=causal,
                                              training=self.training)
-        out = _mp_psum(self.o_proj(out.reshape(b, s, h * d)), cfg.mp_axis)
+        out = _out_proj(out.reshape(b, s, h * d))
         return (out, new_cache) if kv_cache is not None else out
 
 
@@ -315,10 +364,17 @@ class LlamaMLP(Layer):
         self.down_proj = nn.Linear(config.intermediate_size, config.hidden_size,
                                    bias_attr=False, weight_spec=(mp, None))
 
-    def forward(self, x):
+    def forward(self, x, lora=None):
         # SwiGLU (parity: incubate swiglu fused op — XLA fuses this chain)
-        y = self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
-        return _mp_psum(y, self.config.mp_axis)
+        if lora is None:
+            y = self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+            return _mp_psum(y, self.config.mp_axis)
+        g = _apply_lora(self.gate_proj(x), x, lora, "gate_proj")
+        u = _apply_lora(self.up_proj(x), x, lora, "up_proj")
+        t = F.silu(g) * u
+        # down_proj's delta lands AFTER the mp psum (see _out_proj)
+        y = _mp_psum(self.down_proj(t), self.config.mp_axis)
+        return _apply_lora(y, t, lora, "down_proj")
 
 
 class LlamaDecoderLayer(Layer):
@@ -331,18 +387,18 @@ class LlamaDecoderLayer(Layer):
                                                    config.rms_norm_eps)
 
     def forward(self, x, cos, sin, attn_mask=None, kv_cache=None, position_offset=0,
-                paged=None):
+                paged=None, lora=None):
         res = x
         h = self.input_layernorm(x)
         if kv_cache is not None:
             h, new_cache = self.self_attn(h, cos, sin, attn_mask, kv_cache,
-                                          position_offset, paged)
+                                          position_offset, paged, lora)
         else:
-            h = self.self_attn(h, cos, sin, attn_mask)
+            h = self.self_attn(h, cos, sin, attn_mask, lora=lora)
             new_cache = None
         x = res + h
         res = x
-        x = res + self.mlp(self.post_attention_layernorm(x))
+        x = res + self.mlp(self.post_attention_layernorm(x), lora=lora)
         return (x, new_cache) if kv_cache is not None else x
 
 
@@ -384,14 +440,14 @@ class LlamaModel(Layer):
         return self.embed_tokens(input_ids)
 
     def forward(self, input_ids, attn_mask=None, kv_caches=None, position_offset=0,
-                paged=None):
+                paged=None, lora=None):
         x = self._embed(input_ids)
         cos, sin = self.rope_cos, self.rope_sin
         new_caches = []
         for i, layer in enumerate(self.layers):
             if kv_caches is not None:
                 x, c = layer(x, cos, sin, attn_mask, kv_caches[i], position_offset,
-                             paged)
+                             paged, _lora_layer(lora, i))
                 new_caches.append(c)
             elif (self.config.recompute and self.training
                   and i % max(self.config.recompute_interval, 1) == 0):
@@ -422,8 +478,9 @@ class LlamaForCausalLM(Layer):
                                      weight_spec=(None, config.mp_axis))
 
     def forward(self, input_ids, attn_mask=None, kv_caches=None, position_offset=0,
-                paged=None):
-        out = self.model(input_ids, attn_mask, kv_caches, position_offset, paged)
+                paged=None, lora=None):
+        out = self.model(input_ids, attn_mask, kv_caches, position_offset, paged,
+                         lora)
         if kv_caches is not None:
             hidden, new_caches = out
         else:
@@ -510,19 +567,20 @@ class LlamaForCausalLM(Layer):
             return idx[:, 0]
 
         @jax.jit
-        def prefill(state, ids, caches, key):
+        def prefill(state, ids, caches, key, lora=None):
             (logits, caches), _ = functional_call(
-                self, state, ids, None, caches, 0, training=False)
+                self, state, ids, None, caches, 0, lora=lora,
+                training=False)
             return pick(logits[:, -1], key), caches
 
         @jax.jit
-        def decode(state, tok, caches, keys):
+        def decode(state, tok, caches, keys, lora=None):
             def body(carry, xs):
                 tok, caches, done = carry
                 key, pos = xs
                 (logits, caches), _ = functional_call(
                     self, state, tok[:, None], None, caches, pos,
-                    training=False)
+                    lora=lora, training=False)
                 nt = pick(logits[:, -1], key)
                 if eos_token_id is not None:
                     # once a row emits EOS, its later tokens pin to pad
@@ -540,9 +598,10 @@ class LlamaForCausalLM(Layer):
             return toks  # [max_new_tokens - 1, b]
 
         @jax.jit
-        def step(state, tok, caches, pos, key):
+        def step(state, tok, caches, pos, key, lora=None):
             (logits, caches), _ = functional_call(
-                self, state, tok[:, None], None, caches, pos, training=False)
+                self, state, tok[:, None], None, caches, pos, lora=lora,
+                training=False)
             return pick(logits[:, -1], key), caches
 
         cache[sig] = (prefill, decode, step)
@@ -554,7 +613,7 @@ class LlamaForCausalLM(Layer):
                  do_sample: bool = False, top_p: float = 1.0,
                  temperature: float = 1.0, seed: int | None = None,
                  jit_loop: bool = True, eos_token_id: int | None = None,
-                 pad_token_id: int | None = None, kv_dtype=None):
+                 pad_token_id: int | None = None, kv_dtype=None, lora=None):
         """Decode: one jitted prefill + the WHOLE token loop as one jitted
         ``lax.scan`` over the fixed-size KV cache (decode routes through the
         fused masked-MHA path). Two compiled programs total — the per-token
@@ -578,7 +637,14 @@ class LlamaForCausalLM(Layer):
 
         ``kv_dtype``: cache storage dtype — ``"int8"`` decodes over a
         quantized contiguous cache (the reference arm the serving
-        engine's int8 parity tests compare against)."""
+        engine's int8 parity tests compare against).
+
+        ``lora``: a ``(table, params, scales)`` adapter spec (e.g.
+        ``AdapterPool.lora_ref([slot] * b)``, serving/lora.py): every
+        projection gains its gathered low-rank delta through the SAME
+        ``_lora_delta`` graph the serving engine's compiled steps run —
+        the single-request reference arm of the engine==generate
+        bitwise parity contract, now per adapter."""
         input_ids = jnp.asarray(input_ids)
         b, s0 = input_ids.shape
         max_len = max_len or (s0 + max_new_tokens)
@@ -591,18 +657,18 @@ class LlamaForCausalLM(Layer):
         pad = pad_token_id if pad_token_id is not None else eos_token_id
 
         keys = jax.random.split(key0, max_new_tokens)
-        tok, caches = prefill(state, input_ids, caches, keys[0])
+        tok, caches = prefill(state, input_ids, caches, keys[0], lora)
         if max_new_tokens == 1:
             return jnp.concatenate([input_ids, tok[:, None]], axis=1)
         if jit_loop:
-            toks = decode(state, tok, caches, keys[1:])
+            toks = decode(state, tok, caches, keys[1:], lora)
             new = jnp.concatenate([tok[:, None], toks.T], axis=1)
             return jnp.concatenate([input_ids, new], axis=1)
 
         out = [tok]
         done = (tok == eos_token_id) if eos_token_id is not None else None
         for i in range(1, max_new_tokens):
-            tok, caches = step(state, tok, caches, s0 + i - 1, keys[i])
+            tok, caches = step(state, tok, caches, s0 + i - 1, keys[i], lora)
             if eos_token_id is not None:  # same pinning as the scan path
                 tok = jnp.where(done, jnp.int32(pad), tok.astype(jnp.int32))
                 done = done | (tok == eos_token_id)

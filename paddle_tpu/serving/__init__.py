@@ -29,6 +29,8 @@ from .fleet import FleetRequest, FleetRouter
 from .transport import (ChaosTransport, EngineServer, LoopbackTransport,
                         Message, Transport, deterministic_jitter)
 from .kv_cache import KVCachePool, PoolExhaustedError, PrefixMatch
+from .lora import (AdapterExhaustedError, AdapterPool,
+                   AdapterUnavailableError, LoRAAdapter)
 from .metrics import FleetMetrics, ServingMetrics, percentile
 from .parallel import (TPContext, collective_counts, partition_devices,
                        validate_tp_config)
@@ -51,6 +53,8 @@ __all__ = [
     "WAITING", "RUNNING", "PREEMPTED", "FINISHED",
     "SpeculativeConfig", "DraftProposer", "NgramDrafter",
     "HostTier",
+    "AdapterPool", "LoRAAdapter",
+    "AdapterExhaustedError", "AdapterUnavailableError",
     "SnapshotStore", "RequestSnapshot",
     "save_engine_snapshot", "load_engine_snapshot",
     "Workload", "WorkloadRequest", "WorkloadSpec", "heavy_tail_workload",

@@ -150,7 +150,8 @@ class ServingEngine:
                  tp_devices=None, fair_scheduling: bool = False,
                  tenant_weights=None, tenant_max_live: int | None = None,
                  tenant_max_queued_tokens: int | None = None,
-                 shed_infeasible: bool = False, brownout=None):
+                 shed_infeasible: bool = False, brownout=None,
+                 lora=None):
         cfg = model.config
         self.model = model
         self.page_size = page_size
@@ -213,6 +214,28 @@ class ServingEngine:
             fair=fair_scheduling, tenant_weights=tenant_weights,
             tenant_max_live=tenant_max_live,
             tenant_max_queued_tokens=tenant_max_queued_tokens)
+        # multi-tenant LoRA serving (serving/lora.py; SERVING.md
+        # "Multi-tenant LoRA serving"): lora=True builds an AdapterPool
+        # with defaults, a dict forwards kwargs, or pass a ready pool
+        # (share one across colocated engines). Per-slot adapter
+        # selection is an ARRAY lane of the two step programs — gather
+        # by adapter-table index — so churn across thousands of
+        # registered adapters never recompiles. tp>1 is gated here: the
+        # adapter buffers are replicated host-built arrays and the TP
+        # step's lane layout doesn't carry them yet.
+        from .lora import AdapterPool
+        if lora is True:
+            lora = AdapterPool(cfg)
+        elif isinstance(lora, dict):
+            lora = AdapterPool(cfg, **lora)
+        self.adapters: AdapterPool | None = lora or None
+        if self.adapters is not None and self.tp > 1:
+            from .errors import TPConfigError
+            raise TPConfigError(
+                "multi-tenant LoRA serving is single-shard for now: "
+                "adapter buffers are not laid out for the TP step "
+                "programs (pass tp=1 or lora=None)")
+        self.scheduler.adapters = self.adapters
         if brownout is True:
             brownout = BrownoutConfig()
         elif brownout is False:
@@ -277,6 +300,7 @@ class ServingEngine:
                             self.pool.kv_bytes_per_token_shard())
         self.metrics.set_fair(fair_scheduling)
         self.metrics.set_brownout(self._brownout is not None)
+        self.metrics.set_lora(self.adapters is not None)
         # observability (OBSERVABILITY.md): the tracer is shared with
         # the scheduler (request-lifecycle spans) and the pool
         # (eviction/COW/quarantine events); construct it on the same
@@ -329,7 +353,8 @@ class ServingEngine:
                     deadline_s: float | None = None,
                     max_queue_wait_s: float | None = None,
                     tenant: int = 0, priority: int = 0,
-                    prefill_only: bool = False) -> str:
+                    prefill_only: bool = False,
+                    adapter=None) -> str:
         """Admission control happens HERE, not in the scheduler loop:
         a request that can never run raises RequestTooLargeError, a full
         bounded queue raises QueueFullError, a draining engine raises
@@ -353,7 +378,13 @@ class ServingEngine:
         emitting the first token — exports the finished KV to the
         handoff outbox (:meth:`take_handoffs`) and finishes the request
         with reason ``"handoff"``; a decode-role replica emits every
-        token of the stream."""
+        token of the stream. ``adapter`` names the LoRA adapter to
+        decode with (a registered name, hex digest, digest bytes, or
+        LoRAAdapter — resolved by the engine's AdapterPool; requires
+        ``lora=...`` at construction): an unknown adapter is rejected
+        HERE with AdapterUnavailableError, and the stream is bitwise
+        identical to ``generate()`` with that adapter merged into the
+        base weights."""
         if self._draining:
             raise EngineDrainingError(
                 "engine is draining (preempted or shut down); retry on "
@@ -362,6 +393,14 @@ class ServingEngine:
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("prompt must be non-empty")
+        adapter_hex = ""
+        if adapter is not None and adapter != "":
+            from .lora import AdapterUnavailableError
+            if self.adapters is None:
+                raise AdapterUnavailableError(
+                    "engine was built without lora=...; pass "
+                    "lora=True (or an AdapterPool) to serve adapters")
+            adapter_hex = self.adapters.resolve(adapter).hex()
         try:
             self.admission_check(len(prompt), max_new_tokens)
         except RequestTooLargeError:
@@ -389,7 +428,7 @@ class ServingEngine:
                       max_queue_wait_s=max_queue_wait_s,
                       arrival_t=self.metrics.now(),
                       tenant=int(tenant), priority=int(priority),
-                      handoff=bool(prefill_only))
+                      handoff=bool(prefill_only), adapter=adapter_hex)
         try:
             self.scheduler.add(req, self.pool)
         except QueueFullError:
@@ -402,6 +441,19 @@ class ServingEngine:
         self.metrics.on_arrival(rid, tenant=int(tenant),
                                 priority=int(priority))
         return rid
+
+    def register_adapter(self, adapter) -> str:
+        """Register a :class:`serving.lora.LoRAAdapter` with this
+        engine's AdapterPool and return its content digest (hex) — the
+        handle ``add_request(adapter=...)``, fleet ``submit`` and
+        snapshots carry. Registration spills the payload to the pool's
+        host tier; device residency is paid lazily at first admission."""
+        from .lora import AdapterUnavailableError
+        if self.adapters is None:
+            raise AdapterUnavailableError(
+                "engine was built without lora=...; pass lora=True "
+                "(or an AdapterPool) to register adapters")
+        return self.adapters.register(adapter)
 
     def admission_check(self, prompt_len: int, max_new_tokens: int) -> None:
         """Raise RequestTooLargeError if a request of this geometry can
@@ -589,6 +641,12 @@ class ServingEngine:
                                + (self.scheduler.spec_k - 1))
                     with tr.span("prefill_dispatch", rid=req.rid):
                         self._run_prefill(req, events)
+        # adapter admit failures (lost/corrupt payload at acquire —
+        # serving.lora_fetch chaos or a dropped host tier): terminal,
+        # typed, never silently served base weights
+        for req in self.scheduler.admit_failures:
+            self._finish_abnormal(req, "adapter_unavailable", events)
+        self.scheduler.admit_failures.clear()
         # drafts are proposed BEFORE the page guarantee so
         # ensure_decode_pages covers the speculative writes too
         if self._spec is not None and self.scheduler.running:
@@ -617,6 +675,8 @@ class ServingEngine:
         self.metrics.on_prefix_counters(self.pool.counters)
         if self.pool.host_tier is not None:
             self.metrics.on_tier_stats(self.pool.host_tier.stats())
+        if self.adapters is not None:
+            self.metrics.on_lora_stats(self.adapters.stats())
         self.metrics.on_step(self.scheduler.queue_depth,
                              self.pool.utilization())
         self._steps += 1
@@ -847,8 +907,24 @@ class ServingEngine:
                 # reaches the pool — fall back to recompute
                 inject = False
                 self.metrics.counters["snapshot_restore_corrupt"] += 1
+        # multi-tenant LoRA: an adapter-bound snapshot restores only on
+        # an engine that can actually serve that adapter — unknown here
+        # means typed refusal (the router retries elsewhere), never a
+        # silent base-model resume. Its injected KV lands under the
+        # adapter's prefix-cache namespace, so the re-admission match
+        # finds it and a foreign adapter's identical prompt cannot.
+        if snap.adapter:
+            from .lora import AdapterUnavailableError
+            if self.adapters is None:
+                raise AdapterUnavailableError(
+                    f"snapshot {rid!r} is bound to adapter "
+                    f"{snap.adapter[:12]}... but this engine was built "
+                    f"without lora=...")
+            self.adapters.resolve(snap.adapter)
         if inject:
-            self.pool.inject_prefix(snap.seq(), snap.payloads)
+            self.pool.inject_prefix(snap.seq(), snap.payloads,
+                                    namespace=bytes.fromhex(snap.adapter)
+                                    if snap.adapter else b"")
         req = Request(rid=rid, prompt=list(snap.prompt),
                       max_new_tokens=snap.max_new_tokens,
                       sampling=SamplingParams(
@@ -856,7 +932,8 @@ class ServingEngine:
                           do_sample=snap.do_sample, seed=snap.seed),
                       eos_token_id=snap.eos_token_id,
                       arrival_t=self.metrics.now(),
-                      tenant=int(tenant), priority=int(priority))
+                      tenant=int(tenant), priority=int(priority),
+                      adapter=snap.adapter)
         req.tokens = list(snap.tokens)
         try:
             self.scheduler.add(req, self.pool)
@@ -924,7 +1001,8 @@ class ServingEngine:
                 seed=r.sampling.seed, arrival_seq=r.arrival_seq,
                 tokens=list(r.tokens), context_len=int(r.context_len),
                 step=self._steps, kv_tag=self.pool._tier_tag,
-                page_size=ps, payloads=payloads).seal())
+                page_size=ps, payloads=payloads,
+                adapter=r.adapter).seal())
         return snaps
 
     def _capture_snapshots(self) -> None:
@@ -1003,7 +1081,8 @@ class ServingEngine:
             seed=req.sampling.seed, arrival_seq=req.arrival_seq,
             tokens=list(req.tokens), context_len=int(req.context_len),
             step=self._steps, kv_tag=self.pool._tier_tag,
-            page_size=ps, payloads=payloads).seal()
+            page_size=ps, payloads=payloads,
+            adapter=req.adapter).seal()
 
     def _handoff_finish(self, req: Request, events: list[dict]) -> None:
         """Final-chunk completion of a prefill-only request: export its
@@ -1095,13 +1174,14 @@ class ServingEngine:
         if decode:
             _, _, pools = self._decode_step(
                 self._state, self.pool.pools, zi, tables, zi, zb,
-                ones, ones, gt, zi, zi)
+                ones, ones, gt, zi, zi, *self._lora_args())
             self.pool.pools = pools
         if mixed:
             _, _, _, pools = self._mixed_step(
                 self._state, self.pool.pools,
                 jnp.zeros((S, K), jnp.int32),
-                tables, zi, zb, zi, zb, ones, ones, gt, zi, zi)
+                tables, zi, zb, zi, zb, ones, ones, gt, zi, zi,
+                *self._lora_args())
             self.pool.pools = pools
         self._note_retraces()
 
@@ -1129,6 +1209,8 @@ class ServingEngine:
                 "fair": self.scheduler.fair,
                 "brownout": self._brownout is not None,
                 "brownout_level": self._brownout_level,
+                "lora": (self.adapters.stats()
+                         if self.adapters is not None else None),
                 "tracing": self.tracer.enabled}
 
     @property
@@ -1312,15 +1394,42 @@ class ServingEngine:
     # compiled programs
     # ------------------------------------------------------------------
 
+    def _lora_args(self, atable=None) -> tuple:
+        """Trailing step-program args when LoRA serving is on: the
+        ``[max_slots]`` adapter-table lane (slot -> AdapterPool slot)
+        plus the pool's padded device buffers. Empty tuple when off, so
+        the base engine's call signature — and compiled program — is
+        byte-identical to the pre-LoRA engine."""
+        if self.adapters is None:
+            return ()
+        if atable is None:
+            atable = np.zeros((self.max_slots,), np.int32)
+        return (jnp.asarray(atable, jnp.int32), self.adapters.buffers())
+
+    def _slot_atable(self) -> np.ndarray:
+        """The adapter-table lane for the CURRENT running set (0 for
+        free slots — the identity adapter)."""
+        atable = np.zeros((self.max_slots,), np.int32)
+        for slot, req in self.scheduler.running.items():
+            atable[slot] = req.adapter_slot
+        return atable
+
     def _build_decode_step(self):
         from ..nn.module import functional_call
         model = self.model
 
         def decode_step(state, pools, tok, tables, seq_lens, active,
-                        temps, top_ps, greedy, seeds, counts):
+                        temps, top_ps, greedy, seeds, counts,
+                        atable=None, lbuf=None):
+            # multi-tenant LoRA: atable is the [max_slots] adapter-table
+            # lane (slot -> AdapterPool slot; 0 = identity) and lbuf the
+            # pool's padded A/B buffers + scales. A lora engine passes
+            # them on EVERY call, a base engine never does — either way
+            # one treedef, one compiled program.
+            lora = None if lbuf is None else (atable, lbuf[0], lbuf[1])
             (logits, pools), _ = functional_call(
                 model, state, tok[:, None], None, pools, 0,
-                (tables, seq_lens, active), training=False)
+                (tables, seq_lens, active), lora=lora, training=False)
             last = logits[:, -1]
             # per-slot poison sentinel: rows are independent, so a
             # non-finite row indicts exactly one slot
@@ -1373,10 +1482,12 @@ class ServingEngine:
 
         def mixed_step(state, pools, toks, tables, seq_lens, active,
                        n_live, forced, temps, top_ps, greedy, seeds,
-                       counts):
+                       counts, atable=None, lbuf=None):
+            lora = None if lbuf is None else (atable, lbuf[0], lbuf[1])
             (logits, pools), _ = functional_call(
                 model, state, toks, None, pools, 0,
-                (tables, seq_lens, active, n_live), training=False)
+                (tables, seq_lens, active, n_live), lora=lora,
+                training=False)
             S, K, V = logits.shape
             rows = jnp.arange(K)
             live = rows[None, :] < n_live[:, None]            # [S, K]
@@ -1491,13 +1602,16 @@ class ServingEngine:
                 # the pass on this request's next token index (earlier
                 # rows sample at stale indices and are discarded)
                 counts[slot] = len(req.tokens) - (n - 1)
+                atable = np.zeros((S,), np.int32)
+                atable[slot] = req.adapter_slot
                 samp, _, ok, new_pools = self._mixed_step(
                     self._state, self.pool.pools, jnp.asarray(toks),
                     jnp.asarray(tables), jnp.asarray(seq_lens),
                     jnp.asarray(active), jnp.asarray(n_live),
                     jnp.asarray(forced), jnp.asarray(temps),
                     jnp.asarray(top_ps), jnp.asarray(greedy),
-                    jnp.asarray(seeds), jnp.asarray(counts))
+                    jnp.asarray(seeds), jnp.asarray(counts),
+                    *self._lora_args(atable))
                 self.pool.pools = new_pools
                 samp, ok = self._watched_sync(samp, ok)
                 start += n
@@ -1532,7 +1646,8 @@ class ServingEngine:
         # pages are immutable from here on; the trailing partial page
         # keeps filling during decode and is registered at release.
         self.pool.register_prefix(seq[:n_valid], req.pages,
-                                  include_partial=False)
+                                  include_partial=False,
+                                  namespace=req.adapter_ns)
         if req.tokens:
             return  # recompute after preemption: cache rebuilt, the stored
                     # last token is the next decode input — no new emission
@@ -1636,7 +1751,8 @@ class ServingEngine:
                 jnp.asarray(tables), jnp.asarray(seq_lens),
                 jnp.asarray(active), jnp.asarray(temps),
                 jnp.asarray(top_ps), jnp.asarray(greedy),
-                jnp.asarray(seeds), jnp.asarray(counts))
+                jnp.asarray(seeds), jnp.asarray(counts),
+                *self._lora_args(self._slot_atable()))
             self.pool.pools = new_pools
         self._note_retraces()
         nt, ok = self._watched_sync(nt, ok)
@@ -1728,7 +1844,8 @@ class ServingEngine:
                 jnp.asarray(active), jnp.asarray(n_live),
                 jnp.asarray(forced), jnp.asarray(temps),
                 jnp.asarray(top_ps), jnp.asarray(greedy),
-                jnp.asarray(seeds), jnp.asarray(counts))
+                jnp.asarray(seeds), jnp.asarray(counts),
+                *self._lora_args(self._slot_atable()))
             self.pool.pools = new_pools
         self._note_retraces()
         samp, acc, ok = self._watched_sync(samp, acc, ok)
@@ -1773,7 +1890,8 @@ class ServingEngine:
                     seq = req.prompt + req.tokens[:-1]
                     self.pool.register_prefix(seq[:req.prefill_target],
                                               req.pages,
-                                              include_partial=False)
+                                              include_partial=False,
+                                              namespace=req.adapter_ns)
                     if self.kv_quant:
                         qs = self._qscale_max(req.pages)
                         self.metrics.on_kv_quant_scale(qs)

@@ -178,6 +178,8 @@ class FleetRequest:
     handoff_wait_since: int = 0     # router step the wait (offer/pull) began
     handoff_fallback: bool = False  # degraded to plain colocated recompute
     handoff_committed: bool = False  # KV_COMMIT sent (held copy freed)
+    # --- multi-tenant LoRA (SERVING.md "Multi-tenant LoRA serving") ---
+    adapter: str = ""               # adapter digest (hex); "" = base model
 
 
 @dataclass
@@ -345,7 +347,8 @@ class FleetRouter:
                rid: str | None = None,
                deadline_s: float | None = None,
                max_queue_wait_s: float | None = None,
-               tenant: int = 0, priority: int = 0) -> str:
+               tenant: int = 0, priority: int = 0,
+               adapter: str = "") -> str:
         """Fleet admission. A full global queue sheds with
         :class:`FleetOverloadedError` (carrying ``retry_after_s``, the
         router's drain-rate estimate — RESILIENCE.md "Overload
@@ -357,9 +360,14 @@ class FleetRouter:
         probe and dispatch classification covers it). ``tenant`` /
         ``priority`` ride the record to every placement (fair
         scheduling, quotas and brownout shed order on the replicas —
-        SERVING.md "Overload control & tenant fairness"). Placement
-        happens at the next ``step()``, not here: dispatch failures are
-        the router's to retry, never the client's."""
+        SERVING.md "Overload control & tenant fairness"). ``adapter``
+        (a LoRA adapter digest, hex) rides the record too: placement
+        gains an adapter-residency affinity bonus and every failover
+        replay re-binds the same adapter — a stream never silently
+        resumes on base weights (SERVING.md "Multi-tenant LoRA
+        serving"). Placement happens at the next ``step()``, not here:
+        dispatch failures are the router's to retry, never the
+        client's."""
         if self._draining:
             raise EngineDrainingError(
                 "fleet is draining (preempted or shut down); "
@@ -395,7 +403,8 @@ class FleetRouter:
                            deadline_s=deadline_s,
                            max_queue_wait_s=max_queue_wait_s,
                            submit_seq=self._submit_seq,
-                           tenant=int(tenant), priority=int(priority))
+                           tenant=int(tenant), priority=int(priority),
+                           adapter=str(adapter or ""))
         self._submit_seq += 1
         self._records[rid] = rec
         self._pending.append(rec)
@@ -970,9 +979,20 @@ class FleetRouter:
         """Cached-prefix tokens this replica's pool already holds for
         the prompt — the transport's advisory query against the
         content-hash index (0 for an unreachable replica: a partition
-        costs affinity, never correctness)."""
+        costs affinity, never correctness). An adapter-bound request
+        adds an ADAPTER residency bonus (SERVING.md "Multi-tenant LoRA
+        serving"): a replica whose AdapterPool already holds the
+        adapter's weights resident skips the host-tier stream-in, worth
+        more than a few cached prompt tokens — the server weighs it as
+        one full page of cached tokens. Prompt-prefix hits can only
+        come from same-adapter requests anyway (the prefix index is
+        namespaced per adapter), so the two signals compose instead of
+        conflicting."""
+        payload = {"prompt": rec.prompt}
+        if rec.adapter:
+            payload["adapter"] = rec.adapter
         res = self._transport.query(f"replica:{rep.idx}", "affinity",
-                                    {"prompt": rec.prompt})
+                                    payload)
         return int(res["cached_tokens"]) if res else 0
 
     def _usable_snapshot(self, rec: FleetRequest):
@@ -1034,6 +1054,8 @@ class FleetRouter:
                    "max_queue_wait_s": rec.max_queue_wait_s,
                    "tenant": rec.tenant, "priority": rec.priority,
                    "ack": rep.applied_seq}
+        if rec.adapter:
+            payload["adapter"] = rec.adapter
         if prefill_only:
             payload["prefill_only"] = True
         if kind == "KV_PULL":

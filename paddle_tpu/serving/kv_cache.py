@@ -460,14 +460,28 @@ class KVCachePool:
 
     # ---- the prefix index ----
 
+    def _namespaced_root(self, namespace: bytes = b"") -> bytes:
+        """Chain root for a (possibly namespaced) prefix walk. A LoRA
+        request's KV depends on its adapter — the same system prompt
+        produces DIFFERENT page content under adapter X and adapter Y —
+        so each adapter's chain starts from a root derived from the
+        adapter's content digest, and a cross-adapter lookup can never
+        alias (same mechanism as the fp/int8 root split above)."""
+        if not namespace:
+            return self._hash_root
+        return hashlib.blake2b(self._hash_root + namespace,
+                               digest_size=16).digest()
+
     def match_prefix(self, tokens, max_tokens: int | None = None,
-                     count: bool = False) -> PrefixMatch:
+                     count: bool = False,
+                     namespace: bytes = b"") -> PrefixMatch:
         """Longest cached prefix of ``tokens`` at page granularity:
         full pages walked by the chained content hash, then the longest
         indexed partial continuation of the next page. Pure lookup —
         takes no references (callers ``acquire`` what they keep). Pass
         ``count=True`` to tally the hit counters (one tally per
-        admission, not per probe)."""
+        admission, not per probe). ``namespace`` scopes the walk to one
+        adapter's chain (see ``_namespaced_root``)."""
         limit = len(tokens) if max_tokens is None else min(max_tokens,
                                                            len(tokens))
         m = PrefixMatch()
@@ -475,7 +489,7 @@ class KVCachePool:
             return m
         ps = self.page_size
         tier = self.host_tier
-        parent = self._hash_root
+        parent = self._namespaced_root(namespace)
         pos = 0
         while pos + ps <= limit:
             key = _page_hash(parent, tokens[pos:pos + ps])
@@ -534,7 +548,8 @@ class KVCachePool:
                 self.counters["prefix_partial_hits"] += 1
 
     def register_prefix(self, tokens, pages: list[int],
-                        include_partial: bool = True) -> int:
+                        include_partial: bool = True,
+                        namespace: bytes = b"") -> int:
         """Index a request's materialized prefix: page i of ``pages``
         holds ``tokens[i*ps:(i+1)*ps]``. Full pages are registered under
         the chained hash; the trailing partial page (content frozen —
@@ -551,7 +566,7 @@ class KVCachePool:
             return 0
         ps = self.page_size
         n_full = min(len(tokens) // ps, len(pages))
-        parent = self._hash_root
+        parent = self._namespaced_root(namespace)
         registered = 0
         for i in range(n_full):
             key = _page_hash(parent, tokens[i * ps:(i + 1) * ps])
@@ -797,7 +812,8 @@ class KVCachePool:
                             bytes=nbytes, partial=True)
         self.tracer.bump("restores", 1, track="pool")
 
-    def inject_prefix(self, tokens, payloads) -> int:
+    def inject_prefix(self, tokens, payloads,
+                      namespace: bytes = b"") -> int:
         """Write externally-held page payloads (a request snapshot —
         serving/snapshot.py) into the pool and register them under the
         chained content hash as refcount-0 CACHED pages, exactly as if
@@ -816,7 +832,7 @@ class KVCachePool:
             return 0
         ps = self.page_size
         n_full = len(tokens) // ps
-        parent = self._hash_root
+        parent = self._namespaced_root(namespace)
         injected = 0
         for i in range(min(n_full, len(payloads))):
             key = _page_hash(parent, tokens[i * ps:(i + 1) * ps])

@@ -1,0 +1,480 @@
+"""Multi-tenant LoRA adapter serving: a paged adapter pool over one
+base model (S-LoRA, Sheng et al. MLSys'24; Punica, Chen et al.
+MLSys'24).
+
+One base model stays resident; thousands of low-rank adapters page
+through a fixed pool of HBM *adapter slots*, exactly the shape the KV
+pool already built for pages (kv_cache.py): content-hash identity,
+refcounts, LRU eviction of refcount-0 residents, and blake2b-digest-
+verified spill/restore through the existing :class:`HostTier` payload
+format (tiering.py, tag ``"lora"``).
+
+The device layout is the gathered-batch form the engine's two compiled
+programs consume: per projection target ``t`` one pair of buffers
+
+    A[t]: [max_live, num_layers, in_dim,   max_rank]
+    B[t]: [max_live, num_layers, max_rank, out_dim]
+
+plus ``scales: [max_live] f32`` (= alpha/rank per slot). A request's
+slot index selects its adapter through a ``[max_slots]`` adapter-table
+array — an array VALUE, like a block table, so arbitrary adapter churn
+never retraces (``step_program_counts()`` stays ``{decode: 1,
+mixed: 1}``). Slot 0 is the reserved identity adapter: all-zero A/B
+and scale 0, so a base-model request's delta is exactly zero.
+
+Ranks below ``max_rank`` are zero-padded at load time; the padded
+columns contribute exact zeros to the delta, so a rank-4 adapter in a
+rank-8 pool computes the same values it would in a rank-4 pool.
+
+Invariants (mirroring the KV pool's):
+- the device buffers are allocated ONCE at construction and only ever
+  updated with functional ``.at[]`` writes on the host-side load/evict
+  paths — never inside a compiled program;
+- slot 0 is never handed out and never written;
+- a slot with refcount > 0 is never evicted or rewritten; refcount-0
+  residents stay on an LRU and are reclaimed oldest-first;
+- an adapter's identity is the blake2b-128 digest of its payload
+  (weights + rank/alpha meta); the host tier re-verifies that digest
+  at every fetch, so a corrupted spill can never load silently — the
+  request fails typed (:class:`AdapterUnavailableError`), never with
+  wrong tokens.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import jax.numpy as jnp
+import numpy as np
+
+from .errors import ServingError
+from .tiering import HostTier, _payload_digest
+
+__all__ = ["LoRAAdapter", "AdapterPool", "AdapterExhaustedError",
+           "AdapterUnavailableError", "llama_lora_targets"]
+
+
+class AdapterExhaustedError(ServingError):
+    """``acquire`` found no free slot and no evictable refcount-0
+    resident: every live slot is pinned by a running request. The
+    scheduler treats it like pool exhaustion — the request waits at
+    the head of the queue until a running request releases its slot.
+    Retryable by construction (capacity frees as requests finish)."""
+
+    retryable = True
+
+
+class AdapterUnavailableError(ServingError):
+    """The adapter cannot be materialized here: it was never
+    registered on this engine, or its host-tier payload was evicted
+    or failed the blake2b digest re-verify (corruption is DETECTED,
+    never served). Retryable on another replica that still holds an
+    intact copy; never silently degraded to the base model."""
+
+    retryable = True
+
+
+def llama_lora_targets(config):
+    """The seven projection targets of a Llama decoder layer as
+    ``(name, in_dim, out_dim)`` triples — the classic full-target LoRA
+    set (q/k/v/o + gate/up/down)."""
+    h = config.num_attention_heads * config.head_dim
+    kv = config.num_key_value_heads * config.head_dim
+    hs, im = config.hidden_size, config.intermediate_size
+    return (("q_proj", hs, h), ("k_proj", hs, kv), ("v_proj", hs, kv),
+            ("o_proj", h, hs), ("gate_proj", hs, im), ("up_proj", hs, im),
+            ("down_proj", im, hs))
+
+
+class LoRAAdapter:
+    """One adapter's host-side weights: per-target ``(A, B)`` numpy
+    pairs, ``A: [num_layers, in_dim, rank]``, ``B: [num_layers, rank,
+    out_dim]``, plus the classic ``alpha/rank`` scale. Identity is the
+    blake2b-128 digest of the payload (weights + meta), computed once
+    at construction — the content hash the pool keys slots by."""
+
+    def __init__(self, name: str, params: dict, rank: int,
+                 alpha: float | None = None):
+        self.name = str(name)
+        self.rank = int(rank)
+        self.alpha = float(alpha if alpha is not None else rank)
+        self.params = {t: (np.asarray(a, np.float32),
+                           np.asarray(b, np.float32))
+                       for t, (a, b) in params.items()}
+        for t, (a, b) in self.params.items():
+            if a.shape[-1] != self.rank or b.shape[-2] != self.rank:
+                raise ValueError(
+                    f"target {t}: A{a.shape}/B{b.shape} do not carry "
+                    f"rank {self.rank}")
+        self.digest = _payload_digest(self.payload())
+
+    @classmethod
+    def random(cls, name: str, config, rank: int = 4,
+               alpha: float | None = None, seed: int = 0,
+               scale: float = 0.02, targets=None) -> "LoRAAdapter":
+        """Deterministic random adapter for tests/benchmarks (seeded
+        numpy, never jax — host-side identity must not depend on the
+        accelerator)."""
+        rng = np.random.default_rng(seed)
+        L = config.num_hidden_layers
+        params = {}
+        for t, din, dout in (targets or llama_lora_targets(config)):
+            params[t] = (
+                rng.standard_normal((L, din, rank)).astype(np.float32)
+                * scale,
+                rng.standard_normal((L, rank, dout)).astype(np.float32)
+                * scale)
+        return cls(name, params, rank, alpha)
+
+    def payload(self) -> list:
+        """HostTier payload form (tiering.py): a flat list of
+        contiguous numpy arrays — one f32 meta row ``[rank, alpha]``
+        followed by A, B per target in sorted-name order. The digest
+        over this list IS the adapter's identity."""
+        parts = [np.asarray([self.rank, self.alpha], np.float32)]
+        for t in sorted(self.params):
+            a, b = self.params[t]
+            parts.append(np.ascontiguousarray(a))
+            parts.append(np.ascontiguousarray(b))
+        return parts
+
+    @property
+    def nbytes(self) -> int:
+        return sum(a.nbytes + b.nbytes for a, b in self.params.values())
+
+    def merged_into(self, state: dict, prefix: str = "model.layers"):
+        """Fold this adapter into a base-model state dict:
+        ``W_eff = W + scale * (A @ B)`` per target per layer — the
+        reference arm of the engine==merged-generate parity tests.
+        Returns a NEW state dict (the input is not mutated)."""
+        out = dict(state)
+        s = self.alpha / self.rank
+        for t, (a, b) in self.params.items():
+            for li in range(a.shape[0]):
+                sub = "self_attn" if t.endswith(("q_proj", "k_proj",
+                                                "v_proj", "o_proj")) \
+                    else "mlp"
+                key = f"{prefix}.{li}.{sub}.{t}.weight"
+                w = np.asarray(out[key], np.float32)
+                out[key] = jnp.asarray(
+                    w + s * (a[li] @ b[li]), out[key].dtype)
+        return out
+
+
+class AdapterPool:
+    """Paged HBM pool of LoRA adapters behind one base model.
+
+    ``max_live`` counts SLOTS including the reserved identity slot 0;
+    ``max_rank`` is the padded rank every loaded adapter occupies.
+    Registration parks the digest-verified payload in the host tier
+    (tag ``"lora"``); ``acquire`` pages it into a slot on first use
+    and refcounts it across requests; refcount-0 slots linger on an
+    LRU and are evicted (spilled back if the tier lost the payload)
+    only when a miss needs the slot."""
+
+    def __init__(self, config, max_live: int = 8, max_rank: int = 8,
+                 dtype=jnp.float32, host_tier=None, targets=None):
+        if max_live < 2:
+            raise ValueError("max_live must be >= 2 (slot 0 is the "
+                             "reserved identity adapter)")
+        self.config = config
+        self.max_live = int(max_live)
+        self.max_rank = int(max_rank)
+        self.dtype = dtype
+        self.targets = tuple(targets or llama_lora_targets(config))
+        L = config.num_hidden_layers
+        self.num_layers = L
+        # gathered-batch device buffers, slot 0 = identity (all zero)
+        self._A = {t: jnp.zeros((max_live, L, din, max_rank), dtype)
+                   for t, din, dout in self.targets}
+        self._B = {t: jnp.zeros((max_live, L, max_rank, dout), dtype)
+                   for t, din, dout in self.targets}
+        self._scales = jnp.zeros((max_live,), jnp.float32)
+        if host_tier is None or host_tier is True:
+            host_tier = HostTier()
+        elif isinstance(host_tier, int) and not isinstance(host_tier, bool):
+            host_tier = HostTier(max_bytes=host_tier)
+        self.host_tier: HostTier = host_tier
+        # slot accounting (host-side integers, mirrors KVCachePool)
+        self._free = list(range(max_live - 1, 0, -1))
+        self._ref: dict[int, int] = {}
+        self._slot_key: dict[int, bytes] = {}
+        self._key_slot: dict[bytes, int] = {}
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        # digest -> {name, rank, alpha, nbytes}; name -> digest
+        self._registry: dict[bytes, dict] = {}
+        self._names: dict[str, bytes] = {}
+        self._peak_live = 0
+        self.fault_step: int | None = None
+        self.fault_path: str | None = None
+        self.counters: dict[str, int] = {
+            "adapter_hits": 0, "adapter_misses": 0, "adapter_loads": 0,
+            "adapter_evictions": 0, "adapter_spills": 0,
+            "adapter_restore_corrupt": 0, "adapter_unavailable": 0,
+            "lora_bytes_streamed": 0,
+        }
+
+    # ---- registration / identity ----
+
+    def register(self, adapter: LoRAAdapter) -> str:
+        """Park the adapter's digest-verified payload in the host tier
+        and remember its meta; returns the hex content digest (the
+        value requests pass as ``adapter=``). Re-registering identical
+        content is a no-op returning the same digest."""
+        key = adapter.digest
+        if key not in self._registry:
+            if not self.host_tier.put("lora", "full", key,
+                                      adapter.payload()):
+                raise AdapterUnavailableError(
+                    f"adapter {adapter.name!r} ({adapter.nbytes} bytes) "
+                    f"does not fit the host tier budget")
+            self._registry[key] = {"name": adapter.name,
+                                   "rank": adapter.rank,
+                                   "alpha": adapter.alpha,
+                                   "nbytes": adapter.nbytes}
+        self._names[adapter.name] = key
+        return key.hex()
+
+    def resolve(self, ref) -> bytes:
+        """Adapter reference -> content digest: accepts a registered
+        name, a hex digest string, or raw digest bytes. Unknown refs
+        fail typed at submission time, never at decode time."""
+        if isinstance(ref, LoRAAdapter):
+            ref = ref.digest
+        if isinstance(ref, bytes):
+            key = ref
+        elif ref in self._names:
+            key = self._names[ref]
+        else:
+            try:
+                key = bytes.fromhex(ref)
+            except (ValueError, TypeError):
+                raise AdapterUnavailableError(
+                    f"unknown adapter {ref!r}: not a registered name "
+                    f"or digest") from None
+        if key not in self._registry:
+            raise AdapterUnavailableError(
+                f"adapter {ref!r} is not registered on this engine")
+        return key
+
+    def resident(self, key: bytes) -> bool:
+        """True when the adapter is HBM-resident right now (pinned or
+        cached) — the fleet router's adapter-affinity signal."""
+        return key in self._key_slot
+
+    # ---- slot lifecycle ----
+
+    def acquire(self, key: bytes) -> int:
+        """Pin the adapter into a slot (loading it on a miss) and take
+        a reference; returns the slot index for the adapter table.
+        ``b""`` is the identity adapter: slot 0, no refcounting.
+        Raises :class:`AdapterExhaustedError` when every slot is
+        pinned, :class:`AdapterUnavailableError` when the payload is
+        gone or corrupt (digest re-verify failed)."""
+        if not key:
+            return 0
+        slot = self._key_slot.get(key)
+        if slot is not None:
+            r = self._ref.get(slot, 0)
+            if r == 0:
+                self._lru.pop(slot, None)
+            self._ref[slot] = r + 1
+            self.counters["adapter_hits"] += 1
+            self._peak_live = max(self._peak_live, self.num_live)
+            return slot
+        self.counters["adapter_misses"] += 1
+        if key not in self._registry:
+            raise AdapterUnavailableError(
+                f"adapter {key.hex()[:12]} is not registered here")
+        if not self._free and not self._lru:
+            raise AdapterExhaustedError(
+                f"all {self.max_live - 1} adapter slots are pinned")
+        # fault site ``serving.lora_fetch``: ``poison`` corrupts the
+        # host-tier payload so the digest re-verify at fetch MUST catch
+        # it; ``raise`` models a lost payload. Either way the request
+        # fails typed — never a silent base-model fallback.
+        from ..distributed import fault as _fault
+        tier = self.host_tier
+        try:
+            _fault.trip("serving.lora_fetch", step=self.fault_step,
+                        path=self.fault_path or key.hex(),
+                        poison=lambda: tier.corrupt("lora", "full", key))
+        except _fault.FaultInjected as e:
+            self.counters["adapter_unavailable"] += 1
+            raise AdapterUnavailableError(
+                f"injected adapter-fetch fault: {e}") from e
+        before = tier.counters["restore_corrupt_detected"]
+        payload = tier.fetch("lora", "full", key)
+        if payload is None:
+            if tier.counters["restore_corrupt_detected"] > before:
+                self.counters["adapter_restore_corrupt"] += 1
+            self.counters["adapter_unavailable"] += 1
+            raise AdapterUnavailableError(
+                f"adapter {self._registry[key]['name']!r} payload is "
+                f"missing or corrupt in the host tier")
+        slot = self._free.pop() if self._free else self._evict_one()
+        self._write_slot(slot, payload)
+        nbytes = sum(a.nbytes for a in payload)
+        tier.on_restored(nbytes)
+        self.counters["adapter_loads"] += 1
+        self.counters["lora_bytes_streamed"] += nbytes
+        self._slot_key[slot] = key
+        self._key_slot[key] = slot
+        self._ref[slot] = 1
+        self._peak_live = max(self._peak_live, self.num_live)
+        return slot
+
+    def release(self, slot: int) -> None:
+        """Drop one reference; a refcount-0 slot stays resident on the
+        LRU (a popular adapter's next request is a free hit)."""
+        if slot == 0:
+            return
+        r = self._ref.get(slot, 0) - 1
+        if r > 0:
+            self._ref[slot] = r
+            return
+        self._ref.pop(slot, None)
+        if slot in self._slot_key:
+            self._lru[slot] = None
+            self._lru.move_to_end(slot)
+
+    def _evict_one(self) -> int:
+        """Reclaim the LRU-oldest refcount-0 slot, spilling its payload
+        back to the host tier first if the tier no longer holds it (the
+        spill-before-deregister rule the KV pool follows)."""
+        slot, _ = self._lru.popitem(last=False)
+        key = self._slot_key.pop(slot)
+        del self._key_slot[key]
+        tier = self.host_tier
+        if not tier.has("lora", "full", key):
+            payload = self._slot_payload(slot, key)
+            if tier.put("lora", "full", key, payload):
+                self.counters["adapter_spills"] += 1
+                self.counters["lora_bytes_streamed"] += sum(
+                    a.nbytes for a in payload)
+        self.counters["adapter_evictions"] += 1
+        return slot
+
+    # ---- device buffer I/O (host-side functional .at[] writes) ----
+
+    def _write_slot(self, slot: int, payload: list) -> None:
+        rank = int(round(float(payload[0][0])))
+        alpha = float(payload[0][1])
+        it = iter(payload[1:])
+        per = {}
+        for t in sorted(n for n, _, _ in self.targets):
+            per[t] = (next(it), next(it))
+        if rank > self.max_rank:
+            raise AdapterUnavailableError(
+                f"adapter rank {rank} exceeds the pool max_rank "
+                f"{self.max_rank}")
+        for t, din, dout in self.targets:
+            a, b = per[t]
+            a_pad = np.zeros((self.num_layers, din, self.max_rank),
+                             np.float32)
+            b_pad = np.zeros((self.num_layers, self.max_rank, dout),
+                             np.float32)
+            a_pad[:, :, :rank] = a
+            b_pad[:, :rank, :] = b
+            self._A[t] = self._A[t].at[slot].set(
+                jnp.asarray(a_pad, self.dtype))
+            self._B[t] = self._B[t].at[slot].set(
+                jnp.asarray(b_pad, self.dtype))
+        self._scales = self._scales.at[slot].set(alpha / rank)
+
+    def _slot_payload(self, slot: int, key: bytes) -> list:
+        """Rebuild the native-rank payload from the padded device slot
+        (the spill path; bit-exact for f32 buffers because the pad
+        columns are exact zeros and the slice drops them)."""
+        meta = self._registry[key]
+        rank, alpha = meta["rank"], meta["alpha"]
+        parts = [np.asarray([rank, alpha], np.float32)]
+        for t in sorted(n for n, _, _ in self.targets):
+            a = np.asarray(self._A[t][slot], np.float32)[:, :, :rank]
+            b = np.asarray(self._B[t][slot], np.float32)[:, :rank, :]
+            parts.append(np.ascontiguousarray(a))
+            parts.append(np.ascontiguousarray(b))
+        return parts
+
+    # ---- the compiled-program view ----
+
+    def buffers(self):
+        """The (params, scales) pytree the compiled steps consume:
+        ``params[t] = (A[t], B[t])`` gathered-batch buffers + the
+        per-slot scale row. Passed as ARGUMENTS every step — loads and
+        evictions change values, never shapes, so the two compiled
+        programs never retrace."""
+        return ({t: (self._A[t], self._B[t]) for t, _, _ in self.targets},
+                self._scales)
+
+    def lora_ref(self, table) -> tuple:
+        """A ready ``lora=`` argument for the model forward: the
+        adapter table (any per-row slot list/array) bound to the
+        current buffers."""
+        params, scales = self.buffers()
+        return (jnp.asarray(table, jnp.int32), params, scales)
+
+    # ---- accounting ----
+
+    @property
+    def capacity(self) -> int:
+        return self.max_live - 1
+
+    @property
+    def num_live(self) -> int:
+        """Slots pinned by running requests (refcount > 0)."""
+        return len(self._ref)
+
+    @property
+    def num_cached(self) -> int:
+        return len(self._lru)
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def utilization(self) -> float:
+        return self.num_live / max(self.capacity, 1)
+
+    def adapter_bytes_per_slot(self) -> int:
+        """HBM bytes one loaded slot costs across all targets at the
+        padded rank (the figure capacity planning multiplies by
+        max_live)."""
+        item = jnp.dtype(self.dtype).itemsize
+        total = 0
+        for t, din, dout in self.targets:
+            total += self.num_layers * self.max_rank * (din + dout) * item
+        return total
+
+    def stats(self) -> dict:
+        """Schema-stable gauge/counter dict, mirroring
+        ``KVCachePool.stats()``; observability prefixes every key into
+        the ``paddle_serving_lora_*`` family."""
+        hits = self.counters["adapter_hits"]
+        misses = self.counters["adapter_misses"]
+        return {"max_live": self.max_live, "capacity": self.capacity,
+                "max_rank": self.max_rank,
+                "registered": len(self._registry),
+                "resident": len(self._key_slot),
+                "pinned": self.num_live, "cached": self.num_cached,
+                "free": self.num_free,
+                "utilization": self.utilization(),
+                "peak_pinned": self._peak_live,
+                "bytes_per_slot": self.adapter_bytes_per_slot(),
+                "adapter_hit_rate": (hits / (hits + misses)
+                                     if hits + misses else 0.0),
+                **self.counters}
+
+    @staticmethod
+    def zero_stats() -> dict:
+        """All-zero ``stats()`` schema (metrics merges it so the LoRA
+        gauge family is schema-stable even before the first step)."""
+        return {"max_live": 0, "capacity": 0, "max_rank": 0,
+                "registered": 0, "resident": 0, "pinned": 0,
+                "cached": 0, "free": 0, "utilization": 0.0,
+                "peak_pinned": 0, "bytes_per_slot": 0,
+                "adapter_hit_rate": 0.0,
+                "adapter_hits": 0, "adapter_misses": 0,
+                "adapter_loads": 0, "adapter_evictions": 0,
+                "adapter_spills": 0, "adapter_restore_corrupt": 0,
+                "adapter_unavailable": 0, "lora_bytes_streamed": 0}

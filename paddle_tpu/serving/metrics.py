@@ -237,6 +237,13 @@ class ServingMetrics:
         # each capture — schema-stable zeros with snapshots off
         self.snapshots_enabled = 0
         self._snapshot_stats: dict[str, int] = {}
+        # multi-tenant LoRA serving (SERVING.md "Multi-tenant LoRA
+        # serving"): the flag gauge plus a mirror of AdapterPool.stats()
+        # refreshed each step — the lora_* keys become the
+        # paddle_serving_lora_* Prometheus family; schema-stable zeros
+        # with LoRA off
+        self.lora_enabled = 0
+        self._lora_stats: dict = {}
         # tensor parallelism (SERVING.md "Tensor-parallel serving"): the
         # TP degree gauge (1 == single-device engine) and the per-shard
         # KV footprint per cached token — the tp_* keys become the
@@ -585,6 +592,19 @@ class ServingMetrics:
         engine after each periodic capture."""
         self._snapshot_stats = dict(stats)
 
+    # ---- multi-tenant LoRA (SERVING.md "Multi-tenant LoRA serving") --
+
+    def set_lora(self, enabled: bool) -> None:
+        """Arm the lora_enabled gauge (int, for Prometheus export)."""
+        self.lora_enabled = int(bool(enabled))
+
+    def on_lora_stats(self, stats: dict) -> None:
+        """Mirror the adapter pool's gauges (AdapterPool.stats()) into
+        the summary — called by the engine once per step. Keys land
+        under a ``lora_`` prefix so render_prometheus emits them as the
+        ``paddle_serving_lora_*`` family."""
+        self._lora_stats = dict(stats)
+
     def on_mixed_step(self, prefill_tokens: int, decode_slots: int,
                       chunk_slots: int, in_flight: int) -> None:
         """One mixed-step dispatch: ``prefill_tokens`` prompt-chunk
@@ -654,6 +674,7 @@ class ServingMetrics:
         return sum(self._n_tokens.values())
 
     def summary(self) -> dict:
+        from .lora import AdapterPool as _AdapterPool
         from .snapshot import SnapshotStore as _SnapshotStore
         from .tiering import HostTier as _HostTier
         ttft = self.ttfts()
@@ -728,6 +749,14 @@ class ServingMetrics:
             # snapshotting off; the store's keys are snapshot_-prefixed)
             "snapshots_enabled": self.snapshots_enabled,
             **{**_SnapshotStore.zero_stats(), **self._snapshot_stats},
+            # multi-tenant LoRA serving (schema-stable: zeros with LoRA
+            # off). AdapterPool.stats() keys land under a lora_ prefix
+            # — the paddle_serving_lora_* Prometheus family — so pool
+            # gauges like "capacity" can never shadow a summary key.
+            "lora_enabled": self.lora_enabled,
+            **{(k if k.startswith("lora_") else "lora_" + k): v
+               for k, v in {**_AdapterPool.zero_stats(),
+                            **self._lora_stats}.items()},
             # tensor parallelism (schema-stable: tp_degree 1 on a
             # single-device engine) — the paddle_serving_tp_* family
             "tp_degree": self.tp_degree,

@@ -134,6 +134,21 @@ class Request:
     # row that produces the first logits — bitwise identical to the
     # colocated final chunk.
     handoff: bool = False
+    # multi-tenant LoRA (SERVING.md "Multi-tenant LoRA serving"): the
+    # content digest (hex) of the adapter this request decodes with, ""
+    # for the base model. adapter_slot is the AdapterPool slot pinned
+    # for it while RUNNING (0 = the identity slot); acquired at admit,
+    # released with the KV pages, so a preemption drops the pin but a
+    # warm re-admit usually hits the pool's LRU cache.
+    adapter: str = ""
+    adapter_slot: int = 0
+
+    @property
+    def adapter_ns(self) -> bytes:
+        """Prefix-cache namespace: adapters produce different KV for the
+        same tokens, so cache identity is (adapter, tokens) — the digest
+        bytes salt the pool's hash root (kv_cache._namespaced_root)."""
+        return bytes.fromhex(self.adapter) if self.adapter else b""
 
     @property
     def recompute_len(self) -> int:
@@ -203,6 +218,15 @@ class Scheduler:
         # charges the budget chunk by chunk AT DISPATCH, not at
         # admission, so admission only pays the host-tier restore toll.
         self.chunked = False
+        # multi-tenant LoRA: the engine points this at its AdapterPool
+        # when lora serving is on. ``admit`` pins the head's adapter
+        # slot alongside its KV pages; a request whose adapter payload
+        # is lost/corrupt lands in ``admit_failures`` for the engine to
+        # finish with a typed reason (never silently served base
+        # weights), while pool-full exhaustion makes the head WAIT —
+        # retryable, like any other resource.
+        self.adapters = None
+        self.admit_failures: list[Request] = []
         # injected by the engine when tracing is on. The scheduler owns
         # every queue/slot state transition, so it owns the request-track
         # lifecycle spans: "queued" opens at add/_requeue and closes at
@@ -235,7 +259,8 @@ class Scheduler:
                 # total page count exceeds the capacity check above
                 cached = 0
                 if pool.cache_enabled:
-                    cached = len(pool.match_prefix(req.prompt).full_pages)
+                    cached = len(pool.match_prefix(
+                        req.prompt, namespace=req.adapter_ns).full_pages)
                 if need - cached > pool.capacity:
                     raise RequestTooLargeError(
                         f"request {req.rid!r} needs {need} pages for its "
@@ -328,8 +353,12 @@ class Scheduler:
                         context_len=req.context_len)
         if register and req.pages and not req.prefilling:
             seq = (req.prompt + req.tokens)[:req.context_len]
-            pool.register_prefix(seq, req.pages, include_partial=True)
+            pool.register_prefix(seq, req.pages, include_partial=True,
+                                 namespace=req.adapter_ns)
         pool.release(req.pages)
+        if req.adapter_slot and self.adapters is not None:
+            self.adapters.release(req.adapter_slot)
+            req.adapter_slot = 0
         req.pages = []
         req.cached_len = 0
         req.cached_partial = False
@@ -493,7 +522,8 @@ class Scheduler:
             if pool.cache_enabled:
                 cap = n_valid if req.tokens else n_valid - 1
                 seq = req.prompt + req.tokens[:-1]
-                match = pool.match_prefix(seq, max_tokens=cap)
+                match = pool.match_prefix(seq, max_tokens=cap,
+                                          namespace=req.adapter_ns)
                 # the optimistic (pre-restore) view: the whole cache
                 # hierarchy hit, including host-tier tokens that still
                 # have to be restored at commit time
@@ -512,6 +542,27 @@ class Scheduler:
                      - (len(match.full_pages) if match else 0))
             if n_new > pool.num_available:
                 break
+            # multi-tenant LoRA: pin the head's adapter slot BEFORE any
+            # pool mutation (the acquire may stream weights from the
+            # host tier / evict an idle slot, but it never touches KV
+            # pages, so a later rollback only has to release the pin).
+            # Pool-full exhaustion makes the head WAIT like page
+            # exhaustion; a lost/corrupt payload is terminal — the
+            # request moves to admit_failures for the engine to finish
+            # with a typed reason, and the NEXT head gets its turn.
+            aslot = 0
+            if req.adapter and self.adapters is not None:
+                from .lora import (AdapterExhaustedError,
+                                   AdapterUnavailableError)
+                try:
+                    aslot = self.adapters.acquire(req.adapter_ns)
+                except AdapterExhaustedError:
+                    break
+                except AdapterUnavailableError:
+                    self.waiting.remove(req)
+                    self.tracer.end("queued", track=req.rid)
+                    self.admit_failures.append(req)
+                    continue
             # commit order matters: pin the matched pages FIRST so this
             # admission's own allocs (including restores) cannot
             # LRU-evict them, then restore the host-tier chain, then
@@ -558,6 +609,8 @@ class Scheduler:
             except PoolExhaustedError:
                 pool.release(pinned)
                 pool.release(chain_pages)
+                if aslot and self.adapters is not None:
+                    self.adapters.release(aslot)
                 self.tracer.instant("admit_rollback", track=req.rid,
                                     need=n_new,
                                     available=pool.num_available)
@@ -595,6 +648,7 @@ class Scheduler:
             req.cached_len = cached
             req.restored_len = restored_tok
             req.cached_partial = partial_q > 0
+            req.adapter_slot = aslot
             req.slot = self._free_slots.pop()
             req.state = RUNNING
             req.prefill_target = n_valid

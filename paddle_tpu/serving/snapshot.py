@@ -95,6 +95,11 @@ class RequestSnapshot:
     payloads: list = field(default_factory=list)  # per-page HostTier format
     page_digests: list = field(default_factory=list)
     meta_digest: bytes = b""
+    # multi-tenant LoRA (SERVING.md "Multi-tenant LoRA serving"): the
+    # adapter digest (hex) the request decodes with, "" for base. The
+    # restore side re-resolves it BEFORE re-admission — an adapter-bound
+    # stream never silently resumes on base weights.
+    adapter: str = ""
 
     # ---- integrity ----
 
@@ -106,6 +111,10 @@ class RequestSnapshot:
                bool(self.do_sample), int(self.seed), int(self.arrival_seq),
                int(self.context_len), int(self.step), self.kv_tag,
                int(self.page_size)]
+        if self.adapter:
+            # appended only when set, so base-model snapshots sealed by
+            # older builds keep verifying against the same digest
+            rec.append(self.adapter)
         return json.dumps(rec).encode()
 
     def seal(self) -> "RequestSnapshot":
@@ -281,6 +290,7 @@ def save_engine_snapshot(path: str, snaps: list, meta: dict | None = None
             "arrival_seq": int(s.arrival_seq),
             "context_len": int(s.context_len), "step": int(s.step),
             "kv_tag": s.kv_tag, "page_size": int(s.page_size),
+            "adapter": s.adapter,
             "pages": [len(p) for p in s.payloads],
             # npz cannot round-trip extension dtypes (bfloat16): store
             # each array as a raw uint8 view plus its dtype name, and
@@ -350,7 +360,8 @@ def load_engine_snapshot(path: str):
             arrival_seq=rec["arrival_seq"],
             tokens=list(rec["tokens"]), context_len=rec["context_len"],
             step=rec["step"], kv_tag=rec["kv_tag"],
-            page_size=rec["page_size"], payloads=payloads or [],
+            page_size=rec["page_size"], adapter=rec.get("adapter", ""),
+            payloads=payloads or [],
             page_digests=[bytes.fromhex(d) for d in rec["page_digests"]],
             meta_digest=bytes.fromhex(rec["meta_digest"]))
         if not s.verify_meta():

@@ -607,8 +607,20 @@ class EngineServer:
             if pool is None or not getattr(pool, "cache_enabled", False):
                 return {"cached_tokens": 0}
             try:
-                hit = pool.match_prefix(payload["prompt"])
-                return {"cached_tokens": int(hit.cached_tokens)}
+                # multi-tenant LoRA: the probe matches under the
+                # adapter's prefix-cache namespace (a foreign adapter's
+                # identical prompt is not a hit), and a replica whose
+                # AdapterPool already holds the adapter resident earns
+                # one page worth of cached tokens on top — skipping the
+                # weight stream-in beats a few cached prompt tokens
+                ns = (bytes.fromhex(payload["adapter"])
+                      if payload.get("adapter") else b"")
+                hit = pool.match_prefix(payload["prompt"], namespace=ns)
+                cached = int(hit.cached_tokens)
+                adapters = getattr(self.engine, "adapters", None)
+                if ns and adapters is not None and adapters.resident(ns):
+                    cached += int(getattr(pool, "page_size", 0))
+                return {"cached_tokens": cached}
             except Exception:  # noqa: BLE001 — affinity is best-effort
                 return {"cached_tokens": 0}
         if kind == "gauges":
@@ -705,10 +717,14 @@ class EngineServer:
 
         if p.get("prefill_only"):
             tp_kw["prefill_only"] = True
+        if p.get("adapter"):
+            tp_kw["adapter"] = p["adapter"]
         try:
             if snap is not None:
                 misses0 = _restore_misses()
                 tp_kw.pop("prefill_only", None)   # a seeded submit
+                tp_kw.pop("adapter", None)   # the snapshot itself is
+                # adapter-bound; restore_request re-resolves it
                 # already owns its KV — nothing left to hand off
                 eng.restore_request(snap, **tp_kw)
                 reply["used_snapshot"] = True

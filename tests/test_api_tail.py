@@ -7,10 +7,16 @@ import os
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import paddle_tpu as pt
 
 
+@pytest.mark.skipif(
+    not os.path.isdir("/root/reference"),
+    reason="the /root/reference Paddle source mount is absent — "
+           "tools/api_diff.py compares against its tensor/__init__.py, "
+           "so the scripted name diff cannot run in this environment")
 def test_api_diff_clean():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.run([sys.executable, os.path.join(repo, "tools", "api_diff.py")],

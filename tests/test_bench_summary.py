@@ -19,7 +19,7 @@ _DEFAULT_CONFIGS = {
     "llama_serving_chunked", "llama_serving_failover",
     "llama_serving_partition",
     "llama_serving_tp", "llama_serving_fairness",
-    "llama_serving_disagg",
+    "llama_serving_disagg", "llama_serving_lora",
 }
 
 
@@ -297,6 +297,28 @@ def test_dry_serving_disagg_cell_carries_handoff_ab_keys():
                          "handoff_pulls", "handoff_bytes",
                          "handoff_recomputes",
                          "goodput_at_slo", "goodput_at_slo_colocated",
+                         "retraces"}, cell
+    assert all(v is None for v in cell.values()), cell
+
+
+def test_dry_serving_lora_cell_carries_adapter_keys():
+    # the multi-tenant LoRA arm (SERVING.md "Multi-tenant LoRA
+    # serving"): the cell must surface the adapter economics — hit
+    # rate, load/eviction churn, bytes streamed host<->HBM — plus the
+    # base and single-adapter arms' throughput and the multi/single
+    # ratio the acceptance gate reads, next to the usual serving keys
+    out = _run_dry("llama_serving_lora")
+    assert out.returncode == 0, out.stderr
+    last = json.loads(out.stdout.splitlines()[-1])
+    cell = last["bench_summary"]["llama_serving_lora"]
+    assert set(cell) >= {"value", "mfu", "spread",
+                         "ttft_p50", "ttft_p99", "tpot",
+                         "n_adapters", "adapter_hit_rate",
+                         "adapter_loads", "adapter_evictions",
+                         "lora_bytes_streamed",
+                         "tokens_per_s_base", "tokens_per_s_single",
+                         "multi_vs_single_ratio",
+                         "goodput_at_slo", "goodput_at_slo_base",
                          "retraces"}, cell
     assert all(v is None for v in cell.values()), cell
 

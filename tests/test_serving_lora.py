@@ -1,0 +1,462 @@
+"""paddle_tpu.serving.lora — multi-tenant LoRA serving over one base
+model.
+
+The contracts (SERVING.md "Multi-tenant LoRA serving"):
+
+1. TWO PROGRAMS, EVER — the adapter table is an array VALUE like a
+   block table; arbitrary adapter churn (loads, evictions, slot reuse)
+   keeps ``step_program_counts() == {"decode": 1, "mixed": 1}``.
+2. MERGED-WEIGHT PARITY — a stream served through the paged pool is
+   bitwise identical to ``model.generate()`` with that adapter folded
+   into the base weights; a base request through a LoRA engine is
+   bitwise identical to the plain base model (slot 0 = exact zeros).
+3. NAMESPACED PREFIXES — prefix-cache identity includes the adapter
+   digest: the same prompt under two adapters NEVER cross-hits, and
+   adapter A's second request still hits its own entries.
+4. PAGED POOL — content-hash identity, refcounted slots, LRU eviction
+   of refcount-0 residents, blake2b-digest-verified host spill/restore
+   that round-trips bit-exact.
+5. FAULTS TYPED — a corrupted adapter fetch is caught by the digest
+   re-verify and fails the request with ``adapter_unavailable`` (never
+   silent base-model fallback); a killed replica's failover replay is
+   bitwise with the same adapter bound.
+
+Chaos tests (deterministic FaultPlan replays) carry the ``faults``
+marker, same as the serving/fleet suites.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import fault
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+from paddle_tpu.observability import parse_prometheus, render_prometheus
+from paddle_tpu.serving import FleetRouter, HostTier, ServingEngine
+from paddle_tpu.serving.lora import (AdapterExhaustedError, AdapterPool,
+                                     AdapterUnavailableError, LoRAAdapter,
+                                     llama_lora_targets)
+
+RNG = np.random.default_rng(41)
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(123)
+    m = LlamaForCausalLM(llama_tiny(dtype="float32",
+                                    mp_axis=None, fsdp_axis=None))
+    m.eval()
+    return m
+
+
+@pytest.fixture
+def fault_free(monkeypatch):
+    """No FaultPlan leaks out of a chaos test; no rank env leaks in."""
+    fault.deactivate()
+    monkeypatch.delenv("PADDLE_TRAINER_ID", raising=False)
+    monkeypatch.delenv("PROCESS_ID", raising=False)
+    monkeypatch.delenv("PADDLE_RESTART_EPOCH", raising=False)
+    yield
+    fault.deactivate()
+
+
+def _adapter(model, seed, rank=4, scale=0.2, name=None):
+    """A test adapter with deltas large enough that different adapters
+    produce visibly different greedy streams on the tiny model."""
+    return LoRAAdapter.random(name or f"tenant-{seed}", model.config,
+                              rank=rank, seed=seed, scale=scale)
+
+
+def _merged_ref(model, adapter, prompt, max_new):
+    """Reference arm: fold the adapter into the base weights, generate,
+    restore the base weights bit-exact."""
+    state = model.state_dict()
+    try:
+        model.set_state_dict(adapter.merged_into(state))
+        out = model.generate(jnp.asarray([prompt]), max_new_tokens=max_new)
+    finally:
+        model.set_state_dict(state)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def _base_ref(model, prompt, max_new):
+    out = model.generate(jnp.asarray([prompt]), max_new_tokens=max_new)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def _mk_engine(model, lora=None, **kw):
+    cfg = dict(num_pages=64, page_size=8, max_slots=4,
+               lora=lora if lora is not None
+               else {"max_live": 4, "max_rank": 8})
+    cfg.update(kw)
+    return ServingEngine(model, **cfg)
+
+
+def _payloads_equal(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert x.dtype == y.dtype and x.shape == y.shape
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------------
+# AdapterPool: identity, refcounts, LRU, spill/restore
+# ---------------------------------------------------------------------------
+
+class TestAdapterPool:
+    def _pool(self, model, **kw):
+        cfg = dict(max_live=3, max_rank=8)
+        cfg.update(kw)
+        return AdapterPool(model.config, **cfg)
+
+    def test_register_resolve_content_identity(self, model):
+        pool = self._pool(model)
+        a = _adapter(model, 1)
+        h = pool.register(a)
+        assert h == a.digest.hex()
+        # re-register identical content: same digest, no duplicate
+        assert pool.register(a) == h and pool.stats()["registered"] == 1
+        # resolve by name, hex, bytes and the adapter object itself
+        for ref in (a.name, h, a.digest, a):
+            assert pool.resolve(ref) == a.digest
+        with pytest.raises(AdapterUnavailableError):
+            pool.resolve("never-registered")
+
+    def test_acquire_refcount_release_lru_hit(self, model):
+        pool = self._pool(model)
+        a = _adapter(model, 1)
+        pool.register(a)
+        assert pool.acquire(b"") == 0          # identity adapter
+        s1 = pool.acquire(a.digest)
+        assert s1 != 0 and pool.num_live == 1
+        assert pool.acquire(a.digest) == s1    # second pin: same slot
+        pool.release(s1)
+        assert pool.num_live == 1              # still pinned once
+        pool.release(s1)
+        assert pool.num_live == 0 and pool.num_cached == 1
+        # refcount-0 resident: the next acquire is a free LRU hit
+        before = pool.counters["adapter_loads"]
+        assert pool.acquire(a.digest) == s1
+        assert pool.counters["adapter_loads"] == before
+        assert pool.counters["adapter_hits"] >= 2
+
+    def test_exhausted_when_all_slots_pinned(self, model):
+        pool = self._pool(model, max_live=3)   # capacity 2
+        ads = [_adapter(model, i) for i in range(3)]
+        for a in ads:
+            pool.register(a)
+        pool.acquire(ads[0].digest)
+        pool.acquire(ads[1].digest)
+        with pytest.raises(AdapterExhaustedError):
+            pool.acquire(ads[2].digest)
+
+    def test_lru_evict_spill_restore_roundtrip(self, model):
+        pool = self._pool(model, max_live=3)   # capacity 2
+        ads = [_adapter(model, i) for i in range(3)]
+        keys = [a.digest for a in ads]
+        for a in ads:
+            pool.register(a)
+        s0 = pool.acquire(keys[0])
+        pool.release(s0)
+        s1 = pool.acquire(keys[1])
+        pool.release(s1)
+        # drop adapter 0's host copy so eviction MUST spill it back
+        assert pool.host_tier.discard("lora", "full", keys[0])
+        s2 = pool.acquire(keys[2])             # miss -> evict LRU (= 0)
+        assert s2 == s0 and not pool.resident(keys[0])
+        assert pool.counters["adapter_evictions"] == 1
+        assert pool.counters["adapter_spills"] == 1
+        assert pool.host_tier.has("lora", "full", keys[0])
+        # restore: digest-verified, bit-exact vs the original payload
+        pool.release(s2)
+        s0b = pool.acquire(keys[0])
+        _payloads_equal(pool._slot_payload(s0b, keys[0]), ads[0].payload())
+        st = pool.stats()
+        assert st["adapter_loads"] == 4 and st["lora_bytes_streamed"] > 0
+
+    def test_corrupt_host_payload_detected_never_served(self, model):
+        pool = self._pool(model)
+        a = _adapter(model, 5)
+        pool.register(a)
+        pool.host_tier.corrupt("lora", "full", a.digest)
+        with pytest.raises(AdapterUnavailableError):
+            pool.acquire(a.digest)
+        assert pool.counters["adapter_restore_corrupt"] == 1
+        assert pool.counters["adapter_unavailable"] == 1
+
+    def test_rank_above_pool_max_rejected(self, model):
+        pool = self._pool(model, max_rank=4)
+        a = _adapter(model, 7, rank=8)
+        pool.register(a)
+        with pytest.raises(AdapterUnavailableError):
+            pool.acquire(a.digest)
+
+    def test_stats_schema_matches_zero_stats(self, model):
+        pool = self._pool(model)
+        assert set(pool.stats()) == set(AdapterPool.zero_stats())
+
+
+# ---------------------------------------------------------------------------
+# engine: merged-weight parity + the two-program contract
+# ---------------------------------------------------------------------------
+
+class TestEngineParity:
+    def test_streams_match_merged_generate(self, model, fault_free):
+        """Base + two adapters interleaved in one batch: every stream
+        equals generate() with that adapter folded into the weights,
+        and the engine still owns exactly two compiled programs."""
+        a1, a2 = _adapter(model, 1), _adapter(model, 2)
+        prompts = [RNG.integers(1, 500, size=int(n)).tolist()
+                   for n in (6, 9, 7)]
+        refs = [_merged_ref(model, a1, prompts[0], 8),
+                _merged_ref(model, a2, prompts[1], 8),
+                _base_ref(model, prompts[2], 8)]
+        assert refs[0] != refs[1] != refs[2]   # adapters actually differ
+        eng = _mk_engine(model)
+        h1, h2 = eng.register_adapter(a1), eng.register_adapter(a2)
+        rids = [eng.add_request(prompts[0], 8, adapter=h1),
+                eng.add_request(prompts[1], 8, adapter=a2.name),
+                eng.add_request(prompts[2], 8)]
+        out = eng.run_to_completion(max_steps=100)
+        for rid, ref in zip(rids, refs):
+            assert out[rid] == ref
+        assert eng.step_program_counts() == {"decode": 1, "mixed": 1}
+        st = eng.stats()["lora"]
+        assert st["adapter_loads"] == 2 and st["pinned"] == 0
+
+    def test_base_engine_programs_unchanged(self, model, fault_free):
+        """An engine built WITHOUT lora= never threads the extra step
+        arguments: same two programs, and adapter= submissions are
+        refused typed at add time."""
+        eng = _mk_engine(model, lora=False)
+        assert eng.adapters is None
+        rid = eng.add_request([5, 6, 7], 4)
+        out = eng.run_to_completion(max_steps=50)
+        assert out[rid] == _base_ref(model, [5, 6, 7], 4)
+        assert eng.step_program_counts() == {"decode": 1, "mixed": 1}
+        with pytest.raises(AdapterUnavailableError):
+            eng.add_request([1, 2], 4, adapter="deadbeef")
+
+    def test_churn_three_epochs_programs_pinned(self, model, fault_free):
+        """More adapters than slots, three epochs of rotation: loads,
+        LRU evictions and slot reuse are all array-value churn — the
+        program counts never move and parity holds every epoch."""
+        n_adapters, max_new = 5, 6
+        ads = [_adapter(model, i) for i in range(n_adapters)]
+        prompts = [RNG.integers(1, 500, size=int(RNG.integers(5, 10)))
+                   .tolist() for _ in range(n_adapters)]
+        refs = [_merged_ref(model, a, p, max_new)
+                for a, p in zip(ads, prompts)]
+        eng = _mk_engine(model, lora={"max_live": 3, "max_rank": 8},
+                         max_slots=2)
+        hexes = [eng.register_adapter(a) for a in ads]
+        for epoch in range(3):
+            rids = [eng.add_request(prompts[i], max_new, adapter=hexes[i])
+                    for i in range(n_adapters)]
+            out = eng.run_to_completion(max_steps=400)
+            for i, rid in enumerate(rids):
+                assert out[rid] == refs[i], f"epoch {epoch} adapter {i}"
+            assert eng.step_program_counts() == {"decode": 1, "mixed": 1}
+        st = eng.stats()["lora"]
+        # 5 adapters through 2 cache-able slots: evictions + reloads
+        assert st["adapter_evictions"] > 0
+        assert st["adapter_loads"] > n_adapters
+        assert st["registered"] == n_adapters and st["pinned"] == 0
+
+    def test_snapshot_restore_rebinds_adapter(self, model, tmp_path,
+                                              fault_free):
+        """A drained engine's snapshot carries the adapter digest; the
+        warm engine re-resolves it and the continuation is bitwise one
+        life. A warm engine WITHOUT the lora pool refuses typed."""
+        a = _adapter(model, 3)
+        prompt = RNG.integers(1, 500, size=7).tolist()
+        ref = _merged_ref(model, a, prompt, 10)
+        eng = _mk_engine(model)
+        h = eng.register_adapter(a)
+        rid = eng.add_request(prompt, 10, adapter=h)
+        for _ in range(3):
+            eng.step()
+        partial = list(eng.request(rid).tokens)
+        assert 0 < len(partial) < 10
+        path = str(tmp_path / "lora_snap")
+        eng.drain(snapshot_path=path)
+        warm = _mk_engine(model)
+        warm.register_adapter(a)
+        assert warm.restore(path) == [rid]
+        out = warm.run_to_completion(max_steps=100)
+        assert out[rid] == ref and out[rid][:len(partial)] == partial
+        # the warm life admits via plain prefill (no chunk ran): mixed
+        # may legitimately still be uncompiled — but never >1 of either
+        counts = warm.step_program_counts()
+        assert counts["decode"] == 1 and counts["mixed"] <= 1
+        # an engine with no adapter pool cannot silently resume as base
+        bare = _mk_engine(model, lora=False)
+        with pytest.raises(AdapterUnavailableError):
+            bare.restore(path)
+
+
+# ---------------------------------------------------------------------------
+# prefix-cache namespacing
+# ---------------------------------------------------------------------------
+
+class TestPrefixNamespacing:
+    def test_same_prompt_two_adapters_never_cross_hit(self, model,
+                                                      fault_free):
+        """The planted collision: an identical prompt under adapter A,
+        then adapter B — B must MISS A's cached pages (its KV is
+        different math) and still decode its own bitwise stream; A's
+        second run hits its own namespace."""
+        a, b = _adapter(model, 11), _adapter(model, 12)
+        prompt = RNG.integers(1, 500, size=16).tolist()  # 2 full pages
+        ref_a = _merged_ref(model, a, prompt, 6)
+        ref_b = _merged_ref(model, b, prompt, 6)
+        assert ref_a != ref_b
+        eng = _mk_engine(model)
+        ha, hb = eng.register_adapter(a), eng.register_adapter(b)
+        r1 = eng.add_request(prompt, 6, adapter=ha)
+        out = eng.run_to_completion(max_steps=60)
+        assert out[r1] == ref_a
+        hits0 = eng.pool.counters["prefix_hits"]
+        r2 = eng.add_request(prompt, 6, adapter=hb)
+        out = eng.run_to_completion(max_steps=60)
+        assert out[r2] == ref_b                       # not A's KV
+        assert eng.pool.counters["prefix_hits"] == hits0   # planted miss
+        r3 = eng.add_request(prompt, 6, adapter=ha)
+        out = eng.run_to_completion(max_steps=60)
+        assert out[r3] == ref_a
+        assert eng.pool.counters["prefix_hits"] == hits0 + 1  # own hit
+
+    def test_base_namespace_distinct_from_adapters(self, model,
+                                                   fault_free):
+        """The empty namespace (base model) is itself isolated from
+        every adapter namespace."""
+        a = _adapter(model, 13)
+        prompt = RNG.integers(1, 500, size=16).tolist()
+        eng = _mk_engine(model)
+        ha = eng.register_adapter(a)
+        r1 = eng.add_request(prompt, 4)
+        eng.run_to_completion(max_steps=40)
+        hits0 = eng.pool.counters["prefix_hits"]
+        r2 = eng.add_request(prompt, 4, adapter=ha)
+        out = eng.run_to_completion(max_steps=40)
+        assert eng.pool.counters["prefix_hits"] == hits0
+        assert out[r2] == _merged_ref(model, a, prompt, 4)
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+class TestLoraObservability:
+    def test_metrics_summary_and_prometheus_family(self, model,
+                                                   fault_free):
+        eng = _mk_engine(model)
+        a = _adapter(model, 21)
+        rid = eng.add_request([3, 4, 5], 4,
+                              adapter=eng.register_adapter(a))
+        eng.run_to_completion(max_steps=40)
+        s = eng.metrics.summary()
+        assert s["lora_enabled"] == 1
+        assert s["lora_adapter_loads"] == 1
+        assert s["lora_registered"] == 1
+        assert s["lora_bytes_streamed"] > 0   # not double-prefixed
+        page = render_prometheus(s)
+        series = parse_prometheus(page)
+        assert series["paddle_serving_lora_enabled"] == 1.0
+        assert series["paddle_serving_lora_adapter_loads"] == 1.0
+        # a base engine still exports the schema-stable zero family
+        s0 = _mk_engine(model, lora=False).metrics.summary()
+        assert s0["lora_enabled"] == 0 and s0["lora_adapter_loads"] == 0
+
+
+# ---------------------------------------------------------------------------
+# chaos: corrupted fetch + failover replay (deterministic FaultPlans)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faults
+class TestLoraChaos:
+    def test_corrupt_fetch_fails_typed_never_base(self, model,
+                                                  fault_free):
+        """serving.lora_fetch poison corrupts the host payload; the
+        digest re-verify catches it and the request finishes
+        ``adapter_unavailable`` — co-scheduled base and healthy-adapter
+        streams are untouched."""
+        bad, good = _adapter(model, 31), _adapter(model, 32)
+        eng = _mk_engine(model)
+        hb, hg = eng.register_adapter(bad), eng.register_adapter(good)
+        fault.activate(fault.FaultPlan([
+            fault.FaultSpec(site="serving.lora_fetch", action="poison",
+                            match=rf"^{hb}$"),
+        ]))
+        p_bad = RNG.integers(1, 500, size=6).tolist()
+        p_good = RNG.integers(1, 500, size=7).tolist()
+        p_base = RNG.integers(1, 500, size=5).tolist()
+        r_bad = eng.add_request(p_bad, 6, adapter=hb)
+        r_good = eng.add_request(p_good, 6, adapter=hg)
+        r_base = eng.add_request(p_base, 6)
+        events = []
+        while eng.scheduler.has_work():
+            events.extend(eng.step())
+        assert eng.request(r_bad).finish_reason == "adapter_unavailable"
+        assert eng.request(r_bad).tokens == []     # never base tokens
+        term = [e for e in events if e["rid"] == r_bad and e["finished"]]
+        assert term == [{"rid": r_bad, "token": None, "finished": True,
+                         "finish_reason": "adapter_unavailable"}]
+        st = eng.stats()["lora"]
+        assert st["adapter_restore_corrupt"] == 1
+        assert st["adapter_unavailable"] == 1
+        fault.deactivate()
+        assert eng.request(r_good).tokens == \
+            _merged_ref(model, good, p_good, 6)
+        assert eng.request(r_base).tokens == _base_ref(model, p_base, 6)
+        assert eng.step_program_counts() == {"decode": 1, "mixed": 1}
+
+    def test_fleet_kill_replays_bitwise_with_same_adapter(self, model,
+                                                          fault_free):
+        """Kill the replica serving an adapter-bound stream mid-decode:
+        the failover replay re-resolves the SAME adapter on the
+        survivor and the client stream is bitwise the merged-weight
+        reference — exactly-once, never base-model tokens."""
+        a = _adapter(model, 33)
+        prompt = RNG.integers(1, 500, size=8).tolist()
+        max_new = 8
+        ref = _merged_ref(model, a, prompt, max_new)
+        engines = [_mk_engine(model) for _ in range(2)]
+        for e in engines:
+            h = e.register_adapter(a)
+        router = FleetRouter(engines)
+        rid = router.submit(prompt, max_new, adapter=h)
+        guard = 0
+        while router.request(rid).emitted < 2:
+            router.step()
+            guard += 1
+            assert guard < 50
+        victim = router.request(rid).replica
+        router.kill_replica(0 if victim is None else victim)
+        out = router.run_to_completion(max_steps=200)
+        assert out[rid] == ref
+        assert router.request(rid).finish_reason == "length"
+        for e in engines:
+            if not e._draining:
+                assert e.step_program_counts() == \
+                    {"decode": 1, "mixed": 1}
+
+    def test_adapter_affinity_prefers_resident_replica(self, model,
+                                                       fault_free):
+        """Placement: with no prefix cached anywhere, the replica whose
+        pool already holds the adapter wins the affinity query."""
+        a = _adapter(model, 34)
+        engines = [_mk_engine(model) for _ in range(2)]
+        hexes = [e.register_adapter(a) for e in engines]
+        # preload the adapter on replica 1 only
+        engines[1].adapters.release(
+            engines[1].adapters.acquire(a.digest))
+        router = FleetRouter(engines)
+        rid = router.submit(RNG.integers(1, 500, size=6).tolist(), 4,
+                            adapter=hexes[0])
+        router.step()
+        assert router.request(rid).replica == 1
+        router.run_to_completion(max_steps=50)
+        assert router.request(rid).finish_reason == "length"

@@ -157,6 +157,25 @@ Run: python tools/profile_serving.py            (real TPU)
                                                  offer-size histogram
                                                  printed — SERVING.md
                                                  "Disaggregated serving")
+     python tools/profile_serving.py --lora     (multi-tenant LoRA A/B:
+                                                 one staggered trace with
+                                                 every request bound to a
+                                                 Zipf-drawn adapter, more
+                                                 adapters than pool slots
+                                                 so admissions thrash the
+                                                 LRU — every stream
+                                                 asserted bitwise vs
+                                                 generate() with THAT
+                                                 adapter merged into the
+                                                 weights, programs pinned
+                                                 at {decode:1, mixed:1}
+                                                 through the churn,
+                                                 hit-rate / load / evict /
+                                                 spill counters and the
+                                                 base-arm throughput delta
+                                                 printed — SERVING.md
+                                                 "Multi-tenant LoRA
+                                                 serving")
      python tools/profile_serving.py --crash-restart
                                                 (warm-restart rehearsal:
                                                  run a staggered trace,
@@ -1563,6 +1582,148 @@ def disagg():
           "decode, zero recomputes, pools audit clean")
 
 
+def lora():
+    """Multi-tenant LoRA A/B + thrash probe (SERVING.md "Multi-tenant
+    LoRA serving"): one staggered ragged trace where every request is
+    bound to an adapter drawn from a Zipf popularity distribution over
+    MORE tenants than the pool has slots — so admissions thrash the
+    LRU: misses page adapters in from host RAM, evictions spill cold
+    ones back. Every stream is asserted bitwise identical to
+    ``generate()`` with THAT adapter merged into the base weights (the
+    parity contract), the two compiled programs must survive the churn
+    untouched, and the base-model arm on the identical trace prices
+    what the gathered per-slot delta matmuls cost."""
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                         llama_tiny)
+    from paddle_tpu.serving import ServingEngine, ServingMetrics
+    from paddle_tpu.serving.lora import LoRAAdapter
+
+    backend = jax.default_backend()
+    smoke = "--smoke" in sys.argv[1:] or backend != "tpu"
+    if backend != "tpu":
+        print(f"WARNING: backend={backend} — timings are meaningless "
+              f"off-chip, running the smoke shapes")
+
+    pt.seed(0)
+    if smoke:
+        cfg = llama_tiny(mp_axis=None, fsdp_axis=None)
+        n_requests, max_new, lens_lohi = 8, 8, (8, 32)
+        n_adapters, max_live, rank, scale = 6, 5, 4, 0.2
+        page_size, num_pages, max_slots = 4, 128, 4
+    else:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5632, num_hidden_layers=8,
+                          num_attention_heads=16, num_key_value_heads=8,
+                          max_position_embeddings=4096, dtype="bfloat16",
+                          mp_axis=None, fsdp_axis=None)
+        n_requests, max_new, lens_lohi = 16, 64, (64, 256)
+        n_adapters, max_live, rank, scale = 12, 5, 8, 0.02
+        page_size, num_pages, max_slots = 16, 512, 4
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+
+    rng = np.random.default_rng(0)
+    lens = [int(x) for x in rng.integers(*lens_lohi, n_requests)]
+    prompts = [rng.integers(0, cfg.vocab_size, n).astype(np.int32)
+               for n in lens]
+    adapters = [LoRAAdapter.random(f"tenant-{i}", cfg, rank=rank,
+                                   seed=i, scale=scale)
+                for i in range(n_adapters)]
+    w = 1.0 / np.arange(1, n_adapters + 1) ** 1.2
+    draw = rng.choice(n_adapters, size=n_requests, p=w / w.sum())
+    # plant the coldest tenants at the tail so the probe thrashes
+    # deterministically: more distinct adapters than slots, guaranteed
+    draw[-(max_live - 1):] = np.arange(n_adapters - (max_live - 1),
+                                       n_adapters)
+    print(f"trace: {n_requests} requests over {n_adapters} adapters "
+          f"(Zipf 1.2, rank {rank}) through {max_live - 1} pool slots, "
+          f"prompt lens {min(lens)}-{max(lens)}, max_new={max_new}")
+
+    # per-request merged-weight references, grouped by adapter so the
+    # base weights are folded once per tenant (restored bit-exact after)
+    state = model.state_dict()
+    refs = [None] * n_requests
+    try:
+        for k in sorted(set(int(d) for d in draw)):
+            model.set_state_dict(adapters[k].merged_into(state))
+            for i in np.where(draw == k)[0]:
+                out = model.generate(np.asarray([prompts[i]]),
+                                     max_new_tokens=max_new)
+                refs[i] = np.asarray(out)[0, len(prompts[i]):].tolist()
+    finally:
+        model.set_state_dict(state)
+
+    def run_arm(with_adapters):
+        eng = ServingEngine(
+            model, num_pages=num_pages, page_size=page_size,
+            max_slots=max_slots,
+            lora=({"max_live": max_live, "max_rank": rank}
+                  if with_adapters else None))
+        hexes = ([eng.register_adapter(a) for a in adapters]
+                 if with_adapters else None)
+        eng.warm_programs()
+        eng.metrics = ServingMetrics()
+        eng.metrics.set_lora(with_adapters)
+        t0 = time.perf_counter()
+        rids, added, steps = [], 0, 0
+        tokens = {}
+        while added < 2:
+            rids.append(eng.add_request(
+                prompts[added], max_new,
+                adapter=hexes[draw[added]] if with_adapters else None))
+            added += 1
+        while eng.scheduler.has_work() or added < n_requests:
+            for ev in eng.step():
+                if ev.get("token") is not None:
+                    tokens.setdefault(ev["rid"], []).append(ev["token"])
+            steps += 1
+            if added < n_requests and steps % 2 == 0:
+                rids.append(eng.add_request(
+                    prompts[added], max_new,
+                    adapter=hexes[draw[added]] if with_adapters else None))
+                added += 1
+        wall = time.perf_counter() - t0
+        counts = eng.step_program_counts()
+        assert counts["decode"] == 1 and counts["mixed"] <= 1, \
+            f"retraced through adapter churn: {counts}"
+        outs = [tokens.get(r, []) for r in rids]
+        return eng, outs, wall, eng.metrics.summary()
+
+    eng_b, out_base, t_base, m_base = run_arm(False)
+    eng, out_lora, t_lora, m = run_arm(True)
+
+    for i, (got, ref) in enumerate(zip(out_lora, refs)):
+        assert got == ref, (f"request {i} (adapter {draw[i]}) diverged "
+                            f"from merged-weight generate() — bug")
+    print(f"parity: all {n_requests} streams bitwise == generate() "
+          f"with their adapter merged into the weights")
+
+    lst = eng.adapters.stats()
+    assert lst["adapter_evictions"] > 0, \
+        "probe never thrashed — raise n_adapters or shrink max_live"
+    total = sum(len(r) for r in refs)
+    print(f"\narm A base model    : {t_base:7.3f}s  "
+          f"{total / t_base:8.1f} tok/s  "
+          f"ttft p99 = {m_base['ttft_p99_s'] * 1000:.1f}ms")
+    print(f"arm B {n_adapters:2d} adapters  : {t_lora:7.3f}s  "
+          f"{total / t_lora:8.1f} tok/s  "
+          f"ttft p99 = {m['ttft_p99_s'] * 1000:.1f}ms  "
+          f"({t_lora / t_base:.2f}x base wall)")
+    print(f"  adapter hit_rate = {lst['adapter_hit_rate']:.3f}  "
+          f"loads = {lst['adapter_loads']}  "
+          f"evictions = {lst['adapter_evictions']}  "
+          f"spills = {lst['adapter_spills']}")
+    print(f"  lora_bytes_streamed = {lst['lora_bytes_streamed']:,} "
+          f"({lst['bytes_per_slot']:,} B/slot, "
+          f"{max_live - 1} slots resident)")
+    if smoke:
+        print("(smoke mode: deltas are logic evidence only — rerun "
+              "on-chip for the PERF.md numbers)")
+
+
 def main():
     import jax
 
@@ -1773,6 +1934,8 @@ if __name__ == "__main__":
         crash_restart()
     elif "--disagg" in sys.argv[1:]:
         disagg()
+    elif "--lora" in sys.argv[1:]:
+        lora()
     elif "--tp" in sys.argv[1:]:
         tp()
     else:
