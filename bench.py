@@ -98,6 +98,11 @@ _SERVING_SLOS = {
     # partition stretch inter-token gaps — the fleet ITL budget prices
     # the lease ejection + replay, same as any other failover
     "llama_serving_partition": {"ttft_p99_s": 2.0, "itl_p99_s": 1.0},
+    # multi-host A/B (loopback vs real localhost TCP): the socket wire
+    # adds a per-step frame round-trip to every inter-token gap — the
+    # fleet ITL budget prices it, and both arms score against the same
+    # targets so the framing overhead shows up in goodput, not excuses
+    "llama_serving_multihost": {"ttft_p99_s": 2.0, "itl_p99_s": 1.0},
     # chunked-prefill A/B: long prompts land mid-decode, so the OFF
     # arm's itl_p99 carries the head-of-line stall chunking removes; a
     # tight ITL SLO makes goodput_at_slo sensitive to exactly that
@@ -1618,6 +1623,182 @@ def bench_llama_serving_partition(peak, peak_kind, n_requests=12,
     }
 
 
+def bench_llama_serving_multihost(peak, peak_kind, n_requests=12,
+                                  max_new_tokens=48, trace_path=None):
+    """Loopback-vs-socket wire A/B (SERVING.md "Multi-host serving"):
+    the same 420M model and staggered trace served by a 2-replica
+    FleetRouter twice. Arm A is the default in-process
+    ``LoopbackTransport``. Arm B puts every router<->replica message on
+    a REAL localhost TCP socket — length-prefixed frames through
+    ``SocketTransport``, each replica's ``EngineServer`` behind its own
+    dialed connection, exactly the wire ``spawn_fleet`` replicas speak
+    (the engines stay in-process so the chip is allocated once; the
+    process boundary itself is priced by tools/profile_serving.py
+    --multihost). Both arms must produce bitwise-identical client
+    streams (asserted), so the evidence is what the socket costs:
+    frame/byte volume, reconnects (0 on a healthy wire),
+    lease_expirations (0 — framing latency must never masquerade as
+    membership churn), and ``goodput_at_slo`` for both arms."""
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import (FleetMetrics, FleetRouter,
+                                    ServingEngine, ServingMetrics,
+                                    SocketTransport)
+    from paddle_tpu.serving.transport import EngineServer
+
+    name = "llama_serving_multihost"
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                      intermediate_size=5632, num_hidden_layers=8,
+                      num_attention_heads=16, num_key_value_heads=8,
+                      max_position_embeddings=4096, dtype="bfloat16",
+                      mp_axis=None, fsdp_axis=None)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    n_params = model.num_params()
+    weight_bytes = 2.0 * n_params
+    rng = np.random.default_rng(0)
+    lens = [int(x) for x in rng.integers(64, 256, n_requests)]
+    prompts = [rng.integers(0, cfg.vocab_size, n).astype(np.int32)
+               for n in lens]
+    tracer = _make_tracer(trace_path)
+
+    class _RemoteFront:
+        """Engine-shaped stand-in the router holds on the socket arm —
+        the real EngineServer answers from the far end of the wire."""
+        is_remote = True
+        snapshot_store = None
+        flight_recorder = None
+        pool = None
+
+        def __init__(self, idx):
+            self.idx = idx
+
+    def _arm(socket_wire):
+        arm_tracer = tracer if socket_wire else None
+        engines = [ServingEngine(model, num_pages=256, page_size=16,
+                                 max_slots=8, max_pages_per_slot=32,
+                                 tracer=arm_tracer) for _ in range(2)]
+        for e in engines:
+            e.warm_programs()
+        warm_steps = [e.stats()["steps"] for e in engines]
+        reps = []
+        if socket_wire:
+            wire = SocketTransport("router", listen=("127.0.0.1", 0),
+                                   poll_s=0.0005, query_timeout_s=0.01)
+            for i, e in enumerate(engines):
+                tr = SocketTransport(
+                    f"replica:{i}", connect={"router": wire.listen_addr},
+                    poll_s=0.0005)
+                reps.append((tr, EngineServer(i, e, tr)))
+            want = {f"replica:{i}" for i in range(2)}
+            deadline = time.monotonic() + 30
+            while set(wire.peers()) != want:
+                for tr, _ in reps:
+                    tr.pump()
+                wire.pump()
+                assert time.monotonic() < deadline, "fleet never formed"
+            router = FleetRouter([_RemoteFront(i) for i in range(2)],
+                                 transport=wire, tracer=arm_tracer,
+                                 lease_steps=60)
+        else:
+            router = FleetRouter(engines, tracer=arm_tracer,
+                                 lease_steps=60)
+        router.metrics = ServingMetrics()  # compile time stays out
+        router.metrics.set_slo(**_SERVING_SLOS[name])
+        router.fleet_metrics = FleetMetrics()
+        added = 2
+        for p in prompts[:2]:
+            router.submit(p, max_new_tokens)
+        steps = 0
+        out = {}
+        while router.has_work() or added < n_requests:
+            for ev in router.step():
+                if ev.get("token") is not None:
+                    out.setdefault(ev["rid"], []).append(ev["token"])
+            for tr, _ in reps:
+                tr.pump()
+            steps += 1
+            if added < n_requests and steps % 4 == 0:
+                router.submit(prompts[added], max_new_tokens)
+                added += 1
+            assert steps < 20000, "multi-host fleet hung"
+        for e in engines:
+            assert e.decode_program_count() == 1, "serving decode retraced"
+            e.audit_pool()
+        engine_steps = sum(e.stats()["steps"] - w
+                           for e, w in zip(engines, warm_steps))
+        res = {"m": router.metrics.summary(),
+               "fleet": router.fleet_metrics.summary(),
+               "wire": dict(router.transport.stats()),
+               "out": out, "steps": steps, "engine_steps": engine_steps,
+               "retraces": sum(e.decode_program_count() - 1
+                               for e in engines)}
+        if socket_wire:
+            for tr, _ in reps:
+                tr.close()
+            wire.close()
+        return res
+
+    loop = _arm(socket_wire=False)
+    sock = _arm(socket_wire=True)
+    # the framing contract: the socket wire may cost syscalls and
+    # latency, never tokens — streams identical to the loopback arm
+    assert sock["out"] == loop["out"], \
+        "socket arm diverged from the loopback arm"
+    assert len(sock["out"]) == n_requests
+    m, fleet, wire = sock["m"], sock["fleet"], sock["wire"]
+    m0 = loop["m"]
+    assert wire["corrupt_dropped"] == 0, "a damaged frame was injected?"
+    assert fleet["lease_expirations"] == 0, \
+        "socket latency expired a lease on a healthy wire"
+    hbm_bw = {"v4": 1.2e12,
+              "v5e": 0.82e12, "v5litepod": 0.82e12, "v5lite": 0.82e12,
+              "v5p": 2.77e12,
+              "v6e": 1.64e12, "trillium": 1.64e12,
+              }.get(peak_kind.split("(")[0], 0.82e12)
+    wall = max(m["wall_s"], 1e-9)
+    mbu = sock["engine_steps"] * weight_bytes / wall / hbm_bw
+    trace_out = _dump_trace(tracer, trace_path, name)
+    return {
+        "metric": "llama_420m_serving_multihost_tokens_per_sec",
+        "value": round(m["tokens_per_s"], 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(m["tokens_per_s"]
+                             / max(m0["tokens_per_s"], 1e-9), 4),
+        "extra": {"params": n_params, "n_requests": n_requests,
+                  "max_new_tokens": max_new_tokens,
+                  "prompt_lens": lens,
+                  "replicas": 2,
+                  "router_steps": sock["steps"],
+                  "engine_steps": sock["engine_steps"],
+                  # the A/B evidence: what the socket wire cost
+                  "frames_sent": wire["socket_frames_sent"],
+                  "frames_recv": wire["socket_frames_recv"],
+                  "frame_bytes_sent": wire["socket_bytes_sent"],
+                  "frame_bytes_recv": wire["socket_bytes_recv"],
+                  "socket_reconnects": wire["socket_reconnects"],
+                  "lease_expirations": fleet["lease_expirations"],
+                  "duplicates_suppressed": fleet["duplicates_suppressed"],
+                  "token_exact": True,
+                  "ttft_p50": round(m["ttft_p50_s"], 4),
+                  "ttft_p99": round(m["ttft_p99_s"], 4),
+                  "tpot": round(m["tpot_mean_s"], 5),
+                  "itl_p99": round(m["itl_p99_s"], 5),
+                  "goodput_at_slo": round(m["goodput_at_slo"], 4),
+                  "goodput_at_slo_loopback": round(
+                      m0["goodput_at_slo"], 4),
+                  "tokens_per_s_loopback": round(m0["tokens_per_s"], 1),
+                  "slo": _SERVING_SLOS[name],
+                  "retraces": sock["retraces"] + loop["retraces"],
+                  "trace": trace_out,
+                  "mbu_weights_only": round(mbu, 4),
+                  "peak": peak_kind, "hbm_bw": hbm_bw,
+                  "pipeline": False, "runs": _RUNS,
+                  "spread": None},
+    }
+
+
 def bench_llama_serving_tiered(peak, peak_kind, n_requests=12,
                                max_new_tokens=48, trace_path=None):
     """Tiered-KV serving A/B (SERVING.md "KV tiering & traffic
@@ -2391,6 +2572,12 @@ _CONFIGS = {
     # streams by assertion, failover/fencing/goodput evidence for both
     # arms
     "llama_serving_partition": bench_llama_serving_partition,
+    # loopback-vs-socket wire A/B (SERVING.md "Multi-host serving"):
+    # the same trace over the in-process wire and over real localhost
+    # TCP framing; bitwise-identical client streams by assertion,
+    # frame/byte volume + zero reconnects/lease churn + goodput for
+    # both arms
+    "llama_serving_multihost": bench_llama_serving_multihost,
     # chunked-prefill A/B (SERVING.md "Chunked prefill & mixed steps"):
     # whole-prompt vs chunk-streamed prefill on a long-prompt +
     # decode-heavy trace; itl_p99/goodput for both arms, token-exact
@@ -2463,6 +2650,15 @@ _SUMMARY_EXTRA_KEYS = {
                                 "duplicates_suppressed",
                                 "transport_dropped",
                                 "goodput_at_slo", "goodput_at_slo_clean",
+                                "retraces"),
+    "llama_serving_multihost": ("ttft_p50", "ttft_p99", "tpot",
+                                "frames_sent", "frames_recv",
+                                "frame_bytes_sent", "frame_bytes_recv",
+                                "socket_reconnects",
+                                "lease_expirations",
+                                "goodput_at_slo",
+                                "goodput_at_slo_loopback",
+                                "tokens_per_s_loopback",
                                 "retraces"),
     "llama_serving_chunked": ("ttft_p50", "ttft_p99", "tpot",
                               "itl_p99", "itl_p99_baseline",

@@ -70,6 +70,19 @@ Named sites wired into the runtime (see RESILIENCE.md):
   clock) and ``corrupt`` (flip one payload byte WITHOUT updating the
   digest — the receive-side blake2b re-verify must catch it); ``step``
   is the router's step counter.
+- ``fleet.transport.connect`` / ``fleet.transport.accept`` — the
+  multi-host socket transport's connection-life sites
+  (serving/transport_socket.py; SERVING.md "Multi-host serving" and
+  RESILIENCE.md "Multi-host playbook"), fired per dial attempt and per
+  accepted connection. ``ctx['path']`` is the dialed peer's name
+  (``"router"``) on connect and the connector's ``"ip:port"`` on
+  accept. ``drop`` swallows the attempt (the dialer backs off and
+  retries; an accepted-then-dropped connector sees a silent EOF),
+  ``delay`` (``arg`` = SECONDS — wall time, because sockets are)
+  parks it, and ``raise`` models a refused/RST connection — there is
+  no distinct "reset" action; ``raise`` at these sites IS the reset,
+  counted as ``socket_resets``. Armed via ``PADDLE_FAULT_PLAN`` they
+  replay the same connection storm in every spawned replica host.
 
 Actions: ``hang`` (sleep ``arg`` seconds — trips the comm watchdog),
 ``kill`` (SIGKILL self: the un-catchable death), ``exit`` (``os._exit(arg)``),

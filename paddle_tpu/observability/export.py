@@ -119,10 +119,18 @@ def render_fleet_prometheus(router) -> str:
         emit(f"paddle_serving_fleet_{_NAME_RE.sub('_', key)}_total",
              value, "counter")
     # the wire itself (SERVING.md "Fleet transport & membership"):
-    # per-message delivery counters + heartbeat round-trip percentiles
+    # per-message delivery counters + heartbeat round-trip percentiles.
+    # A socket transport adds its paddle_serving_fleet_transport_socket_*
+    # family here for free (frames/bytes/reconnects/torn_frames/...);
+    # keys ending in _s (the socket RTT percentiles) are wall-clock
+    # gauges in seconds, not counters
     for key, value in sorted(stats.get("transport", {}).items()):
-        emit(f"paddle_serving_fleet_transport_"
-             f"{_NAME_RE.sub('_', key)}_total", value, "counter")
+        if key.endswith("_s"):
+            emit(_metric_name("paddle_serving_fleet_transport_", key),
+                 value)
+        else:
+            emit(f"paddle_serving_fleet_transport_"
+                 f"{_NAME_RE.sub('_', key)}_total", value, "counter")
     for key in ("heartbeat_rtt_p50_steps", "heartbeat_rtt_p99_steps"):
         if key in stats:
             emit(f"paddle_serving_fleet_{key}", stats[key])
@@ -141,6 +149,17 @@ def render_fleet_prometheus(router) -> str:
                     "backoff_remaining", "epoch", "lease_age"):
             emit(f"paddle_serving_fleet_replica_{key}", health[key],
                  labels=labels)
+        # multi-host identity (SERVING.md "Multi-host serving"): the
+        # replica's OS pid as a gauge, plus an info-style series whose
+        # labels carry the non-numeric facts (socket address, the
+        # post-mortem exit classification of a dead process)
+        if health.get("pid") is not None:
+            emit("paddle_serving_fleet_replica_pid", health["pid"],
+                 labels=labels)
+        emit("paddle_serving_fleet_replica_info", 1,
+             labels='{replica="%d",addr="%s",exit_status="%s"}'
+                    % (health["replica"], health.get("addr") or "",
+                       health.get("exit_status") or ""))
     # the client-visible stream summary, unlabeled — same names a
     # single-engine scrape produces
     for key in sorted(summary := router.metrics.summary()):

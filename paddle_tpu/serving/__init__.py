@@ -22,12 +22,13 @@ streams.
 from .engine import BrownoutConfig, ServingEngine
 from .errors import (AdmissionShedError, EngineDrainingError,
                      FleetOverloadedError, QueueFullError,
-                     RequestTooLargeError, SchedulerStalledError,
-                     ServingError, StaleEpochError, TPConfigError,
-                     TransportError)
+                     ReplicaSpawnError, RequestTooLargeError,
+                     SchedulerStalledError, ServingError, StaleEpochError,
+                     TPConfigError, TransportError)
 from .fleet import FleetRequest, FleetRouter
 from .transport import (ChaosTransport, EngineServer, LoopbackTransport,
                         Message, Transport, deterministic_jitter)
+from .transport_socket import FrameChaos, FrameDecoder, SocketTransport
 from .kv_cache import KVCachePool, PoolExhaustedError, PrefixMatch
 from .lora import (AdapterExhaustedError, AdapterPool,
                    AdapterUnavailableError, LoRAAdapter)
@@ -37,7 +38,8 @@ from .parallel import (TPContext, collective_counts, partition_devices,
 from .scheduler import (FINISHED, PREEMPTED, RUNNING, WAITING, Request,
                         SamplingParams, Scheduler)
 from .snapshot import (RequestSnapshot, SnapshotStore,
-                       load_engine_snapshot, save_engine_snapshot)
+                       load_engine_snapshot, save_engine_snapshot,
+                       snapshot_from_wire, snapshot_to_wire)
 from .speculative import DraftProposer, NgramDrafter, SpeculativeConfig
 from .tiering import HostTier
 from .workload import (Workload, WorkloadRequest, WorkloadSpec,
@@ -57,14 +59,16 @@ __all__ = [
     "AdapterExhaustedError", "AdapterUnavailableError",
     "SnapshotStore", "RequestSnapshot",
     "save_engine_snapshot", "load_engine_snapshot",
+    "snapshot_to_wire", "snapshot_from_wire",
     "Workload", "WorkloadRequest", "WorkloadSpec", "heavy_tail_workload",
     "long_prompt_workload", "make_workload", "overload_workload",
     "ServingError", "QueueFullError", "RequestTooLargeError",
     "SchedulerStalledError", "EngineDrainingError", "FleetOverloadedError",
     "TPConfigError", "AdmissionShedError",
-    "TransportError", "StaleEpochError",
+    "TransportError", "StaleEpochError", "ReplicaSpawnError",
     "Transport", "LoopbackTransport", "ChaosTransport", "EngineServer",
     "Message", "deterministic_jitter",
+    "SocketTransport", "FrameChaos", "FrameDecoder",
     "TPContext", "partition_devices", "validate_tp_config",
     "collective_counts",
 ]

@@ -68,6 +68,12 @@ router that acts on it is :class:`paddle_tpu.serving.fleet.FleetRouter`
   message is discarded and counted; retryable only in the sense that
   the CURRENT epoch owns the request — the stale sender must never
   retry it.
+- :class:`ReplicaSpawnError` — multi-host spawn/attach (SERVING.md
+  "Multi-host serving"): a replica host process exited before
+  connecting, or the fleet's connect barrier timed out. The fleet was
+  never fully formed — nothing to fail over, nothing was accepted.
+  Retryable: spawn again (a crashed child usually means a bad spec or
+  an environment problem, which the carried exit status pinpoints).
 """
 
 from __future__ import annotations
@@ -75,7 +81,7 @@ from __future__ import annotations
 __all__ = ["ServingError", "QueueFullError", "RequestTooLargeError",
            "SchedulerStalledError", "EngineDrainingError",
            "FleetOverloadedError", "TPConfigError", "AdmissionShedError",
-           "TransportError", "StaleEpochError"]
+           "TransportError", "StaleEpochError", "ReplicaSpawnError"]
 
 
 class ServingError(RuntimeError):
@@ -192,5 +198,16 @@ class StaleEpochError(ServingError):
     handed zombie-epoch commands. Discarded and counted
     (``stale_epoch_discarded`` / ``fenced_dropped``); the CURRENT
     epoch owns the request."""
+
+    retryable = True
+
+
+class ReplicaSpawnError(ServingError):
+    """Multi-host spawn/attach failed (``serving/replica_host.py`` /
+    ``SocketTransport.wait_peers``): a replica host process died before
+    saying HELLO, or the connect barrier timed out. Raised before any
+    request is accepted — the fleet never formed, so there is no
+    failover to attempt. Retryable: fix the spec/environment (the
+    message carries the child's exit status) and spawn again."""
 
     retryable = True

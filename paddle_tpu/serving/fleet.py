@@ -206,6 +206,9 @@ class _Replica:
     hb_suspected: int = 0       # highest seq already counted as a miss
     hb_next: int = 0            # next step a heartbeat is due
     live_rids: set = field(default_factory=set)  # requests placed here
+    # multi-host: the dead process's classified fate ("signal:SIGKILL",
+    # "exit:1", ...) read from its handle at ejection; None in-process
+    exit_status: str | None = None
 
 
 class FleetRouter:
@@ -234,6 +237,7 @@ class FleetRouter:
                  breaker_backoff_steps: int = 2,
                  breaker_backoff_max: int = 16,
                  shed_patience: int = _SHED_PATIENCE,
+                 drain_patience: int = _DRAIN_PATIENCE,
                  clock=None, tracer=None, snapshot_store=None,
                  transport=None, lease_steps: int = _LEASE_STEPS,
                  heartbeat_interval: int = 1,
@@ -262,6 +266,10 @@ class FleetRouter:
         self.breaker_backoff_steps = breaker_backoff_steps
         self.breaker_backoff_max = breaker_backoff_max
         self.shed_patience = shed_patience
+        # multi-host drains ride a real wire with real latencies: the
+        # per-replica retry budget in drain() scales with the transport
+        # instead of hard-wiring the loopback constant
+        self.drain_patience = max(1, int(drain_patience))
         # --- disaggregated placement (SERVING.md "Disaggregated serving") ---
         self.placement = placement
         self.handoff_timeout_steps = max(1, int(handoff_timeout_steps))
@@ -313,7 +321,13 @@ class FleetRouter:
         self._transport = (transport if transport is not None
                            else LoopbackTransport())
         self._transport.bind("router")           # inbox endpoint
-        self._servers = [EngineServer(i, e, self._transport)
+        # multi-host attach (serving/replica_host.py): an engine with
+        # ``is_remote`` is a handle to a replica living in another OS
+        # process — its EngineServer runs THERE, bound to the same
+        # "replica:i" name on the far side of the socket, so the router
+        # builds no local server for it and speaks purely via the wire
+        self._servers = [None if getattr(e, "is_remote", False)
+                         else EngineServer(i, e, self._transport)
                          for i, e in enumerate(engines)]
         # submits in flight: rid -> (replica idx, attempt, sent Message).
         # A pinned submit is retransmitted verbatim until its reply
@@ -327,15 +341,26 @@ class FleetRouter:
         # (the wire has not carried anything yet); replicas whose engine
         # keeps a PRIVATE snapshot store get harvested over the wire
         for rep, srv in zip(self._replicas, self._servers):
-            rep.gauges = srv.gauges()
+            if srv is not None:
+                rep.gauges = srv.gauges()
+            else:
+                # remote replica: best-effort gauge seed over the wire
+                # (None on timeout — the first heartbeat ack fills it)
+                rep.gauges = (self._transport.query(
+                    f"replica:{rep.idx}", "gauges", {}) or rep.gauges)
             # phase heartbeats off the shared deterministic jitter so a
             # large fleet does not burst every lease in the same step
             rep.hb_next = deterministic_jitter(
                 f"fleet-hb:{rep.idx}", self.heartbeat_interval)
         self._fetch_idx = [
             i for i, e in enumerate(engines)
-            if getattr(e, "snapshot_store", None) is not None
-            and e.snapshot_store is not self._snapshot_store]
+            if (getattr(e, "snapshot_store", None) is not None
+                and e.snapshot_store is not self._snapshot_store)
+            # a remote replica's store is BY CONSTRUCTION private (it
+            # lives in another process): harvest it whenever the router
+            # keeps a store of its own to harvest into
+            or (getattr(e, "is_remote", False)
+                and self._snapshot_store is not None)]
 
     # ------------------------------------------------------------------
     # admission
@@ -700,7 +725,7 @@ class FleetRouter:
             tries = 0
             while rep.state != DEAD and rep.live_rids:
                 tries += 1
-                if tries > _DRAIN_PATIENCE:
+                if tries > self.drain_patience:
                     self._eject(rep, "died_in_drain")
                     break
                 # lossy wire: advance the injectable clock so delayed /
@@ -788,7 +813,26 @@ class FleetRouter:
             # membership gauges (SERVING.md "Fleet transport")
             "epoch": rep.epoch,
             "lease_age": max(0, self._steps - rep.last_heard),
+            # multi-host identity (SERVING.md "Multi-host serving"):
+            # where this replica actually runs — its OS pid (local
+            # servers report the router's own; remote ones theirs, via
+            # gauges/handle) and socket address when one exists
+            "pid": (getattr(rep.engine, "pid", None)
+                    or g.get("pid")),
+            "addr": self._replica_addr(rep),
+            # post-mortem classification of a dead replica process
+            # ("signal:SIGKILL", "exit:1", ...); None while alive or
+            # for in-process replicas, which have no exit to classify
+            "exit_status": rep.exit_status,
         }
+
+    def _replica_addr(self, rep: _Replica):
+        peer_addr = getattr(self._transport, "peer_addr", None)
+        if peer_addr is not None:
+            addr = peer_addr(f"replica:{rep.idx}")
+            if addr is not None:
+                return addr
+        return getattr(rep.engine, "addr", None)
 
     def _ready(self, rep: _Replica) -> bool:
         if rep.state == DEAD or rep.state == OPEN:
@@ -1110,6 +1154,15 @@ class FleetRouter:
         rep.state = DEAD
         rep.dead_reason = reason
         self.fleet_metrics.bump("ejections")
+        # multi-host post-mortem: a remote handle can classify how the
+        # process actually died (SIGKILL vs SIGTERM vs clean exit) —
+        # evidence the lease expiry alone cannot carry
+        post_mortem = getattr(rep.engine, "post_mortem", None)
+        if post_mortem is not None:
+            try:
+                rep.exit_status = post_mortem()
+            except Exception:  # noqa: BLE001 — diagnosis is best-effort
+                rep.exit_status = None
         recorder = getattr(rep.engine, "flight_recorder", None)
         if recorder is not None:
             try:

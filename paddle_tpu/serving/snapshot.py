@@ -66,7 +66,8 @@ from ..distributed.checkpoint.save_load import (COMMIT_MARKER,
 from .tiering import _payload_digest
 
 __all__ = ["RequestSnapshot", "SnapshotStore",
-           "save_engine_snapshot", "load_engine_snapshot"]
+           "save_engine_snapshot", "load_engine_snapshot",
+           "snapshot_to_wire", "snapshot_from_wire"]
 
 _STATE_FILE = "state.json"
 _PAGES_FILE = "pages.npz"
@@ -379,3 +380,77 @@ def load_engine_snapshot(path: str):
     meta = dict(state.get("meta") or {})
     meta["corrupt_payloads_dropped"] = dropped_payloads
     return snaps, meta
+
+
+# ---- socket-wire serialization (serving/transport_socket.py) ----
+
+
+def snapshot_to_wire(snap: RequestSnapshot) -> tuple[dict, bytes]:
+    """Split a sealed snapshot into a JSON-able metadata dict and one
+    contiguous payload blob for length-prefixed socket framing. The
+    digests travel verbatim (hex) and are NOT recomputed on either
+    side: the receiving transport's ``snap.verify()`` gate must see
+    exactly the bytes the capturing engine sealed, so a byte flipped in
+    flight fails verification instead of being silently re-blessed.
+    Arrays cross as raw uint8 views with their dtype names recorded —
+    the same bfloat16-safe convention as the durable npz form."""
+    parts = []
+    arrays = []
+    for payload in snap.payloads:
+        page = []
+        for a in payload:
+            raw = np.ascontiguousarray(a)
+            page.append({"dtype": str(np.asarray(a).dtype),
+                         "shape": list(np.asarray(a).shape),
+                         "nbytes": int(raw.nbytes)})
+            parts.append(raw.view(np.uint8).tobytes())
+        arrays.append(page)
+    meta = {
+        "rid": snap.rid, "prompt": list(map(int, snap.prompt)),
+        "tokens": list(map(int, snap.tokens)),
+        "max_new_tokens": int(snap.max_new_tokens),
+        "eos_token_id": (None if snap.eos_token_id is None
+                         else int(snap.eos_token_id)),
+        "temperature": float(snap.temperature),
+        "top_p": float(snap.top_p),
+        "do_sample": bool(snap.do_sample), "seed": int(snap.seed),
+        "arrival_seq": int(snap.arrival_seq),
+        "context_len": int(snap.context_len), "step": int(snap.step),
+        "kv_tag": snap.kv_tag, "page_size": int(snap.page_size),
+        "adapter": snap.adapter,
+        "arrays": arrays,
+        "page_digests": [d.hex() for d in snap.page_digests],
+        "meta_digest": snap.meta_digest.hex(),
+    }
+    return meta, b"".join(parts)
+
+
+def snapshot_from_wire(meta: dict, blob: bytes) -> RequestSnapshot:
+    """Rebuild a :class:`RequestSnapshot` from its wire form — exactly
+    as sent, including any in-flight damage: unlike the durable loader
+    this never degrades or re-seals, so the caller's ``verify()`` is
+    the arbiter of whether the bytes survived the trip."""
+    payloads = []
+    off = 0
+    for page in meta["arrays"]:
+        arrs = []
+        for spec in page:
+            n = int(spec["nbytes"])
+            raw = np.frombuffer(blob[off:off + n], np.uint8).copy()
+            off += n
+            arrs.append(raw.view(np.dtype(spec["dtype"]))
+                        .reshape(spec["shape"]))
+        payloads.append(arrs)
+    return RequestSnapshot(
+        rid=meta["rid"], prompt=list(meta["prompt"]),
+        max_new_tokens=meta["max_new_tokens"],
+        eos_token_id=meta["eos_token_id"],
+        temperature=meta["temperature"], top_p=meta["top_p"],
+        do_sample=meta["do_sample"], seed=meta["seed"],
+        arrival_seq=meta["arrival_seq"],
+        tokens=list(meta["tokens"]), context_len=meta["context_len"],
+        step=meta["step"], kv_tag=meta["kv_tag"],
+        page_size=meta["page_size"], adapter=meta.get("adapter", ""),
+        payloads=payloads,
+        page_digests=[bytes.fromhex(d) for d in meta["page_digests"]],
+        meta_digest=bytes.fromhex(meta["meta_digest"]))

@@ -63,6 +63,7 @@ from __future__ import annotations
 import copy
 import hashlib
 import json
+import os
 from dataclasses import dataclass, field
 
 from ..distributed import fault as _fault
@@ -561,18 +562,30 @@ class EngineServer:
                     "SNAPSHOT_DATA", "ERROR", "KV_OFFER")
 
     def __init__(self, idx: int, engine, transport: Transport,
-                 router: str = "router"):
+                 router: str = "router", step_mode: str = "immediate"):
         self.idx = int(idx)
         self.engine = engine
         self.transport = transport
         self.name = f"replica:{idx}"
         self._router = router
         self._min_epoch = 0           # FENCE floor: epochs below are refused
+        self._epoch_seen = 0          # highest epoch the router spoke at
         self._out_seq = 0
         self._resend: dict[int, Message] = {}   # unacked stream batches
         self._submit_replies: dict = {}         # (rid, epoch, attempt) -> msg
         self._last_step_seq = -1
         self._drain_reply: Message | None = None
+        # "deferred" decouples engine stepping from message handling: a
+        # STEP only LATCHES (multi-host replica hosts run the engine
+        # between transport pumps, so a burst of retransmitted STEPs
+        # can never wedge the handler in back-to-back engine steps and
+        # starve heartbeat acks into a lease expiry). "immediate" —
+        # the in-process default — steps inside the handler, which is
+        # what every loopback/chaos suite pins.
+        if step_mode not in ("immediate", "deferred"):
+            raise ValueError(f"unknown step_mode {step_mode!r}")
+        self.step_mode = step_mode
+        self._step_pending: int | None = None   # latched epoch, if any
         # disaggregated serving: offered-but-uncommitted KV exports,
         # freed by KV_COMMIT (or re-offerable if the router asks again)
         self._handoff_held: dict[str, object] = {}
@@ -598,6 +611,7 @@ class EngineServer:
             "max_queue_depth": None if mqd is None else int(mqd),
             "token_capacity": None if cap is None else int(cap()),
             "handoff_held": len(self._handoff_held),
+            "pid": os.getpid(),
         }
 
     def query(self, kind: str, payload: dict):
@@ -625,6 +639,22 @@ class EngineServer:
                 return {"cached_tokens": 0}
         if kind == "gauges":
             return self.gauges()
+        if kind == "introspect":
+            # multi-host test/debug surface: determinism evidence a
+            # cross-process caller cannot read off the engine object
+            counts = getattr(self.engine, "step_program_counts", None)
+            audit = getattr(self.engine, "audit_pool", None)
+            out = {"pid": os.getpid(),
+                   "step_program_counts":
+                       dict(counts()) if counts is not None else {}}
+            try:
+                if audit is not None:
+                    audit()
+                out["audit_ok"] = True
+            except Exception as e:  # noqa: BLE001 — carry the evidence
+                out["audit_ok"] = False
+                out["audit_error"] = str(e)
+            return out
         if kind == "admission_check":
             check = getattr(self.engine, "admission_check", None)
             if check is None:
@@ -643,6 +673,7 @@ class EngineServer:
             raise StaleEpochError(
                 f"replica {self.idx} fenced at epoch {self._min_epoch}; "
                 f"refusing {msg.kind} from epoch {msg.epoch}")
+        self._epoch_seen = max(self._epoch_seen, msg.epoch)
         p = msg.payload()
         ack = p.get("ack")
         if ack is not None:
@@ -758,34 +789,53 @@ class EngineServer:
         if p["router_step"] <= self._last_step_seq:
             return                       # duplicate STEP: never re-step
         self._last_step_seq = int(p["router_step"])
+        if self.step_mode == "deferred":
+            self._step_pending = msg.epoch
+            return
+        self._do_step(msg.epoch)
+
+    def pending_step(self) -> bool:
+        """True when a latched (deferred-mode) STEP awaits execution."""
+        return self._step_pending is not None
+
+    def run_pending_step(self) -> None:
+        """Execute the latched STEP (deferred mode). Duplicate STEPs
+        between pumps collapse into one engine step — the same dedup
+        the step seqno gives immediate mode."""
+        if self._step_pending is None:
+            return
+        epoch, self._step_pending = self._step_pending, None
+        self._do_step(epoch)
+
+    def _do_step(self, epoch: int) -> None:
         eng = self.engine
         if not eng.scheduler.has_work():
-            self._stream("STEP_RESULTS", msg.epoch, "",
+            self._stream("STEP_RESULTS", epoch, "",
                          {"events": [], "gauges": self.gauges()})
             return
         try:
             events = eng.step()
         except SchedulerStalledError as e:
-            self._stream("ERROR", msg.epoch, "",
+            self._stream("ERROR", epoch, "",
                          {"reason": "stalled",
                           "error": "SchedulerStalledError",
                           "snapshot": e.snapshot,
                           "gauges": self.gauges()})
             return
         except _fault.FaultInjected:
-            self._stream("ERROR", msg.epoch, "",
+            self._stream("ERROR", epoch, "",
                          {"reason": "killed", "error": "FaultInjected",
                           "gauges": self.gauges()})
             return
         except ServingError as e:
-            self._stream("ERROR", msg.epoch, "",
+            self._stream("ERROR", epoch, "",
                          {"reason": f"error:{type(e).__name__}",
                           "error": type(e).__name__,
                           "gauges": self.gauges()})
             return
-        self._stream("STEP_RESULTS", msg.epoch, "",
+        self._stream("STEP_RESULTS", epoch, "",
                      {"events": events, "gauges": self.gauges()})
-        self._stream_handoffs(msg.epoch)
+        self._stream_handoffs(epoch)
 
     def _stream_handoffs(self, epoch: int) -> None:
         """Publish every finished-prefill KV export the engine produced
@@ -823,6 +873,31 @@ class EngineServer:
             {"events": self.engine.last_drain_events,
              "gauges": self.gauges()})
         self._stream_handoffs(msg.epoch)
+
+    def announce_drain(self, timeout_s: float | None = None) -> None:
+        """Replica-INITIATED drain: a multi-host replica host's SIGTERM
+        path (the preemption guard tripped). Runs the engine drain and
+        streams an unsolicited ``DRAIN_RESULTS`` at the highest epoch
+        the router has spoken at — the router's apply path translates
+        drain events regardless of who asked, so in-flight requests
+        finish or classify as preempted instead of dying with the
+        process. One-shot via the same latch as a router-driven DRAIN."""
+        if self._drain_reply is not None:
+            self.transport.send(self._drain_reply)
+            return
+        epoch = max(self._epoch_seen, self._min_epoch)
+        try:
+            self.engine.drain(timeout_s=timeout_s)
+        except (ServingError, _fault.FaultInjected):
+            self._drain_reply = self._stream(
+                "ERROR", epoch, "",
+                {"reason": "died_in_drain", "error": "drain",
+                 "gauges": self.gauges()})
+            return
+        self._drain_reply = self._stream(
+            "DRAIN_RESULTS", epoch, "",
+            {"events": self.engine.last_drain_events,
+             "gauges": self.gauges()})
 
     def _handle_snapshot_fetch(self, msg: Message, p: dict) -> None:
         store = getattr(self.engine, "snapshot_store", None)

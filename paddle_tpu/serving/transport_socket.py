@@ -1,0 +1,842 @@
+"""Multi-host fleet transport: the PR-15 wire over real TCP sockets
+(SERVING.md "Multi-host serving"; the multi-host half of ROADMAP item 4).
+
+:class:`SocketTransport` carries the existing canonical
+:class:`~.transport.Message` bytes between OS processes with
+length-prefixed framing. It deliberately adds NO protocol: every
+guarantee — digest-gated receive, epoch fencing, seq-ordered
+exactly-once streams, lease-based membership, snapshot-seeded bounded
+replay — lives in the transport-agnostic layer above
+(serving/transport.py + fleet.py), and this module only has to move
+bytes and lose them honestly. Delivery into the process reuses the
+base class's ``_deliver`` verbatim, so the ``fleet.transport.send`` /
+``fleet.transport.recv`` fault sites, the blake2b body digest gate and
+the snapshot strip-on-corruption path behave bit-identically to
+loopback.
+
+Topology: one endpoint LISTENS (the router, ``listen=(host, 0)``),
+the others CONNECT (replica hosts, ``connect={"router": addr}``) and
+introduce themselves with a HELLO frame carrying their endpoint name —
+so the router never needs to know replica addresses, only replicas
+need the router's. Reconnects reuse :func:`~.transport.deterministic_jitter`
+for backoff phasing (exponential, capped, keyed on the endpoint pair
+and attempt count — chaos runs replay the same schedule).
+
+Frame format (all integers big-endian)::
+
+    +----+----+------+------------------+
+    | PT | ty | len  | payload[len]     |    ty: 1=MESSAGE 2=HELLO
+    +----+----+------+------------------+        3=PING 4=PONG
+      2B   1B   4B                               5=QUERY 6=QREPLY
+
+A MESSAGE payload is ``u32 header_len | header_json | body |
+snapshot_blob``: the header carries routing metadata plus the body
+digest and per-snapshot array specs VERBATIM (hex) — digests are never
+recomputed in transit, so a flipped byte anywhere in body or snapshot
+bytes fails the existing receive-side re-verify
+(``corrupt_dropped`` / snapshot stripped), exactly like loopback
+corruption.
+
+Failure accounting (all in ``counters`` and exported as
+``paddle_serving_fleet_transport_socket_*``):
+
+- ``socket_torn_frames``   — a connection died mid-frame (short read);
+  the partial bytes are discarded, the stream layer retransmits.
+- ``socket_resets``        — connection reset / abort observed.
+- ``socket_half_open``     — a peer went silent past ``half_open_s``
+  while owing a PONG: the classic half-open TCP state, detected by the
+  application-level ping and resolved by tearing the connection down.
+- ``socket_backpressure_stalls`` — a per-peer bounded outbound queue
+  hit its limit; the overflowing frame is dropped (counted ``dropped``)
+  rather than buffering unboundedly — the stream layer's at-least-once
+  resend makes the drop protocol-safe.
+- ``socket_protocol_errors`` — bad magic / oversized length /
+  undecodable MESSAGE: the connection is reset (never "resynced").
+- ``socket_reconnects`` / ``socket_accepts`` / frame+byte counters.
+
+Connection-level chaos: :class:`FrameChaos` is a seeded fault shim at
+the FRAME layer (below everything the ChaosTransport suite models) —
+per-frame sha256 draws inject byte corruption inside the message body
+region (the digest gate must catch it: ``corrupt_injected`` ==
+receiver ``corrupt_dropped``), link stalls, and mid-frame RST resets
+(the receiver sees a torn frame + reset). Same seed, same weather.
+
+Fault sites (RESILIENCE.md "Multi-host playbook"):
+``fleet.transport.connect`` fires per dial attempt (``path`` = peer
+name; ``drop`` skips the attempt into backoff, ``delay`` pushes it
+``arg`` seconds, ``raise`` models a refused/reset connect) and
+``fleet.transport.accept`` per accepted connection (``path`` =
+``ip:port``; ``drop`` closes it silently, ``delay`` parks it ``arg``
+seconds, ``raise`` closes it with an RST). Both replay from
+``PADDLE_FAULT_PLAN``, which spawned replica hosts inherit.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import select
+import socket as _socket
+import struct
+import time
+from collections import deque
+from dataclasses import dataclass
+
+from ..distributed import fault as _fault
+from .errors import ReplicaSpawnError, TransportError
+from .metrics import percentile
+from .snapshot import snapshot_from_wire, snapshot_to_wire
+from .transport import Message, Transport, deterministic_jitter
+
+__all__ = ["SocketTransport", "FrameDecoder", "FrameChaos",
+           "FrameProtocolError", "encode_message", "decode_message",
+           "FT_MESSAGE", "FT_HELLO", "FT_PING", "FT_PONG",
+           "FT_QUERY", "FT_QREPLY"]
+
+_MAGIC = b"PT"
+_HEADER = struct.Struct(">2sBI")
+_U32 = struct.Struct(">I")
+_MAX_FRAME = 1 << 30          # 1 GiB: far above any snapshot batch
+
+FT_MESSAGE = 1
+FT_HELLO = 2
+FT_PING = 3
+FT_PONG = 4
+FT_QUERY = 5
+FT_QREPLY = 6
+_FRAME_TYPES = frozenset((FT_MESSAGE, FT_HELLO, FT_PING, FT_PONG,
+                          FT_QUERY, FT_QREPLY))
+
+
+class FrameProtocolError(TransportError):
+    """The byte stream is not a valid frame sequence (bad magic, unknown
+    frame type, or an absurd length prefix). There is no safe way to
+    resynchronize a corrupted length-prefixed stream — the connection
+    is reset and the stream layer retransmits."""
+
+
+class FrameDecoder:
+    """Incremental length-prefixed frame parser: feed arbitrary byte
+    chunks, get complete ``(frame_type, payload)`` frames out. Torn
+    frames (a connection dying mid-frame) simply stay in ``pending``
+    for the caller to count and discard."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    @property
+    def pending(self) -> int:
+        """Bytes buffered that do not yet form a complete frame —
+        nonzero at disconnect means the peer died mid-frame."""
+        return len(self._buf)
+
+    def feed(self, data: bytes) -> list:
+        self._buf.extend(data)
+        frames = []
+        while True:
+            if len(self._buf) < _HEADER.size:
+                return frames
+            magic, ftype, length = _HEADER.unpack_from(self._buf)
+            if magic != _MAGIC or ftype not in _FRAME_TYPES:
+                raise FrameProtocolError(
+                    f"bad frame header: magic={magic!r} type={ftype}")
+            if length > _MAX_FRAME:
+                raise FrameProtocolError(
+                    f"frame length {length} exceeds limit {_MAX_FRAME}")
+            if len(self._buf) < _HEADER.size + length:
+                return frames
+            payload = bytes(self._buf[_HEADER.size:_HEADER.size + length])
+            del self._buf[:_HEADER.size + length]
+            frames.append((ftype, payload))
+
+
+def _frame(ftype: int, payload: bytes) -> bytes:
+    return _HEADER.pack(_MAGIC, ftype, len(payload)) + payload
+
+
+# ---------------------------------------------------------------------------
+# Message <-> wire bytes
+# ---------------------------------------------------------------------------
+
+
+def encode_message(msg: Message) -> bytes:
+    """Serialize one :class:`Message` to MESSAGE-frame payload bytes.
+    The body bytes and every digest travel verbatim — the receive side
+    re-verifies against exactly what the sender sealed."""
+    snap_meta, blobs = [], []
+    for s in msg.snaps:
+        meta, blob = snapshot_to_wire(s)
+        snap_meta.append(meta)
+        blobs.append(blob)
+    header = {"kind": msg.kind, "src": msg.src, "dst": msg.dst,
+              "epoch": msg.epoch, "seq": msg.seq, "rid": msg.rid,
+              "digest": msg.digest.hex(),
+              "body_nbytes": len(msg.body),
+              "snap_nbytes": [len(b) for b in blobs],
+              "snaps": snap_meta}
+    hj = json.dumps(header, separators=(",", ":")).encode()
+    return _U32.pack(len(hj)) + hj + msg.body + b"".join(blobs)
+
+
+def decode_message(payload: bytes) -> Message:
+    """Rebuild a :class:`Message` from MESSAGE-frame payload bytes —
+    as received, damage included: the transport's ``_deliver`` digest
+    gate (not this function) decides whether the bytes are usable."""
+    if len(payload) < _U32.size:
+        raise FrameProtocolError("message frame shorter than its header")
+    (hlen,) = _U32.unpack_from(payload)
+    if _U32.size + hlen > len(payload):
+        raise FrameProtocolError("message header overruns the frame")
+    try:
+        header = json.loads(payload[_U32.size:_U32.size + hlen].decode())
+        off = _U32.size + hlen
+        body = payload[off:off + int(header["body_nbytes"])]
+        off += int(header["body_nbytes"])
+        snaps = []
+        for meta, n in zip(header["snaps"], header["snap_nbytes"]):
+            snaps.append(snapshot_from_wire(meta, payload[off:off + int(n)]))
+            off += int(n)
+        return Message(kind=header["kind"], src=header["src"],
+                       dst=header["dst"], epoch=int(header["epoch"]),
+                       seq=int(header["seq"]), rid=header["rid"],
+                       body=body, digest=bytes.fromhex(header["digest"]),
+                       snaps=tuple(snaps))
+    except FrameProtocolError:
+        raise
+    except Exception as e:  # noqa: BLE001 — any malformed field
+        raise FrameProtocolError(f"undecodable message frame: {e}") from e
+
+
+# ---------------------------------------------------------------------------
+# frame-layer chaos
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FrameChaos:
+    """Seeded connection-level fault shim, applied per outbound MESSAGE
+    frame (sha256 draws over ``(seed, decision, frame_seq)`` — same
+    seed, same weather, no wall-clock entropy in the DECISIONS; the
+    stall duration is wall time because sockets are):
+
+    - ``corrupt_p`` — flip one byte inside the message BODY region
+      (frame header and message header stay intact, so the frame
+      decodes and the existing digest gate must catch it:
+      sender ``corrupt_injected`` == receiver ``corrupt_dropped``).
+    - ``reset_p``   — transmit only half the frame, then close with an
+      RST: the receiver counts a torn frame and a reset.
+    - ``stall_p``   — freeze the link ``stall_s`` seconds (outbound
+      frames queue; the peer may ping into half-open detection).
+    """
+
+    seed: int = 0
+    corrupt_p: float = 0.0
+    reset_p: float = 0.0
+    stall_p: float = 0.0
+    stall_s: float = 0.02
+
+    def _draw(self, what: str, n: int) -> float:
+        h = hashlib.sha256(
+            f"framechaos:{self.seed}:{what}:{n}".encode()).digest()
+        return int.from_bytes(h[:8], "big") / 2**64
+
+    def corrupt(self, n: int) -> bool:
+        return self._draw("corrupt", n) < self.corrupt_p
+
+    def reset(self, n: int) -> bool:
+        return self._draw("reset", n) < self.reset_p
+
+    def stall(self, n: int) -> bool:
+        return self._draw("stall", n) < self.stall_p
+
+
+def _corrupt_frame_payload(payload: bytes) -> bytes:
+    """Flip the first body byte of a MESSAGE-frame payload, leaving the
+    message header intact — so the frame still parses and the damage is
+    the digest gate's to catch (never a protocol error)."""
+    (hlen,) = _U32.unpack_from(payload)
+    pos = _U32.size + hlen
+    if pos >= len(payload):
+        return payload
+    flat = bytearray(payload)
+    flat[pos] ^= 0xFF
+    return bytes(flat)
+
+
+# ---------------------------------------------------------------------------
+# the transport
+# ---------------------------------------------------------------------------
+
+
+class _Peer:
+    """One live TCP connection. ``name`` is None until its HELLO
+    arrives (accepted connections introduce themselves)."""
+
+    __slots__ = ("name", "sock", "decoder", "addr", "last_recv",
+                 "last_ping", "pings", "stall_until", "wbuf",
+                 "reset_after_wbuf")
+
+    def __init__(self, sock, addr):
+        self.name = None
+        self.sock = sock
+        self.decoder = FrameDecoder()
+        self.addr = addr                   # "ip:port" of the far end
+        self.last_recv = time.monotonic()
+        self.last_ping = 0.0
+        self.pings: dict[int, float] = {}  # token -> sent monotonic
+        self.stall_until = 0.0
+        self.wbuf = b""                    # bytes committed to this socket
+        self.reset_after_wbuf = False      # FrameChaos reset armed
+
+
+class SocketTransport(Transport):
+    """The PR-15 message fabric over TCP. See the module docstring for
+    the wire format and failure accounting; the behavioural contract is
+    the base :class:`~.transport.Transport`'s — ``send``/``pump``/
+    ``recv``/``query``/``tick`` — plus connection management:
+
+    - ``node``     — this endpoint's name (``"router"``/``"replica:i"``).
+      Locally-bound endpoints still deliver in-process (a router and an
+      in-process EngineServer on the SAME SocketTransport short-circuit
+      exactly like loopback); only foreign destinations hit the wire.
+    - ``listen``   — ``(host, port)`` to accept peers on (port 0 = ephemeral;
+      see ``listen_addr``).
+    - ``connect``  — ``{peer_name: (host, port)}`` to dial, with
+      automatic reconnect (exponential backoff + the shared
+      deterministic jitter) for as long as the transport lives.
+    - ``chaos``    — an optional :class:`FrameChaos`.
+
+    ``pump()`` is non-blocking while traffic flows; when fully idle it
+    blocks in one ``select`` for at most ``poll_s`` — which is what
+    paces a quiet router/replica loop without spinning a core.
+    """
+
+    def __init__(self, node: str, listen=None, connect=None, *,
+                 poll_s: float = 0.005, outbound_limit: int = 512,
+                 ping_interval_s: float = 0.25, half_open_s: float = 2.0,
+                 query_timeout_s: float = 0.25,
+                 reconnect_base_s: float = 0.05,
+                 reconnect_max_s: float = 2.0,
+                 chaos: FrameChaos | None = None):
+        super().__init__()
+        self.node = str(node)
+        self.poll_s = float(poll_s)
+        self.outbound_limit = max(1, int(outbound_limit))
+        self.ping_interval_s = float(ping_interval_s)
+        self.half_open_s = float(half_open_s)
+        self.query_timeout_s = float(query_timeout_s)
+        self.reconnect_base_s = float(reconnect_base_s)
+        self.reconnect_max_s = float(reconnect_max_s)
+        self.chaos = chaos
+        self.counters.update({
+            "socket_frames_sent": 0, "socket_frames_recv": 0,
+            "socket_bytes_sent": 0, "socket_bytes_recv": 0,
+            "socket_accepts": 0, "socket_reconnects": 0,
+            "socket_resets": 0, "socket_torn_frames": 0,
+            "socket_half_open": 0, "socket_backpressure_stalls": 0,
+            "socket_protocol_errors": 0,
+        })
+        self._peers: dict[str, _Peer] = {}       # named, live
+        self._anon: list[_Peer] = []             # accepted, pre-HELLO
+        self._out: dict[str, deque] = {}         # name -> (fseq, ty, bytes)
+        self._dial: dict[str, dict] = {}
+        self._pending_accepts: list[tuple] = []  # (release_t, sock, addr)
+        self._qreplies: dict[int, object] = {}
+        self._qid = 0
+        self._ping_seq = 0
+        self._frame_seq = 0
+        self._rtt: dict[str, list[float]] = {}
+        self._closed = False
+        self._listener = None
+        if listen is not None:
+            self._listener = _socket.socket(_socket.AF_INET,
+                                            _socket.SOCK_STREAM)
+            self._listener.setsockopt(_socket.SOL_SOCKET,
+                                      _socket.SO_REUSEADDR, 1)
+            self._listener.bind(tuple(listen))
+            self._listener.listen(64)
+            self._listener.setblocking(False)
+        for name, addr in (connect or {}).items():
+            self._dial[str(name)] = {"addr": tuple(addr), "attempts": 0,
+                                     "next": 0.0, "connected_once": False}
+
+    # ---- addressing ----
+
+    @property
+    def listen_addr(self):
+        """``(host, port)`` actually bound (port resolved if 0)."""
+        if self._listener is None:
+            return None
+        return self._listener.getsockname()[:2]
+
+    def peer_addr(self, name: str):
+        """The far end's ``"ip:port"`` for a connected peer, else None."""
+        peer = self._peers.get(name)
+        return peer.addr if peer is not None else None
+
+    def peers(self) -> list:
+        return sorted(self._peers)
+
+    def wait_peers(self, names, timeout_s: float = 30.0,
+                   procs=None) -> None:
+        """Block until every named peer has connected and said HELLO —
+        the spawn/attach barrier. ``procs`` (optional Popen-likes) lets
+        a dead child fail fast with its exit status instead of burning
+        the whole timeout. Raises :class:`ReplicaSpawnError`."""
+        deadline = time.monotonic() + float(timeout_s)
+        missing = [n for n in names if n not in self._peers]
+        while missing:
+            for p in procs or ():
+                rc = p.poll() if hasattr(p, "poll") else None
+                if rc is not None:
+                    raise ReplicaSpawnError(
+                        f"replica process pid={getattr(p, 'pid', '?')} "
+                        f"exited rc={rc} before connecting")
+            if time.monotonic() >= deadline:
+                raise ReplicaSpawnError(
+                    f"peers {missing} did not connect within "
+                    f"{timeout_s}s (connected: {sorted(self._peers)})")
+            self._io_sweep(block_s=min(0.05, self.poll_s or 0.05))
+            missing = [n for n in names if n not in self._peers]
+
+    def pending_output(self) -> int:
+        """Frames queued or partially written — a drain barrier for a
+        replica host flushing its last results before exit."""
+        n = sum(len(q) for q in self._out.values())
+        n += sum(1 for p in self._peers.values() if p.wbuf)
+        return n
+
+    # ---- routing: local short-circuit, else frame to the peer ----
+
+    def _route(self, msg: Message) -> None:
+        if msg.dst in self._handlers or msg.dst in self._inboxes:
+            self._ready.append(msg)
+            return
+        self._enqueue(msg.dst, FT_MESSAGE, encode_message(msg))
+
+    def _enqueue(self, name: str, ftype: int, payload: bytes) -> bool:
+        if name not in self._peers and name not in self._dial:
+            # no connection and nobody dialing one: honest loss (a FENCE
+            # to a SIGKILLed replica lands here) — the layer above
+            # already treats sends as best-effort
+            self.counters["dropped"] += 1
+            return False
+        q = self._out.setdefault(name, deque())
+        if len(q) >= self.outbound_limit:
+            self.counters["socket_backpressure_stalls"] += 1
+            self._flush_peer(name)                 # try to relieve first
+            if len(q) >= self.outbound_limit:
+                self.counters["dropped"] += 1      # bounded, never OOM
+                return False
+        self._frame_seq += 1
+        q.append((self._frame_seq, ftype, payload))
+        return True
+
+    # ---- pump ----
+
+    def pump(self) -> None:
+        if self._closed:
+            super().pump()
+            return
+        self._io_sweep()
+        had_work = bool(self._ready)
+        super().pump()            # digest gate + handlers, as loopback
+        self._io_sweep()          # flush replies the handlers produced
+        if not had_work and not self._ready and self.poll_s > 0:
+            self._io_sweep(block_s=self.poll_s)
+            super().pump()
+            self._io_sweep()
+
+    # ---- queries: frame round-trip with a bounded wait ----
+
+    def query(self, dst: str, kind: str, payload: dict):
+        if dst in self._query_handlers:           # local endpoint
+            return self._query_handlers[dst](kind, payload)
+        if dst not in self._peers:
+            return None
+        self._qid += 1
+        qid = self._qid
+        body = json.dumps({"qid": qid, "dst": dst, "kind": kind,
+                           "payload": payload},
+                          separators=(",", ":")).encode()
+        if not self._enqueue(dst, FT_QUERY, body):
+            return None
+        deadline = time.monotonic() + self.query_timeout_s
+        while time.monotonic() < deadline:
+            self._io_sweep(block_s=0.002)
+            if qid in self._qreplies:
+                return self._qreplies.pop(qid)
+            if dst not in self._peers:            # peer died mid-query
+                return None
+        return None                               # advisory: degrade
+
+    # ---- the io sweep ----
+
+    def _io_sweep(self, block_s: float = 0.0) -> None:
+        if self._closed:
+            return
+        now = time.monotonic()
+        self._service_dials(now)
+        self._service_accepts(now)
+        if block_s > 0 and not self._ready:
+            self._select_wait(block_s)
+        self._accept_new()
+        for peer in list(self._peers.values()) + list(self._anon):
+            self._read_peer(peer)
+        self._ping_sweep(time.monotonic())
+        for name in set(self._out) | set(self._peers):
+            self._flush_peer(name)
+
+    def _select_wait(self, timeout: float) -> None:
+        rlist = [p.sock for p in self._peers.values() if p.sock]
+        rlist += [p.sock for p in self._anon if p.sock]
+        if self._listener is not None:
+            rlist.append(self._listener)
+        wlist = [p.sock for n, p in self._peers.items()
+                 if p.sock and (p.wbuf or self._out.get(n))]
+        # a pending dial or parked accept caps how long we may sleep
+        wake = [d["next"] for n, d in self._dial.items()
+                if n not in self._peers]
+        wake += [t for t, _, _ in self._pending_accepts]
+        now = time.monotonic()
+        if wake:
+            timeout = max(0.0, min(timeout, min(wake) - now))
+        if not rlist and not wlist:
+            time.sleep(min(timeout, 0.05))
+            return
+        try:
+            select.select(rlist, wlist, [], timeout)
+        except (OSError, ValueError):
+            pass                        # a socket died mid-select; the
+            # per-peer read path classifies it next sweep
+
+    # ---- dialing / accepting ----
+
+    def _service_dials(self, now: float) -> None:
+        for name, d in self._dial.items():
+            if name in self._peers or now < d["next"] or self._closed:
+                continue
+            fx = {"drop": False, "delay": 0.0}
+            if _fault.active_plan() is not None:
+                try:
+                    _fault.trip(
+                        "fleet.transport.connect", step=self._step,
+                        path=name,
+                        drop=lambda: fx.__setitem__("drop", True),
+                        delay=lambda s: fx.__setitem__("delay",
+                                                       float(s)))
+                except _fault.FaultInjected:
+                    # "reset": the far end refused/reset the attempt
+                    self.counters["socket_resets"] += 1
+                    self._dial_backoff(name, d, now)
+                    continue
+            if fx["drop"]:
+                self._dial_backoff(name, d, now)
+                continue
+            if fx["delay"]:
+                d["next"] = now + fx["delay"]
+                continue
+            try:
+                sock = _socket.create_connection(d["addr"], timeout=0.25)
+            except OSError:
+                self._dial_backoff(name, d, now)
+                continue
+            sock.setblocking(False)
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            peer = _Peer(sock, "%s:%d" % sock.getpeername()[:2])
+            peer.name = name
+            if d["connected_once"]:
+                self.counters["socket_reconnects"] += 1
+            d["connected_once"] = True
+            d["attempts"] = 0
+            old = self._peers.get(name)
+            if old is not None:
+                self._close_sock(old.sock)
+            self._peers[name] = peer
+            # HELLO must be the first bytes on this socket: commit it to
+            # the socket's write buffer ahead of any queued frames
+            peer.wbuf = _frame(FT_HELLO, self.node.encode())
+
+    def _dial_backoff(self, name: str, d: dict, now: float) -> None:
+        d["attempts"] += 1
+        base = self.reconnect_base_s * (2 ** min(d["attempts"] - 1, 6))
+        bounded = min(base, self.reconnect_max_s)
+        jit = deterministic_jitter(
+            f"socket-reconnect:{self.node}:{name}:{d['attempts']}",
+            1000) / 1000.0
+        d["next"] = now + bounded * (0.5 + 0.5 * jit)
+
+    def _accept_new(self) -> None:
+        if self._listener is None:
+            return
+        while True:
+            try:
+                conn, addr = self._listener.accept()
+            except (BlockingIOError, OSError):
+                return
+            fx = {"drop": False, "delay": 0.0}
+            path = "%s:%d" % addr[:2]
+            if _fault.active_plan() is not None:
+                try:
+                    _fault.trip(
+                        "fleet.transport.accept", step=self._step,
+                        path=path,
+                        drop=lambda: fx.__setitem__("drop", True),
+                        delay=lambda s: fx.__setitem__("delay",
+                                                       float(s)))
+                except _fault.FaultInjected:
+                    self.counters["socket_resets"] += 1
+                    self._rst_close(conn)
+                    continue
+            if fx["drop"]:
+                conn.close()              # silent: connector sees EOF
+                continue
+            if fx["delay"]:
+                self._pending_accepts.append(
+                    (time.monotonic() + fx["delay"], conn, addr))
+                continue
+            self._adopt(conn, addr)
+
+    def _service_accepts(self, now: float) -> None:
+        due = [e for e in self._pending_accepts if e[0] <= now]
+        if due:
+            self._pending_accepts = [e for e in self._pending_accepts
+                                     if e[0] > now]
+            for _, conn, addr in due:
+                self._adopt(conn, addr)
+
+    def _adopt(self, conn, addr) -> None:
+        conn.setblocking(False)
+        conn.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        self.counters["socket_accepts"] += 1
+        self._anon.append(_Peer(conn, "%s:%d" % addr[:2]))
+
+    # ---- reading ----
+
+    def _read_peer(self, peer: _Peer) -> None:
+        if peer.sock is None:
+            return
+        while True:
+            try:
+                data = peer.sock.recv(1 << 16)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                self._drop_peer(peer, reset=True)
+                return
+            if not data:
+                self._drop_peer(peer, reset=False)
+                return
+            self.counters["socket_bytes_recv"] += len(data)
+            peer.last_recv = time.monotonic()
+            try:
+                frames = peer.decoder.feed(data)
+            except FrameProtocolError:
+                self.counters["socket_protocol_errors"] += 1
+                self._drop_peer(peer, reset=True)
+                return
+            for ftype, payload in frames:
+                self.counters["socket_frames_recv"] += 1
+                self._on_frame(peer, ftype, payload)
+                if peer.sock is None:
+                    return
+
+    def _on_frame(self, peer: _Peer, ftype: int, payload: bytes) -> None:
+        if ftype == FT_HELLO:
+            name = payload.decode(errors="replace")
+            old = self._peers.get(name)
+            if old is not None and old is not peer:
+                self._close_sock(old.sock)    # reconnect replaces
+                old.sock = None
+            if peer in self._anon:
+                self._anon.remove(peer)
+            peer.name = name
+            self._peers[name] = peer
+        elif ftype == FT_MESSAGE:
+            try:
+                self._ready.append(decode_message(payload))
+            except FrameProtocolError:
+                self.counters["socket_protocol_errors"] += 1
+        elif ftype == FT_PING:
+            if peer.name is not None:
+                self._enqueue(peer.name, FT_PONG, payload)
+        elif ftype == FT_PONG:
+            try:
+                (token,) = _U32.unpack(payload)
+            except struct.error:
+                return
+            sent = peer.pings.pop(token, None)
+            if sent is not None:
+                peer.pings.clear()        # any pong proves liveness
+                if peer.name is not None:
+                    samples = self._rtt.setdefault(peer.name, [])
+                    samples.append(time.monotonic() - sent)
+                    if len(samples) > 1024:
+                        del samples[:512]
+        elif ftype == FT_QUERY:
+            try:
+                q = json.loads(payload.decode())
+                fn = self._query_handlers.get(q["dst"])
+                result = (fn(q["kind"], q["payload"])
+                          if fn is not None else None)
+            except Exception:  # noqa: BLE001 — advisory, never fatal
+                q, result = None, None
+            if q is not None and peer.name is not None:
+                self._enqueue(peer.name, FT_QREPLY, json.dumps(
+                    {"qid": q["qid"], "result": result},
+                    separators=(",", ":")).encode())
+        elif ftype == FT_QREPLY:
+            try:
+                r = json.loads(payload.decode())
+                self._qreplies[int(r["qid"])] = r.get("result")
+            except Exception:  # noqa: BLE001
+                pass
+
+    # ---- pings / half-open ----
+
+    def _ping_sweep(self, now: float) -> None:
+        for peer in list(self._peers.values()):
+            if peer.sock is None:
+                continue
+            if peer.pings and now - peer.last_recv > self.half_open_s:
+                # we are owed a PONG and the link has been silent past
+                # the window: half-open — tear it down (a dial target
+                # reconnects; an accepted peer must redial us)
+                self.counters["socket_half_open"] += 1
+                self._drop_peer(peer, reset=False)
+                continue
+            if (now - peer.last_recv >= self.ping_interval_s
+                    and now - peer.last_ping >= self.ping_interval_s):
+                self._ping_seq += 1
+                peer.pings[self._ping_seq] = now
+                peer.last_ping = now
+                self._enqueue(peer.name, FT_PING,
+                              _U32.pack(self._ping_seq))
+
+    # ---- writing ----
+
+    def _flush_peer(self, name: str) -> None:
+        peer = self._peers.get(name)
+        q = self._out.get(name)
+        if peer is None or peer.sock is None:
+            return
+        now = time.monotonic()
+        if peer.stall_until > now:
+            return
+        while True:
+            if peer.wbuf:
+                try:
+                    n = peer.sock.send(peer.wbuf)
+                except (BlockingIOError, InterruptedError):
+                    return
+                except OSError:
+                    self._drop_peer(peer, reset=True)
+                    return
+                self.counters["socket_bytes_sent"] += n
+                peer.wbuf = peer.wbuf[n:]
+                if peer.wbuf:
+                    return                    # kernel buffer full
+                if peer.reset_after_wbuf:
+                    # FrameChaos reset: mid-frame RST — the receiver
+                    # sees a torn frame + connection reset
+                    self.counters["socket_resets"] += 1
+                    self._drop_peer(peer, reset=False, rst=True)
+                    return
+            if not q:
+                return
+            fseq, ftype, payload = q.popleft()
+            if self.chaos is not None and ftype == FT_MESSAGE:
+                if self.chaos.stall(fseq):
+                    peer.stall_until = (time.monotonic()
+                                        + self.chaos.stall_s)
+                    q.appendleft((fseq, ftype, payload))
+                    return
+                if self.chaos.corrupt(fseq):
+                    payload = _corrupt_frame_payload(payload)
+                    self.counters["corrupt_injected"] += 1
+                if self.chaos.reset(fseq):
+                    block = _frame(ftype, payload)
+                    peer.wbuf = block[:max(1, len(block) // 2)]
+                    peer.reset_after_wbuf = True
+                    self.counters["socket_frames_sent"] += 1
+                    continue
+            peer.wbuf = _frame(ftype, payload)
+            self.counters["socket_frames_sent"] += 1
+
+    # ---- teardown ----
+
+    def _drop_peer(self, peer: _Peer, reset: bool,
+                   rst: bool = False) -> None:
+        if peer.sock is None:
+            return
+        if peer.decoder.pending:
+            self.counters["socket_torn_frames"] += 1
+            peer.decoder = FrameDecoder()
+        if reset:
+            self.counters["socket_resets"] += 1
+        if rst:
+            self._rst_close(peer.sock)
+        else:
+            self._close_sock(peer.sock)
+        peer.sock = None
+        peer.wbuf = b""
+        peer.reset_after_wbuf = False
+        peer.pings.clear()
+        if peer in self._anon:
+            self._anon.remove(peer)
+        if peer.name is not None and self._peers.get(peer.name) is peer:
+            del self._peers[peer.name]
+            d = self._dial.get(peer.name)
+            if d is not None:
+                # immediate first retry, backoff after (the jitter keys
+                # on the attempt counter, so the schedule replays)
+                d["next"] = time.monotonic()
+
+    @staticmethod
+    def _close_sock(sock) -> None:
+        if sock is None:
+            return
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    @staticmethod
+    def _rst_close(sock) -> None:
+        try:
+            sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_LINGER,
+                            struct.pack("ii", 1, 0))
+        except OSError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        """Close every connection and the listener. Idempotent."""
+        self._closed = True
+        for peer in list(self._peers.values()) + list(self._anon):
+            self._close_sock(peer.sock)
+            peer.sock = None
+        self._peers.clear()
+        self._anon.clear()
+        for _, conn, _ in self._pending_accepts:
+            self._close_sock(conn)
+        self._pending_accepts.clear()
+        if self._listener is not None:
+            self._close_sock(self._listener)
+            self._listener = None
+
+    # ---- introspection ----
+
+    def rtt_summary(self) -> dict:
+        """Peer round-trip percentiles in seconds (application-level
+        ping->pong, so a replica mid-engine-step counts — the honest
+        'how stale can my view of this peer be' number)."""
+        samples = [s for v in self._rtt.values() for s in v]
+        return {"socket_rtt_p50_s": percentile(samples, 50),
+                "socket_rtt_p99_s": percentile(samples, 99)}
+
+    def stats(self) -> dict:
+        return {**super().stats(), **self.rtt_summary()}

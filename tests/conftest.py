@@ -16,10 +16,27 @@ if not _REAL_CHIP:
         os.environ["XLA_FLAGS"] = (
             flags + " --xla_force_host_platform_device_count=8").strip()
 
+import sys  # noqa: E402
+
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 if not _REAL_CHIP:
     jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(autouse=True)
+def _reap_replica_processes():
+    """Multi-host hygiene: no replica host process may outlive its
+    test. Zero-cost unless the test imported serving.replica_host; a
+    nonzero reap count means the test leaked — fail it loudly."""
+    yield
+    mod = sys.modules.get("paddle_tpu.serving.replica_host")
+    if mod is not None:
+        leaked = mod.reap_orphans()
+        assert leaked == 0, (
+            f"{leaked} replica host process(es) outlived the test "
+            "and were SIGKILLed by the reaper")
 
 
 def pytest_configure(config):

@@ -17,7 +17,7 @@ _DEFAULT_CONFIGS = {
     "llama_serving_prefix", "llama_decode_int8", "llama_serving_int8",
     "llama_serving_fleet", "llama_serving_spec", "llama_serving_tiered",
     "llama_serving_chunked", "llama_serving_failover",
-    "llama_serving_partition",
+    "llama_serving_partition", "llama_serving_multihost",
     "llama_serving_tp", "llama_serving_fairness",
     "llama_serving_disagg", "llama_serving_lora",
 }
@@ -183,6 +183,25 @@ def test_dry_partition_cell_carries_lossy_wire_ab_keys():
                          "duplicates_suppressed", "transport_dropped",
                          "goodput_at_slo", "goodput_at_slo_clean",
                          "retraces"}, cell
+    assert all(v is None for v in cell.values()), cell
+
+
+def test_dry_multihost_cell_carries_socket_ab_keys():
+    # the loopback-vs-socket A/B (SERVING.md "Multi-host serving"): the
+    # cell must surface what the real TCP wire cost — frame/byte
+    # volume, reconnects and lease churn (both 0 on a healthy wire),
+    # and goodput_at_slo for BOTH arms — next to the usual serving keys
+    out = _run_dry("llama_serving_multihost")
+    assert out.returncode == 0, out.stderr
+    last = json.loads(out.stdout.splitlines()[-1])
+    cell = last["bench_summary"]["llama_serving_multihost"]
+    assert set(cell) >= {"value", "mfu", "spread",
+                         "ttft_p50", "ttft_p99", "tpot",
+                         "frames_sent", "frames_recv",
+                         "frame_bytes_sent", "frame_bytes_recv",
+                         "socket_reconnects", "lease_expirations",
+                         "goodput_at_slo", "goodput_at_slo_loopback",
+                         "tokens_per_s_loopback", "retraces"}, cell
     assert all(v is None for v in cell.values()), cell
 
 

@@ -125,6 +125,20 @@ Run: python tools/profile_serving.py            (real TPU)
                                                  consumed, zombie fenced —
                                                  SERVING.md "Fleet
                                                  transport & membership")
+     python tools/profile_serving.py --multihost
+                                                (multi-host kill replay:
+                                                 spawn 3 REAL replica host
+                                                 processes over the socket
+                                                 wire, SIGKILL one mid-
+                                                 stream; prints the outcome
+                                                 histogram, socket frame/
+                                                 reconnect and fleet lease/
+                                                 failover counters, per-
+                                                 process pid/addr/exit
+                                                 rows, and asserts every
+                                                 stream bitwise ==
+                                                 generate() — SERVING.md
+                                                 "Multi-host serving")
      python tools/profile_serving.py --tp       (tensor-parallel A/B on a
                                                  forced 2-device CPU mesh:
                                                  the same staggered trace
@@ -474,6 +488,124 @@ def fleet_chaos():
             assert eng.decode_program_count() == 1, "decode retraced"
     print("invariants held: all classified, 2 ejections dumped, "
           "survivors never retraced")
+
+
+def multihost():
+    """Multi-host kill replay (SERVING.md "Multi-host serving"): spawn
+    three REAL replica host processes on localhost (``spawn_fleet`` —
+    each one a ``python -m paddle_tpu.serving.replica_host`` child
+    owning its own engine behind the socket wire), run a seeded
+    workload through the router, and SIGKILL one replica mid-stream.
+
+    Prints the per-replica outcome histogram (which process delivered
+    each finish), the socket transport's frame/reconnect counters, the
+    fleet's lease/failover/snapshot counters, and each replica's
+    terminal health row with its OS pid, socket address and post-mortem
+    exit classification. The invariant asserted at the end is the
+    acceptance bar: every client stream is bitwise identical to a
+    single-engine ``generate()`` run of the same seed — the kill is
+    invisible to clients, exactly-once, via lease expiry -> epoch fence
+    -> snapshot-seeded failover."""
+    import collections
+
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.serving.fleet import DEAD
+    from paddle_tpu.serving.replica_host import (reap_orphans,
+                                                 shutdown_fleet,
+                                                 spawn_fleet)
+
+    spec = {"seed": 0, "snapshots": True,
+            "engine": {"num_pages": 64, "page_size": 4, "max_slots": 4,
+                       "snapshot_interval": 2}}
+    rng = np.random.default_rng(0)
+    n_requests, max_new = 8, 12
+    prompts = [rng.integers(1, 500, int(rng.integers(3, 7)))
+               .astype(np.int32) for _ in range(n_requests)]
+
+    # single-engine ground truth: same seed, same config, no fleet
+    pt.seed(0)
+    model = LlamaForCausalLM(llama_tiny(mp_axis=None, fsdp_axis=None))
+    model.eval()
+    refs = [np.asarray(model.generate(jnp.asarray([p]),
+                                      max_new_tokens=max_new))
+            [0, len(p):].tolist() for p in prompts]
+
+    print("spawning 3 replica host processes (model build + warm "
+          "per child — tens of seconds on CPU)...")
+    t0 = time.perf_counter()
+    router, handles = spawn_fleet(
+        3, spec, router_kwargs={"snapshot_fetch_interval": 2})
+    print(f"fleet up in {time.perf_counter() - t0:.1f}s: "
+          + "  ".join(f"replica {h.idx} pid={h.pid} addr={h.addr}"
+                      for h in handles))
+
+    rids = [router.submit(p, max_new) for p in prompts]
+
+    def emitted():
+        return sum(len(router.request(r).tokens) for r in rids)
+
+    steps = 0
+    while router.has_work() and emitted() < 30:
+        router.step()
+        steps += 1
+        assert steps < 40000, "fleet hung before the kill"
+    victim = next((router.request(r).replica for r in rids
+                   if router.request(r).replica is not None
+                   and not router.request(r).finished), 1)
+    print(f"\nSIGKILL replica {victim} (pid {handles[victim].pid}) at "
+          f"{emitted()} emitted tokens, router step {steps}")
+    handles[victim].kill()
+    handles[victim].wait(10)
+    while router.has_work():
+        router.step()
+        steps += 1
+        assert steps < 40000, "fleet hung after the kill"
+
+    outcomes = collections.Counter()
+    for rid in rids:
+        req = router.request(rid)
+        where = ("-" if req.replica is None
+                 else f"replica {req.replica}")
+        outcomes[(where, req.finish_reason or "unfinished")] += 1
+
+    fleet = router.fleet_metrics.summary()
+    st = router.stats()
+    tr = st.get("transport", {})
+    print(f"\nmulti-host kill replay: {n_requests} requests over 3 "
+          f"processes, {steps} router steps")
+    print("per-replica outcome histogram:")
+    for (where, reason), n in sorted(outcomes.items()):
+        print(f"  {where:10s} {reason:20s} {n}")
+    print("socket counters: "
+          + "  ".join(f"{k.removeprefix('socket_')}={tr[k]}"
+                      for k in sorted(tr)
+                      if k.startswith("socket_") and tr[k]))
+    print("fleet counters:  "
+          + "  ".join(f"{k}={v}" for k, v in sorted(fleet.items()) if v))
+    print("replica health:")
+    for h in st["replica_health"]:
+        line = (f"  replica {h['replica']}: state={h['state']:9s} "
+                f"pid={h['pid']} addr={h['addr']}")
+        if h["exit_status"]:
+            line += f" exit_status={h['exit_status']}"
+        print(line)
+
+    mismatches = [rid for rid, ref in zip(rids, refs)
+                  if router.request(rid).tokens != ref]
+    assert not mismatches, (
+        f"streams diverged from generate(): {mismatches}")
+    h = router.health(victim)
+    assert h["state"] == DEAD and h["exit_status"] == "signal:SIGKILL"
+    assert fleet["lease_expirations"] >= 1, "the kill never expired a lease"
+    assert fleet["failovers"] >= 1, "the kill produced no failover"
+
+    shutdown_fleet(router, handles)
+    assert reap_orphans() == 0, "a replica process outlived the run"
+    print("invariants held: all streams bitwise == generate(), "
+          "exactly-once, victim classified signal:SIGKILL, no orphans")
 
 
 def netchaos():
@@ -1910,7 +2042,9 @@ def tp():
 
 
 if __name__ == "__main__":
-    if "--netchaos" in sys.argv[1:]:
+    if "--multihost" in sys.argv[1:]:
+        multihost()
+    elif "--netchaos" in sys.argv[1:]:
         netchaos()
     elif "--fleet-chaos" in sys.argv[1:]:
         fleet_chaos()
