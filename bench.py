@@ -122,6 +122,9 @@ _SERVING_SLOS = {
     # the mesh must not hide behind looser targets; both arms report
     # goodput against the identical budget
     "llama_serving_tp": {"ttft_p99_s": 2.0, "itl_p99_s": 0.25},
+    # pp arm: same workload and SLOs as llama_serving_tp — staging the
+    # decoder must not be allowed to hide behind looser targets
+    "llama_serving_pp": {"ttft_p99_s": 2.0, "itl_p99_s": 0.25},
     # disaggregated prefill/decode A/B: the long-prompt trace makes
     # TTFT prefill-dominated (chunked 10x prompts take seconds on the
     # bench chip), so the TTFT budget is generous — the SLO that the
@@ -2216,6 +2219,153 @@ def bench_llama_serving_tp(peak, peak_kind, n_requests=12,
     }
 
 
+def bench_llama_serving_pp(peak, peak_kind, n_requests=12,
+                           max_new_tokens=48, trace_path=None):
+    """Pipeline-parallel serving A/B (SERVING.md "Pipeline-parallel
+    serving"): ONE seeded staggered Workload trace served by a tp=2
+    engine and by a pp=2 x tp=2 engine that stages the decoder along
+    the stacked-layer axis (embed + first half on stage 0, lm_head +
+    last half on stage 1), carves the KV pool per stage, and hands
+    activations between stages with one ppermute ring INSIDE each of
+    the two compiled step programs. The arms' per-request token streams
+    are asserted BITWISE IDENTICAL — staging relocates layers, it never
+    changes the math — so every delta in the summary is attributable to
+    the pipeline alone. On the loopback harness both stages of the one
+    shard_map program run back-to-back in-process, so each arm is timed
+    on the VIRTUAL PARALLEL CLOCK (PR 16 precedent): the measured clock
+    advances by each engine step's wall time, compile time off the
+    clock (epoch 1 warms, epoch 2 is measured). The headline pipeline
+    evidence: per-chip KV bytes exactly 1/pp of the tp-only shard, and
+    the microbatched mixed step's pipeline_bubble_frac
+    ``(pp-1)/(waves+pp-1)`` strictly below the unwaved ``(pp-1)/pp``.
+    Needs >= 4 devices (TPU slice, or CPU with
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` exported
+    before the first jax import)."""
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import (ServingEngine, ServingMetrics,
+                                    make_workload)
+
+    name = "llama_serving_pp"
+    if jax.device_count() < 4:
+        raise RuntimeError(
+            "llama_serving_pp needs >= 4 devices for the pp=2 x tp=2 "
+            "arm; on CPU export XLA_FLAGS=--xla_force_host_platform_"
+            "device_count=8 before running bench.py (jax is already "
+            "initialized by the time this config runs, so the flag "
+            "cannot be set here)")
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                      intermediate_size=5632, num_hidden_layers=8,
+                      num_attention_heads=16, num_key_value_heads=8,
+                      max_position_embeddings=4096, dtype="bfloat16",
+                      mp_axis="mp", fsdp_axis=None)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    n_params = model.num_params()
+    wl = make_workload(seed=0, n_requests=n_requests, arrival="poisson",
+                       rate=0.5, tenants=3, zipf_alpha=1.2,
+                       system_len=(96, 160),
+                       prompt_mix=((0.7, 16, 48), (0.3, 48, 96)),
+                       max_new=(max_new_tokens, max_new_tokens),
+                       vocab_size=cfg.vocab_size)
+    tracer = _make_tracer(trace_path)
+    arms = {}
+    for arm, pp in (("tp2", 1), ("pp2", 2)):
+        eng = ServingEngine(model, num_pages=64, page_size=16,
+                            max_slots=4, tracer=tracer, tp=2, pp=pp)
+        # virtual parallel clock: a real pp x tp slice runs the one
+        # compiled step across 2 x pp chips at once, but the loopback
+        # harness executes every fake device in one process — score the
+        # metrics on accumulated engine-step wall time so both arms pay
+        # exactly their step cost, nothing else
+        vt = [0.0]
+
+        def timed(_orig=eng.step):
+            t0 = time.perf_counter()
+            ev = _orig()
+            vt[0] += time.perf_counter() - t0
+            return ev
+
+        eng.step = timed
+        rec = _StreamRecorder(eng)
+        wl.replay(rec, max_steps=4000, rid_prefix="warm-")
+        vt[0] = 0.0                     # compile time stays off the clock
+        eng.metrics = ServingMetrics(clock=lambda _vt=vt: _vt[0])
+        eng.metrics.set_slo(**_SERVING_SLOS[name])
+        eng.metrics.set_tp(2, eng.pool.kv_bytes_per_token_shard())
+        eng.metrics.set_pp(eng.pp, eng._pp_waves,
+                           eng.pipeline_bubble_frac())
+        out = wl.replay(rec, max_steps=4000, rid_prefix="run-")
+        m = eng.metrics.summary()
+        assert eng.step_program_counts() == {"decode": 1, "mixed": 1}, \
+            f"pp={pp} step retraced"
+        streams = {r: t for r, t in rec.tokens.items()
+                   if r.startswith("run-")}
+        arms[arm] = (eng, m, out, streams)
+    assert arms["tp2"][3] == arms["pp2"][3], \
+        "pp=2 streams diverged from tp-only — staging must be bitwise"
+    eng, m, out, _ = arms["pp2"]
+    m0 = arms["tp2"][1]
+    # the two headline pipeline claims, priced into the summary
+    shard_pp = eng.pool.kv_bytes_per_token_shard()
+    shard_tp = arms["tp2"][0].pool.kv_bytes_per_token_shard()
+    assert shard_pp * eng.pp == shard_tp, \
+        "per-chip KV bytes must be exactly 1/pp of the tp-only shard"
+    bubble = eng.pipeline_bubble_frac()
+    bubble_unwaved = eng.pipeline_bubble_frac(waves=1)
+    assert bubble < bubble_unwaved, \
+        "microbatched bubble fraction must beat the unwaved schedule"
+    hbm_bw = {"v4": 1.2e12,
+              "v5e": 0.82e12, "v5litepod": 0.82e12, "v5lite": 0.82e12,
+              "v5p": 2.77e12,
+              "v6e": 1.64e12, "trillium": 1.64e12,
+              }.get(peak_kind.split("(")[0], 0.82e12)
+    wall = max(m["wall_s"], 1e-9)
+    mbu = out["steps"] * 2.0 * n_params / wall / hbm_bw
+    trace_out = _dump_trace(tracer, trace_path, name)
+    return {
+        "metric": "llama_420m_serving_pp_tokens_per_sec",
+        "value": round(m["tokens_per_s"], 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mbu, 4),
+        "extra": {"params": n_params, "workload": wl.stats(),
+                  "max_new_tokens": max_new_tokens,
+                  "engine_steps": out["steps"],
+                  "submitted": out["submitted"], "shed": out["shed"],
+                  "pp_degree": eng.pp, "tp_degree": 2,
+                  "pp_waves": eng._pp_waves,
+                  "pipeline_bubble_frac": round(bubble, 4),
+                  "pipeline_bubble_frac_unwaved":
+                      round(bubble_unwaved, 4),
+                  "pp_stage_layers":
+                      cfg.num_hidden_layers // eng.pp,
+                  "tp_shard_kv_bytes_per_token": shard_pp,
+                  "tp_shard_kv_bytes_per_token_tponly": shard_tp,
+                  "kv_bytes_per_token": eng.pool.kv_bytes_per_token(),
+                  "ttft_p50": round(m["ttft_p50_s"], 4),
+                  "ttft_p99": round(m["ttft_p99_s"], 4),
+                  "tpot": round(m["tpot_mean_s"], 5),
+                  "itl_p99": round(m["itl_p99_s"], 5),
+                  "preemptions": m["preemptions"],
+                  "rejected": m["rejected"],
+                  "goodput_at_slo": round(m["goodput_at_slo"], 4),
+                  "goodput_at_slo_tponly":
+                      round(m0["goodput_at_slo"], 4),
+                  "tokens_per_s_tponly": round(m0["tokens_per_s"], 1),
+                  "bitwise_parity": True,
+                  "slo": _SERVING_SLOS[name],
+                  "retraces": eng.decode_program_count() - 1,
+                  "trace": trace_out,
+                  "mbu_weights_only": round(mbu, 4),
+                  "peak": peak_kind, "hbm_bw": hbm_bw,
+                  "pipeline": True, "runs": _RUNS,
+                  "spread": None},
+    }
+
+
 def bench_llama8b_shape(peak, peak_kind, batch=1, seq=4096, layers=2):
     """North-star-SHAPE evidence (VERDICT r4 missing #1): ``layers``
     llama_3_8b-config decoder layers (hidden 4096, ffn 14336, GQA 32/8,
@@ -2601,6 +2751,13 @@ _CONFIGS = {
     # Needs >= 2 devices (CPU: XLA_FLAGS=--xla_force_host_platform_
     # device_count=8 exported before launch)
     "llama_serving_tp": bench_llama_serving_tp,
+    # pipeline-parallel serving A/B (SERVING.md "Pipeline-parallel
+    # serving"): tp=2 vs pp=2 x tp=2 on one seeded trace, virtual
+    # parallel clock, streams asserted bitwise identical; per-chip KV
+    # bytes (exactly 1/pp), microbatched vs unwaved bubble fraction +
+    # goodput for both arms. Needs >= 4 devices (CPU: XLA_FLAGS=
+    # --xla_force_host_platform_device_count=8 exported before launch)
+    "llama_serving_pp": bench_llama_serving_pp,
     # disaggregated prefill/decode A/B (SERVING.md "Disaggregated
     # serving"): colocated vs phase-specialized 2-replica fleet on the
     # long-prompt trace at 1x and 10x prompt length, virtual parallel
@@ -2686,6 +2843,16 @@ _SUMMARY_EXTRA_KEYS = {
                          "kv_bytes_per_token",
                          "tokens_per_s_tp1",
                          "goodput_at_slo", "goodput_at_slo_tp1",
+                         "retraces"),
+    "llama_serving_pp": ("ttft_p50", "ttft_p99", "tpot",
+                         "pp_degree", "pp_waves",
+                         "pipeline_bubble_frac",
+                         "pipeline_bubble_frac_unwaved",
+                         "tp_shard_kv_bytes_per_token",
+                         "tp_shard_kv_bytes_per_token_tponly",
+                         "kv_bytes_per_token",
+                         "tokens_per_s_tponly",
+                         "goodput_at_slo", "goodput_at_slo_tponly",
                          "retraces"),
     "llama_serving_disagg": ("ttft_p50", "ttft_p99",
                              "ttft_p99_colocated", "tpot",

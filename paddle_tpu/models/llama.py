@@ -88,6 +88,26 @@ def _mp_psum(x, axis):
     return x
 
 
+def _vocab_parallel_embed(w, input_ids, axis):
+    """Vocab-parallel embedding lookup from a weight VALUE. In the
+    hint-based path the plain gather + the (mp, None) weight spec let
+    GSPMD insert the collective; inside a manual-mp shard_map region
+    (the TP/PP serving steps) ``w`` is the local vocab-row shard, so
+    this is the reference's masked local lookup + psum
+    (mp_layers.py:47) — bitwise equal to the replicated gather, because
+    exactly one shard contributes each row and the rest add zeros."""
+    if axis is not None:
+        from ..distributed.fleet.mp_layers import current_manual_mp
+        if current_manual_mp() == axis:
+            per = w.shape[0]
+            local = input_ids - jax.lax.axis_index(axis) * per
+            ok = (local >= 0) & (local < per)
+            rows = jnp.take(w, jnp.clip(local, 0, per - 1), axis=0)
+            rows = jnp.where(ok[..., None], rows, 0)
+            return jax.lax.psum(rows, axis)
+    return F.embedding(input_ids, w)
+
+
 def _mp_gather_logits(logits, axis):
     """all_gather of the vocab-sharded logits inside a manual-mp region
     (both the untied lm_head and the tied embed.T shard vocab on mp) —
@@ -419,24 +439,15 @@ class LlamaModel(Layer):
         self.register_buffer("rope_sin", sin, persistable=False)
 
     def _embed(self, input_ids):
-        """Vocab-parallel embedding. In the hint-based path the plain
-        gather + the (mp, None) weight spec let GSPMD insert the
-        collective; inside a manual-mp shard_map region (the TP serving
-        steps) the weight is the local vocab-row shard, so this is the
-        reference's masked local lookup + psum (mp_layers.py:47) —
-        bitwise equal to the replicated gather, because exactly one shard
-        contributes each row and the rest add zeros."""
+        """Vocab-parallel embedding (see :func:`_vocab_parallel_embed`;
+        routed through the shared helper so the pipeline-staged serving
+        forward embeds bitwise-identically)."""
         mp = self.config.mp_axis
         if mp is not None:
             from ..distributed.fleet.mp_layers import current_manual_mp
             if current_manual_mp() == mp:
-                w = self.embed_tokens.weight
-                per = w.shape[0]
-                local = input_ids - jax.lax.axis_index(mp) * per
-                ok = (local >= 0) & (local < per)
-                rows = jnp.take(w, jnp.clip(local, 0, per - 1), axis=0)
-                rows = jnp.where(ok[..., None], rows, 0)
-                return jax.lax.psum(rows, mp)
+                return _vocab_parallel_embed(self.embed_tokens.weight,
+                                             input_ids, mp)
         return self.embed_tokens(input_ids)
 
     def forward(self, input_ids, attn_mask=None, kv_caches=None, position_offset=0,
@@ -491,6 +502,40 @@ class LlamaForCausalLM(Layer):
             logits = self.lm_head(hidden)
         logits = _mp_gather_logits(logits, self.config.mp_axis)
         return (logits, new_caches) if kv_caches is not None else logits
+
+    def pp_parts(self):
+        """The embed / stacked-layers / head decomposition the
+        pipeline-parallel serving engine stages over a 'pp' mesh axis
+        (serving/parallel.py TPContext, pp>1). ``embed``/``head`` are
+        closures over a path-keyed state dict — the SAME expressions
+        ``forward`` runs (shared ``_vocab_parallel_embed``, rms_norm +
+        tied/untied head matmul + the one mp logits gather), so the
+        staged forward is bitwise-equal to the flat one. ``template`` is
+        layer 0 — every decoder layer is isomorphic, so one
+        functional_call per stacked slice replays any layer."""
+        cfg = self.config
+
+        def embed(state, input_ids):
+            return _vocab_parallel_embed(
+                state["model.embed_tokens.weight"], input_ids, cfg.mp_axis)
+
+        def head(state, hidden):
+            hidden = F.rms_norm(hidden, state["model.norm.weight"],
+                                cfg.rms_norm_eps)
+            if cfg.tie_word_embeddings:
+                logits = hidden @ state["model.embed_tokens.weight"].T
+            else:
+                logits = F.linear(hidden, state["lm_head.weight"])
+            return _mp_gather_logits(logits, cfg.mp_axis)
+
+        return {
+            "layer_prefix": "model.layers.",
+            "num_layers": cfg.num_hidden_layers,
+            "template": self.model.layers[0],
+            "rope_keys": ("model.rope_cos", "model.rope_sin"),
+            "embed": embed,
+            "head": head,
+        }
 
     def init_kv_caches(self, batch_size, max_len, dtype=None):
         """Fixed-size contiguous caches; ``dtype="int8"`` (or jnp.int8)

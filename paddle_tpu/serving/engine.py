@@ -147,7 +147,9 @@ class ServingEngine:
                  host_tier=None, chunked: bool = True,
                  prefill_chunk: int = 64, snapshot_store=None,
                  snapshot_interval: int = 16, tp: int = 1,
-                 tp_devices=None, fair_scheduling: bool = False,
+                 tp_devices=None, pp: int = 1,
+                 pp_microbatch: bool = True,
+                 fair_scheduling: bool = False,
                  tenant_weights=None, tenant_max_live: int | None = None,
                  tenant_max_queued_tokens: int | None = None,
                  shed_infeasible: bool = False, brownout=None,
@@ -168,11 +170,21 @@ class ServingEngine:
         # over the mp axis. tp=1 is exactly the single-device engine.
         # Un-shardable configs raise TPConfigError here, not a shape
         # crash inside the compiled step.
+        # pipeline parallelism (same file; SERVING.md "Pipeline-parallel
+        # serving"): pp=P stages the decoder over a leading pp mesh axis
+        # — embed + the first L/pp layers on stage 0, lm_head + the last
+        # on stage P-1 — with the KV pool stacked and carved per stage.
+        # Each step is STILL one jit(shard_map) over the full pp×mp
+        # mesh; stage handoff is a ppermute ring inside the program.
+        # pp_microbatch splits the mixed step's chunk into pp waves so
+        # stages overlap instead of idling (pp-1)/pp of the time.
         from .parallel import TPContext, validate_tp_config
-        validate_tp_config(cfg, tp)
+        validate_tp_config(cfg, tp, pp)
         self.tp = int(tp)
-        self._tp = (TPContext(model, tp, devices=tp_devices)
-                    if tp > 1 else None)
+        self.pp = int(pp)
+        self._tp = (TPContext(model, tp, devices=tp_devices, pp=pp)
+                    if tp > 1 or pp > 1 else None)
+        self._pp_waves = self.pp if (self.pp > 1 and pp_microbatch) else 1
         # int8 KV mode: kv_quant=True, or kv_dtype="int8"/jnp.int8 — the
         # pool stores int8 codes + fp32 absmax scales, quantized at
         # scatter time and dequantized inside the one shared decode core
@@ -193,7 +205,7 @@ class ServingEngine:
             cache_enabled=prefix_cache, quantized=kv_quant,
             host_tier=host_tier if prefix_cache else None,
             sharding=(self._tp.kv_shardings() if self._tp else None),
-            tp_degree=self.tp)
+            tp_degree=self.tp, pp_degree=self.pp)
         # every (re-)admission must fit the slot's block table and the
         # rope table — admission_check guards the window up front
         self._ctx_pages = min(self.max_pages_per_slot,
@@ -229,12 +241,12 @@ class ServingEngine:
         elif isinstance(lora, dict):
             lora = AdapterPool(cfg, **lora)
         self.adapters: AdapterPool | None = lora or None
-        if self.adapters is not None and self.tp > 1:
+        if self.adapters is not None and (self.tp > 1 or self.pp > 1):
             from .errors import TPConfigError
             raise TPConfigError(
                 "multi-tenant LoRA serving is single-shard for now: "
-                "adapter buffers are not laid out for the TP step "
-                "programs (pass tp=1 or lora=None)")
+                "adapter buffers are not laid out for the TP/PP step "
+                "programs (pass tp=1, pp=1 or lora=None)")
         self.scheduler.adapters = self.adapters
         if brownout is True:
             brownout = BrownoutConfig()
@@ -274,7 +286,14 @@ class ServingEngine:
         self.chunked = bool(chunked)
         self.prefill_chunk = int(prefill_chunk)
         self._chunk = max(self.prefill_chunk, self.scheduler.spec_k)
+        if self._pp_waves > 1:
+            # the microbatched mixed step splits its row axis into
+            # pp equal waves — round the compile-time chunk up so the
+            # wave width K/waves is integral (a few extra padded rows,
+            # never a second program shape)
+            self._chunk = -(-self._chunk // self._pp_waves) * self._pp_waves
         self.scheduler.chunked = self.chunked
+        self.scheduler.pp_waves = self._pp_waves
         # crash-consistent snapshots (serving/snapshot.py; RESILIENCE.md
         # "Serving recovery playbook"): with a SnapshotStore attached,
         # every snapshot_interval steps the engine captures each live
@@ -298,6 +317,8 @@ class ServingEngine:
         self.metrics.set_snapshots(snapshot_store is not None)
         self.metrics.set_tp(self.tp,
                             self.pool.kv_bytes_per_token_shard())
+        self.metrics.set_pp(self.pp, self._pp_waves,
+                            self.pipeline_bubble_frac())
         self.metrics.set_fair(fair_scheduling)
         self.metrics.set_brownout(self._brownout is not None)
         self.metrics.set_lora(self.adapters is not None)
@@ -324,8 +345,12 @@ class ServingEngine:
         self._watchdog = watchdog
         self._state = model.state_dict(include_non_persistable_buffer=True)
         if self._tp is not None:
-            # one-time placement onto the TP mesh (column/row/vocab
-            # layout from the creation-time weight specs)
+            # one-time placement onto the mesh (column/row/vocab layout
+            # from the creation-time weight specs); pp>1 first folds the
+            # per-layer keys into [L, ...] stacks whose leading dim
+            # shards on the pp axis
+            if self.pp > 1:
+                self._state = self._tp.stage_state(self._state)
             self._state = self._tp.shard_state(self._state)
         self._requests: dict[str, Request] = {}
         # disaggregated serving (SERVING.md "Disaggregated serving"):
@@ -835,12 +860,13 @@ class ServingEngine:
         dir that :meth:`restore` rejects; the previous committed
         snapshot at ``path`` is replaced only by the atomic rename."""
         snaps = self._capture_requests()
-        # "tp" is informational: payloads are full logical pages (the
-        # capture device_get gathers shards), so a tp=2 snapshot
-        # restores into a tp=1 engine and vice versa
+        # "tp"/"pp" are informational: payloads are full logical pages
+        # (the capture device_get gathers shards, and the stacked pp
+        # pool emits the same per-layer payload order), so a tp=2 or
+        # pp=2 snapshot restores into a tp=1 engine and vice versa
         save_engine_snapshot(path, snaps, meta={
             "steps": self._steps, "kv_quant": self.kv_quant,
-            "page_size": self.page_size, "tp": self.tp})
+            "page_size": self.page_size, "tp": self.tp, "pp": self.pp})
         self.metrics.counters["snapshot_saves"] += 1
         self.tracer.instant("snapshot_save", requests=len(snaps),
                             step=self._steps)
@@ -1185,6 +1211,19 @@ class ServingEngine:
             self.pool.pools = pools
         self._note_retraces()
 
+    def pipeline_bubble_frac(self, waves: int | None = None) -> float:
+        """Idle-stage fraction of the pipelined mixed step: a ring of
+        ``pp`` stages over ``W`` waves runs ``W + pp - 1`` ticks of
+        which ``pp - 1`` are fill/drain — the bubble is
+        ``(pp - 1) / (W + pp - 1)``. At ``waves == 1`` (the unwaved,
+        naive sequential schedule) this is ``(pp - 1) / pp``;
+        microbatching with ``waves == pp`` shrinks it to
+        ``(pp - 1) / (2 pp - 1)`` — strictly below. 0.0 when pp=1."""
+        if self.pp <= 1:
+            return 0.0
+        W = int(waves) if waves is not None else self._pp_waves
+        return (self.pp - 1) / (W + self.pp - 1)
+
     def stats(self) -> dict:
         return {"steps": self._steps,
                 "pool": self.pool.stats(),
@@ -1206,6 +1245,9 @@ class ServingEngine:
                 "snapshots": self.snapshot_store is not None,
                 "snapshot_interval": self.snapshot_interval,
                 "tp": self.tp,
+                "pp": self.pp,
+                "pp_waves": self._pp_waves,
+                "pipeline_bubble_frac": self.pipeline_bubble_frac(),
                 "fair": self.scheduler.fair,
                 "brownout": self._brownout is not None,
                 "brownout_level": self._brownout_level,
@@ -1378,6 +1420,17 @@ class ServingEngine:
         page = req.pages[-1]
         pk, pv = self.pool.pools[0]
         from ..quantization.serving import QuantizedKV
+        if self.pool.stacked:
+            # pp pool: pools[0] is the stacked [L, pages, ...] pair —
+            # poison layer 0 of the page (stage 0's slice; the NaN
+            # still reaches every stage through the activation ring)
+            if isinstance(pk, QuantizedKV):
+                pk = QuantizedKV(pk.q,
+                                 pk.scale.at[0, page, :, 0].set(jnp.nan))
+            else:
+                pk = pk.at[0, page, :, 0].set(jnp.nan)
+            self.pool.pools[0] = (pk, pv)
+            return
         if isinstance(pk, QuantizedKV):
             # int8 codes cannot hold a NaN — poison the page's fp32
             # SCALE row instead: NaN * code propagates through the
@@ -1439,6 +1492,28 @@ class ServingEngine:
 
         if self._tp is None:
             return jax.jit(decode_step)
+        tp = self._tp
+        if tp.pp > 1:
+            # pp>1: the forward routes through the staged pipeline ring
+            # (TPContext.staged_forward, one wave — decode is a single
+            # row per slot) instead of the flat model; the sampling tail
+            # is byte-identical, running on the replicated post-gather
+            # logits, so the fold_in contract and bitwise parity vs the
+            # tp-only engine hold
+            def decode_step_pp(state, pools, tok, tables, seq_lens,
+                               active, temps, top_ps, greedy, seeds,
+                               counts):
+                logits, pools = tp.staged_forward(
+                    state, pools, tok[:, None], tables, seq_lens, active,
+                    None, waves=1)
+                last = logits[:, -1]
+                ok = jnp.all(jnp.isfinite(last.astype(jnp.float32)),
+                             axis=-1)
+                nt = _sample_rows(last, temps, top_ps, greedy, seeds,
+                                  counts)
+                return nt, ok, pools
+            return tp.compile_step(decode_step_pp, self._state,
+                                   self.pool.pools, n_lanes=9, n_lead=2)
         # tp>1: the SAME body compiles as ONE shard_map program over the
         # mp axis — state/pools come in sharded, the 9 host-built lanes
         # replicated, tokens/ok out replicated (sampling ran on the
@@ -1532,6 +1607,50 @@ class ServingEngine:
 
         if self._tp is None:
             return jax.jit(mixed_step)
+        tp = self._tp
+        if tp.pp > 1:
+            # pp>1: the forward is the microbatched pipeline ring — the
+            # chunk splits into waves that overlap across stages — and
+            # everything after the logits (finite sentinel, Leviathan
+            # accept, in-program rollback) repeats the tp body verbatim
+            # on the replicated values, except the rollback scatter
+            # addresses the stacked [L, pages, ...] pool layout
+            waves = self._pp_waves
+
+            def mixed_step_pp(state, pools, toks, tables, seq_lens,
+                              active, n_live, forced, temps, top_ps,
+                              greedy, seeds, counts):
+                logits, pools = tp.staged_forward(
+                    state, pools, toks, tables, seq_lens, active, n_live,
+                    waves=waves)
+                S, K, V = logits.shape
+                rows = jnp.arange(K)
+                live = rows[None, :] < n_live[:, None]        # [S, K]
+                ok = jnp.all(jnp.where(
+                    live[..., None],
+                    jnp.isfinite(logits.astype(jnp.float32)),
+                    True), axis=(1, 2))
+                samp = _sample_rows(
+                    logits.reshape(S * K, V),
+                    jnp.repeat(temps, K), jnp.repeat(top_ps, K),
+                    jnp.repeat(greedy, K), jnp.repeat(seeds, K),
+                    (counts[:, None] + rows[None, :]).reshape(-1),
+                ).reshape(S, K)
+                match = (toks[:, 1:] == samp[:, :-1]) & live[:, 1:]
+                m = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                            axis=1)
+                m = jnp.where(forced, n_live - 1, m)
+                pos = seq_lens[:, None] + rows[None, :]
+                rej = live & (rows[None, :] > m[:, None]) & active[:, None]
+                page = jnp.take_along_axis(tables, pos // ps, axis=1)
+                page = jnp.where(rej, page, 0)
+                off = jnp.where(rej, pos % ps, 0)
+                pools = [(KVCachePool._pos_zero(pk, page, off, True),
+                          KVCachePool._pos_zero(pv, page, off, True))
+                         for pk, pv in pools]
+                return samp, m, ok, pools
+            return tp.compile_step(mixed_step_pp, self._state,
+                                   self.pool.pools, n_lanes=11, n_lead=3)
         # tp>1: same body, ONE shard_map program (the rollback scatter is
         # head-local — page/off index the replicated dims, every shard
         # zeroes its own kvh/tp heads of the rejected rows)
@@ -1681,6 +1800,7 @@ class ServingEngine:
         if not self.chunked:
             return plan
         C = self._chunk
+        Kw = C // max(self.scheduler.pp_waves, 1)
         prefilling = sorted(
             ((slot, req) for slot, req in self.scheduler.running.items()
              if req.prefilling),
@@ -1691,6 +1811,14 @@ class ServingEngine:
             n = min(C, need, cap)
             if n <= 0:
                 break
+            if n < need and n > Kw:
+                # wave alignment (pp microbatching): a non-final bite
+                # rounds down to whole waves of the microbatched mixed
+                # step, so no wave runs half-empty mid-prompt. Pure
+                # pacing — chunk boundaries never change the emitted
+                # stream (chunked-prefill parity contract). At
+                # pp_waves=1, Kw == C >= n and this never fires.
+                n = (n // Kw) * Kw
             plan[slot] = n
             budget -= n
         return plan
@@ -1835,6 +1963,13 @@ class ServingEngine:
         self.metrics.on_mixed_step(
             chunk_tokens, len(n_drafted), len(plan),
             sum(1 for r in sched.running.values() if r.prefilling))
+        if tr.enabled and self._pp_waves > 1:
+            # stage waves run inside the one compiled mixed program, so
+            # the per-wave instants are logical markers emitted at
+            # dispatch (the device timeline can't be split from host)
+            for w in range(self._pp_waves):
+                tr.instant("pp_wave", wave=w, width=K // self._pp_waves,
+                           pp=self.pp)
         with tr.span("mixed_dispatch", slots=len(plan) + len(n_drafted),
                      chunk_tokens=chunk_tokens,
                      drafts=sum(n_drafted.values())):

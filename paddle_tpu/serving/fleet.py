@@ -801,6 +801,7 @@ class FleetRouter:
             # failover-replay path below is the same either way
             # (RESILIENCE.md), this gauge just sizes the blast radius.
             "tp_degree": int(g.get("tp_degree", 1)),
+            "pp_degree": int(g.get("pp_degree", 1)),
             # overload-control gauge: which brownout rung this replica
             # is on (0 = normal service; engines without the ladder
             # always read 0)

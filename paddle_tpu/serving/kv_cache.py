@@ -70,22 +70,29 @@ _HASH_ROOT_INT8 = hashlib.blake2b(b"paddle_tpu.kv.int8",
                                   digest_size=16).digest()
 
 
-def _page_copy(arr, src: int, dst: int):
+def _page_copy(arr, src: int, dst: int, stacked: bool = False):
     """Device-copy one page; a QuantizedKV page carries its scale row
     along with the int8 codes (COW without the scales would dequantize
-    the copy with garbage)."""
+    the copy with garbage). ``stacked`` indexes pages on dim 1 of the
+    pipeline-stacked ``[L, pages, ...]`` layout — the copy spans every
+    layer, same as the per-layer list-comprehension it replaces."""
     if isinstance(arr, QuantizedKV):
-        return QuantizedKV(arr.q.at[dst].set(arr.q[src]),
-                           arr.scale.at[dst].set(arr.scale[src]))
+        return QuantizedKV(_page_copy(arr.q, src, dst, stacked),
+                           _page_copy(arr.scale, src, dst, stacked))
+    if stacked:
+        return arr.at[:, dst].set(arr[:, src])
     return arr.at[dst].set(arr[src])
 
 
-def _page_zero(arr, idx):
+def _page_zero(arr, idx, stacked: bool = False):
     """Zero pages; a QuantizedKV page zeroes codes AND scales — a scrub
     that left a poisoned (NaN) scale row behind would re-poison the next
     tenant on its first dequantized read."""
     if isinstance(arr, QuantizedKV):
-        return QuantizedKV(arr.q.at[idx].set(0), arr.scale.at[idx].set(0))
+        return QuantizedKV(_page_zero(arr.q, idx, stacked),
+                           _page_zero(arr.scale, idx, stacked))
+    if stacked:
+        return arr.at[:, idx].set(0)
     return arr.at[idx].set(0)
 
 
@@ -141,7 +148,8 @@ class KVCachePool:
     def __init__(self, num_layers: int, num_pages: int, page_size: int,
                  num_kv_heads: int, head_dim: int, dtype=jnp.bfloat16,
                  cache_enabled: bool = True, quantized: bool = False,
-                 host_tier=None, sharding=None, tp_degree: int = 1):
+                 host_tier=None, sharding=None, tp_degree: int = 1,
+                 pp_degree: int = 1):
         if num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is the "
                              "reserved scratch page)")
@@ -158,9 +166,20 @@ class KVCachePool:
         # every host-side path below (alloc/refcount/hash metadata,
         # .at[].set writes, device_get spill/snapshot capture) is
         # tp-agnostic because sharding is a layout, not a shape change.
+        # Pipeline parallelism stacks the per-layer pairs into ONE
+        # [num_layers, pages, ...] pair whose leading dim splits on the
+        # pp mesh axis — each stage's HBM holds only its own layers'
+        # pages (the ~1/pp per-chip KV saving); ``stacked`` flags the
+        # layout and every content-touching path below branches on it.
+        # The HOST payload format (per layer k then v) is unchanged, so
+        # spills and snapshots stay portable across pp degrees.
         self.sharding = sharding
         self.tp_degree = int(tp_degree)
+        self.pp_degree = int(pp_degree)
+        self.stacked = self.pp_degree > 1
         shape = (num_pages, page_size, num_kv_heads, head_dim)
+        if self.stacked:
+            shape = (num_layers,) + shape
 
         def _place(z, scale=False):
             if sharding is None:
@@ -174,12 +193,13 @@ class KVCachePool:
             def _zeros():
                 return QuantizedKV(
                     _place(jnp.zeros(shape, jnp.int8)),
-                    _place(jnp.zeros(shape[:3], jnp.float32), scale=True))
-            self.pools = [(_zeros(), _zeros()) for _ in range(num_layers)]
+                    _place(jnp.zeros(shape[:-1], jnp.float32), scale=True))
+            self.pools = [(_zeros(), _zeros())
+                          for _ in range(1 if self.stacked else num_layers)]
         else:
             self.pools = [(_place(jnp.zeros(shape, dtype)),
                            _place(jnp.zeros(shape, dtype)))
-                          for _ in range(num_layers)]
+                          for _ in range(1 if self.stacked else num_layers)]
         # fp and int8 caches chain their content hashes from different
         # roots — same tokens, different page content, never aliased
         self._hash_root = _HASH_ROOT_INT8 if quantized else _HASH_ROOT
@@ -233,14 +253,15 @@ class KVCachePool:
     def from_config(cls, config, num_pages: int, page_size: int,
                     dtype=jnp.bfloat16, cache_enabled: bool = True,
                     quantized: bool = False, host_tier=None,
-                    sharding=None, tp_degree: int = 1) -> "KVCachePool":
+                    sharding=None, tp_degree: int = 1,
+                    pp_degree: int = 1) -> "KVCachePool":
         """Build from a model config carrying num_hidden_layers /
         num_key_value_heads / head_dim (LlamaConfig shape)."""
         return cls(config.num_hidden_layers, num_pages, page_size,
                    config.num_key_value_heads, config.head_dim, dtype,
                    cache_enabled=cache_enabled, quantized=quantized,
                    host_tier=host_tier, sharding=sharding,
-                   tp_degree=tp_degree)
+                   tp_degree=tp_degree, pp_degree=pp_degree)
 
     # ---- accounting ----
 
@@ -289,11 +310,15 @@ class KVCachePool:
         return 2 * self.num_layers * per
 
     def kv_bytes_per_token_shard(self) -> int:
-        """Per-DEVICE bytes one cached token costs under tensor
-        parallelism: the kv-head dim is split tp ways, so each shard
-        holds ``kvh/tp`` heads of every page (== the full figure at
-        tp=1). The per-chip HBM budget a TP deployment plans against."""
-        return self.kv_bytes_per_token() // max(self.tp_degree, 1)
+        """Per-DEVICE bytes one cached token costs under tensor /
+        pipeline parallelism: the kv-head dim is split tp ways (each
+        shard holds ``kvh/tp`` heads of every page) and the stacked
+        layer dim pp ways (each stage holds only its own ``L/pp``
+        layers' pages), so the per-chip figure is the full cost over
+        ``tp * pp`` (== the full figure at tp=pp=1). The per-chip HBM
+        budget a parallel deployment plans against."""
+        return (self.kv_bytes_per_token()
+                // max(self.tp_degree, 1) // max(self.pp_degree, 1))
 
     def stats(self) -> dict:
         # host-tier breakdown rides along (schema-stable zeros when the
@@ -313,6 +338,9 @@ class KVCachePool:
                 "kv_quant": int(self.quantized),
                 "host_tier": int(self.host_tier is not None),
                 "tp_degree": self.tp_degree,
+                "pp_degree": self.pp_degree,
+                "pp_stage_layers":
+                    self.num_layers // max(self.pp_degree, 1),
                 "tp_shard_kv_bytes_per_token": shard_bpt,
                 "tp_shard_in_use_bytes":
                     self.num_in_use * self.page_size * shard_bpt,
@@ -639,12 +667,22 @@ class KVCachePool:
         self.tracer.instant("spill", track="pool", page=page, kind=kind)
         self.tracer.bump("spills", 1, track="pool")
 
-    def _page_payload(self, page: int) -> list:
-        """One page's bytes as host numpy arrays, per layer in pool
-        order (k then v; a quantized pool interleaves codes and scales
-        — spilling codes without scales would dequantize the restore
-        with garbage). One batched device_get for the whole page."""
+    def _page_parts(self, page: int) -> list:
+        """One page's device slices in the host payload order: per layer
+        k then v (quantized: codes then scales). The stacked pp layout
+        iterates its layer dim so the payload format is IDENTICAL to the
+        per-layer list — pp-portable by construction."""
         parts = []
+        if self.stacked:
+            (pk, pv), = self.pools
+            for li in range(self.num_layers):
+                for arr in (pk, pv):
+                    if isinstance(arr, QuantizedKV):
+                        parts.append(arr.q[li, page])
+                        parts.append(arr.scale[li, page])
+                    else:
+                        parts.append(arr[li, page])
+            return parts
         for pk, pv in self.pools:
             for arr in (pk, pv):
                 if isinstance(arr, QuantizedKV):
@@ -652,6 +690,14 @@ class KVCachePool:
                     parts.append(arr.scale[page])
                 else:
                     parts.append(arr[page])
+        return parts
+
+    def _page_payload(self, page: int) -> list:
+        """One page's bytes as host numpy arrays, per layer in pool
+        order (k then v; a quantized pool interleaves codes and scales
+        — spilling codes without scales would dequantize the restore
+        with garbage). One batched device_get for the whole page."""
+        parts = self._page_parts(page)
         if self.tp_degree > 1:
             # the device_get below collects every shard's kvh/tp heads
             # into the full logical page — the HostTier payload format
@@ -670,13 +716,7 @@ class KVCachePool:
             return []
         parts = []
         for page in pages:
-            for pk, pv in self.pools:
-                for arr in (pk, pv):
-                    if isinstance(arr, QuantizedKV):
-                        parts.append(arr.q[page])
-                        parts.append(arr.scale[page])
-                    else:
-                        parts.append(arr[page])
+            parts.extend(self._page_parts(page))
         if self.tp_degree > 1:
             # shard-gather: snapshot payloads hold full logical pages,
             # so a tp=2 snapshot restores into a tp=1 engine (and back)
@@ -693,6 +733,23 @@ class KVCachePool:
         bf16, fp32 and int8 bytes unchanged)."""
         self._scrubbed.discard(page)
         it = iter(arrays)
+        if self.stacked:
+            (pk, pv), = self.pools
+            pair = [pk, pv]
+            for li in range(self.num_layers):
+                for i in range(2):
+                    arr = pair[i]
+                    if isinstance(arr, QuantizedKV):
+                        q = jnp.asarray(next(it), arr.q.dtype)
+                        s = jnp.asarray(next(it), arr.scale.dtype)
+                        pair[i] = QuantizedKV(
+                            arr.q.at[li, page].set(q),
+                            arr.scale.at[li, page].set(s))
+                    else:
+                        pair[i] = arr.at[li, page].set(
+                            jnp.asarray(next(it), arr.dtype))
+            self.pools = [tuple(pair)]
+            return
         new_pools = []
         for pk, pv in self.pools:
             pair = []
@@ -870,7 +927,8 @@ class KVCachePool:
         """Copy-on-write materialization: device-copy page ``src`` into
         the freshly-allocated page ``dst``. The cached source is never
         written in place — the hitter extends its own copy."""
-        self.pools = [(_page_copy(pk, src, dst), _page_copy(pv, src, dst))
+        self.pools = [(_page_copy(pk, src, dst, self.stacked),
+                       _page_copy(pv, src, dst, self.stacked))
                       for pk, pv in self.pools]
         self.counters["prefix_cow_copies"] += 1
         self.tracer.instant("cow_copy", track="pool", src=src, dst=dst)
@@ -881,7 +939,8 @@ class KVCachePool:
         if not pages:
             return
         idx = jnp.asarray(sorted(set(pages)), jnp.int32)
-        self.pools = [(_page_zero(pk, idx), _page_zero(pv, idx))
+        self.pools = [(_page_zero(pk, idx, self.stacked),
+                       _page_zero(pv, idx, self.stacked))
                       for pk, pv in self.pools]
         self._scrubbed.update(int(p) for p in pages)
 
@@ -904,18 +963,23 @@ class KVCachePool:
         pg = jnp.asarray([pages[p // ps] for p in range(start, stop)],
                          jnp.int32)
         off = jnp.asarray([p % ps for p in range(start, stop)], jnp.int32)
-        self.pools = [(self._pos_zero(pk, pg, off),
-                       self._pos_zero(pv, pg, off))
+        self.pools = [(self._pos_zero(pk, pg, off, self.stacked),
+                       self._pos_zero(pv, pg, off, self.stacked))
                       for pk, pv in self.pools]
         self.counters["rewound_tokens"] += stop - start
 
     @staticmethod
-    def _pos_zero(arr, pages, offs):
+    def _pos_zero(arr, pages, offs, stacked: bool = False):
         """Zero individual (page, offset) rows; QuantizedKV zeroes codes
-        AND scales (same reasoning as ``_page_zero``)."""
+        AND scales (same reasoning as ``_page_zero``). ``stacked``
+        addresses the pipeline layout's ``[L, pages, ...]`` arrays —
+        the zero spans every layer, like the per-layer loop."""
         if isinstance(arr, QuantizedKV):
-            return QuantizedKV(arr.q.at[pages, offs].set(0),
-                               arr.scale.at[pages, offs].set(0))
+            return QuantizedKV(
+                KVCachePool._pos_zero(arr.q, pages, offs, stacked),
+                KVCachePool._pos_zero(arr.scale, pages, offs, stacked))
+        if stacked:
+            return arr.at[:, pages, offs].set(0)
         return arr.at[pages, offs].set(0)
 
     # ---- invariant audit ----
@@ -1015,13 +1079,18 @@ class KVCachePool:
             zeroed = sorted(free & self._scrubbed)
             if zeroed:
                 idx = jnp.asarray(zeroed, jnp.int32)
+
+                def _sel(arr):
+                    # stacked pp layout: pages live on dim 1, and one
+                    # slice covers every layer at once
+                    return arr[:, idx] if self.stacked else arr[idx]
                 for li, (pk, pv) in enumerate(self.pools):
                     for name, arr in (("k", pk), ("v", pv)):
                         if isinstance(arr, QuantizedKV):
-                            ok = (bool(jnp.all(arr.q[idx] == 0))
-                                  and bool(jnp.all(arr.scale[idx] == 0)))
+                            ok = (bool(jnp.all(_sel(arr.q) == 0))
+                                  and bool(jnp.all(_sel(arr.scale) == 0)))
                         else:
-                            ok = bool(jnp.all(arr[idx] == 0))
+                            ok = bool(jnp.all(_sel(arr) == 0))
                         if not ok:
                             problems.append(
                                 f"scrubbed free page holds nonzero "

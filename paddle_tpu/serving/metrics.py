@@ -250,6 +250,14 @@ class ServingMetrics:
         # paddle_serving_tp_* Prometheus family via render_prometheus
         self.tp_degree = 1
         self.tp_shard_kv_bytes_per_token = 0
+        # pipeline parallelism (SERVING.md "Pipeline-parallel serving"):
+        # the pp degree, mixed-step microbatch wave count, and the
+        # schedule's idle-stage fraction — the pp_* keys become the
+        # paddle_serving_pp_* Prometheus family; schema-stable
+        # 1/1/0.0 on a non-pipelined engine
+        self.pp_degree = 1
+        self.pp_waves = 1
+        self.pipeline_bubble_frac = 0.0
         self._mixed_steps = 0
         self._chunk_tokens = 0
         self._chunks_dispatched = 0
@@ -586,6 +594,15 @@ class ServingMetrics:
         self.tp_degree = int(tp)
         self.tp_shard_kv_bytes_per_token = int(shard_kv_bytes_per_token)
 
+    def set_pp(self, pp: int, waves: int = 1,
+               bubble_frac: float = 0.0) -> None:
+        """Arm the pipeline-parallel gauges: the pp degree, the mixed
+        step's microbatch wave count, and the pipeline schedule's
+        idle-stage (bubble) fraction ``(pp-1)/(waves+pp-1)``."""
+        self.pp_degree = int(pp)
+        self.pp_waves = int(waves)
+        self.pipeline_bubble_frac = float(bubble_frac)
+
     def on_snapshot_stats(self, stats: dict) -> None:
         """Mirror the snapshot store's capture gauges
         (SnapshotStore.stats()) into the summary — called by the
@@ -761,6 +778,11 @@ class ServingMetrics:
             # single-device engine) — the paddle_serving_tp_* family
             "tp_degree": self.tp_degree,
             "tp_shard_kv_bytes_per_token": self.tp_shard_kv_bytes_per_token,
+            # pipeline parallelism (schema-stable: pp_degree 1, bubble
+            # 0.0 on an unstaged engine) — the paddle_serving_pp_* family
+            "pp_degree": self.pp_degree,
+            "pp_waves": self.pp_waves,
+            "pipeline_bubble_frac": self.pipeline_bubble_frac,
             # SLO-aware overload control (schema-stable zeros when fair
             # scheduling / the brownout ladder are off); the per-tenant
             # and per-priority flattenings below are dynamic keys, like

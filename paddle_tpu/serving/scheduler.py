@@ -218,6 +218,14 @@ class Scheduler:
         # charges the budget chunk by chunk AT DISPATCH, not at
         # admission, so admission only pays the host-tier restore toll.
         self.chunked = False
+        # pipeline-parallel serving: the engine sets this to its mixed
+        # step's microbatch wave count (pp when waving, else 1). The
+        # engine's chunk planner wave-aligns non-final prefill bites to
+        # multiples of the wave width chunk/pp_waves so a bite fills
+        # whole waves instead of leaving the last wave half-empty — a
+        # pacing hint only; chunk boundaries never change emitted
+        # streams (the chunked-prefill parity contract).
+        self.pp_waves = 1
         # multi-tenant LoRA: the engine points this at its AdapterPool
         # when lora serving is on. ``admit`` pins the head's adapter
         # slot alongside its KV pages; a request whose adapter payload

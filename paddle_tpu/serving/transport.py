@@ -608,6 +608,7 @@ class EngineServer:
             "draining": bool(getattr(eng, "_draining", False)),
             "brownout_level": int(getattr(eng, "brownout_level", 0)),
             "tp_degree": int(getattr(eng, "tp", 1)),
+            "pp_degree": int(getattr(eng, "pp", 1)),
             "max_queue_depth": None if mqd is None else int(mqd),
             "token_capacity": None if cap is None else int(cap()),
             "handoff_held": len(self._handoff_held),

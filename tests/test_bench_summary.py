@@ -18,7 +18,7 @@ _DEFAULT_CONFIGS = {
     "llama_serving_fleet", "llama_serving_spec", "llama_serving_tiered",
     "llama_serving_chunked", "llama_serving_failover",
     "llama_serving_partition", "llama_serving_multihost",
-    "llama_serving_tp", "llama_serving_fairness",
+    "llama_serving_tp", "llama_serving_pp", "llama_serving_fairness",
     "llama_serving_disagg", "llama_serving_lora",
 }
 
@@ -274,6 +274,30 @@ def test_dry_serving_tp_cell_carries_tp_keys():
                          "tp_degree", "tp_shard_kv_bytes_per_token",
                          "kv_bytes_per_token", "tokens_per_s_tp1",
                          "goodput_at_slo", "goodput_at_slo_tp1",
+                         "retraces"}, cell
+    assert all(v is None for v in cell.values()), cell
+
+
+def test_dry_serving_pp_cell_carries_pipeline_keys():
+    # the pipeline-parallel arm (SERVING.md "Pipeline-parallel
+    # serving"): the cell must surface the A/B evidence — pp degree and
+    # wave count, the microbatched vs unwaved bubble fraction, per-chip
+    # KV bytes for the staged vs tp-only pool (the ~1/pp saving), and
+    # tokens/s + goodput_at_slo for BOTH arms — next to the usual
+    # serving keys
+    out = _run_dry("llama_serving_pp")
+    assert out.returncode == 0, out.stderr
+    last = json.loads(out.stdout.splitlines()[-1])
+    cell = last["bench_summary"]["llama_serving_pp"]
+    assert set(cell) >= {"value", "mfu", "spread",
+                         "ttft_p50", "ttft_p99", "tpot",
+                         "pp_degree", "pp_waves",
+                         "pipeline_bubble_frac",
+                         "pipeline_bubble_frac_unwaved",
+                         "tp_shard_kv_bytes_per_token",
+                         "tp_shard_kv_bytes_per_token_tponly",
+                         "kv_bytes_per_token", "tokens_per_s_tponly",
+                         "goodput_at_slo", "goodput_at_slo_tponly",
                          "retraces"}, cell
     assert all(v is None for v in cell.values()), cell
 

@@ -1,0 +1,401 @@
+"""paddle_tpu.serving.parallel — pipeline-parallel (pp x mp) serving.
+
+The PP contracts (SERVING.md "Pipeline-parallel serving"):
+
+1. BITWISE ACROSS DEGREES — ``ServingEngine(pp=2, tp=2)`` emits
+   streams bitwise identical to the tp-only engine and to
+   ``model.generate()``, composed with prefix caching, int8 KV,
+   speculation and chunked prefill: staging the decoder along the
+   stacked-layer axis changes WHERE layers run, never WHAT they
+   compute (stage handoff is a ppermute of exact activations; sampling
+   stays replicated after the final-stage logits gather, so
+   ``fold_in(key, token_index)`` is untouched).
+2. TWO PROGRAMS, ANY DEGREE — the ``[max_slots]`` decode step and the
+   ``[max_slots, chunk]`` mixed step each stay ONE ``jit(shard_map)``
+   over the full pp x mp mesh; ``step_program_counts()`` stays
+   ``{"decode": 1, "mixed": 1}`` under churn. The jaxpr audit pins the
+   wire: per stage, ``2 * L/pp + 1`` mp-psums, ONE pp ring (static
+   ppermute 1, trips ``waves + pp - 1``), ONE pp-psum (ring close),
+   ONE logits all_gather.
+3. PORTABLE SNAPSHOTS — the stacked pool's host payloads keep the
+   per-layer k-then-v order, so a pp=2 snapshot restores into a tp-only
+   engine (and vice versa) bitwise; meta records ``pp``.
+4. TYPED REJECTION — a decoder that doesn't carve into equal stages
+   (``num_hidden_layers % pp != 0``) raises :class:`TPConfigError` at
+   construction, not a shape crash inside the compiled step.
+
+The suite runs on CPU: tests/conftest.py forces
+``--xla_force_host_platform_device_count=8``, so pp=2 x tp=2, pp=4 and
+a 2-replica pp=2 x tp=2 fleet all fit. Chaos tests carry the
+``faults`` marker; heavy compile matrices are ``slow``.
+"""
+
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import fault
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, llama_tiny
+from paddle_tpu.observability import render_prometheus
+from paddle_tpu.serving import (FleetRouter, ServingEngine, TPConfigError,
+                                collective_counts, partition_devices,
+                                validate_tp_config)
+
+RNG = np.random.default_rng(43)
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(123)
+    m = LlamaForCausalLM(llama_tiny(dtype="float32",
+                                    mp_axis="mp", fsdp_axis=None))
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def model_l4():
+    """pp=4 needs num_hidden_layers % 4 == 0 (llama_tiny has 2)."""
+    pt.seed(123)
+    cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                      intermediate_size=384, num_hidden_layers=4,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=512, dtype="float32",
+                      mp_axis="mp", fsdp_axis=None)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture
+def fault_free(monkeypatch):
+    """No FaultPlan leaks out of a chaos test; no rank env leaks in."""
+    fault.deactivate()
+    monkeypatch.delenv("PADDLE_TRAINER_ID", raising=False)
+    monkeypatch.delenv("PROCESS_ID", raising=False)
+    monkeypatch.delenv("PADDLE_RESTART_EPOCH", raising=False)
+    yield
+    fault.deactivate()
+
+
+def _reference(model, prompt, max_new, **kw):
+    out = model.generate(jnp.asarray([prompt]), max_new_tokens=max_new, **kw)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def _mk(model, tp=1, pp=1, **kw):
+    cfg = dict(num_pages=64, page_size=8, max_slots=4)
+    cfg.update(kw)
+    return ServingEngine(model, tp=tp, pp=pp, **cfg)
+
+
+def _prompts(n=3, lo=4, hi=14):
+    return [RNG.integers(1, 500, size=int(RNG.integers(lo, hi))).tolist()
+            for _ in range(n)]
+
+
+def _serve(model, tp, pp, prompts, max_new=8, **kw):
+    eng = _mk(model, tp=tp, pp=pp, **kw)
+    rids = [eng.add_request(p, max_new, eos_token_id=None) for p in prompts]
+    out = eng.run_to_completion(max_steps=400)
+    assert eng.step_program_counts() == {"decode": 1, "mixed": 1}
+    eng.audit_pool()
+    return [out[r] for r in rids], eng
+
+
+# ---------------------------------------------------------------------------
+# typed construction-time rejection + 2-D device carving
+# ---------------------------------------------------------------------------
+
+class TestPPValidation:
+    def test_layers_not_divisible(self, model, fault_free):
+        with pytest.raises(TPConfigError, match="num_hidden_layers"):
+            _mk(model, tp=1, pp=3)      # llama_tiny: L=2, 2 % 3 != 0
+
+    def test_pp_zero_rejected(self):
+        with pytest.raises(TPConfigError, match=">= 1"):
+            validate_tp_config(SimpleNamespace(), 1, 0)
+
+    def test_pp_one_skips_layer_check(self):
+        validate_tp_config(SimpleNamespace(num_hidden_layers=3), 1, 1)
+
+    def test_model_without_pp_parts_rejected(self, fault_free):
+        from paddle_tpu.serving.parallel import TPContext
+        bare = SimpleNamespace(
+            config=SimpleNamespace(num_hidden_layers=2),
+            spec_dict=lambda: {}, state_dict=lambda: {})
+        with pytest.raises(TPConfigError, match="pp_parts"):
+            TPContext(bare, 1, pp=2)
+
+    def test_partition_devices_2d_disjoint(self):
+        groups = partition_devices(2, 2, 2)      # 2 replicas of pp2 x tp2
+        assert len(groups) == 2 and all(len(g) == 4 for g in groups)
+        assert len({d.id for g in groups for d in g}) == 8
+
+    def test_partition_devices_2d_too_few(self):
+        with pytest.raises(TPConfigError, match="host_platform_device_count"):
+            partition_devices(4, 2, 2)           # 16 > 8 fake devices
+
+    def test_partition_devices_back_compat_2arg(self):
+        """The original (n, tp) form still means n groups of tp."""
+        groups = partition_devices(2, 2)
+        assert all(len(g) == 2 for g in groups)
+
+    def test_too_few_devices_for_engine(self, model, fault_free):
+        import jax
+        with pytest.raises(TPConfigError, match="host_platform_device_count"):
+            # pp=2 x tp=2 needs 4 devices; hand the engine only 2
+            _mk(model, tp=2, pp=2, tp_devices=jax.devices()[:2])
+
+
+# ---------------------------------------------------------------------------
+# bitwise parity across pp degrees x feature compositions
+# ---------------------------------------------------------------------------
+
+class TestPPParity:
+    def test_pp2_tp2_matches_tp_only_and_generate(self, model, fault_free):
+        prompts = _prompts()
+        a, _ = _serve(model, 1, 1, prompts)
+        b, _ = _serve(model, 2, 2, prompts)
+        c, _ = _serve(model, 1, 2, prompts)
+        assert a == b == c
+        assert a[0] == _reference(model, prompts[0], 8, eos_token_id=None)
+
+    def test_pp2_unwaved_bitwise(self, model, fault_free):
+        """Microbatching is a schedule change, not a math change: waved
+        and unwaved mixed steps emit identical streams."""
+        prompts = _prompts(lo=10, hi=20)
+        a, _ = _serve(model, 1, 1, prompts)
+        b, eng = _serve(model, 2, 2, prompts, pp_microbatch=False)
+        assert a == b
+        assert eng._pp_waves == 1
+
+    def test_pp2_prefix_reuse_bitwise(self, model, fault_free):
+        base = RNG.integers(1, 500, size=16).tolist()
+        prompts = [base + [7, 8], base + [9, 10, 11]]
+
+        def sequential(tp, pp):
+            eng = _mk(model, tp=tp, pp=pp)
+            streams = []
+            for p in prompts:         # 2nd admission sees 1st's pages
+                rid = eng.add_request(p, 8, eos_token_id=None)
+                streams.append(eng.run_to_completion(max_steps=200)[rid])
+            return streams, eng
+
+        a, _ = sequential(1, 1)
+        b, eng = sequential(2, 2)
+        assert a == b
+        assert eng.pool.counters["prefix_hits"] >= 1
+        assert eng.step_program_counts() == {"decode": 1, "mixed": 1}
+
+    def test_pp2_int8_kv_bitwise(self, model, fault_free):
+        prompts = _prompts()
+        a, _ = _serve(model, 1, 1, prompts, kv_quant=True)
+        b, eng = _serve(model, 2, 2, prompts, kv_quant=True)
+        assert a == b
+        assert eng.pool.stats()["pp_degree"] == 2
+
+    @pytest.mark.slow
+    def test_pp2_speculative_bitwise(self, model, fault_free):
+        prompts = _prompts()
+        a, _ = _serve(model, 1, 1, prompts, speculative=2)
+        b, _ = _serve(model, 2, 2, prompts, speculative=2)
+        assert a == b
+
+    @pytest.mark.slow
+    def test_pp2_chunked_prefill_bitwise(self, model, fault_free):
+        prompts = _prompts(lo=10, hi=20)
+        a, _ = _serve(model, 1, 1, prompts, chunked=True, prefill_chunk=4)
+        b, _ = _serve(model, 2, 2, prompts, chunked=True, prefill_chunk=4)
+        assert a == b
+
+    @pytest.mark.slow
+    def test_pp4_matches_unstaged(self, model_l4, fault_free):
+        prompts = _prompts()
+        a, _ = _serve(model_l4, 1, 1, prompts)
+        b, _ = _serve(model_l4, 1, 4, prompts)
+        assert a == b
+
+
+# ---------------------------------------------------------------------------
+# program counts, collectives, observability
+# ---------------------------------------------------------------------------
+
+class TestPPPrograms:
+    def test_counts_pinned_over_churn_epochs(self, model, fault_free):
+        """3 admission waves through one pp=2 x tp=2 engine: churn
+        changes array values, never shapes — and under pp, never the
+        stage layout."""
+        eng = _mk(model, tp=2, pp=2)
+        for epoch in range(3):
+            rids = [eng.add_request(p, 6, eos_token_id=None)
+                    for p in _prompts(n=4)]
+            out = eng.run_to_completion(max_steps=400)
+            assert all(len(out[r]) == 6 for r in rids)
+            assert eng.step_program_counts() == {"decode": 1, "mixed": 1}, \
+                f"retraced in epoch {epoch}"
+        eng.audit_pool()
+
+    def test_collective_budget_per_stage(self, model, fault_free):
+        """Each stage runs ``2 * L/pp + 1`` mp-psums (two per local
+        layer block plus the vocab-parallel embed), ONE pp ring close
+        psum, ONE logits all_gather, and ONE static ppermute whose trip
+        count is the ring length ``waves + pp - 1`` (== pp for decode's
+        single wave)."""
+        eng = _mk(model, tp=2, pp=2)
+        L, pp, W = model.config.num_hidden_layers, 2, eng._pp_waves
+        S, M = eng.max_slots, eng.max_pages_per_slot
+        z = lambda *s: jnp.zeros(s, jnp.int32)         # noqa: E731
+        o = lambda *s: jnp.ones(s, jnp.float32)        # noqa: E731
+        decode_args = (eng._state, eng.pool.pools, z(S), z(S, M), z(S),
+                       jnp.zeros((S,), bool), o(S), o(S),
+                       jnp.ones((S,), bool), z(S), z(S))
+        K = eng._chunk
+        mixed_args = (eng._state, eng.pool.pools, z(S, K), z(S, M), z(S),
+                      jnp.zeros((S,), bool), z(S), jnp.zeros((S,), bool),
+                      o(S), o(S), jnp.ones((S,), bool), z(S), z(S))
+        for waves, step, args in ((1, eng._decode_step, decode_args),
+                                  (W, eng._mixed_step, mixed_args)):
+            c = collective_counts(step._tp_inner, *args)
+            assert c.get("psum[mp]", 0) == 2 * (L // pp) + 1, c
+            assert c.get("psum[pp]", 0) == 1, c
+            assert c.get("ppermute", 0) == 1, c
+            assert c.get("ppermute_trips[pp]", 0) == waves + pp - 1, c
+            assert c.get("all_gather", 0) == 1, c
+            assert c.get("all_to_all", 0) == 0, c
+
+    def test_pp_observability_surface(self, model, fault_free):
+        eng = _mk(model, tp=2, pp=2)
+        eng.add_request(_prompts(n=1)[0], 4, eos_token_id=None)
+        eng.run_to_completion(max_steps=200)
+        st = eng.pool.stats()
+        assert st["pp_degree"] == 2
+        assert st["pp_stage_layers"] == model.config.num_hidden_layers // 2
+        assert st["tp_shard_kv_bytes_per_token"] \
+            == eng.pool.kv_bytes_per_token() // 4      # tp2 x pp2
+        s = eng.stats()
+        assert s["pp"] == 2 and s["pp_waves"] == 2
+        assert s["pipeline_bubble_frac"] == pytest.approx(1 / 3)
+        ms = eng.metrics.summary()
+        assert ms["pp_degree"] == 2 and ms["pp_waves"] == 2
+        assert ms["pipeline_bubble_frac"] == pytest.approx(1 / 3)
+        page = render_prometheus(ms, st, eng.tracer.counters)
+        assert "paddle_serving_pp_degree 2" in page
+        assert "paddle_serving_pool_pp_stage_layers" in page
+
+    def test_bubble_frac_waved_below_unwaved(self, model, fault_free):
+        """The whole point of microbatching: (pp-1)/(W+pp-1) < (pp-1)/pp."""
+        waved = _mk(model, tp=1, pp=2)
+        unwaved = _mk(model, tp=1, pp=2, pp_microbatch=False)
+        assert waved.pipeline_bubble_frac() \
+            < unwaved.pipeline_bubble_frac() == 0.5
+        assert _mk(model).pipeline_bubble_frac() == 0.0
+
+    def test_pp1_has_no_pp_machinery(self, model, fault_free):
+        eng = _mk(model, tp=1, pp=1)
+        assert eng._tp is None
+        assert eng.pool.stats()["pp_degree"] == 1
+        assert not eng.pool.stacked
+        assert eng.metrics.summary()["pp_degree"] == 1
+        assert eng.metrics.summary()["pipeline_bubble_frac"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# snapshot portability across pp degrees
+# ---------------------------------------------------------------------------
+
+class TestPPSnapshotPortability:
+    def _partial(self, model, tmp_path, tp, pp, steps=6, **kw):
+        prompts = [RNG.integers(1, 500, size=7).tolist(),
+                   RNG.integers(1, 500, size=5).tolist()]
+        eng = _mk(model, tp=tp, pp=pp, **kw)
+        rids = [eng.add_request(p, 10, eos_token_id=None) for p in prompts]
+        for _ in range(steps):
+            eng.step()
+        path = str(tmp_path / "snap")
+        eng.save_snapshot(path)
+        return eng, rids, path
+
+    def test_pp2_snapshot_restores_into_tp1(self, model, tmp_path,
+                                            fault_free):
+        """The stacked pool's host payloads keep the per-layer k-then-v
+        order — a pp=2 snapshot is just bytes an unstaged engine can
+        re-place per layer."""
+        eng, rids, path = self._partial(model, tmp_path, tp=1, pp=2)
+        warm = _mk(model, tp=1, pp=1)
+        assert warm.restore(path) == rids
+        out = warm.run_to_completion(max_steps=100)
+        cont = eng.run_to_completion(max_steps=100)
+        for r in rids:
+            assert out[r] == cont[r]
+        assert warm.metrics.counters["snapshot_restore_corrupt"] == 0
+        warm.audit_pool()
+        eng.audit_pool()
+
+    @pytest.mark.slow
+    def test_tp1_snapshot_restores_into_pp2(self, model, tmp_path,
+                                            fault_free):
+        eng, rids, path = self._partial(model, tmp_path, tp=1, pp=1)
+        warm = _mk(model, tp=1, pp=2)
+        assert warm.restore(path) == rids
+        out = warm.run_to_completion(max_steps=100)
+        cont = eng.run_to_completion(max_steps=100)
+        for r in rids:
+            assert out[r] == cont[r]
+        counts = warm.step_program_counts()
+        assert counts["decode"] == 1 and counts["mixed"] <= 1
+        warm.audit_pool()
+
+    def test_snapshot_meta_records_pp(self, model, tmp_path, fault_free):
+        from paddle_tpu.serving import load_engine_snapshot
+        _, _, path = self._partial(model, tmp_path, tp=2, pp=2)
+        _, meta = load_engine_snapshot(path)
+        assert meta["pp"] == 2 and meta["tp"] == 2
+
+
+# ---------------------------------------------------------------------------
+# chaos: a fleet replica IS a pp x tp group
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faults
+@pytest.mark.slow
+class TestPPFleetChaos:
+    def test_kill_pp_replica_midstream_replays_bitwise(self, model,
+                                                       fault_free):
+        """2 replicas x (pp=2 x tp=2) on 8 disjoint devices: a
+        permanent alloc storm pinned to replica 0 ejects the whole
+        staged group mid-stream; its requests replay on the survivor
+        bitwise (snapshot-seeded or from scratch — same tokens either
+        way), the survivor's two programs stay pinned and its stacked
+        pool audits clean."""
+        groups = partition_devices(2, 2, 2)
+        engines = [_mk(model, tp=2, pp=2, tp_devices=g) for g in groups]
+        assert all(e.tp == 2 and e.pp == 2 for e in engines)
+        router = FleetRouter(engines, max_queue_depth=64)
+        prompts = _prompts(n=6, lo=4, hi=8)
+        refs = [_reference(model, p, 6, eos_token_id=None) for p in prompts]
+        fault.activate(fault.FaultPlan([
+            fault.FaultSpec(site="serving.alloc", action="raise",
+                            once=False, match=r"^0$"),
+        ]))
+        rids = [router.submit(p, 6, eos_token_id=None) for p in prompts]
+        while router.has_work():
+            router.step()
+            assert router.stats()["steps"] < 2000, "router hang"
+        for rid, ref in zip(rids, refs):
+            rec = router.request(rid)
+            assert rec.finished
+            assert rec.finish_reason in ("stop", "length")
+            assert rec.tokens == ref        # replay is bitwise
+        st = router.stats()
+        for h in st["replica_health"]:
+            assert h["pp_degree"] == 2 and h["tp_degree"] == 2
+            if h["state"] != "dead":
+                eng = router.engines[h["replica"]]
+                assert eng.step_program_counts() == {"decode": 1,
+                                                     "mixed": 1}
+                eng.audit_pool()

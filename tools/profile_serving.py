@@ -2041,6 +2041,108 @@ def tp():
           "all_gather, the KV pool is never gathered")
 
 
+def pp():
+    """Pipeline-parallel serving A/B (SERVING.md "Pipeline-parallel
+    serving"): one staggered trace served by a tp=2 engine, a
+    pp=2 x tp=2 engine spanning a forced 8-device CPU mesh, and
+    ``generate()`` — all three must be bitwise identical. Then the
+    collective audit: trace both staged step programs' shard_map bodies
+    and assert each carries exactly ``2 * L/pp + 1`` mp-psums per
+    stage, ONE pp ring-close psum, ONE static ppermute whose trip
+    count is the ring length ``waves + pp - 1`` (== pp for decode's
+    single wave), and exactly ONE all_gather (the vocab-sharded
+    logits) — an accidental extra ring hop or a gather of the staged
+    KV pool would show up here."""
+    import os
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.serving import ServingEngine, collective_counts
+
+    pt.seed(0)
+    model = LlamaForCausalLM(llama_tiny(dtype="float32",
+                                        mp_axis="mp", fsdp_axis=None))
+    model.eval()
+    L = model.config.num_hidden_layers
+    PP = 2
+
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, 500, size=int(n)).tolist()
+               for n in rng.integers(5, 14, size=6)]
+    max_new = 10
+    refs = [np.asarray(model.generate(jnp.asarray([p]),
+                                      max_new_tokens=max_new))[0, len(p):]
+            .tolist() for p in prompts]
+
+    arms = {}
+    for arm, pp_deg in (("tp2", 1), ("pp2", PP)):
+        eng = ServingEngine(model, num_pages=64, page_size=8, max_slots=4,
+                            tp=2, pp=pp_deg)
+        rids = [eng.add_request(p, max_new, eos_token_id=None)
+                for p in prompts]
+        t0 = time.perf_counter()
+        out = eng.run_to_completion(max_steps=500)
+        dt = time.perf_counter() - t0
+        streams = [out[r] for r in rids]
+        assert streams == refs, f"{arm} diverged from generate()"
+        assert eng.step_program_counts() == {"decode": 1, "mixed": 1}
+        st = eng.pool.stats()
+        print(f"{arm}: {sum(map(len, streams))} tokens in {dt:6.3f}s  "
+              f"programs={eng.step_program_counts()}  "
+              f"shard kv B/tok={st['tp_shard_kv_bytes_per_token']}  "
+              f"stage layers={st['pp_stage_layers']}  "
+              f"bubble={eng.pipeline_bubble_frac():.3f}")
+        arms[arm] = (eng, streams)
+    assert arms["tp2"][1] == arms["pp2"][1]
+    shard_ratio = (arms["tp2"][0].pool.kv_bytes_per_token_shard()
+                   // arms["pp2"][0].pool.kv_bytes_per_token_shard())
+    assert shard_ratio == PP, "per-chip KV bytes must shrink by 1/pp"
+    print(f"bitwise parity: pp=2 x tp=2 == tp=2 == generate() "
+          f"({len(prompts)} streams x {max_new} tokens); "
+          f"per-chip KV bytes 1/{shard_ratio} of the tp-only shard")
+
+    # collective audit on the pp=2 x tp=2 step programs
+    eng = arms["pp2"][0]
+    W = eng._pp_waves
+    S, M, K = eng.max_slots, eng.max_pages_per_slot, eng._chunk
+    z = lambda *s: jnp.zeros(s, jnp.int32)           # noqa: E731
+    o = lambda *s: jnp.ones(s, jnp.float32)          # noqa: E731
+    programs = {
+        "decode": (1, eng._decode_step._tp_inner,
+                   (eng._state, eng.pool.pools, z(S), z(S, M), z(S),
+                    jnp.zeros((S,), bool), o(S), o(S),
+                    jnp.ones((S,), bool), z(S), z(S))),
+        "mixed": (W, eng._mixed_step._tp_inner,
+                  (eng._state, eng.pool.pools, z(S, K), z(S, M), z(S),
+                   jnp.zeros((S,), bool), z(S), jnp.zeros((S,), bool),
+                   o(S), o(S), jnp.ones((S,), bool), z(S), z(S))),
+    }
+    want_mp = 2 * (L // PP) + 1
+    print(f"\ncollectives per staged step program (want: psum[mp]="
+          f"{want_mp} = 2 x {L // PP} local layers + embedding, "
+          f"psum[pp]=1 = ring close, ppermute trips = waves + pp - 1, "
+          f"all_gather=1 = logits):")
+    for name, (waves, inner, args) in programs.items():
+        c = collective_counts(inner, *args)
+        print(f"  {name:6s}: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(c.items())) or "none")
+        assert c.get("psum[mp]", 0) == want_mp, (name, c)
+        assert c.get("psum[pp]", 0) == 1, (name, c)
+        assert c.get("ppermute", 0) == 1, (name, c)
+        assert c.get("ppermute_trips[pp]", 0) == waves + PP - 1, (name, c)
+        assert c.get("all_gather", 0) == 1, (name, c)
+        assert c.get("all_to_all", 0) == 0, (name, c)
+    print("collective audit PASSED — one psum per local block, one "
+          "ppermute ring, logits-only all_gather, the staged KV pool "
+          "never crosses a stage boundary")
+
+
 if __name__ == "__main__":
     if "--multihost" in sys.argv[1:]:
         multihost()
@@ -2072,5 +2174,7 @@ if __name__ == "__main__":
         lora()
     elif "--tp" in sys.argv[1:]:
         tp()
+    elif "--pp" in sys.argv[1:]:
+        pp()
     else:
         main()
